@@ -1,0 +1,21 @@
+"""TPU-native Kubernetes device plugin and JAX benchmark workloads.
+
+A from-scratch re-design of the capabilities of the AMD ROCm GPU device plugin
+(catsdogone/k8s-device-plugin, surveyed in SURVEY.md): discover TPU chips on a
+node, register a ``google.com/tpu`` resource with the kubelet over the
+device-plugin v1beta1 gRPC API, stream per-chip health, and answer ``Allocate``
+by mounting the requested ``/dev/accel*`` nodes and injecting ICI-mesh/topology
+environment so JAX/libtpu inside the pod can form the chip mesh.
+
+Subpackages
+-----------
+- ``kubelet``  — the v1beta1 wire contract (proto, constants, gRPC bindings).
+- ``plugin``   — discovery, topology, health, the DevicePlugin server, and the
+  lifecycle manager (registration, kubelet-restart recovery, signals).
+- ``models``   — JAX/Flax benchmark workloads (AlexNet, ResNet-50, BERT).
+- ``parallel`` — device-mesh/sharding helpers for the workloads.
+- ``ops``      — Pallas/TPU kernels used by the workloads.
+- ``utils``    — logging and small shared helpers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+#!/bin/sh
+# Regenerate deviceplugin_pb2.py from the hand-authored proto.
+cd "$(dirname "$0")" && exec protoc --python_out=. deviceplugin.proto

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU backend BEFORE jax is imported anywhere,
+so sharding/mesh tests exercise real multi-device paths without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Repo root on sys.path so `import k8s_device_plugin_tpu` works without install.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
