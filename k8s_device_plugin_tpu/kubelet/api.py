@@ -57,6 +57,11 @@ class DevicePluginStub:
             request_serializer=pb.Empty.SerializeToString,
             response_deserializer=pb.ListAndWatchResponse.FromString,
         )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{DEVICE_PLUGIN_SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
         self.Allocate = channel.unary_unary(
             f"/{DEVICE_PLUGIN_SERVICE}/Allocate",
             request_serializer=pb.AllocateRequest.SerializeToString,
@@ -99,6 +104,11 @@ def add_device_plugin_servicer(servicer, server) -> None:
             servicer.ListAndWatch,
             request_deserializer=pb.Empty.FromString,
             response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
         ),
         "Allocate": grpc.unary_unary_rpc_method_handler(
             servicer.Allocate,

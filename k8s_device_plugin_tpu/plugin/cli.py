@@ -1,0 +1,105 @@
+"""tpu-device-plugin entry point.
+
+≙ reference main() (main.go:189-220): parse flags, wire discovery + health +
+server + manager, install signal handlers, block.  The reference's single
+`-pulse` flag (main.go:190-193) is kept by name; everything the reference
+hard-coded is a flag here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+from ..kubelet import constants
+from ..utils.logging import setup_logging
+from . import discovery
+from .health import ChipHealthChecker
+from .manager import DEFAULT_ENDPOINT, PluginManager
+from .server import RESOURCE, TpuDevicePlugin
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-device-plugin",
+        description="Kubernetes device plugin advertising google.com/tpu chips",
+    )
+    p.add_argument(
+        "--pulse",
+        type=float,
+        default=0.0,
+        help="seconds between health polls (0 disables the heartbeat, as in the reference)",
+    )
+    p.add_argument(
+        "--root",
+        default="/",
+        help="filesystem root for devfs/sysfs/metadata reads (tests/fixtures use a tempdir)",
+    )
+    p.add_argument(
+        "--plugin-dir",
+        default=constants.DEVICE_PLUGIN_PATH,
+        help="kubelet device-plugin socket directory",
+    )
+    p.add_argument("--endpoint", default=DEFAULT_ENDPOINT, help="plugin socket filename")
+    p.add_argument("--resource", default=RESOURCE, help="resource name to advertise")
+    p.add_argument(
+        "--require-chips",
+        action="store_true",
+        help="exit immediately if no TPU chips are discovered (default: serve an empty list; "
+        "the reference instead probed /sys/class/kfd before announcing, main.go:211-217)",
+    )
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--json-logs", action="store_true", help="emit JSON log lines")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, args.json_logs)
+
+    plugin = TpuDevicePlugin(
+        discover=lambda: discovery.discover(root=args.root),
+        health_checker=ChipHealthChecker(root=args.root),
+    )
+    inventory = plugin.inventory  # discovery already ran once in the ctor
+    if args.require_chips and inventory.chip_count == 0:
+        log.error("no TPU chips found under %s and --require-chips is set", args.root)
+        return 1
+    manager = PluginManager(
+        plugin,
+        plugin_dir=args.plugin_dir,
+        endpoint=args.endpoint,
+        resource=args.resource,
+        pulse=args.pulse,
+    )
+
+    def _on_signal(signum, _frame):
+        log.info("received %s; shutting down", signal.Signals(signum).name)
+        manager.shutdown()
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGQUIT):
+            signal.signal(sig, _on_signal)
+    except ValueError:
+        # Not on the main interpreter thread (hermetic tests drive main() from
+        # a worker thread); shutdown is then delivered via manager.shutdown().
+        log.debug("not on main thread; skipping signal handlers")
+
+    log.info(
+        "starting %s plugin: %d chip(s), plugin_dir=%s, pulse=%.1fs",
+        args.resource,
+        inventory.chip_count,
+        args.plugin_dir,
+        args.pulse,
+    )
+    manager.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

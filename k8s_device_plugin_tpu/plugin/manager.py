@@ -1,0 +1,248 @@
+"""Plugin lifecycle: serve, register, survive kubelet restarts.
+
+A threaded re-expression of the reference's DPM framework (reference
+dpm/manager.go + dpm/plugin.go), with its sharp edges filed off:
+
+- server start is idempotent and mutex-guarded (≙ dpm/plugin.go:62-90) and
+  retried 3×/3s (≙ dpm/manager.go:17-20,204-218),
+- registration failure rolls the server back per the protocol's
+  "terminate upon registration failure" contract (≙ dpm/plugin.go:83-87),
+- kubelet.sock create ⇒ full restart + re-register, remove ⇒ stop
+  (≙ dpm/manager.go:73-84), via watcher.KubeletSocketWatcher,
+- a heartbeat thread drives per-chip health/discovery polls (≙ the reference's
+  ticker goroutine at main.go:201-209, minus its duplicate-append bug),
+- no 10-second startup stall: the reference's readiness loop waited for a
+  service count that could never be reached (dpm/plugin.go:114-120); grpc's
+  server.start() needs no such poll.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from ..kubelet import constants
+from ..kubelet.api import RegistrationStub, add_device_plugin_servicer, pb
+from .server import RESOURCE, TpuDevicePlugin
+
+log = logging.getLogger(__name__)
+
+DEFAULT_ENDPOINT = "google.com_tpu.sock"
+
+
+class PluginManager:
+    """Owns the gRPC server, kubelet registration, and recovery threads for
+    one resource (google.com/tpu)."""
+
+    def __init__(
+        self,
+        plugin: TpuDevicePlugin,
+        plugin_dir: str = constants.DEVICE_PLUGIN_PATH,
+        endpoint: str = DEFAULT_ENDPOINT,
+        resource: str = RESOURCE,
+        pulse: float = 0.0,
+        register_retries: int = 3,
+        register_retry_delay: float = 3.0,
+        watch_poll_interval: float = 1.0,
+    ):
+        self.plugin = plugin
+        self.plugin_dir = plugin_dir
+        self.endpoint = endpoint
+        self.resource = resource
+        self.pulse = pulse
+        self._register_retries = register_retries
+        self._register_retry_delay = register_retry_delay
+        self._watch_poll_interval = watch_poll_interval
+
+        self._lock = threading.Lock()  # guards _server lifecycle
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+        self._watcher = None
+        self._heartbeat: threading.Thread | None = None
+        self.registrations = 0  # observability: how many times we registered
+
+    # ----------------------------------------------------------------- paths
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.plugin_dir, self.endpoint)
+
+    @property
+    def kubelet_socket(self) -> str:
+        return os.path.join(self.plugin_dir, constants.KUBELET_SOCKET_NAME)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def run(self) -> None:
+        """Start everything and block until :meth:`shutdown` (or a signal
+        handler calling it) fires.  ≙ dpm Manager.Run (dpm/manager.go:41-94)."""
+        self.start()
+        try:
+            self._stop.wait()
+        finally:
+            self.stop_all()
+
+    def start(self) -> None:
+        self._start_and_register()
+        self._watcher = self._make_watcher()
+        self._watcher.start()
+        # Don't return until the watch is armed, or a kubelet restarting
+        # immediately after our startup would go unnoticed.
+        if not self._watcher.ready.wait(timeout=10):
+            log.warning("socket watcher failed to arm within 10s")
+        if self.pulse > 0:
+            self._heartbeat = threading.Thread(
+                target=self._heartbeat_loop, name="tpu-heartbeat", daemon=True
+            )
+            self._heartbeat.start()
+
+    def shutdown(self) -> None:
+        """Request an orderly exit of :meth:`run` (signal-handler safe)."""
+        self._stop.set()
+
+    def stop_all(self) -> None:
+        # Order matters: mark stopping FIRST so a concurrent watcher callback
+        # (kubelet restarting at the same moment as our SIGTERM) cannot
+        # resurrect the server after we tear it down.
+        self._stop.set()
+        self.plugin.interrupt_streams()
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher.join(timeout=5)
+            self._watcher = None
+        if self._heartbeat is not None:
+            self._heartbeat.join(timeout=5)
+            self._heartbeat = None
+        self._stop_server()
+
+    # --------------------------------------------------------------- serving
+
+    def _start_server(self) -> None:
+        """Idempotently bring the DevicePlugin server up on our unix socket."""
+        with self._lock:
+            if self._server is not None:
+                return
+            if self._stop.is_set():
+                raise RuntimeError("manager is shutting down")
+            # Remove a stale socket from a previous incarnation
+            # (≙ dpm/plugin.go:96-99).
+            try:
+                os.unlink(self.socket_path)
+            except FileNotFoundError:
+                pass
+            server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+            add_device_plugin_servicer(self.plugin, server)
+            server.add_insecure_port(f"unix://{self.socket_path}")
+            server.start()
+            self._server = server
+            log.info("DevicePlugin server listening on %s", self.socket_path)
+
+    def _stop_server(self) -> None:
+        self.plugin.interrupt_streams()
+        with self._lock:
+            if self._server is None:
+                return
+            self._server.stop(grace=1).wait()
+            self._server = None
+            try:
+                os.unlink(self.socket_path)
+            except FileNotFoundError:
+                pass
+            log.info("DevicePlugin server stopped")
+
+    def _register(self) -> None:
+        """Announce ourselves on the kubelet's Registration socket."""
+        with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as channel:
+            RegistrationStub(channel).Register(
+                pb.RegisterRequest(
+                    version=constants.VERSION,
+                    endpoint=self.endpoint,
+                    resource_name=self.resource,
+                    options=pb.DevicePluginOptions(
+                        pre_start_required=False,
+                        get_preferred_allocation_available=True,
+                    ),
+                ),
+                timeout=10,
+            )
+        self.registrations += 1
+        log.info("registered %s with kubelet (endpoint %s)", self.resource, self.endpoint)
+
+    def _start_and_register(self) -> None:
+        """Server-up + register, with retry; registration failure tears the
+        server back down before the next attempt."""
+        last_error: Exception | None = None
+        for attempt in range(1, self._register_retries + 1):
+            if self._stop.is_set():
+                raise RuntimeError("manager is shutting down")
+            try:
+                self._start_server()
+                self._register()
+                return
+            except Exception as e:  # noqa: BLE001 — retry any startup failure
+                last_error = e
+                log.warning(
+                    "start/register attempt %d/%d failed: %s",
+                    attempt,
+                    self._register_retries,
+                    e,
+                )
+                self._stop_server()
+                if attempt < self._register_retries and not self._stop.wait(
+                    self._register_retry_delay
+                ):
+                    continue
+                break
+        raise RuntimeError(
+            f"could not register {self.resource} with kubelet at "
+            f"{self.kubelet_socket}"
+        ) from last_error
+
+    # ------------------------------------------------------------- recovery
+
+    def _make_watcher(self):
+        from .watcher import KubeletSocketWatcher
+
+        return KubeletSocketWatcher(
+            self.plugin_dir,
+            constants.KUBELET_SOCKET_NAME,
+            on_create=self._on_kubelet_create,
+            on_remove=self._on_kubelet_remove,
+            poll_interval=self._watch_poll_interval,
+        )
+
+    def _on_kubelet_create(self) -> None:
+        """kubelet.sock (re)appeared: the kubelet restarted and forgot us.
+        Restart our server (fresh socket) and re-register."""
+        if self._stop.is_set():
+            return
+        log.info("kubelet restart detected; re-registering")
+        try:
+            self._stop_server()
+            self._start_and_register()
+        except Exception:
+            if self._stop.is_set():
+                log.info("shutdown interrupted re-registration")
+            else:
+                log.exception("re-registration after kubelet restart failed")
+
+    def _on_kubelet_remove(self) -> None:
+        """kubelet.sock vanished: kubelet is down; stop serving until it
+        returns (the create event will bring us back)."""
+        log.info("kubelet socket removed; stopping plugin server")
+        self._stop_server()
+
+    # ------------------------------------------------------------- heartbeat
+
+    def _heartbeat_loop(self) -> None:
+        log.info("health heartbeat every %.1fs", self.pulse)
+        while not self._stop.wait(self.pulse):
+            try:
+                self.plugin.poll_once()
+            except Exception:
+                log.exception("health poll failed")

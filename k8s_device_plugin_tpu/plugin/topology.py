@@ -1,0 +1,126 @@
+"""ICI mesh topology model for the chips on one host.
+
+The reference collected interconnect topology in its sysfs fixture but never
+used it (SURVEY.md §2.4: `countGPUDev` at reference main.go:50-81 reads only
+`simd_count`).  For TPUs the host-local ICI mesh is load-bearing: a multi-chip
+allocation must be mesh-contiguous or the workload's collectives fall off ICI.
+This module owns:
+
+- the (x, y, z) bounds of the chips on one host for each supported host shape,
+- chip-index <-> mesh-coordinate mapping (row-major, x fastest),
+- contiguous sub-mesh selection for `Allocate` requests smaller than a host.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+# Host-local chip-mesh bounds by chip count.  TPU hosts expose 1, 4, or 8
+# chips; 4-chip hosts are a 2x2 ICI square (e.g. v4 / v5p / one v5e "sub-host"
+# group), 8-chip hosts a 2x4 (v5e/v6e full host).  An unrecognized count is
+# treated as a 1-D chain as the least-structured assumption available (note a
+# chain still asserts links between consecutive chips).
+CHIPS_PER_HOST_BOUNDS: dict[int, tuple[int, int, int]] = {
+    1: (1, 1, 1),
+    2: (2, 1, 1),
+    4: (2, 2, 1),
+    8: (2, 4, 1),
+    16: (4, 4, 1),
+}
+
+
+def host_bounds_for_count(n_chips: int) -> tuple[int, int, int]:
+    """Bounds of the host-local chip mesh for ``n_chips`` chips."""
+    return CHIPS_PER_HOST_BOUNDS.get(n_chips, (n_chips, 1, 1))
+
+
+def chip_coords(index: int, bounds: tuple[int, int, int]) -> tuple[int, int, int]:
+    """Mesh coordinates of host-local chip ``index``; x varies fastest."""
+    bx, by, _bz = bounds
+    x = index % bx
+    y = (index // bx) % by
+    z = index // (bx * by)
+    return (x, y, z)
+
+
+def chip_index(coords: tuple[int, int, int], bounds: tuple[int, int, int]) -> int:
+    bx, by, _bz = bounds
+    x, y, z = coords
+    return x + bx * (y + by * z)
+
+
+@dataclass(frozen=True)
+class SubMesh:
+    """A contiguous axis-aligned block of the host chip mesh."""
+
+    origin: tuple[int, int, int]
+    bounds: tuple[int, int, int]  # extent along (x, y, z)
+
+    def chip_indices(self, host_bounds: tuple[int, int, int]) -> tuple[int, ...]:
+        ox, oy, oz = self.origin
+        sx, sy, sz = self.bounds
+        return tuple(
+            sorted(
+                chip_index((ox + dx, oy + dy, oz + dz), host_bounds)
+                for dz in range(sz)
+                for dy in range(sy)
+                for dx in range(sx)
+            )
+        )
+
+
+def _block_shapes(count: int, host_bounds: tuple[int, int, int]) -> list[tuple[int, int, int]]:
+    """All (sx, sy, sz) factorizations of ``count`` that fit in the host mesh,
+    most compact (closest to a cube/square) first — compact blocks have the
+    shortest ICI diameter, which is what collective latency tracks."""
+    bx, by, bz = host_bounds
+    shapes = set()
+    for sx in range(1, min(count, bx) + 1):
+        if count % sx:
+            continue
+        rest = count // sx
+        for sy in range(1, min(rest, by) + 1):
+            if rest % sy:
+                continue
+            sz = rest // sy
+            if sz <= bz:
+                shapes.add((sx, sy, sz))
+    # Compactness: minimize mesh diameter (sum of extents), tie-break on
+    # larger x-extent for deterministic output.
+    return sorted(shapes, key=lambda s: (s[0] + s[1] + s[2], -s[0]))
+
+
+def select_contiguous(
+    count: int,
+    available: Iterable[int],
+    host_bounds: tuple[int, int, int],
+    must_include: Iterable[int] = (),
+) -> SubMesh | None:
+    """Pick a mesh-contiguous block of ``count`` chips from ``available``.
+
+    Returns the most compact axis-aligned sub-mesh whose chips are all
+    available and which contains every chip in ``must_include``, or None if no
+    such block exists (the caller may then fall back to an arbitrary subset).
+    """
+    avail = frozenset(available)
+    must = frozenset(must_include)
+    if count <= 0 or len(avail | must) < count or len(must) > count:
+        return None
+    bx, by, bz = host_bounds
+    for shape in _block_shapes(count, host_bounds):
+        sx, sy, sz = shape
+        for oz, oy, ox in itertools.product(
+            range(bz - sz + 1), range(by - sy + 1), range(bx - sx + 1)
+        ):
+            sub = SubMesh(origin=(ox, oy, oz), bounds=shape)
+            indices = set(sub.chip_indices(host_bounds))
+            if indices <= (avail | must) and must <= indices:
+                return sub
+    return None
+
+
+def bounds_str(bounds: Sequence[int]) -> str:
+    """Render bounds the way libtpu env vars expect: "x,y,z"."""
+    return ",".join(str(b) for b in bounds)

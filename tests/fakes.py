@@ -1,0 +1,132 @@
+"""Fixture builders: fake TPU host filesystem trees and a fake kubelet.
+
+The reference tests by pointing its scanner at a captured sysfs tree
+(reference main_test.go:7-14 + testdata/topology-parsing/).  We generalize the
+same seam: build a synthetic devfs/sysfs/metadata tree under a tempdir and
+point `discovery.discover(root=...)` at it — plus (what the reference lacks,
+SURVEY.md §4) an in-process fake kubelet so registration, streaming, and
+allocation are testable hermetically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+
+from k8s_device_plugin_tpu.kubelet import constants
+from k8s_device_plugin_tpu.kubelet.api import (
+    DevicePluginStub,
+    add_registration_servicer,
+    pb,
+)
+
+
+def make_fake_tpu_host(
+    root,
+    n_chips: int = 4,
+    vendor_id: str = "0x1ae0",
+    device_id: str = "0x0063",
+    accelerator_type: str | None = "v5litepod-4",
+    worker_id: int | None = None,
+    worker_hostnames: str | None = None,
+    chips_per_host_bounds: str | None = None,
+    skip_dev_for: tuple[int, ...] = (),
+    numa_of=lambda i: i // 2,
+) -> str:
+    """Build a fake TPU host tree under ``root`` and return str(root).
+
+    Layout mirrors a TPU VM: /dev/accelN chardev stand-ins, /sys/class/accel/
+    accelN/device/{vendor,device,numa_node,uevent}, /run/tpu metadata drop-ins.
+    """
+    root = str(root)
+    os.makedirs(os.path.join(root, "dev"), exist_ok=True)
+    for i in range(n_chips):
+        if i not in skip_dev_for:
+            with open(os.path.join(root, "dev", f"accel{i}"), "w") as f:
+                f.write("")  # plain file stands in for the chardev node
+        dev_dir = os.path.join(root, "sys/class/accel", f"accel{i}", "device")
+        os.makedirs(dev_dir, exist_ok=True)
+        with open(os.path.join(dev_dir, "vendor"), "w") as f:
+            f.write(vendor_id + "\n")
+        with open(os.path.join(dev_dir, "device"), "w") as f:
+            f.write(device_id + "\n")
+        with open(os.path.join(dev_dir, "numa_node"), "w") as f:
+            f.write(f"{numa_of(i)}\n")
+        with open(os.path.join(dev_dir, "uevent"), "w") as f:
+            f.write(
+                "DRIVER=accel\n"
+                f"PCI_CLASS=120000\n"
+                f"PCI_SLOT_NAME=0000:00:{4 + i:02x}.0\n"
+            )
+    meta_dir = os.path.join(root, "run/tpu")
+    os.makedirs(meta_dir, exist_ok=True)
+    meta = {
+        "accelerator-type": accelerator_type,
+        "worker-id": None if worker_id is None else str(worker_id),
+        "worker-hostnames": worker_hostnames,
+        "chips-per-host-bounds": chips_per_host_bounds,
+    }
+    for name, value in meta.items():
+        if value is not None:
+            with open(os.path.join(meta_dir, name), "w") as f:
+                f.write(value + "\n")
+    return root
+
+
+class FakeKubelet:
+    """In-process kubelet double.
+
+    Serves the `Registration` service on `<plugin_dir>/kubelet.sock`, records
+    every RegisterRequest, and — like the real kubelet — can then dial back
+    into the registered plugin's DevicePlugin socket.
+    """
+
+    def __init__(self, plugin_dir: str):
+        self.plugin_dir = str(plugin_dir)
+        self.socket_path = os.path.join(self.plugin_dir, constants.KUBELET_SOCKET_NAME)
+        self.requests: list = []
+        self.registered = threading.Event()
+        self._server = None
+
+    # --- Registration service ------------------------------------------------
+    def Register(self, request, context):
+        self.requests.append(request)
+        self.registered.set()
+        return pb.Empty()
+
+    # --- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        assert self._server is None
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_registration_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+
+    def stop(self, remove_socket: bool = True) -> None:
+        """Stop serving; optionally leave the socket file behind (the real
+        kubelet often does not remove its socket on shutdown — reference
+        dpm/manager.go:79-80 notes the same)."""
+        if self._server is not None:
+            self._server.stop(grace=None).wait()
+            self._server = None
+        if remove_socket and os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    def restart(self) -> None:
+        """Simulate a kubelet restart: new server, socket recreated."""
+        self.stop(remove_socket=True)
+        self.registered.clear()
+        self.start()
+
+    # --- acting on a registered plugin ----------------------------------------
+    def plugin_channel(self, endpoint: str | None = None) -> grpc.Channel:
+        if endpoint is None:
+            assert self.requests, "no plugin registered yet"
+            endpoint = self.requests[-1].endpoint
+        return grpc.insecure_channel(f"unix://{os.path.join(self.plugin_dir, endpoint)}")
+
+    def plugin_stub(self, endpoint: str | None = None) -> DevicePluginStub:
+        return DevicePluginStub(self.plugin_channel(endpoint))

@@ -86,6 +86,15 @@ class _StaticDevicePlugin:
             devices=[pb.Device(ID="tpu-0", health=constants.UNHEALTHY)]
         )
 
+    def GetPreferredAllocation(self, request, context):
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            pref = resp.container_responses.add()
+            pref.deviceIDs.extend(
+                sorted(creq.available_deviceIDs)[: creq.allocation_size]
+            )
+        return resp
+
     def Allocate(self, request, context):
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
@@ -145,6 +154,18 @@ def test_device_plugin_loopback(grpc_server):
         assert [d.ID for d in first.devices] == ["tpu-0"]
         second = next(stream)
         assert second.devices[0].health == constants.UNHEALTHY
+
+        pref = stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=["tpu-1", "tpu-0", "tpu-2"],
+                        allocation_size=2,
+                    )
+                ]
+            )
+        )
+        assert list(pref.container_responses[0].deviceIDs) == ["tpu-0", "tpu-1"]
 
         resp = stub.Allocate(
             pb.AllocateRequest(

@@ -1,0 +1,69 @@
+"""KubeletSocketWatcher edge cases: events, in-place recreation, and loss of
+the watched directory itself (kubelet reinstall)."""
+
+import os
+import shutil
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.plugin.watcher import KubeletSocketWatcher
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+@pytest.fixture
+def watched(tmp_path):
+    events = []
+    watcher = KubeletSocketWatcher(
+        str(tmp_path),
+        "kubelet.sock",
+        on_create=lambda: events.append("create"),
+        on_remove=lambda: events.append("remove"),
+        poll_interval=0.05,
+    )
+    watcher.start()
+    assert watcher.ready.wait(5)
+    yield tmp_path, events
+    watcher.stop()
+    watcher.join(timeout=5)
+
+
+def test_create_and_remove_events(watched):
+    tmp_path, events = watched
+    sock = tmp_path / "kubelet.sock"
+    sock.touch()
+    assert wait_for(lambda: events == ["create"])
+    sock.unlink()
+    assert wait_for(lambda: events == ["create", "remove"])
+
+
+def test_other_files_ignored(watched):
+    tmp_path, events = watched
+    (tmp_path / "google.com_tpu.sock").touch()
+    (tmp_path / "google.com_tpu.sock").unlink()
+    time.sleep(0.3)
+    assert events == []
+
+
+def test_watched_directory_recreated(watched):
+    # A kubelet reinstall can remove the whole device-plugins dir.  The watch
+    # must survive: re-arm on the new dir and fire create for the new socket.
+    tmp_path, events = watched
+    sock = tmp_path / "kubelet.sock"
+    sock.touch()
+    assert wait_for(lambda: events[-1:] == ["create"])
+
+    shutil.rmtree(tmp_path)
+    assert wait_for(lambda: "remove" in events[1:])
+
+    os.makedirs(tmp_path)
+    sock.touch()
+    assert wait_for(lambda: events[-1:] == ["create"], timeout=10)
