@@ -1,0 +1,125 @@
+"""Headline benchmark: ResNet-50 training images/sec on one TPU chip.
+
+Matches BASELINE.json's metric ("AlexNet/ResNet-50 images/sec/chip in k8s
+pod") and the measurement style of the reference's benchmark pod (synthetic
+data, steady-state timing — reference k8s-pod-example-gpu.yaml runs the
+convnet-benchmarks AlexNet timing script).  The reference publishes no
+numbers ("published": {}), so vs_baseline is reported against our own
+first-round target of parity (1.0 = target met).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Extra detail (per-model numbers, allocation latency) goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_device_plugin_tpu.models.benchmark import log, timed_steps
+from k8s_device_plugin_tpu.models.data import synthetic_image_batch
+from k8s_device_plugin_tpu.models.resnet import ResNet50
+from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
+
+
+def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 5) -> float:
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # Structural smoke run only (no TPU attached): keep shapes tiny so
+        # the script still exercises the full path.
+        batch_size, image_size, steps, warmup = 8, 64, 3, 1
+        log("no accelerator: running tiny CPU smoke configuration")
+    else:
+        image_size = 224
+
+    rng = jax.random.PRNGKey(0)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    batch = synthetic_image_batch(rng, batch_size, image_size=image_size, num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_train_state(rng, model, batch, tx)
+    step = jax.jit(make_train_step(model, tx), donate_argnums=0)
+
+    state, loss, dt = timed_steps(step, state, batch, warmup, steps)
+    ips = batch_size * steps / dt
+    log(f"resnet50 b{batch_size}: {steps} steps in {dt:.2f}s -> {ips:.1f} images/sec")
+    return ips
+
+
+def bench_allocation_latency() -> float | None:
+    """Secondary metric from BASELINE.json: chip-allocation latency through
+    the actual plugin gRPC path (fixture-backed, no cluster needed)."""
+    try:
+        import os
+        import tempfile
+        from concurrent import futures
+
+        import grpc
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tests.fakes import make_fake_tpu_host
+        from k8s_device_plugin_tpu.kubelet.api import (
+            DevicePluginStub,
+            add_device_plugin_servicer,
+            pb,
+        )
+        from k8s_device_plugin_tpu.plugin import discovery
+        from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
+        from k8s_device_plugin_tpu.plugin.server import TpuDevicePlugin
+
+        root = make_fake_tpu_host(tempfile.mkdtemp(), n_chips=4)
+        plugin = TpuDevicePlugin(
+            discover=lambda: discovery.discover(root=root, environ={}),
+            health_checker=ChipHealthChecker(root=root),
+        )
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_device_plugin_servicer(plugin, server)
+        sock = tempfile.mktemp(suffix=".sock")
+        server.add_insecure_port(f"unix://{sock}")
+        server.start()
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            stub = DevicePluginStub(ch)
+            req = pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=["tpu-0", "tpu-1"])
+                ]
+            )
+            stub.Allocate(req)  # warm
+            t0 = time.perf_counter()
+            n = 100
+            for _ in range(n):
+                stub.Allocate(req)
+            latency_ms = (time.perf_counter() - t0) / n * 1e3
+        server.stop(grace=None)
+        log(f"plugin Allocate p50 latency: {latency_ms:.2f} ms")
+        return latency_ms
+    except Exception as e:  # bench must never die on the secondary metric
+        log(f"allocation-latency probe failed: {e}")
+        return None
+
+
+def main() -> None:
+    ips = bench_resnet50(batch_size=128)
+    bench_allocation_latency()
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "images/sec/chip",
+                # No published reference numbers (BASELINE.md): 1.0 == the
+                # round-1 parity target; scale when a real baseline lands.
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

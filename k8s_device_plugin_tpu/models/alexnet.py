@@ -1,0 +1,48 @@
+"""AlexNet in Flax — the workload of the reference's example pods.
+
+The reference's benchmark pod runs the convnet-benchmarks AlexNet *timing*
+benchmark on synthetic data under TensorFlow/ROCm
+(reference k8s-pod-example-gpu.yaml:10-19).  This is the TPU-native
+equivalent: same architecture and measurement style (synthetic batches,
+images/sec), re-expressed for the MXU — NHWC layouts, bfloat16 compute,
+everything jit-compiled with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    """Classic 5-conv/3-dense AlexNet (single-tower), NHWC."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    # Width multiplier so tests can run a tiny-but-structurally-identical net.
+    width: float = 1.0
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        w = lambda c: max(8, int(c * self.width))
+        conv = lambda feats, kernel, stride: nn.Conv(
+            feats, kernel, strides=stride, dtype=self.dtype, padding="SAME"
+        )
+        x = images.astype(self.dtype)
+        x = nn.relu(conv(w(64), (11, 11), (4, 4))(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(w(192), (5, 5), (1, 1))(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(w(384), (3, 3), (1, 1))(x))
+        x = nn.relu(conv(w(256), (3, 3), (1, 1))(x))
+        x = nn.relu(conv(w(256), (3, 3), (1, 1))(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(w(4096), dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(w(4096), dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        # Logits in float32 for a numerically stable softmax/cross-entropy.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,160 @@
+"""In-pod benchmark runner — what the example/benchmark pods execute.
+
+≙ the reference's benchmark container command (k8s-pod-example-gpu.yaml runs
+convnet-benchmarks' `benchmark_alexnet.py` inside the pod).  Here the pod runs
+    python -m k8s_device_plugin_tpu.models.benchmark --model resnet50 ...
+against whatever chips the plugin allocated: the injected TPU_* env makes
+libtpu expose exactly those chips, and the mesh axes are laid over them in
+TPU_VISIBLE_CHIPS order so collectives ride the granted ICI block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .alexnet import AlexNet
+from .bert import Bert, BertConfig
+from .data import synthetic_image_batch, synthetic_token_batch
+from .resnet import ResNet50
+from .train import create_train_state, make_train_step
+from ..parallel.mesh import make_mesh
+from ..parallel.sharding import shard_train_step
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _positive_int(text: str) -> int:
+    value = int(text)
+    if value < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {value}")
+    return value
+
+
+def timed_steps(step, state, batch, warmup: int, steps: int) -> tuple:
+    """Shared timing harness: warmup (includes compile), then a timed run.
+    Returns (state, loss, seconds_for_timed_steps)."""
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    log(f"compile+warmup {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    return state, loss, time.perf_counter() - t0
+
+
+def build(model_name: str, args, rng):
+    if model_name == "alexnet":
+        model = AlexNet(num_classes=1000, dtype=jnp.bfloat16)
+        batch = synthetic_image_batch(rng, args.batch_size, args.image_size)
+        return model, batch, "images", args.batch_size
+    if model_name == "resnet50":
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = synthetic_image_batch(rng, args.batch_size, args.image_size)
+        return model, batch, "images", args.batch_size
+    if model_name == "bert":
+        model = Bert(BertConfig.base())
+        batch = synthetic_token_batch(rng, args.batch_size, args.seq_len)
+        return model, batch, "input_ids", args.batch_size * args.seq_len
+    raise SystemExit(f"unknown model {model_name!r}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="tpu-benchmark")
+    p.add_argument("--model", choices=["alexnet", "resnet50", "bert"], default="resnet50")
+    p.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=384)
+    p.add_argument("--steps", type=_positive_int, default=30)
+    p.add_argument("--warmup", type=_positive_int, default=5)
+    p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1: all devices)")
+    p.add_argument("--mp", type=int, default=1, help="param-sharding axis size")
+    args = p.parse_args(argv)
+
+    # Honor an explicit JAX_PLATFORMS from the pod spec even if the image's
+    # site hooks programmatically pinned a platform (the CPU-control pod
+    # k8s-pod-example-cpu.yaml depends on this: ≙ the reference pinning its
+    # control run off-GPU with HIP_VISIBLE_DEVICES=-1).
+    env_platform = os.environ.get("JAX_PLATFORMS")
+    if env_platform:
+        try:
+            jax.config.update("jax_platforms", env_platform)
+        except Exception as e:
+            log(f"could not pin platform {env_platform!r}: {e}")
+
+    # Multi-host (k8s-job-resnet50-2host.yaml): stitch processes over DCN.
+    # Each pod got its host's chips from the plugin; jax.distributed makes
+    # jax.devices() global so the dp axis spans hosts.
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ["JAX_PROCESS_ID"]),
+        )
+        log(f"jax.distributed: process {jax.process_index()}/{jax.process_count()}")
+
+    devices = jax.devices()
+    log(f"devices: {[str(d) for d in devices]}")
+    mesh = make_mesh({"dp": args.dp, "mp": args.mp}, devices=devices)
+    log(f"mesh: {dict(mesh.shape)}")
+
+    rng = jax.random.PRNGKey(0)
+    model, batch, input_key, items_per_step = build(args.model, args, rng)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_train_state(rng, model, batch, tx, input_key=input_key)
+    step, state, batch_sh = shard_train_step(
+        make_train_step(model, tx, input_key=input_key), mesh, state, batch
+    )
+    if jax.process_count() > 1:
+        # Each process owns a slice of the global batch; assemble global
+        # arrays from process-local shards (the SPMD multi-host idiom).
+        n = jax.process_count()
+
+        def globalize(x, sh):
+            per = x.shape[0] // n
+            pid = jax.process_index()
+            local = np.asarray(x)[pid * per : (pid + 1) * per]
+            return jax.make_array_from_process_local_data(sh, local)
+
+        batch = jax.tree.map(globalize, batch, batch_sh)
+    else:
+        batch = jax.device_put(batch, batch_sh)
+
+    state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
+
+    n_chips = len(devices)
+    throughput = items_per_step * args.steps / dt
+    unit = "tokens/sec" if args.model == "bert" else "images/sec"
+    print(
+        json.dumps(
+            {
+                "model": args.model,
+                "chips": n_chips,
+                "global_batch": args.batch_size,
+                "throughput": round(throughput, 2),
+                "throughput_per_chip": round(throughput / n_chips, 2),
+                "unit": unit,
+                "step_time_ms": round(dt / args.steps * 1e3, 2),
+                "final_loss": float(loss),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""BERT encoder in Flax — the 8-chip pmap/pjit benchmark workload.
+
+Named in BASELINE.json's configs ("BERT-base JAX pmap pod, google.com/tpu: 8").
+TPU-first: bfloat16 activations, float32 layernorm/softmax accumulation,
+sequence lengths padded to MXU-friendly multiples of 128, attention via
+einsum so XLA fuses QKV projections and the attention matmuls onto the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        """Structural stand-in for CPU tests."""
+        return BertConfig(
+            vocab_size=1024,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+            max_position=128,
+        )
+
+
+class BertEncoderLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        attn_out = nn.SelfAttention(
+            num_heads=cfg.num_heads,
+            dtype=cfg.dtype,
+            deterministic=True,
+        )(hidden, mask=mask)
+        hidden = nn.LayerNorm(dtype=jnp.float32)(hidden + attn_out)
+        mlp = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype)(hidden)
+        mlp = nn.gelu(mlp)
+        mlp = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(mlp)
+        return nn.LayerNorm(dtype=jnp.float32)(hidden + mlp)
+
+
+class Bert(nn.Module):
+    """Token-classification-shaped BERT: embeddings → N layers → vocab logits
+    (a masked-LM-style head, which is what throughput benchmarks exercise)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        cfg = self.config
+        seq_len = input_ids.shape[-1]
+        if seq_len > cfg.max_position:
+            # XLA gather would silently clamp out-of-range position indices,
+            # reusing the last embedding row — fail loudly instead.
+            raise ValueError(
+                f"seq_len {seq_len} exceeds max_position {cfg.max_position}"
+            )
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        # [batch, 1, 1, seq] additive-style boolean mask for SelfAttention.
+        mask = attention_mask[:, None, None, :].astype(bool)
+
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)(input_ids)
+        pos = nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype)(
+            jnp.arange(seq_len)[None, :]
+        )
+        hidden = nn.LayerNorm(dtype=jnp.float32)(tok + pos).astype(cfg.dtype)
+
+        for _ in range(cfg.num_layers):
+            hidden = BertEncoderLayer(cfg)(hidden, mask)
+
+        # MLM head: project back to vocab in float32 for a stable softmax.
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32)(hidden)

@@ -1,0 +1,34 @@
+"""Synthetic benchmark batches.
+
+The reference's benchmark (convnet-benchmarks `benchmark_alexnet.py`, run by
+k8s-pod-example-gpu.yaml) times training on random data — no input pipeline.
+Same here: batches are generated on device, so the numbers measure the chip,
+not the loader.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def synthetic_image_batch(
+    key: jax.Array, batch_size: int, image_size: int = 224, num_classes: int = 1000
+) -> dict:
+    k_img, k_lbl = jax.random.split(key)
+    return {
+        "images": jax.random.normal(
+            k_img, (batch_size, image_size, image_size, 3), jnp.float32
+        ),
+        "labels": jax.random.randint(k_lbl, (batch_size,), 0, num_classes),
+    }
+
+
+def synthetic_token_batch(
+    key: jax.Array, batch_size: int, seq_len: int = 128, vocab_size: int = 30522
+) -> dict:
+    k_tok, k_lbl = jax.random.split(key)
+    return {
+        "input_ids": jax.random.randint(k_tok, (batch_size, seq_len), 0, vocab_size),
+        "labels": jax.random.randint(k_lbl, (batch_size, seq_len), 0, vocab_size),
+    }

@@ -1,0 +1,79 @@
+"""ResNet-V1.5 (ResNet-50 and friends) in Flax — the flagship benchmark model.
+
+Named in BASELINE.json's configs ("ResNet-50 JAX pod, google.com/tpu: 4").
+TPU-first choices: NHWC, bfloat16 compute with float32 BatchNorm statistics
+and float32 logits, stride-2 placed on the 3x3 (the V1.5 variant every
+images/sec baseline uses), static shapes throughout so XLA tiles the convs
+onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.features, (3, 3), strides=self.strides)(y)  # V1.5: stride here
+        y = nn.relu(norm()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), strides=self.strides)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, *, train: bool = False):
+        x = images.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=jnp.float32
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.width * 2**stage, strides=strides, dtype=self.dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def ResNet50(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def ResNet18Thin(**kwargs) -> ResNet:
+    """Tiny structural stand-in for CPU tests (same code paths, ~1000x fewer FLOPs)."""
+    kwargs.setdefault("width", 8)
+    return ResNet(stage_sizes=(1, 1, 1, 1), **kwargs)

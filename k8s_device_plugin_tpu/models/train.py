@@ -1,0 +1,122 @@
+"""Training-step factories for the benchmark workloads.
+
+Pure-functional train steps built for XLA: state in, state out, no Python
+control flow on traced values, dropout rngs folded from the step counter so a
+step is a deterministic function of (state, batch).  Everything here works
+unchanged under jit on one chip or pjit over a mesh (parallel/sharding.py
+supplies the shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    """Minimal train state: params + optimizer + (optional) BatchNorm stats."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # empty dict for stat-less models
+
+    def with_updates(self, **kwargs) -> "TrainState":
+        return self.replace(**kwargs)
+
+
+def create_train_state(
+    rng: jax.Array,
+    model: nn.Module,
+    sample_batch: dict,
+    tx: optax.GradientTransformation,
+    input_key: str = "images",
+) -> TrainState:
+    variables = model.init(rng, sample_batch[input_key])
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats=variables.get("batch_stats", {}),
+    )
+
+
+def _takes_train_kwarg(model: nn.Module) -> bool:
+    import inspect
+
+    return "train" in inspect.signature(type(model).__call__).parameters
+
+
+def _apply(model, state, params, x, train, rngs):
+    """Model apply that tolerates models with/without batch_stats and the
+    `train` kwarg (image models take it; BERT does not).  The kwarg decision
+    is static (signature inspection), never a traced-time fallback."""
+    variables = {"params": params}
+    kwargs = {"train": train} if _takes_train_kwarg(model) else {}
+    if bool(state.batch_stats):
+        variables["batch_stats"] = state.batch_stats
+        out, mutated = model.apply(
+            variables, x, mutable=["batch_stats"], rngs=rngs, **kwargs
+        )
+        return out, mutated["batch_stats"]
+    return model.apply(variables, x, rngs=rngs, **kwargs), {}
+
+
+def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_train_step(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    input_key: str = "images",
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_xent,
+) -> Callable[[TrainState, dict], tuple[TrainState, jax.Array]]:
+    """Build `(state, batch) -> (state, loss)`; jit/pjit it at the call site."""
+
+    def train_step(state: TrainState, batch: dict):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+
+        def compute_loss(params):
+            logits, new_stats = _apply(
+                model,
+                state,
+                params,
+                batch[input_key],
+                train=True,
+                rngs={"dropout": dropout_rng},
+            )
+            return loss_fn(logits, batch["labels"]), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.with_updates(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                batch_stats=new_stats,
+            ),
+            loss,
+        )
+
+    return train_step
+
+
+def make_eval_step(
+    model: nn.Module, input_key: str = "images"
+) -> Callable[[TrainState, dict], jax.Array]:
+    def eval_step(state: TrainState, batch: dict):
+        logits, _ = _apply(model, state, state.params, batch[input_key], train=False, rngs=None)
+        return logits
+
+    return eval_step

@@ -1,0 +1,68 @@
+"""Device-mesh construction for workloads running inside allocated pods.
+
+The plugin injects TPU_VISIBLE_CHIPS / TPU_CHIPS_PER_HOST_BOUNDS /
+TPU_WORKER_* (plugin/envs.py); libtpu consumes those to enumerate chips.  This
+module is the workload-side counterpart: turn `jax.devices()` plus the
+injected env into a `jax.sharding.Mesh` whose axes line up with the physical
+ICI block the plugin granted, so collectives ride ICI links instead of
+arbitrary permutations.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def chips_per_host_bounds(environ: Mapping[str, str] | None = None) -> tuple[int, ...] | None:
+    environ = os.environ if environ is None else environ
+    text = environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if not text:
+        return None
+    try:
+        return tuple(int(v) for v in text.split(","))
+    except ValueError:
+        return None
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """Build a Mesh.
+
+    ``axes`` maps axis name -> size in declaration order, e.g.
+    ``{"dp": 2, "mp": 4}``; sizes must multiply to the device count.  A size of
+    -1 means "whatever is left" (at most one).  Default: all devices on one
+    data-parallel axis ``{"dp": -1}``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    axes = dict(axes) if axes else {"dp": -1}
+    n = len(devices)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"axes {axes} do not cover {n} devices")
+    grid = np.array(devices).reshape(sizes)
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def make_host_mesh(
+    axes: Mapping[str, int] | None = None,
+    environ: Mapping[str, str] | None = None,
+) -> Mesh:
+    """Mesh over this process's addressable devices, ordered so that the
+    trailing mesh axis walks the x-direction of the granted ICI block (device
+    order from libtpu already follows the injected TPU_VISIBLE_CHIPS order)."""
+    return make_mesh(axes, devices=jax.local_devices())

@@ -7,7 +7,10 @@ so sharding/mesh tests exercise real multi-device paths without TPU hardware.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may pre-select the real TPU
+# (tunnel images export JAX/TPU variables ambiently), and tests must never
+# grab the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 # Repo root on sys.path so `import k8s_device_plugin_tpu` works without install.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A TPU-VM sitecustomize may have pre-registered the hardware PJRT plugin and
+# programmatically pinned the platform before this file runs; the env var
+# alone does not undo that, the config update does.  Guarded: the plugin-only
+# install (grpcio/protobuf, no workloads extra) has no jax and its tests must
+# still collect.
+try:
+    import jax  # noqa: E402
+except ImportError:
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,104 @@
+"""Mesh/sharding tests on the virtual 8-device CPU backend.
+
+Validates the multi-chip story end to end without hardware: dp×mp meshes,
+FSDP-style param sharding, and a fully sharded jitted train step whose
+compiled output shardings match the annotations.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from k8s_device_plugin_tpu.models.data import synthetic_image_batch
+from k8s_device_plugin_tpu.models.resnet import ResNet18Thin
+from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
+from k8s_device_plugin_tpu.parallel.mesh import chips_per_host_bounds, make_mesh
+from k8s_device_plugin_tpu.parallel.sharding import (
+    batch_sharding,
+    param_sharding,
+    shard_train_step,
+)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_default_dp():
+    mesh = make_mesh()
+    assert mesh.axis_names == ("dp",)
+    assert mesh.shape["dp"] == 8
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh({"dp": 2, "mp": -1})
+    assert mesh.shape == {"dp": 2, "mp": 4}
+
+
+def test_make_mesh_errors():
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+    with pytest.raises(ValueError):
+        make_mesh({"dp": -1, "mp": -1})
+
+
+def test_chips_per_host_bounds_env():
+    assert chips_per_host_bounds({"TPU_CHIPS_PER_HOST_BOUNDS": "2,4,1"}) == (2, 4, 1)
+    assert chips_per_host_bounds({}) is None
+    assert chips_per_host_bounds({"TPU_CHIPS_PER_HOST_BOUNDS": "x"}) is None
+
+
+def test_param_sharding_rule():
+    mesh = make_mesh({"dp": 2, "mp": 4})
+    params = {
+        "big_kernel": jnp.zeros((256, 128)),  # 32k elems -> shard dim 0 on mp
+        "odd_kernel": jnp.zeros((258, 129)),  # not divisible by 4 on dim1... dim0? 258%4!=0, 129%4!=0 -> replicated
+        "tiny_bias": jnp.zeros((128,)),  # below threshold -> replicated
+    }
+    sh = param_sharding(params, mesh, min_weight_size=2**14)
+    assert sh["big_kernel"].spec == P("mp", None)
+    assert sh["odd_kernel"].spec == P()
+    assert sh["tiny_bias"].spec == P()
+
+
+def test_sharded_train_step_runs_and_preserves_shardings():
+    rng = jax.random.PRNGKey(0)
+    mesh = make_mesh({"dp": 2, "mp": 4})
+    model = ResNet18Thin(num_classes=16, width=16, dtype=jnp.float32)
+    batch = synthetic_image_batch(rng, 16, image_size=32, num_classes=16)
+    tx = optax.adamw(1e-3)
+    state = create_train_state(rng, model, batch, tx)
+    step, state, batch_sh = shard_train_step(
+        make_train_step(model, tx), mesh, state, batch
+    )
+    batch = jax.device_put(batch, batch_sh)
+
+    state, loss = step(state, batch)
+    state, loss = step(state, batch)
+    assert jnp.isfinite(loss)
+    assert int(state.step) == 2
+
+    # The dense kernel (16*8... final Dense: (512*?, 16)) may or may not pass
+    # the size threshold; check a conv that certainly does if any leaf is
+    # sharded — at minimum verify every leaf's committed sharding matches the
+    # annotation tree we asked for.
+    from k8s_device_plugin_tpu.parallel.sharding import state_sharding
+
+    want = state_sharding(state, mesh)
+    leaves_got = jax.tree.leaves(
+        jax.tree.map(lambda a: a.sharding, state.params)
+    )
+    leaves_want = jax.tree.leaves(want.params)
+    assert leaves_got == leaves_want
+
+    # Batch really is split over dp: each shard holds batch/2 rows.
+    shard_shapes = {s.data.shape for s in batch["images"].addressable_shards}
+    assert shard_shapes == {(8, 32, 32, 3)}
+
+
+def test_batch_sharding_layout():
+    mesh = make_mesh({"dp": 8})
+    x = jax.device_put(jnp.zeros((16, 4)), batch_sharding(mesh))
+    assert {s.data.shape for s in x.addressable_shards} == {(2, 4)}
