@@ -1,0 +1,134 @@
+"""CLI entry-point tests.
+
+≙ reference main() wiring (main.go:189-220).  The reference has no test for
+its entry point at all; here the daemon is run as a real subprocess against a
+fixture host tree and an in-process fake kubelet, covering flag parsing, the
+--require-chips probe (≙ the /sys/class/kfd existence probe, main.go:211-217),
+registration, and SIGTERM shutdown (≙ dpm HandleSignals, dpm/manager.go:85-91).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+from k8s_device_plugin_tpu.kubelet import constants
+from k8s_device_plugin_tpu.kubelet.api import pb
+from k8s_device_plugin_tpu.plugin.cli import build_parser, main
+from k8s_device_plugin_tpu.plugin.manager import DEFAULT_ENDPOINT
+
+from tests.fakes import FakeKubelet, make_fake_tpu_host
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args([])
+    assert args.pulse == 0.0
+    assert args.root == "/"
+    assert args.plugin_dir == constants.DEVICE_PLUGIN_PATH
+    assert args.endpoint == DEFAULT_ENDPOINT
+    assert args.resource == "google.com/tpu"
+    assert args.require_chips is False
+
+
+def test_require_chips_exits_nonzero_on_empty_host(tmp_path):
+    empty_root = tmp_path / "root"
+    empty_root.mkdir()
+    rc = main(
+        [
+            "--root",
+            str(empty_root),
+            "--plugin-dir",
+            str(tmp_path / "dp"),
+            "--require-chips",
+        ]
+    )
+    assert rc == 1
+
+
+def test_daemon_subprocess_registers_and_shuts_down_on_sigterm(tmp_path):
+    host_root = make_fake_tpu_host(tmp_path / "root", n_chips=4)
+    plugin_dir = str(tmp_path / "dp")
+    os.makedirs(plugin_dir)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "k8s_device_plugin_tpu.plugin.cli",
+                "--root",
+                host_root,
+                "--plugin-dir",
+                plugin_dir,
+                "--pulse",
+                "0.2",
+                "--json-logs",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            assert kubelet.registered.wait(timeout=20), "plugin never registered"
+            req = kubelet.requests[-1]
+            assert req.resource_name == "google.com/tpu"
+            assert req.version == constants.VERSION
+            assert req.options.get_preferred_allocation_available
+
+            # The advertised endpoint must actually be servable.
+            stub = kubelet.plugin_stub()
+            stream = stub.ListAndWatch(pb.Empty(), timeout=10)
+            first = next(stream)
+            assert len(first.devices) == 4
+            stream.cancel()
+
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=15)
+            assert rc == 0
+            assert not os.path.exists(
+                os.path.join(plugin_dir, req.endpoint)
+            ), "plugin socket not cleaned up on shutdown"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=5)
+    finally:
+        kubelet.stop()
+
+
+def test_daemon_subprocess_exits_when_registration_impossible(tmp_path):
+    """No kubelet socket at all: the daemon must give up after its retry
+    budget and exit nonzero (≙ the registration-failure rollback contract,
+    api.proto:20-22 / dpm/plugin.go:83-87), not hang forever."""
+    host_root = make_fake_tpu_host(tmp_path / "root", n_chips=1)
+    plugin_dir = str(tmp_path / "dp")
+    os.makedirs(plugin_dir)
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "k8s_device_plugin_tpu.plugin.cli",
+            "--root",
+            host_root,
+            "--plugin-dir",
+            plugin_dir,
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        # 3 retries x 3s delay + grpc connect timeouts; generous ceiling.
+        rc = proc.wait(timeout=90)
+        assert rc != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5)

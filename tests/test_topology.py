@@ -1,0 +1,149 @@
+"""Unit tests for the ICI mesh topology model.
+
+This is the capability the reference collected data for but never built
+(SURVEY.md §2.4 row 4: io_links fixtures exist, countGPUDev reads only
+simd_count) — so these tests have no reference analogue and define the
+contract from scratch: coordinate mapping round-trips, contiguous sub-mesh
+selection prefers compact blocks, and selection honors availability and
+must-include constraints.
+"""
+
+import itertools
+
+import pytest
+
+from k8s_device_plugin_tpu.plugin.topology import (
+    SubMesh,
+    bounds_str,
+    chip_coords,
+    chip_index,
+    host_bounds_for_count,
+    select_contiguous,
+)
+
+
+def test_host_bounds_for_known_counts():
+    assert host_bounds_for_count(1) == (1, 1, 1)
+    assert host_bounds_for_count(4) == (2, 2, 1)
+    assert host_bounds_for_count(8) == (2, 4, 1)
+    assert host_bounds_for_count(16) == (4, 4, 1)
+
+
+def test_host_bounds_unknown_count_degrades_to_chain():
+    assert host_bounds_for_count(6) == (6, 1, 1)
+    assert host_bounds_for_count(3) == (3, 1, 1)
+
+
+@pytest.mark.parametrize("bounds", [(1, 1, 1), (2, 2, 1), (2, 4, 1), (4, 4, 1), (2, 2, 2)])
+def test_coords_index_roundtrip(bounds):
+    n = bounds[0] * bounds[1] * bounds[2]
+    seen = set()
+    for i in range(n):
+        coords = chip_coords(i, bounds)
+        assert all(0 <= c < b for c, b in zip(coords, bounds))
+        assert chip_index(coords, bounds) == i
+        seen.add(coords)
+    assert len(seen) == n  # bijective
+
+
+def test_coords_x_fastest():
+    # Row-major with x varying fastest: on a 2x4 host, chip 1 is (1,0,0),
+    # chip 2 wraps to (0,1,0).
+    assert chip_coords(0, (2, 4, 1)) == (0, 0, 0)
+    assert chip_coords(1, (2, 4, 1)) == (1, 0, 0)
+    assert chip_coords(2, (2, 4, 1)) == (0, 1, 0)
+    assert chip_coords(7, (2, 4, 1)) == (1, 3, 0)
+
+
+def test_submesh_chip_indices_sorted_and_complete():
+    sub = SubMesh(origin=(0, 1, 0), bounds=(2, 2, 1))
+    assert sub.chip_indices((2, 4, 1)) == (2, 3, 4, 5)
+
+
+def test_select_prefers_compact_block():
+    # 4 chips on a 2x4 host: the 2x2 square beats the 1x4 column.
+    sub = select_contiguous(4, available=range(8), host_bounds=(2, 4, 1))
+    assert sub is not None
+    assert sorted(sub.bounds) == [1, 2, 2]
+    assert len(sub.chip_indices((2, 4, 1))) == 4
+
+
+def test_select_two_chips_are_neighbors():
+    sub = select_contiguous(2, available=range(8), host_bounds=(2, 4, 1))
+    assert sub is not None
+    a, b = (chip_coords(i, (2, 4, 1)) for i in sub.chip_indices((2, 4, 1)))
+    # Manhattan distance 1 = one ICI hop.
+    assert sum(abs(x - y) for x, y in zip(a, b)) == 1
+
+
+def test_select_respects_availability():
+    # Chips 0 and 1 busy on a 2x2 host: the only 2-block left is {2,3}.
+    sub = select_contiguous(2, available=[2, 3], host_bounds=(2, 2, 1))
+    assert sub is not None
+    assert sub.chip_indices((2, 2, 1)) == (2, 3)
+
+
+def test_select_fragmented_returns_none():
+    # Diagonal chips on a 2x2 host form no axis-aligned block.
+    assert select_contiguous(2, available=[0, 3], host_bounds=(2, 2, 1)) is None
+
+
+def test_select_must_include_steers_block():
+    sub = select_contiguous(
+        2, available=range(8), host_bounds=(2, 4, 1), must_include=[6]
+    )
+    assert sub is not None
+    assert 6 in sub.chip_indices((2, 4, 1))
+
+
+def test_select_must_include_unsatisfiable():
+    # must_include chips that cannot co-reside in any 2-block.
+    assert (
+        select_contiguous(2, available=range(4), host_bounds=(2, 2, 1), must_include=[0, 3])
+        is None
+    )
+
+
+def test_select_count_exceeds_available():
+    assert select_contiguous(4, available=[0, 1], host_bounds=(2, 2, 1)) is None
+    assert select_contiguous(0, available=range(4), host_bounds=(2, 2, 1)) is None
+
+
+def test_select_whole_host():
+    for bounds in [(2, 2, 1), (2, 4, 1), (4, 4, 1)]:
+        n = bounds[0] * bounds[1] * bounds[2]
+        sub = select_contiguous(n, available=range(n), host_bounds=bounds)
+        assert sub is not None
+        assert sub.chip_indices(bounds) == tuple(range(n))
+
+
+def test_select_exhaustive_small_host():
+    """On a 2x2 host, every available-set/count combination either yields a
+    valid in-bounds block drawn from the available set, or None exactly when
+    no axis-aligned block exists (cross-checked by brute force)."""
+    bounds = (2, 2, 1)
+    blocks_by_count = {}
+    for sx, sy in itertools.product([1, 2], repeat=2):
+        for ox in range(2 - sx + 1):
+            for oy in range(2 - sy + 1):
+                sub = SubMesh(origin=(ox, oy, 0), bounds=(sx, sy, 1))
+                blocks_by_count.setdefault(sx * sy, []).append(
+                    set(sub.chip_indices(bounds))
+                )
+    for r in range(5):
+        for avail in itertools.combinations(range(4), r):
+            for count in range(1, 5):
+                got = select_contiguous(count, avail, bounds)
+                feasible = any(
+                    blk <= set(avail) for blk in blocks_by_count.get(count, [])
+                )
+                if feasible:
+                    assert got is not None, (avail, count)
+                    assert set(got.chip_indices(bounds)) <= set(avail)
+                    assert len(got.chip_indices(bounds)) == count
+                else:
+                    assert got is None, (avail, count)
+
+
+def test_bounds_str():
+    assert bounds_str((2, 4, 1)) == "2,4,1"
