@@ -16,10 +16,11 @@ import sys
 
 from ..kubelet import constants
 from ..utils.logging import setup_logging
+from ..utils.metrics import MetricsServer
 from . import discovery
 from .health import ChipHealthChecker
 from .manager import DEFAULT_ENDPOINT, PluginManager
-from .server import RESOURCE, TpuDevicePlugin
+from .server import DEFAULT_REGISTRY, RESOURCE, TpuDevicePlugin, default_plugin_metrics
 
 log = logging.getLogger(__name__)
 
@@ -55,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--json-logs", action="store_true", help="emit JSON log lines")
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="serve Prometheus /metrics (+ /healthz) on this port (0 disables; "
+        "beyond-reference observability, SURVEY.md §5.5/§7)",
+    )
     return p
 
 
@@ -65,6 +73,7 @@ def main(argv: list[str] | None = None) -> int:
     plugin = TpuDevicePlugin(
         discover=lambda: discovery.discover(root=args.root),
         health_checker=ChipHealthChecker(root=args.root),
+        metrics=default_plugin_metrics(),
     )
     inventory = plugin.inventory  # discovery already ran once in the ctor
     if args.require_chips and inventory.chip_count == 0:
@@ -77,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
         resource=args.resource,
         pulse=args.pulse,
     )
+    metrics_server = None
 
     def _on_signal(signum, _frame):
         log.info("received %s; shutting down", signal.Signals(signum).name)
@@ -97,7 +107,17 @@ def main(argv: list[str] | None = None) -> int:
         args.plugin_dir,
         args.pulse,
     )
-    manager.run()
+    try:
+        if args.metrics_port:
+            metrics_server = MetricsServer(
+                DEFAULT_REGISTRY, port=args.metrics_port, health=manager.alive
+            )
+            metrics_server.start()
+            log.info("metrics on :%d/metrics", metrics_server.port)
+        manager.run()
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -105,6 +105,15 @@ class PluginManager:
         """Request an orderly exit of :meth:`run` (signal-handler safe)."""
         self._stop.set()
 
+    def alive(self) -> bool:
+        """Liveness (drives /healthz): not shut down and the recovery watcher
+        thread is still running.  A stopped gRPC server while the kubelet is
+        down is a NORMAL state (we restart on its return), not death — but a
+        dead watcher means restarts would go unnoticed, which IS death."""
+        if self._stop.is_set():
+            return False
+        return self._watcher is not None and self._watcher.is_alive()
+
     def stop_all(self) -> None:
         # Order matters: mark stopping FIRST so a concurrent watcher callback
         # (kubelet restarting at the same moment as our SIGTERM) cannot
@@ -171,6 +180,7 @@ class PluginManager:
                 timeout=10,
             )
         self.registrations += 1
+        self.plugin.metrics.registrations.inc()
         log.info("registered %s with kubelet (endpoint %s)", self.resource, self.endpoint)
 
     def _start_and_register(self) -> None:
@@ -221,6 +231,7 @@ class PluginManager:
         Restart our server (fresh socket) and re-register."""
         if self._stop.is_set():
             return
+        self.plugin.metrics.kubelet_restarts.inc()
         log.info("kubelet restart detected; re-registering")
         try:
             self._stop_server()

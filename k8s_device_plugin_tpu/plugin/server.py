@@ -26,6 +26,7 @@ import grpc
 
 from ..kubelet import constants
 from ..kubelet.api import pb
+from ..utils.metrics import MetricsRegistry
 from .discovery import TpuChip, TpuHostInventory
 from .envs import allocation_annotations, allocation_envs
 from .health import ChipHealthChecker
@@ -36,6 +37,76 @@ log = logging.getLogger(__name__)
 RESOURCE_NAMESPACE = "google.com"
 RESOURCE_NAME = "tpu"
 RESOURCE = f"{RESOURCE_NAMESPACE}/{RESOURCE_NAME}"
+
+# Process-wide registry: the daemon has exactly one plugin+manager, and a
+# single registry keeps the /metrics endpoint wiring trivial.  Tests that need
+# isolation construct their own MetricsRegistry and pass it in.
+DEFAULT_REGISTRY = MetricsRegistry()
+
+_default_metrics = None
+_default_metrics_lock = threading.Lock()
+
+
+def default_plugin_metrics() -> "PluginMetrics":
+    """The PluginMetrics bound to DEFAULT_REGISTRY, created once (metric names
+    may only be registered once per registry, and main() may run more than
+    once in one process — hermetic tests do)."""
+    global _default_metrics
+    with _default_metrics_lock:
+        if _default_metrics is None:
+            _default_metrics = PluginMetrics(DEFAULT_REGISTRY)
+        return _default_metrics
+
+
+class PluginMetrics:
+    """The plugin's instrumentation, named in Prometheus conventions.
+
+    Beyond-reference observability (SURVEY.md §5.5 records the reference has
+    none); every load-bearing event in the serve/stream/allocate paths gets a
+    series here.
+    """
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.chips = registry.gauge(
+            "tpu_plugin_chips", "Discovered TPU chips by health state", ["state"]
+        )
+        self.device_updates = registry.counter(
+            "tpu_plugin_device_updates_total",
+            "State versions published to ListAndWatch streams",
+        )
+        self.health_transitions = registry.counter(
+            "tpu_plugin_health_transitions_total",
+            "Per-chip Healthy<->Unhealthy flips observed by polling",
+            ["direction"],
+        )
+        self.streams = registry.gauge(
+            "tpu_plugin_listandwatch_streams", "Open ListAndWatch streams"
+        )
+        self.allocations = registry.counter(
+            "tpu_plugin_allocations_total",
+            "Container allocation requests by outcome",
+            ["outcome"],
+        )
+        self.allocated_chips = registry.counter(
+            "tpu_plugin_allocated_chips_total", "Chips handed out by Allocate"
+        )
+        self.allocation_latency = registry.summary(
+            "tpu_plugin_allocation_latency_seconds",
+            "Wall time of Allocate RPCs (BASELINE.json secondary metric)",
+        )
+        self.preferred_allocations = registry.counter(
+            "tpu_plugin_preferred_allocations_total",
+            "GetPreferredAllocation container requests by result",
+            ["result"],
+        )
+        self.registrations = registry.counter(
+            "tpu_plugin_registrations_total", "Successful kubelet registrations"
+        )
+        self.kubelet_restarts = registry.counter(
+            "tpu_plugin_kubelet_restarts_total",
+            "kubelet.sock recreations observed by the watcher",
+        )
 
 
 class TpuDevicePlugin:
@@ -51,9 +122,11 @@ class TpuDevicePlugin:
         self,
         discover: Callable[[], TpuHostInventory],
         health_checker: ChipHealthChecker,
+        metrics: PluginMetrics | None = None,
     ):
         self._discover = discover
         self._health_checker = health_checker
+        self.metrics = metrics if metrics is not None else PluginMetrics(MetricsRegistry())
         self._cond = threading.Condition()
         self._version = 0
         self._epoch = 0  # bumped by interrupt_streams(); streams die on change
@@ -84,15 +157,25 @@ class TpuDevicePlugin:
                 or [c.k8s_id for c in inventory.chips]
                 != [c.k8s_id for c in self._inventory.chips]
             )
+            for k8s_id, healthy in health.items():
+                was = self._health.get(k8s_id)
+                if was is not None and was != healthy:
+                    self.metrics.health_transitions.inc(
+                        direction="to_unhealthy" if was else "to_healthy"
+                    )
             self._inventory = inventory
             self._health = health
             if changed:
                 self._version += 1
                 self._cond.notify_all()
+            version = self._version
+        self.metrics.chips.set(sum(health.values()), state="healthy")
+        self.metrics.chips.set(len(health) - sum(health.values()), state="unhealthy")
         if changed:
+            self.metrics.device_updates.inc()
             log.info(
                 "device state v%d: %s",
-                self._version,
+                version,
                 {k: ("Healthy" if v else "Unhealthy") for k, v in health.items()},
             )
         return changed
@@ -137,24 +220,28 @@ class TpuDevicePlugin:
             epoch = self._epoch
         version, inventory, health = self._snapshot()
         log.info("ListAndWatch stream opened (v%d, %d chips)", version, inventory.chip_count)
-        yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
-        while True:
-            with self._cond:
-                # Wake on state change or interrupt; time out periodically to
-                # notice a disconnected kubelet and end the stream cleanly.
-                while self._version == version and self._epoch == epoch:
-                    if not self._cond.wait(timeout=5.0):
-                        if not context.is_active():
-                            log.info("ListAndWatch stream closed by peer")
-                            return
-                if self._epoch != epoch:
-                    log.info("ListAndWatch stream interrupted (server stopping)")
-                    return
-                version = self._version
-                inventory, health = self._inventory, dict(self._health)
-            if not context.is_active():
-                return
+        self.metrics.streams.inc()
+        try:
             yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
+            while True:
+                with self._cond:
+                    # Wake on state change or interrupt; time out periodically to
+                    # notice a disconnected kubelet and end the stream cleanly.
+                    while self._version == version and self._epoch == epoch:
+                        if not self._cond.wait(timeout=5.0):
+                            if not context.is_active():
+                                log.info("ListAndWatch stream closed by peer")
+                                return
+                    if self._epoch != epoch:
+                        log.info("ListAndWatch stream interrupted (server stopping)")
+                        return
+                    version = self._version
+                    inventory, health = self._inventory, dict(self._health)
+                if not context.is_active():
+                    return
+                yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
+        finally:
+            self.metrics.streams.dec()
 
     # --------------------------------------------------- RPC: preferred alloc
 
@@ -171,6 +258,11 @@ class TpuDevicePlugin:
             resp.container_responses.add(deviceIDs=preferred)
         return resp
 
+    def _record_preference(self, contiguous: bool) -> None:
+        self.metrics.preferred_allocations.inc(
+            result="contiguous" if contiguous else "fragmented"
+        )
+
     def _prefer(
         self,
         inventory: TpuHostInventory,
@@ -183,6 +275,7 @@ class TpuDevicePlugin:
             must_idx = {inventory.chip_by_k8s_id(d).index for d in must_include}
         except KeyError as e:
             log.warning("GetPreferredAllocation names unknown device %s", e)
+            self.metrics.preferred_allocations.inc(result="unknown_device")
             return sorted(available)[:size]
         by_index = {c.index: c for c in inventory.chips}
         sub = select_contiguous(
@@ -192,37 +285,51 @@ class TpuDevicePlugin:
             must_include=must_idx,
         )
         if sub is not None:
+            self._record_preference(contiguous=True)
             return [
                 by_index[i].k8s_id
                 for i in sorted(sub.chip_indices(inventory.host_bounds))
             ]
         # No contiguous block containing the musts: fill musts first, then
         # lowest available indices (deterministic, NUMA-dense-ish).
+        self._record_preference(contiguous=False)
         chosen = sorted(must_idx) + sorted(avail_idx - must_idx)
         return [by_index[i].k8s_id for i in chosen[:size]]
 
     # ---------------------------------------------------------- RPC: allocate
 
     def Allocate(self, request, context):
-        _, inventory, health = self._snapshot()
-        resp = pb.AllocateResponse()
-        for creq in request.container_requests:
-            ids = list(creq.devicesIDs)
-            try:
-                chips = [inventory.chip_by_k8s_id(d) for d in ids]
-            except KeyError as e:
-                context.abort(
-                    grpc.StatusCode.NOT_FOUND, f"unknown device id {e.args[0]!r}"
-                )
-            unhealthy = [c.k8s_id for c in chips if not health.get(c.k8s_id)]
-            if unhealthy:
-                context.abort(
-                    grpc.StatusCode.FAILED_PRECONDITION,
-                    f"device(s) {unhealthy} are Unhealthy",
-                )
-            resp.container_responses.append(self._allocate_one(inventory, chips))
-            log.info("allocated %s", ids)
-        return resp
+        with self.metrics.allocation_latency.time():
+            _, inventory, health = self._snapshot()
+            resp = pb.AllocateResponse()
+            granted_chips = 0
+            for creq in request.container_requests:
+                ids = list(creq.devicesIDs)
+                try:
+                    chips = [inventory.chip_by_k8s_id(d) for d in ids]
+                except KeyError as e:
+                    self.metrics.allocations.inc(outcome="unknown_device")
+                    context.abort(
+                        grpc.StatusCode.NOT_FOUND, f"unknown device id {e.args[0]!r}"
+                    )
+                unhealthy = [c.k8s_id for c in chips if not health.get(c.k8s_id)]
+                if unhealthy:
+                    self.metrics.allocations.inc(outcome="unhealthy_device")
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        f"device(s) {unhealthy} are Unhealthy",
+                    )
+                resp.container_responses.append(self._allocate_one(inventory, chips))
+                granted_chips += len(chips)
+                log.info("allocated %s", ids)
+            # Success counters only once the WHOLE response is built: a later
+            # container's abort discards the entire AllocateResponse, and the
+            # metrics must not claim chips were handed out.
+            self.metrics.allocations.inc(
+                len(request.container_requests), outcome="ok"
+            )
+            self.metrics.allocated_chips.inc(granted_chips)
+            return resp
 
     def _allocate_one(
         self, inventory: TpuHostInventory, chips: list[TpuChip]

@@ -1,0 +1,257 @@
+"""Prometheus-format metrics, stdlib-only.
+
+The reference has no metrics at all (SURVEY.md §5.5: glog lines only, "no
+metrics endpoint, no Prometheus") — this subsystem is deliberately beyond
+parity, per SURVEY.md §7 step 7.  A tiny text-exposition implementation is
+used instead of the `prometheus_client` package so the plugin image keeps
+zero non-gRPC dependencies.
+
+Exposition format: https://prometheus.io/docs/instrumenting/exposition_formats/
+(text version 0.0.4) — `# HELP` / `# TYPE` headers, one `name{labels} value`
+line per labeled series.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable, Mapping
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _format_labels(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _format_value(value: float) -> str:
+    # Integers render without a trailing ".0" (matches common exporters).
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class _Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, help_text: str, labelnames: Iterable[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], float] = {}
+
+    def _key(self, labels: Mapping[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, want {sorted(self.labelnames)}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.TYPE}"]
+        with self._lock:
+            if not self._series:
+                return lines if self.labelnames else lines + [f"{self.name} 0"]
+            for key in sorted(self._series):
+                labels = dict(zip(self.labelnames, key))
+                lines.append(
+                    f"{self.name}{_format_labels(labels)} "
+                    f"{_format_value(self._series[key])}"
+                )
+        return lines
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Summary:
+    """count + sum pair (enough for rate()/avg in PromQL; no quantiles)."""
+
+    TYPE = "summary"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += float(value)
+
+    def time(self):
+        """Context manager observing elapsed wall seconds."""
+        summary = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                summary.observe(time.monotonic() - self._t0)
+                return False
+
+        return _Timer()
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            return [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.TYPE}",
+                f"{self.name}_count {self._count}",
+                f"{self.name}_sum {_format_value(self._sum)}",
+            ]
+
+
+class MetricsRegistry:
+    """Holds metrics and renders the exposition text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _register(self, metric):
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name: str, help_text: str, labelnames: Iterable[str] = ()) -> Counter:
+        return self._register(Counter(name, help_text, labelnames))
+
+    def gauge(self, name: str, help_text: str, labelnames: Iterable[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_text, labelnames))
+
+    def summary(self, name: str, help_text: str) -> Summary:
+        return self._register(Summary(name, help_text))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for metric in metrics:
+            lines.extend(metric.collect())
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Serves GET /metrics (exposition text) and GET /healthz on a daemon
+    thread.  Port 0 picks a free port (tests); `.port` reports it.
+
+    ``health`` is an optional callable consulted by /healthz: True (or no
+    callable) ⇒ 200 "ok", False ⇒ 503 — so a liveness probe reflects the
+    daemon's actual state, not just this HTTP thread's.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        host: str = "0.0.0.0",
+        port: int = 9100,
+        health=None,
+    ):
+        registry_ref = registry
+        health_ref = health
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] == "/metrics":
+                    body = registry_ref.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?")[0] == "/healthz":
+                    try:
+                        healthy = health_ref is None or bool(health_ref())
+                    except Exception:
+                        healthy = False
+                    body = b"ok\n" if healthy else b"unhealthy\n"
+                    self.send_response(200 if healthy else 503)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):  # quiet: scrapes are frequent
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        assert self._thread is None
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
