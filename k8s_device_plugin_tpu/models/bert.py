@@ -2,8 +2,11 @@
 
 Named in BASELINE.json's configs ("BERT-base JAX pmap pod, google.com/tpu: 8").
 TPU-first: bfloat16 activations, float32 layernorm/softmax accumulation,
-sequence lengths padded to MXU-friendly multiples of 128, attention via
-einsum so XLA fuses QKV projections and the attention matmuls onto the MXU.
+sequence lengths padded to MXU-friendly multiples of 128, and attention via
+the fused Pallas flash kernel (ops/flash_attention.py) when no padding mask
+is in play — falling back to plain-XLA masked attention otherwise (both paths
+share the same projection parameters, so a checkpoint is portable between
+them).
 """
 
 from __future__ import annotations
@@ -13,6 +16,8 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from ..ops.flash_attention import flash_attention
 
 
 @dataclass(frozen=True)
@@ -43,17 +48,49 @@ class BertConfig:
         )
 
 
+class MultiHeadSelfAttention(nn.Module):
+    """Self-attention whose computation — not its parameters — switches
+    between the fused flash kernel (``mask is None``: benchmark/full-sequence
+    path) and plain-XLA masked attention (padded batches)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        projections = {
+            name: nn.DenseGeneral(
+                features=(cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+            )(hidden)
+            for name in ("query", "key", "value")
+        }  # each [batch, seq, heads, head_dim]
+        seq_len = hidden.shape[1]
+        block = min(128, seq_len)
+        if mask is None and seq_len % block == 0:
+            q, k, v = (
+                projections[n].transpose(0, 2, 1, 3) for n in ("query", "key", "value")
+            )
+            attn = flash_attention(q, k, v).transpose(0, 2, 1, 3)
+        else:
+            attn = nn.dot_product_attention(
+                projections["query"],
+                projections["key"],
+                projections["value"],
+                mask=mask,
+            )
+        return nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(attn)
+
+
 class BertEncoderLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
     def __call__(self, hidden, mask):
         cfg = self.config
-        attn_out = nn.SelfAttention(
-            num_heads=cfg.num_heads,
-            dtype=cfg.dtype,
-            deterministic=True,
-        )(hidden, mask=mask)
+        attn_out = MultiHeadSelfAttention(cfg)(hidden, mask)
         hidden = nn.LayerNorm(dtype=jnp.float32)(hidden + attn_out)
         mlp = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype)(hidden)
         mlp = nn.gelu(mlp)
@@ -78,9 +115,12 @@ class Bert(nn.Module):
                 f"seq_len {seq_len} exceeds max_position {cfg.max_position}"
             )
         if attention_mask is None:
-            attention_mask = jnp.ones_like(input_ids)
-        # [batch, 1, 1, seq] additive-style boolean mask for SelfAttention.
-        mask = attention_mask[:, None, None, :].astype(bool)
+            # Full-sequence batches (the benchmark path): no mask at all, so
+            # the encoder layers take the fused flash-attention path.
+            mask = None
+        else:
+            # [batch, 1, 1, seq] boolean mask for dot_product_attention.
+            mask = attention_mask[:, None, None, :].astype(bool)
 
         tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)(input_ids)
         pos = nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype)(

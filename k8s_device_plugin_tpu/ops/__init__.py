@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the benchmark workloads' hot ops."""
+
+from .flash_attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

@@ -57,6 +57,23 @@ def test_bert_forward_shape(rng):
     assert logits.shape == (2, 16, cfg.vocab_size)
 
 
+def test_bert_flash_and_masked_paths_agree(rng):
+    """The flash-kernel path (no mask) and the plain-XLA path (all-ones mask)
+    share parameters and must produce the same logits."""
+    cfg = BertConfig.tiny()
+    model = Bert(cfg)
+    # seq 128 = one full flash block on the no-mask path.
+    batch = synthetic_token_batch(rng, 2, seq_len=128, vocab_size=cfg.vocab_size)
+    variables = model.init(rng, batch["input_ids"])
+    flash_logits = model.apply(variables, batch["input_ids"])
+    masked_logits = model.apply(
+        variables, batch["input_ids"], jnp.ones_like(batch["input_ids"])
+    )
+    assert jnp.allclose(flash_logits, masked_logits, atol=5e-2), (
+        float(jnp.max(jnp.abs(flash_logits - masked_logits)))
+    )
+
+
 @pytest.mark.parametrize(
     "model,batch_kwargs,input_key",
     [
