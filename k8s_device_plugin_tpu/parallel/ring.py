@@ -1,0 +1,154 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context workloads shard the SEQUENCE over devices (the ``sp`` axis) so
+no chip ever holds the full [seq, seq] score matrix or even the full kv.
+Each device keeps its resident q shard and passes its k/v shard around the
+ICI ring with ``lax.ppermute``; at every step it folds the visiting kv block
+into a running online-softmax state (same math as the Pallas flash kernel in
+ops/flash_attention.py, lifted from "one VMEM tile at a time" to "one
+device's shard at a time").  After ``sp`` steps every q row has attended to
+every kv position, with peak per-device memory O(local_seq²) and traffic
+that rides neighbor-to-neighbor ICI links — never a global all-gather.
+
+The reference has no distributed compute at all (SURVEY.md §2.4: parallelism
+is "the workload's problem"); this module is the workload-side answer, built
+on XLA collectives rather than any NCCL/MPI pattern.
+
+Layering: `ring_attention` is the per-device body (call inside `shard_map`);
+`ring_self_attention` wraps it for a global [batch, heads, seq, head_dim]
+array over a Mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = float("-inf")
+
+try:  # jax >= 0.8 spelling
+    from jax import shard_map as _shard_map
+except ImportError:  # older: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _mark_varying(tree, axis_name):
+    """Tag device-invariant values as varying over ``axis_name`` (shard_map
+    tracks varying manual axes; scan carries must agree).  API drifted:
+    pcast(to="varying") is current, pvary the older spelling."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(tree, axis_name)
+    return tree  # pre-varying-types jax: no tagging needed
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-device ring attention body.
+
+    Shapes are the LOCAL shards: [batch, heads, local_seq, head_dim], where
+    global seq = local_seq * mesh.shape[axis_name] and shard i owns global
+    positions [i*local_seq, (i+1)*local_seq).  Must run inside ``shard_map``
+    (or ``pmap``) with ``axis_name`` bound.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    batch, heads, seq_q, _ = q.shape
+    seq_kv = k.shape[2]
+    f32 = jnp.float32
+    qf = q.astype(f32)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_kv), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_kv), 1)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (rank - t) % n  # which shard's kv we hold at this step
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                qf,
+                k_blk.astype(f32),
+                preferred_element_type=f32,
+            )
+            * sm_scale
+        )
+        if causal:
+            row_g = rank * seq_q + rows
+            col_g = src * seq_kv + cols
+            s = jnp.where(row_g >= col_g, s, NEG_INF)
+
+        # Online softmax fold (identical update rule to the flash kernel).
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        seen = m_new > NEG_INF
+        p = jnp.where(seen, jnp.exp(s - jnp.where(seen, m_new, 0.0)), 0.0)
+        alpha = jnp.where(seen, jnp.exp(jnp.where(seen, m - m_new, 0.0)), 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(f32), preferred_element_type=f32
+        )
+
+        # Rotate kv one hop around the ring (neighbor ICI traffic only).
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l_new, acc_new), None
+
+    # The initial state is device-invariant; mark it as varying over the ring
+    # axis so the scan carry types line up (shard_map tracks varying axes).
+    m0, l0, acc0 = _mark_varying(
+        (
+            jnp.full((batch, heads, seq_q, 1), NEG_INF, f32),
+            jnp.zeros((batch, heads, seq_q, 1), f32),
+            jnp.zeros(qf.shape, f32),
+        ),
+        axis_name,
+    )
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+    return (acc / l).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Global-view wrapper: [batch, heads, seq, head_dim] arrays, sequence
+    sharded over ``mesh`` axis ``axis``; returns the same global shape."""
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
+    spec = P(None, None, axis, None)
+    body = functools.partial(
+        ring_attention, axis_name=axis, causal=causal, sm_scale=sm_scale
+    )
+    shard_mapped = _shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    sharding = NamedSharding(mesh, spec)
+    return shard_mapped(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )
