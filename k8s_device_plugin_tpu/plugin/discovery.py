@@ -25,6 +25,7 @@ import re
 from dataclasses import dataclass
 from typing import Mapping
 
+from . import native
 from .topology import bounds_str, chip_coords, host_bounds_for_count
 
 log = logging.getLogger(__name__)
@@ -164,11 +165,20 @@ def discover(
         generations.update(extra_generations)
 
     # --- chip enumeration: /dev/accel* is authoritative for existence -------
+    # One readdir in C when libtpu_probe.so is loaded (plugin/native.py);
+    # glob+regex is the fallback and the behavioral reference.
     indices: set[int] = set()
-    for path in glob.glob(os.path.join(root, "dev", "accel[0-9]*")):
-        m = _ACCEL_DEV_RE.search(os.path.basename(path))
-        if m:
-            indices.add(int(m.group(1)))
+    prober = native.shared_prober()
+    scanned = (
+        prober.scan_accel_indices(os.path.join(root, "dev")) if prober else None
+    )
+    if scanned is not None:
+        indices = set(scanned)
+    else:
+        for path in glob.glob(os.path.join(root, "dev", "accel[0-9]*")):
+            m = _ACCEL_DEV_RE.search(os.path.basename(path))
+            if m:
+                indices.add(int(m.group(1)))
     # Cross-check sysfs: a chip the driver bound but whose dev node is missing
     # is worth logging (it will be advertised Unhealthy-from-birth territory,
     # but we do not advertise what cannot be mounted).

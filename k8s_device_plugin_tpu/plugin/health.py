@@ -14,6 +14,7 @@ import logging
 import os
 import stat
 
+from . import native
 from .discovery import TpuChip
 
 log = logging.getLogger(__name__)
@@ -29,10 +30,22 @@ _BUSY_ERRNOS = {errno.EBUSY, errno.EACCES, errno.EPERM}
 
 
 class ChipHealthChecker:
-    """Probes one chip at a time; stateless between calls."""
+    """Probes one chip at a time; stateless between calls.
 
-    def __init__(self, root: str = "/"):
+    The probe itself runs through libtpu_probe.so when available (one C call
+    per chip, see plugin/native.py) with this file's pure-Python sequence as
+    the fallback and the behavioral reference; override files are always
+    handled in Python (cold path).
+    """
+
+    def __init__(
+        self,
+        root: str = "/",
+        prober: native.NativeProber | None | object = "auto",
+    ):
         self._root = root
+        # "auto" → process-wide shared library; None → force Python path.
+        self._prober = native.shared_prober() if prober == "auto" else prober
 
     def _override(self, chip: TpuChip) -> bool | None:
         path = os.path.join(self._root, HEALTH_OVERRIDE_DIR, f"accel{chip.index}")
@@ -52,6 +65,9 @@ class ChipHealthChecker:
             return override
 
         dev_path = os.path.join(self._root, chip.device_path.lstrip("/"))
+        if self._prober is not None:
+            code, err = self._prober.probe(dev_path)
+            return self._classify(dev_path, code, err)
         try:
             st = os.stat(dev_path)
         except OSError:
@@ -69,3 +85,31 @@ class ChipHealthChecker:
         else:
             os.close(fd)
             return True
+
+    def _classify(self, dev_path: str, code: int, err: int) -> bool:
+        if code == native.PROBE_OPENFAIL:
+            log.warning(
+                "open(%s) failed: %s", dev_path, os.strerror(err) if err else err
+            )
+        return native.is_healthy_code(code)
+
+    def check_many(self, chips: tuple[TpuChip, ...] | list[TpuChip]) -> dict[str, bool]:
+        """Health of a whole inventory, k8s_id -> healthy.  With the native
+        prober this is ONE FFI crossing for every non-overridden chip (the
+        per-pulse hot path of the daemon); otherwise it loops check()."""
+        result: dict[str, bool] = {}
+        if self._prober is None:
+            return {chip.k8s_id: self.check(chip) for chip in chips}
+        batched: list[tuple[TpuChip, str]] = []
+        for chip in chips:
+            override = self._override(chip)
+            if override is not None:
+                result[chip.k8s_id] = override
+            else:
+                batched.append(
+                    (chip, os.path.join(self._root, chip.device_path.lstrip("/")))
+                )
+        codes = self._prober.probe_many([path for _, path in batched])
+        for (chip, path), (code, err) in zip(batched, codes):
+            result[chip.k8s_id] = self._classify(path, code, err)
+        return result

@@ -1,0 +1,165 @@
+"""ctypes loader for the native probe helper (native/tpu_probe.c).
+
+The daemon's two hot filesystem paths — the per-pulse per-chip health probe
+and discovery's /dev scan — have a C implementation (libtpu_probe.so) so a
+fast pulse costs a fixed few syscalls per chip with no Python-level file
+object churn.  This module finds and wraps the library; every caller treats
+it as optional and falls back to the pure-Python implementations
+(plugin/health.py, plugin/discovery.py), which remain the behavioral
+reference.  The reference plugin has no native component at all (SURVEY.md:
+100% Go, kernel driver consumed via sysfs); this helper is our equivalent of
+its compiled-binary probe path, built per SURVEY.md §7's guidance ("a tight
+health-poll helper … as a small C++ tool").
+
+Search order for the shared object:
+1. ``TPU_PROBE_LIB`` environment variable (absolute path) — used by the
+   container image, which builds the .so at image-build time;
+2. ``native/libtpu_probe.so`` next to the repo checkout (dev/test builds);
+3. give up and return None (callers use the Python path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import shutil
+import subprocess
+
+log = logging.getLogger(__name__)
+
+# Probe result codes — must mirror native/tpu_probe.c.
+PROBE_OK = 0
+PROBE_BUSY = 1
+PROBE_MISSING = 2
+PROBE_WRONGTYPE = 3
+PROBE_OPENFAIL = 4
+
+_ABI_VERSION = 1
+
+_HEALTHY_CODES = frozenset({PROBE_OK, PROBE_BUSY})
+
+_REPO_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "libtpu_probe.so",
+)
+_SOURCE = os.path.join(os.path.dirname(_REPO_LIB), "tpu_probe.c")
+
+
+class NativeProber:
+    """Thin typed wrapper over a loaded libtpu_probe.so."""
+
+    def __init__(self, lib: ctypes.CDLL, path: str):
+        self.path = path
+        self._lib = lib
+        lib.tpu_probe_abi_version.restype = ctypes.c_int
+        lib.tpu_probe_abi_version.argtypes = []
+        lib.tpu_probe_device.restype = ctypes.c_int
+        lib.tpu_probe_device.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.tpu_probe_devices.restype = None
+        lib.tpu_probe_devices.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.tpu_scan_accel_indices.restype = ctypes.c_int
+        lib.tpu_scan_accel_indices.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        abi = lib.tpu_probe_abi_version()
+        if abi != _ABI_VERSION:
+            raise OSError(f"libtpu_probe ABI {abi} != expected {_ABI_VERSION}")
+
+    def probe(self, device_path: str) -> tuple[int, int]:
+        """Probe one device node; returns (code, errno)."""
+        err = ctypes.c_int(0)
+        code = self._lib.tpu_probe_device(
+            device_path.encode(), ctypes.byref(err)
+        )
+        return code, err.value
+
+    def probe_many(self, device_paths: list[str]) -> list[tuple[int, int]]:
+        """Probe a batch of nodes in one FFI crossing."""
+        n = len(device_paths)
+        if n == 0:
+            return []
+        paths = (ctypes.c_char_p * n)(*[p.encode() for p in device_paths])
+        codes = (ctypes.c_int * n)()
+        errnos = (ctypes.c_int * n)()
+        self._lib.tpu_probe_devices(paths, n, codes, errnos)
+        return [(codes[i], errnos[i]) for i in range(n)]
+
+    def scan_accel_indices(self, dev_dir: str) -> list[int] | None:
+        """Chip indices of accelN entries under dev_dir; None if unreadable."""
+        cap = 256
+        out = (ctypes.c_int * cap)()
+        n = self._lib.tpu_scan_accel_indices(dev_dir.encode(), out, cap)
+        if n < 0:
+            return None
+        if n > cap:  # absurdly many chips: retry with an exact buffer
+            cap = n
+            out = (ctypes.c_int * cap)()
+            n = self._lib.tpu_scan_accel_indices(dev_dir.encode(), out, cap)
+            if n < 0:
+                return None
+        return sorted(out[i] for i in range(min(n, cap)))
+
+
+def is_healthy_code(code: int) -> bool:
+    """True iff a probe code means the chip should be advertised Healthy."""
+    return code in _HEALTHY_CODES
+
+
+def load_prober(lib_path: str | None = None) -> NativeProber | None:
+    """Load libtpu_probe.so if available; None (with a debug log) otherwise."""
+    candidates = (
+        [lib_path]
+        if lib_path
+        else [os.environ.get("TPU_PROBE_LIB"), _REPO_LIB]
+    )
+    for candidate in candidates:
+        if not candidate or not os.path.exists(candidate):
+            continue
+        try:
+            return NativeProber(ctypes.CDLL(candidate), candidate)
+        # AttributeError: the .so loaded but lacks the expected symbols
+        # (stale/foreign library) — fall back, don't crash the daemon.
+        except (OSError, AttributeError) as e:
+            log.warning("failed to load native prober %s: %s", candidate, e)
+    log.debug("native prober unavailable; using pure-Python probes")
+    return None
+
+
+_shared: tuple[NativeProber | None] | None = None
+
+
+def shared_prober() -> NativeProber | None:
+    """Process-wide prober, loaded once (None is also cached)."""
+    global _shared
+    if _shared is None:
+        _shared = (load_prober(),)
+    return _shared[0]
+
+
+def build_probe_library(
+    out_path: str, source: str = _SOURCE, cc: str | None = None
+) -> str:
+    """Compile tpu_probe.c into a shared object (dev/test convenience; the
+    container image runs the same compile in its build stage)."""
+    compiler = cc or shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if compiler is None:
+        raise RuntimeError("no C compiler available to build libtpu_probe")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    subprocess.run(
+        [compiler, "-O2", "-Wall", "-fPIC", "-shared", "-o", out_path, source],
+        check=True,
+        capture_output=True,
+    )
+    return out_path

@@ -147,9 +147,7 @@ class TpuDevicePlugin:
         """Re-discover chips and re-check health; returns True if anything
         changed (and wakes every ListAndWatch stream)."""
         inventory = self._discover()
-        health = {
-            chip.k8s_id: self._health_checker.check(chip) for chip in inventory.chips
-        }
+        health = self._health_checker.check_many(inventory.chips)
         with self._cond:
             changed = (
                 self._inventory is None

@@ -1,0 +1,114 @@
+/* tpu_probe: native device-node probe helper for the TPU device plugin.
+ *
+ * The daemon's health loop probes every /dev/accelN node once per pulse
+ * (reference analogue: simpleHealthCheck's single open() of /dev/kfd at
+ * reference main.go:83-91, upgraded here to per-chip probes).  This shim
+ * performs the stat+open+close probe sequence — and the /dev directory scan
+ * used by discovery — in one C call each, so a high-frequency pulse costs a
+ * fixed handful of syscalls with no Python object churn, and the probe
+ * semantics (exact errno classification) are pinned in one place.
+ *
+ * Pure C, no dependencies; built as libtpu_probe.so and loaded via ctypes
+ * (k8s_device_plugin_tpu/plugin/native.py).  The Python implementation in
+ * plugin/health.py remains the behavioral reference and the fallback when
+ * the library is absent.
+ */
+
+#define _POSIX_C_SOURCE 200809L /* O_CLOEXEC under -std=c11 */
+
+#include <ctype.h>
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Probe result codes (mirrored in plugin/native.py). */
+#define TPU_PROBE_OK 0        /* openable: healthy and idle            */
+#define TPU_PROBE_BUSY 1      /* EBUSY/EACCES/EPERM: held by a workload */
+#define TPU_PROBE_MISSING 2   /* node does not exist                   */
+#define TPU_PROBE_WRONGTYPE 3 /* exists but not chardev/regular file   */
+#define TPU_PROBE_OPENFAIL 4  /* other open() failure                  */
+
+#define TPU_PROBE_ABI_VERSION 1
+
+int tpu_probe_abi_version(void) { return TPU_PROBE_ABI_VERSION; }
+
+/* Probe one device node.  Returns a TPU_PROBE_* code; *out_errno (optional)
+ * receives the errno of the failing syscall, 0 on success. */
+int tpu_probe_device(const char *path, int *out_errno) {
+  struct stat st;
+  if (out_errno != NULL) *out_errno = 0;
+  if (stat(path, &st) != 0) {
+    if (out_errno != NULL) *out_errno = errno;
+    return TPU_PROBE_MISSING;
+  }
+  /* Real nodes are chardevs; hermetic fixture trees use regular files. */
+  if (!S_ISCHR(st.st_mode) && !S_ISREG(st.st_mode)) {
+    return TPU_PROBE_WRONGTYPE;
+  }
+  int fd = open(path, O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd < 0) {
+    int e = errno;
+    if (out_errno != NULL) *out_errno = e;
+    /* libtpu holds the accel fd exclusively while a workload runs, so a
+     * busy/permission failure means the chip is alive and in use. */
+    if (e == EBUSY || e == EACCES || e == EPERM) return TPU_PROBE_BUSY;
+    return TPU_PROBE_OPENFAIL;
+  }
+  close(fd);
+  return TPU_PROBE_OK;
+}
+
+/* Probe a batch of nodes in one FFI crossing.  paths is an array of n
+ * C strings; codes (and optionally errnos) receive n results. */
+void tpu_probe_devices(const char *const *paths, int n, int *codes,
+                       int *errnos) {
+  for (int i = 0; i < n; i++) {
+    codes[i] = tpu_probe_device(paths[i], errnos != NULL ? &errnos[i] : NULL);
+  }
+}
+
+/* Scan a directory for accelN entries (discovery's /dev enumeration).
+ * Writes up to cap chip indices into out (unsorted, deduped by the kernel's
+ * own namespace) and returns the number found, or -1 on opendir failure.
+ * A count > cap means the caller's buffer was too small; indices beyond cap
+ * are counted but not stored. */
+int tpu_scan_accel_indices(const char *dev_dir, int *out, int cap) {
+  DIR *d = opendir(dev_dir);
+  if (d == NULL) return -1;
+  int n = 0;
+  struct dirent *ent;
+  while ((ent = readdir(d)) != NULL) {
+    const char *name = ent->d_name;
+    if (strncmp(name, "accel", 5) != 0) continue;
+    const char *digits = name + 5;
+    if (*digits == '\0') continue;
+    /* Exactly `accel` + decimal digits, same as the Python \d+ reference —
+     * strtol would also accept signs/whitespace ("accel+5"). */
+    long idx = 0;
+    int ok = 1;
+    for (const char *p = digits; *p != '\0'; p++) {
+      if (!isdigit((unsigned char)*p) || idx > 1000000) {
+        ok = 0;
+        break;
+      }
+      idx = idx * 10 + (*p - '0');
+    }
+    if (!ok) continue; /* e.g. accel0_foo, accel+5, "accel 7" */
+    if (n < cap) out[n] = (int)idx;
+    n++;
+  }
+  closedir(d);
+  return n;
+}
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif

@@ -1,0 +1,140 @@
+"""Native probe library (native/tpu_probe.c via plugin/native.py).
+
+Builds the shared object with the in-image C toolchain, then checks that the
+C probe/scan agree with the pure-Python implementations they accelerate
+(plugin/health.py, plugin/discovery.py) on the same fixture trees — the
+fake-backend-by-filesystem seam inherited from the reference's
+`countGPUDev(topoRootParam)` test design (reference main.go:52-56).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+
+import pytest
+
+from k8s_device_plugin_tpu.plugin import discovery, native
+from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
+
+from tests.fakes import make_fake_tpu_host
+
+pytestmark = pytest.mark.skipif(
+    not (shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")),
+    reason="no C compiler in environment",
+)
+
+
+@pytest.fixture(scope="module")
+def prober(tmp_path_factory) -> native.NativeProber:
+    lib = str(tmp_path_factory.mktemp("native") / "libtpu_probe.so")
+    native.build_probe_library(lib)
+    loaded = native.load_prober(lib)
+    assert loaded is not None, "built library failed to load"
+    return loaded
+
+
+def test_probe_codes_on_fixture(tmp_path, prober):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=2)
+
+    code, err = prober.probe(os.path.join(root, "dev/accel0"))
+    assert code == native.PROBE_OK and err == 0
+    assert native.is_healthy_code(code)
+
+    code, _ = prober.probe(os.path.join(root, "dev/accel99"))
+    assert code == native.PROBE_MISSING
+    assert not native.is_healthy_code(code)
+
+    os.mkdir(os.path.join(root, "dev/notadev"))
+    code, _ = prober.probe(os.path.join(root, "dev/notadev"))
+    assert code == native.PROBE_WRONGTYPE
+
+    # Unreadable node → BUSY (EACCES means "alive, exclusively held").
+    locked = os.path.join(root, "dev/accel1")
+    os.chmod(locked, 0)
+    try:
+        code, err = prober.probe(locked)
+        if os.geteuid() != 0:  # root bypasses mode bits
+            assert code == native.PROBE_BUSY
+            assert native.is_healthy_code(code)
+    finally:
+        os.chmod(locked, stat.S_IRUSR | stat.S_IWUSR)
+
+
+def test_probe_many_batches(tmp_path, prober):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=4)
+    paths = [os.path.join(root, f"dev/accel{i}") for i in range(4)]
+    paths.append(os.path.join(root, "dev/accel77"))
+    results = prober.probe_many(paths)
+    assert [c for c, _ in results] == [native.PROBE_OK] * 4 + [native.PROBE_MISSING]
+    assert prober.probe_many([]) == []
+
+
+def test_scan_matches_python_glob(tmp_path, prober):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=4)
+    # Distractors the scanner must ignore, same as discovery's regex.
+    open(os.path.join(root, "dev/accel2_renderD"), "w").close()
+    open(os.path.join(root, "dev/accelerometer"), "w").close()
+    open(os.path.join(root, "dev/null0"), "w").close()
+    # strtol-style parsing would accept these; the \d+ contract must not.
+    open(os.path.join(root, "dev/accel+5"), "w").close()
+    open(os.path.join(root, "dev/accel 7"), "w").close()
+
+    assert prober.scan_accel_indices(os.path.join(root, "dev")) == [0, 1, 2, 3]
+    assert prober.scan_accel_indices(os.path.join(root, "nosuchdir")) is None
+
+
+def test_health_checker_native_vs_python_parity(tmp_path, prober):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=2)
+    os.remove(os.path.join(root, "dev/accel1"))  # vanished chip
+    inv = discovery.discover(root=root, environ={})
+
+    with_native = ChipHealthChecker(root=root, prober=prober)
+    pure_python = ChipHealthChecker(root=root, prober=None)
+    # inv only holds surviving chips; probe the vanished one explicitly.
+    gone = discovery.TpuChip(index=1, device_path="/dev/accel1")
+    for chip in list(inv.chips) + [gone]:
+        assert with_native.check(chip) == pure_python.check(chip), chip
+
+    # Override files stay authoritative over the native probe result.
+    os.makedirs(os.path.join(root, "run/tpu/health"), exist_ok=True)
+    with open(os.path.join(root, "run/tpu/health/accel0"), "w") as f:
+        f.write("Unhealthy")
+    assert with_native.check(inv.chips[0]) is False
+
+
+def test_check_many_batch_parity(tmp_path, prober):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=4)
+    os.remove(os.path.join(root, "dev/accel2"))
+    os.makedirs(os.path.join(root, "run/tpu/health"), exist_ok=True)
+    with open(os.path.join(root, "run/tpu/health/accel3"), "w") as f:
+        f.write("Unhealthy")
+    chips = [
+        discovery.TpuChip(index=i, device_path=f"/dev/accel{i}") for i in range(4)
+    ]
+    batched = ChipHealthChecker(root=root, prober=prober).check_many(chips)
+    looped = ChipHealthChecker(root=root, prober=None).check_many(chips)
+    assert batched == looped == {
+        "tpu-0": True,
+        "tpu-1": True,
+        "tpu-2": False,  # device node vanished
+        "tpu-3": False,  # operator override
+    }
+
+
+def test_load_prober_rejects_foreign_library(tmp_path):
+    # A valid .so without our symbols must fall back (None), not raise.
+    src = tmp_path / "empty.c"
+    src.write_text("int unrelated_symbol(void) { return 0; }\n")
+    lib = str(tmp_path / "libforeign.so")
+    native.build_probe_library(lib, source=str(src))
+    assert native.load_prober(lib) is None
+
+
+def test_discovery_uses_native_scan(tmp_path, prober, monkeypatch):
+    root = make_fake_tpu_host(str(tmp_path), n_chips=4)
+    monkeypatch.setattr(native, "_shared", (prober,))
+    inv = discovery.discover(root=root, environ={})
+    assert inv.chip_count == 4
+    assert [c.index for c in inv.chips] == [0, 1, 2, 3]
