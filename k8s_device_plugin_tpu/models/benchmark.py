@@ -26,7 +26,8 @@ from .bert import Bert, BertConfig
 from .data import synthetic_image_batch, synthetic_token_batch
 from .resnet import ResNet50
 from .train import create_train_state, make_train_step
-from ..parallel.mesh import make_mesh
+from ..parallel import distributed
+from ..parallel.distributed import make_slice_mesh
 from ..parallel.sharding import shard_train_step
 
 
@@ -95,21 +96,16 @@ def main(argv: list[str] | None = None) -> None:
         except Exception as e:
             log(f"could not pin platform {env_platform!r}: {e}")
 
-    # Multi-host (k8s-job-resnet50-2host.yaml): stitch processes over DCN.
-    # Each pod got its host's chips from the plugin; jax.distributed makes
-    # jax.devices() global so the dp axis spans hosts.
-    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coordinator:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
-            process_id=int(os.environ["JAX_PROCESS_ID"]),
-        )
+    # Multi-host (k8s-job-resnet50-2host.yaml): stitch processes over DCN,
+    # derived from the plugin-injected TPU_WORKER_* env (or explicit JAX_*
+    # overrides — parallel/distributed.py).  jax.devices() then spans the
+    # slice and the dp axis crosses hosts.
+    if distributed.initialize():
         log(f"jax.distributed: process {jax.process_index()}/{jax.process_count()}")
 
     devices = jax.devices()
     log(f"devices: {[str(d) for d in devices]}")
-    mesh = make_mesh({"dp": args.dp, "mp": args.mp}, devices=devices)
+    mesh = make_slice_mesh({"dp": args.dp, "mp": args.mp})
     log(f"mesh: {dict(mesh.shape)}")
 
     rng = jax.random.PRNGKey(0)

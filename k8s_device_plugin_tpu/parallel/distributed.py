@@ -1,0 +1,152 @@
+"""Multi-host bootstrap: plugin-injected env -> jax.distributed process group.
+
+The device-plugin API is node-local (one gRPC socket per kubelet), so the
+reference has no cross-node path at all (SURVEY.md §2.4: its DaemonSet runs an
+independent plugin per node and "parallelism is the workload's problem").
+The TPU slice story instead rides on environment: the plugin's Allocate
+response injects TPU_WORKER_ID / TPU_WORKER_HOSTNAMES (plugin/envs.py,
+written from the node's /run/tpu drop-ins), and THIS module — imported by the
+workload inside the pod — turns that env into a `jax.distributed` process
+group over DCN, after which `jax.devices()` spans every chip in the slice and
+XLA collectives ride ICI within a host and DCN across hosts.
+
+Deployment analogue: deploy/k8s-job-resnet50-2host.yaml's two pods each call
+`initialize()` first thing; worker 0's pod hosts the coordinator.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+import jax
+
+from .mesh import make_mesh
+
+log = logging.getLogger(__name__)
+
+# jax's conventional coordinator port; overridable via env.
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class ProcessGroupConfig:
+    """Arguments for jax.distributed.initialize, derived from injected env."""
+
+    coordinator_address: str  # "host:port" of worker 0
+    num_processes: int
+    process_id: int
+
+
+def process_group_from_env(
+    environ: Mapping[str, str] | None = None,
+    coordinator_port: int | None = None,
+) -> ProcessGroupConfig | None:
+    """Derive the slice's process group from the plugin-injected environment.
+
+    Returns None when this pod is a single-host allocation (no
+    TPU_WORKER_HOSTNAMES, or a one-host list) — jax needs no process group
+    then.  Explicit JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID always win over the TPU_* derivation, so operators can
+    override without touching the plugin.
+    """
+    environ = os.environ if environ is None else environ
+    port = coordinator_port or int(
+        environ.get("JAX_COORDINATOR_PORT", DEFAULT_COORDINATOR_PORT)
+    )
+
+    explicit = environ.get("JAX_COORDINATOR_ADDRESS")
+    if explicit:
+        num = int(environ.get("JAX_NUM_PROCESSES", "0"))
+        pid = int(environ.get("JAX_PROCESS_ID", environ.get("TPU_WORKER_ID", "0")))
+        if num <= 0:
+            # Only a multi-host hostname list is a usable implicit count; a
+            # sub-host/fragmented allocation never gets one injected
+            # (plugin/envs.py), and silently defaulting to 1 would let worker
+            # 0 "succeed" solo while its peers crash or hang.
+            hostnames = _hostnames(environ)
+            if len(hostnames) <= 1:
+                raise ValueError(
+                    "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES is "
+                    "not, and no multi-host TPU_WORKER_HOSTNAMES to infer from"
+                )
+            num = len(hostnames)
+        address = explicit if ":" in explicit else f"{explicit}:{port}"
+        return ProcessGroupConfig(address, num, pid)
+
+    hostnames = _hostnames(environ)
+    if len(hostnames) <= 1:
+        return None
+    worker_id_text = environ.get("TPU_WORKER_ID", "0")
+    try:
+        worker_id = int(worker_id_text)
+    except ValueError:
+        # A malformed id must not silently become process 0: two processes
+        # claiming id 0 deadlocks group formation until the timeout.
+        raise ValueError(f"malformed TPU_WORKER_ID {worker_id_text!r}")
+    if not 0 <= worker_id < len(hostnames):
+        raise ValueError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hostnames)} worker hostnames"
+        )
+    return ProcessGroupConfig(
+        coordinator_address=f"{hostnames[0]}:{port}",
+        num_processes=len(hostnames),
+        process_id=worker_id,
+    )
+
+
+def _hostnames(environ: Mapping[str, str]) -> tuple[str, ...]:
+    text = environ.get("TPU_WORKER_HOSTNAMES", "")
+    return tuple(h.strip() for h in text.split(",") if h.strip())
+
+
+_initialized = False
+
+
+def initialize(
+    environ: Mapping[str, str] | None = None,
+    coordinator_port: int | None = None,
+    **kwargs,
+) -> bool:
+    """Join the slice's jax.distributed process group if the injected env
+    says this pod is part of a multi-host slice.  Idempotent; returns True
+    iff a process group is (now) active.  kwargs pass through to
+    jax.distributed.initialize (e.g. initialization_timeout)."""
+    global _initialized
+    if _initialized:
+        return True
+    config = process_group_from_env(environ, coordinator_port)
+    if config is None:
+        log.info("single-host allocation: no jax.distributed process group")
+        return False
+    log.info(
+        "joining process group: coordinator=%s, process %d/%d",
+        config.coordinator_address,
+        config.process_id,
+        config.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator_address,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+        **kwargs,
+    )
+    _initialized = True
+    return True
+
+
+def make_slice_mesh(
+    axes: Mapping[str, int] | None = None,
+    devices: Sequence | None = None,
+):
+    """Mesh over EVERY chip in the slice (all hosts), ordered host-major so
+    that intra-host mesh axes map to ICI and the leading (cross-host) axis to
+    DCN — shard batch over the leading axis, params/sequence over trailing
+    ones, and collectives ride the fast links.  Single-host this equals
+    make_mesh over local devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    devices.sort(key=lambda d: (d.process_index, d.id))
+    return make_mesh(axes, devices=devices)

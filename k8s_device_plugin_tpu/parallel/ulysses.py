@@ -1,0 +1,123 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second classic long-context layout (alongside ring attention, ring.py):
+instead of streaming kv shards around the ring, ONE all-to-all per tensor
+re-partitions [batch, heads, seq/n, head_dim] into [batch, heads/n, seq,
+head_dim] — every device then holds the FULL sequence for a SUBSET of heads,
+runs an ordinary (flash) attention locally with no inner-loop communication,
+and a reverse all-to-all restores sequence sharding.  Traffic is O(seq·d)
+per device in two bursts that XLA lowers to ICI all-to-alls, versus ring's
+n neighbor hops overlapped with compute; Ulysses wins when heads ≥ n and the
+all-to-all fits comfortably in ICI bisection bandwidth, ring wins for very
+long sequences or few heads.  (Pattern from the DeepSpeed-Ulysses paper;
+built here on jax.lax.all_to_all inside shard_map — the reference has no
+distributed compute at all, SURVEY.md §2.4.)
+
+Layering mirrors ring.py: `ulysses_attention` is the per-device body (call
+inside shard_map with the axis bound); `ulysses_self_attention` wraps a
+global array view over a Mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.flash_attention import flash_attention
+from .ring import _shard_map
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-device Ulysses body.
+
+    Local shard shapes [batch, heads, local_seq, head_dim]; global seq =
+    local_seq * n where n = size of ``axis_name``; heads must divide by n.
+    Must run inside shard_map (or pmap) with ``axis_name`` bound.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)  # concrete under shard_map
+    if q.shape[1] % n:
+        raise ValueError(
+            f"heads {q.shape[1]} not divisible by {axis_name}={n}; "
+            "use ring attention for head-poor long-context models"
+        )
+
+    def scatter_heads(x):
+        # [b, h, s/n, d] -> [b, h/n, s, d]: each device trades head blocks
+        # for sequence blocks with every ring peer in one all-to-all.
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def gather_heads(x):
+        # [b, h/n, s, d] -> [b, h, s/n, d]: the inverse exchange.
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    q_full = scatter_heads(q)
+    k_full = scatter_heads(k)
+    v_full = scatter_heads(v)
+    # Full-sequence attention on the owned heads via the O(seq)-memory flash
+    # kernel (ops/flash_attention.py): compiled Pallas on TPU, interpreter
+    # elsewhere — no [seq, seq] score matrix is ever materialized.
+    out_full = flash_attention(
+        q_full, k_full, v_full, causal=causal, sm_scale=sm_scale
+    )
+    return gather_heads(out_full)
+
+
+def ulysses_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Global-view wrapper: [batch, heads, seq, head_dim] arrays, sequence
+    sharded over ``mesh`` axis ``axis``; returns the same global shape.
+    Requires heads % mesh.shape[axis] == 0 (the head-scatter step)."""
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
+    if q.shape[1] % n:
+        raise ValueError(
+            f"heads {q.shape[1]} not divisible by {axis}={n}; "
+            "use ring attention for head-poor long-context models"
+        )
+    spec = P(None, None, axis, None)
+    body = functools.partial(
+        ulysses_attention, axis_name=axis, causal=causal, sm_scale=sm_scale
+    )
+    # The Pallas call inside the body reports no varying-manual-axes info on
+    # its outputs, so shard_map's vma checking must be off (check_rep on
+    # pre-0.8 jax spellings).
+    try:
+        shard_mapped = _shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    except TypeError:
+        shard_mapped = _shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+    sharding = NamedSharding(mesh, spec)
+    return shard_mapped(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )

@@ -1,0 +1,118 @@
+"""Multi-host bootstrap (parallel/distributed.py): env -> process group
+derivation, plus the host-major slice mesh on the virtual CPU devices."""
+
+import pytest
+
+from k8s_device_plugin_tpu.parallel import distributed
+from k8s_device_plugin_tpu.parallel.distributed import (
+    ProcessGroupConfig,
+    make_slice_mesh,
+    process_group_from_env,
+)
+
+
+def test_single_host_needs_no_group():
+    assert process_group_from_env({}) is None
+    assert process_group_from_env({"TPU_WORKER_HOSTNAMES": "only-host"}) is None
+
+
+def test_group_from_plugin_injected_env():
+    env = {
+        "TPU_WORKER_HOSTNAMES": "tpu-job-0.headless,tpu-job-1.headless",
+        "TPU_WORKER_ID": "1",
+    }
+    cfg = process_group_from_env(env)
+    assert cfg == ProcessGroupConfig(
+        coordinator_address="tpu-job-0.headless:8476",
+        num_processes=2,
+        process_id=1,
+    )
+
+
+def test_coordinator_port_override():
+    env = {
+        "TPU_WORKER_HOSTNAMES": "a,b,c,d",
+        "TPU_WORKER_ID": "2",
+        "JAX_COORDINATOR_PORT": "9999",
+    }
+    cfg = process_group_from_env(env)
+    assert cfg.coordinator_address == "a:9999"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+
+
+def test_explicit_jax_env_wins():
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "coord.svc:1234",
+        "JAX_NUM_PROCESSES": "16",
+        "JAX_PROCESS_ID": "5",
+        # Would derive a different group; must be ignored:
+        "TPU_WORKER_HOSTNAMES": "a,b",
+        "TPU_WORKER_ID": "0",
+    }
+    cfg = process_group_from_env(env)
+    assert cfg == ProcessGroupConfig("coord.svc:1234", 16, 5)
+
+
+def test_explicit_address_without_port_gets_default():
+    env = {"JAX_COORDINATOR_ADDRESS": "coord.svc", "TPU_WORKER_HOSTNAMES": "a,b"}
+    cfg = process_group_from_env(env)
+    assert cfg.coordinator_address == "coord.svc:8476"
+    assert cfg.num_processes == 2  # fell back to hostname count
+
+
+def test_explicit_address_without_any_count_raises():
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
+        process_group_from_env({"JAX_COORDINATOR_ADDRESS": "coord.svc"})
+
+
+def test_malformed_worker_id_raises():
+    env = {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "one"}
+    with pytest.raises(ValueError, match="malformed TPU_WORKER_ID"):
+        process_group_from_env(env)
+
+
+def test_worker_id_out_of_range_raises():
+    env = {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "7"}
+    with pytest.raises(ValueError, match="out of range"):
+        process_group_from_env(env)
+
+
+def test_initialize_noop_for_single_host(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    calls = []
+    monkeypatch.setattr(
+        distributed.jax.distributed,
+        "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    assert distributed.initialize({}) is False
+    assert calls == []
+
+
+def test_initialize_joins_group_once(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    calls = []
+    monkeypatch.setattr(
+        distributed.jax.distributed,
+        "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    env = {"TPU_WORKER_HOSTNAMES": "h0,h1", "TPU_WORKER_ID": "1"}
+    assert distributed.initialize(env) is True
+    assert distributed.initialize(env) is True  # idempotent: one real init
+    assert calls == [
+        {
+            "coordinator_address": "h0:8476",
+            "num_processes": 2,
+            "process_id": 1,
+        }
+    ]
+
+
+def test_slice_mesh_host_major_order():
+    # Single process: equals a mesh over local devices, host-major sort is a
+    # no-op but must not reorder within the host.
+    mesh = make_slice_mesh({"dp": 2, "mp": 4})
+    assert dict(mesh.shape) == {"dp": 2, "mp": 4}
+    flat = list(mesh.devices.flat)
+    assert [d.id for d in flat] == sorted(d.id for d in flat)
