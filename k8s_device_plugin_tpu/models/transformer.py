@@ -1,0 +1,295 @@
+"""Decoder-only transformer LM — the long-context flagship workload.
+
+The reference repo ships no model code at all (SURVEY.md §2.4: its "model" is
+an external benchmark container, k8s-pod-example-gpu.yaml:10-19); this family
+exists so the TPU plugin has a first-party long-context workload to allocate
+chips to.  TPU-first choices:
+
+- bfloat16 matmuls with float32 RMSNorm/softmax accumulation (MXU-friendly);
+- causal attention through the fused Pallas flash kernel
+  (ops/flash_attention.py) whenever the sequence tiles into 128-blocks,
+  plain-XLA oracle otherwise — both share parameters, checkpoints are
+  portable between paths;
+- rotary position embeddings (no learned position table to shard);
+- a `decode` mode with a KV cache carried in flax's ``cache`` collection so
+  autoregressive generation is a `lax`-scannable fixed-shape step;
+- parameter shapes laid out so Megatron-style tensor parallelism
+  (parallel/tensor.py) can split heads/ffn over a ``tp`` mesh axis, and
+  sequence parallelism (parallel/ring.py, parallel/ulysses.py) can split the
+  sequence over ``sp``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import flash_attention, mha_reference
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    intermediate_size: int = 5632
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        """Structural stand-in for CPU tests: every width divisible by small
+        tp/ep axis sizes, sequence lengths kept off the flash path."""
+        return GPTConfig(
+            vocab_size=512,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+            max_seq=128,
+            dtype=jnp.float32,
+        )
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm, computed in float32 regardless of input dtype."""
+
+    dtype: Any = jnp.bfloat16
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+def rope_angles(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embeddings, float32. positions: [...,seq]."""
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., seq, head_dim/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (x[2i], x[2i+1]); x: [batch, seq, heads, head_dim]."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    """Causal MHA with RoPE; fused flash kernel on 128-tileable sequences.
+
+    In ``decode`` mode a fixed-shape KV cache lives in the ``cache``
+    collection (cached_key/cached_value/cache_index), so a single-token step
+    has static shapes and is scannable under jit.
+    """
+
+    config: GPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.config
+        proj = {
+            name: nn.DenseGeneral(
+                features=(cfg.num_heads, cfg.head_dim),
+                dtype=cfg.dtype,
+                use_bias=False,
+                name=name,
+            )(hidden)
+            for name in ("query", "key", "value")
+        }  # each [batch, seq, heads, head_dim]
+        cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+        q = apply_rope(proj["query"], cos, sin)
+        k = apply_rope(proj["key"], cos, sin)
+        v = proj["value"]
+
+        if self.decode:
+            # Fixed-shape cache: [batch, max_seq, heads, head_dim].
+            batch = hidden.shape[0]
+            shape = (batch, cfg.max_seq, cfg.num_heads, cfg.head_dim)
+            ck = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            idx = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+            idx.value = cur + hidden.shape[1]
+            k, v = ck.value, cv.value
+            # Mask out cache slots at or beyond the write frontier.
+            key_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
+            q_pos = positions[:, None, :, None]  # [batch, 1, q_len, 1]
+            mask = key_pos <= q_pos
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) * (cfg.head_dim ** -0.5)
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        else:
+            qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            seq_len = hidden.shape[1]
+            if seq_len % 128 == 0:
+                attn = flash_attention(qh, kh, vh, causal=True)
+            else:
+                attn = mha_reference(qh, kh, vh, causal=True)
+            attn = attn.transpose(0, 2, 1, 3)
+
+        return nn.DenseGeneral(
+            features=cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            use_bias=False,
+            name="out",
+        )(attn)
+
+
+class SwiGluMlp(nn.Module):
+    """SwiGLU feed-forward: silu(gate(x)) * up(x) -> down."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False, name="gate")(x)
+        up = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False, name="up")(x)
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="down")(
+            nn.silu(gate) * up
+        )
+
+
+class DecoderBlock(nn.Module):
+    config: GPTConfig
+    decode: bool = False
+    mlp_factory: Optional[Any] = None  # swap-in point for MoE (parallel/moe.py)
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.config
+        attn = CausalSelfAttention(cfg, decode=self.decode, name="attn")(
+            RMSNorm(dtype=cfg.dtype, name="attn_norm")(hidden), positions
+        )
+        hidden = hidden + attn
+        mlp_mod = (
+            self.mlp_factory() if self.mlp_factory is not None else SwiGluMlp(cfg, name="mlp")
+        )
+        mlp = mlp_mod(RMSNorm(dtype=cfg.dtype, name="mlp_norm")(hidden))
+        return hidden + mlp
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: embed -> N pre-norm blocks -> RMSNorm -> vocab logits.
+
+    ``__call__(input_ids)`` returns [batch, seq, vocab] float32 logits.  In
+    ``decode`` mode pass ``positions`` (absolute positions of the provided
+    tokens) and keep the ``cache`` collection mutable.
+    """
+
+    config: GPTConfig
+    decode: bool = False
+    mlp_factory: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        seq_len = input_ids.shape[-1]
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(seq_len)[None, :], input_ids.shape
+            )
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(
+            input_ids
+        )
+        for i in range(cfg.num_layers):
+            hidden = DecoderBlock(
+                cfg, decode=self.decode, mlp_factory=self.mlp_factory, name=f"layer_{i}"
+            )(hidden, positions)
+        hidden = RMSNorm(dtype=cfg.dtype, name="final_norm")(hidden)
+        # Logits in float32 for a stable softmax/xent.
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head")(
+            hidden
+        )
+
+
+def greedy_generate(
+    config: GPTConfig,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Greedy autoregressive decode with the fixed-shape KV cache.
+
+    prompt: [batch, prompt_len] int32.  Returns [batch, prompt_len + new].
+    The whole loop is one jitted `lax.scan` over single-token steps — static
+    shapes throughout, no host round-trips.
+    """
+    model = TransformerLM(config, decode=True)
+    batch, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > config.max_seq:
+        # dynamic_update_slice would silently clamp cache writes past
+        # max_seq, overwriting the last slot — fail loudly instead.
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq {config.max_seq}"
+        )
+
+    # init() runs a forward pass, which writes its dummy token into the cache
+    # and advances cache_index — zero the whole collection so generation
+    # starts from an empty cache at index 0.
+    cache = jax.tree.map(
+        jnp.zeros_like,
+        model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch, 1), jnp.int32),
+        )["cache"],
+    )
+
+    @jax.jit
+    def run(params, prompt):
+        # Prefill one token at a time keeps a single compiled step; the
+        # prompt is short in benchmark configs.  [batch, 1] token steps.
+        def step(carry, t):
+            cache, tok = carry
+            pos = jnp.broadcast_to(t, (batch, 1))
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                tok,
+                pos,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+            # While still inside the prompt, feed the ground-truth token.
+            in_prompt = t + 1 < prompt_len
+            forced = jax.lax.dynamic_slice_in_dim(
+                prompt, jnp.minimum(t + 1, prompt_len - 1), 1, axis=1
+            )
+            nxt = jnp.where(in_prompt, forced, nxt)
+            return (mut["cache"], nxt), nxt[:, 0]
+
+        steps = prompt_len + max_new_tokens - 1
+        (_, _), toks = jax.lax.scan(
+            step, (cache, prompt[:, :1]), jnp.arange(steps)
+        )
+        seq = jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+        return seq
+
+    return run(params, prompt)

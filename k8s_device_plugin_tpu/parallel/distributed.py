@@ -60,7 +60,6 @@ def process_group_from_env(
     explicit = environ.get("JAX_COORDINATOR_ADDRESS")
     if explicit:
         num = int(environ.get("JAX_NUM_PROCESSES", "0"))
-        pid = int(environ.get("JAX_PROCESS_ID", environ.get("TPU_WORKER_ID", "0")))
         if num <= 0:
             # Only a multi-host hostname list is a usable implicit count; a
             # sub-host/fragmented allocation never gets one injected
@@ -73,6 +72,26 @@ def process_group_from_env(
                     "not, and no multi-host TPU_WORKER_HOSTNAMES to infer from"
                 )
             num = len(hostnames)
+        pid_text = environ.get("JAX_PROCESS_ID", environ.get("TPU_WORKER_ID"))
+        if pid_text is None:
+            if num > 1:
+                # Same duplicate-id-0 deadlock the implicit branch guards
+                # against: every worker would claim process 0.
+                raise ValueError(
+                    "JAX_COORDINATOR_ADDRESS is set with "
+                    f"JAX_NUM_PROCESSES={num} but neither JAX_PROCESS_ID nor "
+                    "TPU_WORKER_ID identifies this worker"
+                )
+            pid = 0
+        else:
+            try:
+                pid = int(pid_text)
+            except ValueError:
+                raise ValueError(
+                    f"malformed JAX_PROCESS_ID/TPU_WORKER_ID {pid_text!r}"
+                )
+        if not 0 <= pid < num:
+            raise ValueError(f"process id {pid} out of range for {num} processes")
         address = explicit if ":" in explicit else f"{explicit}:{port}"
         return ProcessGroupConfig(address, num, pid)
 

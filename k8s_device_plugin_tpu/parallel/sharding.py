@@ -79,15 +79,27 @@ def state_sharding(state: Any, mesh: Mesh, axis: str = "mp", **kwargs) -> Any:
     )
 
 
-def shard_train_step(train_step, mesh: Mesh, state: Any, batch: Any, axis_mp: str = "mp"):
+def shard_train_step(
+    train_step,
+    mesh: Mesh,
+    state: Any,
+    batch: Any,
+    axis_mp: str = "mp",
+    batch_axis: str = "dp",
+    state_sharding_fn=None,
+):
     """jit the train step with explicit in/out shardings and donated state.
 
     Returns ``(jitted_step, sharded_state, batch_shardings)``; the caller
     device_puts batches with ``batch_shardings`` (or relies on jit's implicit
-    transfer) and loops.
+    transfer) and loops.  ``state_sharding_fn`` overrides the default
+    FSDP-over-``axis_mp`` state layout (tensor.py passes its tp rules).
     """
-    state_sh = state_sharding(state, mesh, axis_mp)
-    batch_sh = batch_tree_sharding(batch, mesh)
+    if state_sharding_fn is None:
+        state_sh = state_sharding(state, mesh, axis_mp)
+    else:
+        state_sh = state_sharding_fn(state)
+    batch_sh = batch_tree_sharding(batch, mesh, batch_axis)
     placed_state = jax.device_put(state, state_sh)
     step = jax.jit(
         train_step,

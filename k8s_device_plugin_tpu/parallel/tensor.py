@@ -1,0 +1,146 @@
+"""Megatron-style tensor parallelism, expressed as GSPMD sharding rules.
+
+The reference has no parallelism code at all (SURVEY.md §2.4: the plugin's
+multi-device story ends at handing chips to pods); this module is the
+workload-side layer that makes an N-chip allocation compute as one model.
+TPU-first: no hand-written collectives — parameters are annotated with
+NamedShardings over a ``tp`` mesh axis and XLA inserts the all-reduces, which
+then ride the ICI links of the mesh block the plugin granted
+(plugin/topology.py keeps grants ICI-contiguous for exactly this reason).
+
+Layout (the classic Megatron column/row split, scaling-book recipe):
+
+- attention query/key/value kernels  [embed, heads, head_dim] -> heads over tp
+  (column-parallel: each chip owns a head group);
+- attention out kernel  [heads, head_dim, embed] -> heads over tp
+  (row-parallel: XLA all-reduces the partial outputs);
+- MLP gate/up kernels  [embed, ffn] -> ffn over tp (column-parallel);
+- MLP down kernel  [ffn, embed] -> ffn over tp (row-parallel);
+- token embedding  [vocab, embed] -> vocab over tp;
+- lm_head kernel  [embed, vocab] -> vocab over tp (sharded logits);
+- norms / scalars replicated.
+
+One forward+backward therefore needs exactly two all-reduces per block (attn
+out + mlp down) plus the gradient reduce over ``dp`` — the minimal-comms
+layout for a decoder block.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding import replicated, shard_train_step
+
+# (path regex, partition spec builder) — first match wins.  Specs are written
+# for models/transformer.py's parameter tree; the fallthrough replicates, so
+# foreign models degrade to pure data parallelism rather than breaking.
+_TP_RULES: tuple[tuple[str, Any], ...] = (
+    # MoE expert kernels (parallel/moe.py) first — their names would
+    # otherwise suffix-match the dense gate/up/down rules below.
+    (r"(^|/)experts_(gate|up)/kernel$", lambda tp: P("ep", None, tp)),
+    (r"(^|/)experts_down/kernel$", lambda tp: P("ep", tp, None)),
+    (r"(^|/)(query|key|value)/kernel$", lambda tp: P(None, tp, None)),
+    (r"(^|/)out/kernel$", lambda tp: P(tp, None, None)),
+    (r"(^|/)(gate|up)/kernel$", lambda tp: P(None, tp)),
+    (r"(^|/)down/kernel$", lambda tp: P(tp, None)),
+    (r"(^|/)embed/embedding$", lambda tp: P(tp, None)),
+    (r"(^|/)lm_head/kernel$", lambda tp: P(None, tp)),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", None)
+        if name is None:
+            name = getattr(entry, "idx", "")
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def tp_spec_for(
+    path_str: str,
+    leaf: Any,
+    axis_sizes: Mapping[str, int],
+    tp_axis: str = "tp",
+) -> P:
+    """PartitionSpec for one parameter leaf, by path rule.
+
+    ``axis_sizes`` maps mesh axis name -> size (i.e. ``dict(mesh.shape)``).
+    Falls back to replication when no rule matches, when the rule names a
+    mesh axis the mesh does not have (e.g. expert kernels on a dp/tp-only
+    mesh), or when a named dimension is not divisible by its axis size (tiny
+    test configs on big meshes) — foreign models degrade to pure data
+    parallelism rather than breaking.
+    """
+    for pattern, build in _TP_RULES:
+        if re.search(pattern, path_str):
+            spec = build(tp_axis)
+            for dim, name in enumerate(spec):
+                if name is None:
+                    continue
+                names = name if isinstance(name, tuple) else (name,)
+                for axis in names:
+                    if axis not in axis_sizes:
+                        return P()
+                    if dim >= getattr(leaf, "ndim", 0) or leaf.shape[dim] % axis_sizes[axis]:
+                        return P()
+            return spec
+    return P()
+
+
+def tp_param_sharding(params: Any, mesh: Mesh, tp_axis: str = "tp") -> Any:
+    """NamedSharding tree for a transformer parameter pytree (or any pytree
+    whose leaf paths end with the rule suffixes — optimizer moments mirror the
+    param dict structure, so the same function shards them)."""
+    axis_sizes = dict(mesh.shape)
+
+    def rule(path, leaf):
+        return NamedSharding(mesh, tp_spec_for(_path_str(path), leaf, axis_sizes, tp_axis))
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def tp_state_sharding(state: Any, mesh: Mesh, tp_axis: str = "tp") -> Any:
+    """Sharding tree for models.train.TrainState under tensor parallelism.
+
+    Optimizer moments are param-shaped subtrees whose key paths carry the same
+    suffixes, so the path rules apply transitively; scalar counts fall through
+    to replicated."""
+    return type(state)(
+        step=replicated(mesh),
+        params=tp_param_sharding(state.params, mesh, tp_axis),
+        opt_state=tp_param_sharding(state.opt_state, mesh, tp_axis),
+        batch_stats=tp_param_sharding(state.batch_stats, mesh, tp_axis),
+    )
+
+
+def shard_train_step_tp(
+    train_step,
+    mesh: Mesh,
+    state: Any,
+    batch: Any,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+):
+    """jit a train step with dp-sharded batch and tp-sharded parameters.
+
+    Returns ``(jitted_step, placed_state, batch_shardings)`` like
+    sharding.shard_train_step; gradients all-reduce over ``dp``, tensor
+    partials all-reduce over ``tp`` — both inserted by XLA from the
+    annotations, riding ICI.
+    """
+    return shard_train_step(
+        train_step,
+        mesh,
+        state,
+        batch,
+        batch_axis=dp_axis,
+        state_sharding_fn=lambda s: tp_state_sharding(s, mesh, tp_axis),
+    )

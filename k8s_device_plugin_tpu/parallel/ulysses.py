@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.flash_attention import flash_attention
+from ..ops.flash_attention import flash_attention, mha_reference
 from .ring import _shard_map
 
 
@@ -69,12 +69,21 @@ def ulysses_attention(
     q_full = scatter_heads(q)
     k_full = scatter_heads(k)
     v_full = scatter_heads(v)
-    # Full-sequence attention on the owned heads via the O(seq)-memory flash
-    # kernel (ops/flash_attention.py): compiled Pallas on TPU, interpreter
-    # elsewhere — no [seq, seq] score matrix is ever materialized.
-    out_full = flash_attention(
-        q_full, k_full, v_full, causal=causal, sm_scale=sm_scale
-    )
+    # Full-sequence attention on the owned heads.  128-tileable sequences go
+    # through the O(seq)-memory flash kernel (ops/flash_attention.py) — no
+    # [seq, seq] score matrix is ever materialized; anything else falls back
+    # to the plain-XLA oracle (same policy as models/transformer.py) instead
+    # of failing deep inside Pallas block validation.
+    seq_full = q_full.shape[2]
+    block = min(128, seq_full)
+    if seq_full % block == 0:
+        out_full = flash_attention(
+            q_full, k_full, v_full, causal=causal, sm_scale=sm_scale
+        )
+    else:
+        out_full = mha_reference(
+            q_full, k_full, v_full, causal=causal, sm_scale=sm_scale
+        )
     return gather_heads(out_full)
 
 

@@ -54,10 +54,33 @@ def test_explicit_jax_env_wins():
 
 
 def test_explicit_address_without_port_gets_default():
-    env = {"JAX_COORDINATOR_ADDRESS": "coord.svc", "TPU_WORKER_HOSTNAMES": "a,b"}
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "coord.svc",
+        "TPU_WORKER_HOSTNAMES": "a,b",
+        "TPU_WORKER_ID": "1",
+    }
     cfg = process_group_from_env(env)
     assert cfg.coordinator_address == "coord.svc:8476"
     assert cfg.num_processes == 2  # fell back to hostname count
+    assert cfg.process_id == 1
+
+
+def test_explicit_address_multiprocess_without_worker_id_raises():
+    """Every worker silently claiming process 0 would deadlock group
+    formation — the missing id must fail loudly instead."""
+    env = {"JAX_COORDINATOR_ADDRESS": "coord.svc", "JAX_NUM_PROCESSES": "4"}
+    with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+        process_group_from_env(env)
+
+
+def test_explicit_out_of_range_process_id_raises():
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "coord.svc",
+        "JAX_NUM_PROCESSES": "2",
+        "JAX_PROCESS_ID": "5",
+    }
+    with pytest.raises(ValueError, match="out of range"):
+        process_group_from_env(env)
 
 
 def test_explicit_address_without_any_count_raises():
