@@ -1,0 +1,93 @@
+"""Checkpoint/resume for training workloads, via orbax.
+
+The reference plugin is deliberately stateless (SURVEY.md §5.4: device
+assignments are the kubelet's checkpoint, not the plugin's), so this module
+serves the *workload* side: a pod whose chips are reclaimed (health fault,
+preemption, node drain) must resume from its last step rather than restart.
+Orbax handles the TPU-native concerns — async device-to-host transfer,
+multi-host coordination over the jax.distributed group (parallel/
+distributed.py), and restoring arrays directly INTO their NamedShardings so
+a resumed run never materializes the full state on one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from .train import TrainState
+
+
+class CheckpointManager:
+    """Thin policy wrapper over ocp.CheckpointManager for TrainState.
+
+    - keeps the newest ``max_to_keep`` steps;
+    - ``save`` is async (device-to-host copy happens in the background;
+      training continues immediately);
+    - ``restore`` places every array according to ``target`` — pass the
+      abstract/sharded state from shard_train_step so leaves land sharded.
+    """
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            os.fspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=True
+            ),
+        )
+
+    @property
+    def directory(self) -> str:
+        return os.fspath(self._mgr.directory)
+
+    def save(self, state: TrainState, *, force: bool = False) -> bool:
+        """Queue an async save at the state's current step."""
+        return self._mgr.save(
+            int(jax.device_get(state.step)),
+            args=ocp.args.StandardSave(state),
+            force=force,
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, target: TrainState, step: Optional[int] = None) -> TrainState:
+        """Restore ``step`` (default: latest) shaped/sharded like ``target``.
+
+        ``target`` may be a concrete state (its shardings are reused) or an
+        abstract one built with jax.eval_shape + NamedShardings.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_once(directory: str | os.PathLike, state: TrainState) -> None:
+    """One-shot synchronous save (benchmark/export convenience)."""
+    with CheckpointManager(directory, max_to_keep=1) as mgr:
+        mgr.save(state, force=True)
+
+
+def restore_latest(directory: str | os.PathLike, target: TrainState) -> TrainState:
+    """One-shot restore of the newest step under ``directory``."""
+    with CheckpointManager(directory) as mgr:
+        return mgr.restore(target)

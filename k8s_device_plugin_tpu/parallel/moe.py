@@ -1,0 +1,142 @@
+"""Mixture-of-experts with expert parallelism, GShard/Switch style.
+
+The reference has no model or parallelism code (SURVEY.md §2.4); this is the
+expert-parallel member of the workload family the TPU plugin allocates chips
+to.  TPU-first design: routing is dense one-hot dispatch/combine einsums with
+fully static shapes — no gather/scatter, no data-dependent control flow — so
+XLA tiles everything onto the MXU; the expert dimension of the kernels is
+annotated over an ``ep`` mesh axis (parallel/tensor.py's ``experts_*`` rules)
+and GSPMD lowers the dispatch einsums to all-to-alls over ICI, exactly the
+GShard recipe.
+
+Capacity model: each expert processes at most ``capacity_factor *
+tokens_per_group / num_experts`` tokens per group (group = one sequence);
+over-capacity tokens fall through the residual connection (their combine
+weight is zero), keeping shapes static at the cost of dropped-token error —
+standard for Switch/GShard training.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import GPTConfig
+
+
+class MoeMlp(nn.Module):
+    """Drop-in replacement for models.transformer.SwiGluMlp.
+
+    Parameters (shapes chosen for parallel/tensor.py's sharding rules):
+      router/kernel        [embed, experts]            (replicated)
+      experts_gate/kernel  [experts, embed, ffn]       (ep, -, tp)
+      experts_up/kernel    [experts, embed, ffn]       (ep, -, tp)
+      experts_down/kernel  [experts, ffn, embed]       (ep, tp, -)
+    """
+
+    config: GPTConfig
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if x.ndim == 2:  # tolerate [tokens, embed] by adding a group dim
+            x = x[None]
+            squeeze = True
+        else:
+            squeeze = False
+        g, s, d = x.shape
+        e = self.num_experts
+        capacity = max(1, math.ceil(self.capacity_factor * s / e))
+
+        # --- routing (float32 for a stable softmax) ---------------------
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(x.astype(jnp.float32))  # [g, s, e]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+
+        # Top-k one-hot assignment, k selections in sequence (k is tiny and
+        # static, so this unrolled Python loop is compiler-friendly).
+        combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+        remaining = probs
+        # Running per-expert fill count, advanced after each selection round.
+        fill = jnp.zeros((g, e), jnp.int32)
+        for _ in range(self.experts_per_token):
+            choice = jnp.argmax(remaining, axis=-1)  # [g, s]
+            onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [g, s, e]
+            # Position of each token within its chosen expert's buffer this
+            # round: tokens earlier in the sequence fill earlier slots.
+            pos_in_round = (jnp.cumsum(onehot, axis=1) - onehot)  # [g, s, e]
+            pos = pos_in_round + fill[:, None, :]
+            pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [g, s]
+            keep = (pos_tok < capacity).astype(jnp.float32)  # [g, s]
+            weight = jnp.sum(remaining * onehot, axis=-1) * keep  # [g, s]
+            slot = jax.nn.one_hot(
+                jnp.minimum(pos_tok, capacity - 1), capacity, dtype=jnp.float32
+            )  # [g, s, c]
+            combine = combine + (
+                weight[..., None, None] * onehot[..., :, None] * slot[..., None, :]
+            )
+            fill = fill + jnp.sum(onehot * keep[..., None], axis=1).astype(jnp.int32)
+            remaining = remaining * (1.0 - onehot)
+
+        # Normalize the kept gates so the combine weights of each token sum
+        # to 1 (unless everything it picked was over capacity).
+        total = jnp.sum(combine, axis=(-2, -1), keepdims=True)
+        combine = jnp.where(total > 0, combine / jnp.maximum(total, 1e-9), 0.0)
+        dispatch = (combine > 0).astype(x.dtype)  # [g, s, e, c]
+
+        # Load-balance auxiliary loss (GShard eq.4): mean fraction of tokens
+        # per expert * mean router prob per expert, scaled by e².
+        frac_tokens = jnp.mean(dispatch.sum(axis=-1), axis=1)  # [g, e]
+        frac_probs = jnp.mean(probs, axis=1)  # [g, e]
+        aux = jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1)) * e
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        # --- dispatch -> expert SwiGLU -> combine ------------------------
+        ffn = cfg.intermediate_size
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate", lambda r: init(r, (e, d, ffn))).astype(cfg.dtype)
+        w_up = self.param("experts_up", lambda r: init(r, (e, d, ffn))).astype(cfg.dtype)
+        w_down = self.param("experts_down", lambda r: init(r, (e, ffn, d))).astype(cfg.dtype)
+
+        # expert_in: [e, g, c, d] — GSPMD turns this einsum into the
+        # all-to-all that ships token slots to their expert's ep shard.
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, x)
+        gate = jnp.einsum("egcd,edf->egcf", expert_in, w_gate)
+        up = jnp.einsum("egcd,edf->egcf", expert_in, w_up)
+        act = nn.silu(gate) * up
+        expert_out = jnp.einsum("egcf,efd->egcd", act, w_down)
+        out = jnp.einsum(
+            "gsec,egcd->gsd", combine.astype(expert_out.dtype), expert_out
+        )
+        out = out.astype(cfg.dtype)
+        return out[0] if squeeze else out
+
+
+def moe_mlp_factory(
+    config: GPTConfig,
+    num_experts: int = 8,
+    experts_per_token: int = 2,
+    capacity_factor: float = 1.25,
+):
+    """mlp_factory for models.transformer.DecoderBlock / TransformerLM:
+    ``TransformerLM(cfg, mlp_factory=moe_mlp_factory(cfg, 8))`` builds a
+    fully MoE decoder."""
+
+    def factory():
+        return MoeMlp(
+            config,
+            num_experts=num_experts,
+            experts_per_token=experts_per_token,
+            capacity_factor=capacity_factor,
+            name="moe",
+        )
+
+    return factory

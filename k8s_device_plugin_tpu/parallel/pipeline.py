@@ -1,0 +1,143 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+The reference has no parallelism code (SURVEY.md §2.4); this is the pipeline
+member of the workload-side parallel layer (alongside tensor.py, ring.py,
+ulysses.py, moe.py).  TPU-first shape: the pipeline is a *spatial* program —
+every device holds ONE stage's parameters permanently, activations flow
+stage-to-stage with ``lax.ppermute`` over neighbor ICI links, and the whole
+schedule is a single ``lax.scan`` inside ``shard_map`` (static trip count
+``n_micro + n_stages - 1``, no Python-level orchestration, one compiled
+program).  Autodiff of the scan gives the classic GPipe backward schedule
+for free — fill-drain bubbles and all — so a pipelined train step is just
+``jax.grad`` around :func:`pipeline_apply`.
+
+Zero-bubble/1F1B refinements trade this simplicity for schedule control;
+GPipe is the right first rung and its bubble fraction
+``(n_stages-1)/(n_micro+n_stages-1)`` vanishes with enough microbatches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring import _shard_map
+
+
+def stack_stage_params(stage_params: list) -> Any:
+    """Stack per-stage parameter pytrees along a new leading stage axis.
+
+    All stages must share a tree structure and leaf shapes (same layer type
+    per stage — the GPipe regime)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run ``microbatches`` through ``n_stages`` chained applications of
+    ``stage_fn``, one stage per device along ``axis``.
+
+    Args:
+      stage_fn: ``(one_stage_params, x) -> y`` with ``y.shape == x.shape``
+        (chainable stages; wrap embed/head outside the pipelined region).
+      stacked_params: pytree whose leaves have leading dim ``n_stages``
+        (:func:`stack_stage_params`); sharded over ``axis``.
+      microbatches: ``[n_micro, ...]`` activation stream (replicated).
+      mesh: mesh whose ``axis`` size equals ``n_stages``.
+
+    Returns ``[n_micro, ...]`` outputs of the final stage, replicated.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(
+            f"stacked_params lead dim {lead} != mesh axis {axis}={n_stages}"
+        )
+
+    def body(params_local, stream):
+        # params_local leaves: [1, ...] (this device's stage); stream is the
+        # full microbatch array (replicated input).
+        params_me = jax.tree.map(lambda leaf: leaf[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        ticks = n_micro + n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        x_shape = stream.shape[1:]
+        init_carry = (
+            jnp.zeros(x_shape, stream.dtype),  # activation arriving from the left
+            jnp.zeros((n_micro,) + x_shape, stream.dtype),  # output accumulator
+        )
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # Stage 0 ingests microbatch t (clamped; ticks past the stream
+            # feed dead data that is never collected).
+            feed = jax.lax.dynamic_index_in_dim(
+                stream, jnp.minimum(t, n_micro - 1), 0, keepdims=False
+            )
+            x = jnp.where(stage == 0, feed, incoming)
+            y = stage_fn(params_me, x)
+            # The last stage completes microbatch t - (n_stages-1) at tick t.
+            done_idx = t - (n_stages - 1)
+            is_last = stage == n_stages - 1
+            collect = jnp.logical_and(is_last, done_idx >= 0)
+            outputs = jax.lax.cond(
+                collect,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(done_idx, 0), 0
+                ),
+                lambda o: o,
+                outputs,
+            )
+            incoming = jax.lax.ppermute(y, axis, fwd_perm)
+            return (incoming, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, init_carry, jnp.arange(ticks))
+        # Only the last stage holds real outputs; zero the rest and psum so
+        # every device returns the replicated result.
+        outputs = jnp.where(stage == n_stages - 1, outputs, 0)
+        return jax.lax.psum(outputs, axis)
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        P(),
+    )
+    try:
+        fn = _shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
+        )
+    except TypeError:
+        fn = _shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False
+        )
+    return fn(stacked_params, microbatches)
+
+
+def pipelined_loss_fn(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    axis: str = "pp",
+    loss: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
+):
+    """Build ``(stacked_params, microbatches, targets) -> scalar`` suitable
+    for ``jax.grad``: pipeline forward, then mean loss over all microbatches
+    (targets shaped like the pipeline output).  Default loss: MSE."""
+
+    if loss is None:
+        loss = lambda y, t: jnp.mean((y.astype(jnp.float32) - t.astype(jnp.float32)) ** 2)
+
+    def fn(stacked_params, microbatches, targets):
+        y = pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis)
+        return loss(y, targets)
+
+    return fn

@@ -39,10 +39,10 @@ from .sharding import replicated, shard_train_step
 # for models/transformer.py's parameter tree; the fallthrough replicates, so
 # foreign models degrade to pure data parallelism rather than breaking.
 _TP_RULES: tuple[tuple[str, Any], ...] = (
-    # MoE expert kernels (parallel/moe.py) first — their names would
-    # otherwise suffix-match the dense gate/up/down rules below.
-    (r"(^|/)experts_(gate|up)/kernel$", lambda tp: P("ep", None, tp)),
-    (r"(^|/)experts_down/kernel$", lambda tp: P("ep", tp, None)),
+    # MoE expert kernels (parallel/moe.py, bare-param leaves) first — their
+    # names would otherwise suffix-match the dense gate/up/down rules below.
+    (r"(^|/)experts_(gate|up)$", lambda tp: P("ep", None, tp)),
+    (r"(^|/)experts_down$", lambda tp: P("ep", tp, None)),
     (r"(^|/)(query|key|value)/kernel$", lambda tp: P(None, tp, None)),
     (r"(^|/)out/kernel$", lambda tp: P(tp, None, None)),
     (r"(^|/)(gate|up)/kernel$", lambda tp: P(None, tp)),

@@ -45,10 +45,13 @@ def test_spec_rules():
     assert tp_spec_for("layer_0/attn/query/kernel", Leaf((64, 3, 16)), sizes) == P()
     # Expert kernels on a mesh WITHOUT an ep axis replicate instead of
     # referencing an axis the mesh doesn't have.
-    assert tp_spec_for("layer_1/moe/experts_gate/kernel", Leaf((8, 64, 128)), sizes) == P()
+    assert tp_spec_for("layer_1/moe/experts_gate", Leaf((8, 64, 128)), sizes) == P()
     with_ep = {"dp": 2, "tp": 2, "ep": 2}
-    assert tp_spec_for("layer_1/moe/experts_gate/kernel", Leaf((8, 64, 128)), with_ep) == P(
+    assert tp_spec_for("layer_1/moe/experts_gate", Leaf((8, 64, 128)), with_ep) == P(
         "ep", None, "tp"
+    )
+    assert tp_spec_for("layer_1/moe/experts_down", Leaf((8, 128, 64)), with_ep) == P(
+        "ep", "tp", None
     )
 
 
