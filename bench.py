@@ -52,6 +52,81 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 5) -> float:
     return ips
 
 
+def bench_lm_train() -> float | None:
+    """Secondary: decoder-LM training tokens/sec on one chip (stderr only)."""
+    try:
+        from k8s_device_plugin_tpu.models.transformer import GPTConfig, TransformerLM
+
+        platform = jax.devices()[0].platform
+        if platform == "cpu":
+            cfg = GPTConfig.tiny()
+            batch_size, seq, steps, warmup = 4, 64, 3, 1
+        else:
+            cfg = GPTConfig(
+                vocab_size=32000,
+                hidden_size=1024,
+                num_layers=8,
+                num_heads=16,
+                intermediate_size=2816,
+                max_seq=1024,
+            )
+            batch_size, seq, steps, warmup = 8, 1024, 20, 5
+        model = TransformerLM(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (batch_size, seq + 1), 0, cfg.vocab_size)
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        tx = optax.adamw(1e-3)
+        state = create_train_state(rng, model, batch, tx, input_key="input_ids")
+        step = jax.jit(make_train_step(model, tx, input_key="input_ids"), donate_argnums=0)
+        state, loss, dt = timed_steps(step, state, batch, warmup, steps)
+        tps = batch_size * seq * steps / dt
+        log(f"transformer-lm b{batch_size} s{seq}: {tps:.0f} tokens/sec (loss {float(loss):.3f})")
+        return tps
+    except Exception as e:  # secondary metrics must never kill the bench
+        log(f"lm bench failed: {e}")
+        return None
+
+
+def bench_flash_attention() -> float | None:
+    """Secondary: fused flash kernel speedup over plain-XLA attention."""
+    try:
+        from k8s_device_plugin_tpu.ops.flash_attention import (
+            flash_attention,
+            mha_reference,
+        )
+
+        platform = jax.devices()[0].platform
+        if platform == "cpu":
+            shape = (1, 2, 256, 64)  # interpreter mode: keep it tiny
+            iters = 2
+        else:
+            shape = (4, 16, 2048, 64)
+            iters = 20
+        q = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
+        flash = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+        ref = jax.jit(lambda q: mha_reference(q, q, q, causal=True))
+        for fn in (flash, ref):
+            jax.block_until_ready(fn(q))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = flash(q)
+        jax.block_until_ready(out)
+        t_flash = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = ref(q)
+        jax.block_until_ready(out)
+        t_ref = time.perf_counter() - t0
+        log(
+            f"flash-attention {shape}: {t_flash/iters*1e3:.2f} ms vs XLA "
+            f"{t_ref/iters*1e3:.2f} ms ({t_ref/max(t_flash,1e-9):.2f}x)"
+        )
+        return t_ref / max(t_flash, 1e-9)
+    except Exception as e:
+        log(f"flash-attention bench failed: {e}")
+        return None
+
+
 def bench_allocation_latency() -> float | None:
     """Secondary metric from BASELINE.json: chip-allocation latency through
     the actual plugin gRPC path (fixture-backed, no cluster needed)."""
@@ -106,6 +181,8 @@ def bench_allocation_latency() -> float | None:
 
 def main() -> None:
     ips = bench_resnet50(batch_size=128)
+    bench_lm_train()
+    bench_flash_attention()
     bench_allocation_latency()
     print(
         json.dumps(

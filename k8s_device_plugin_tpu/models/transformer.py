@@ -41,6 +41,10 @@ class GPTConfig:
     max_seq: int = 4096
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # Rematerialize each decoder block in the backward pass (jax.checkpoint):
+    # trades recompute FLOPs for activation HBM — the standard long-context
+    # memory lever alongside sequence parallelism.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -101,6 +105,11 @@ class CausalSelfAttention(nn.Module):
 
     config: GPTConfig
     decode: bool = False
+    # Optional override for the core attention computation, signature
+    # ``(q, k, v, causal=..., sm_scale=...) -> out`` on [batch, heads, seq,
+    # head_dim] — the hook parallel/sequence.py uses to swap in ring or
+    # Ulysses sequence-parallel attention.  Ignored in decode mode.
+    attention_fn: Optional[Any] = None
 
     @nn.compact
     def __call__(self, hidden, positions):
@@ -146,7 +155,9 @@ class CausalSelfAttention(nn.Module):
         else:
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]
-            if seq_len % 128 == 0:
+            if self.attention_fn is not None:
+                attn = self.attention_fn(qh, kh, vh, causal=True)
+            elif seq_len % 128 == 0:
                 attn = flash_attention(qh, kh, vh, causal=True)
             else:
                 attn = mha_reference(qh, kh, vh, causal=True)
@@ -180,11 +191,14 @@ class DecoderBlock(nn.Module):
     config: GPTConfig
     decode: bool = False
     mlp_factory: Optional[Any] = None  # swap-in point for MoE (parallel/moe.py)
+    attention_fn: Optional[Any] = None
 
     @nn.compact
     def __call__(self, hidden, positions):
         cfg = self.config
-        attn = CausalSelfAttention(cfg, decode=self.decode, name="attn")(
+        attn = CausalSelfAttention(
+            cfg, decode=self.decode, attention_fn=self.attention_fn, name="attn"
+        )(
             RMSNorm(dtype=cfg.dtype, name="attn_norm")(hidden), positions
         )
         hidden = hidden + attn
@@ -206,6 +220,7 @@ class TransformerLM(nn.Module):
     config: GPTConfig
     decode: bool = False
     mlp_factory: Optional[Any] = None
+    attention_fn: Optional[Any] = None
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -218,9 +233,16 @@ class TransformerLM(nn.Module):
         hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(
             input_ids
         )
+        block_cls = (
+            nn.remat(DecoderBlock, static_argnums=()) if cfg.remat else DecoderBlock
+        )
         for i in range(cfg.num_layers):
-            hidden = DecoderBlock(
-                cfg, decode=self.decode, mlp_factory=self.mlp_factory, name=f"layer_{i}"
+            hidden = block_cls(
+                cfg,
+                decode=self.decode,
+                mlp_factory=self.mlp_factory,
+                attention_fn=self.attention_fn,
+                name=f"layer_{i}",
             )(hidden, positions)
         hidden = RMSNorm(dtype=cfg.dtype, name="final_norm")(hidden)
         # Logits in float32 for a stable softmax/xent.

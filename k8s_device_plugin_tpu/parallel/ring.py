@@ -54,13 +54,16 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    extra_varying: tuple = (),
 ) -> jax.Array:
     """Per-device ring attention body.
 
     Shapes are the LOCAL shards: [batch, heads, local_seq, head_dim], where
     global seq = local_seq * mesh.shape[axis_name] and shard i owns global
     positions [i*local_seq, (i+1)*local_seq).  Must run inside ``shard_map``
-    (or ``pmap``) with ``axis_name`` bound.
+    (or ``pmap``) with ``axis_name`` bound.  ``extra_varying`` names any
+    other manual axes the inputs are sharded over (dp/tp in a composed
+    mesh), so the scan carry's varying-axis types line up.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -108,15 +111,16 @@ def ring_attention(
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m_new, l_new, acc_new), None
 
-    # The initial state is device-invariant; mark it as varying over the ring
-    # axis so the scan carry types line up (shard_map tracks varying axes).
+    # The initial state is device-invariant; mark it as varying over every
+    # manual axis the inputs vary over so the scan carry types line up
+    # (shard_map tracks varying axes).
     m0, l0, acc0 = _mark_varying(
         (
             jnp.full((batch, heads, seq_q, 1), NEG_INF, f32),
             jnp.zeros((batch, heads, seq_q, 1), f32),
             jnp.zeros(qf.shape, f32),
         ),
-        axis_name,
+        (axis_name,) + tuple(extra_varying),
     )
     (_, _, _, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n)
@@ -133,15 +137,27 @@ def ring_self_attention(
     axis: str = "sp",
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Global-view wrapper: [batch, heads, seq, head_dim] arrays, sequence
-    sharded over ``mesh`` axis ``axis``; returns the same global shape."""
+    sharded over ``mesh`` axis ``axis``; returns the same global shape.
+
+    ``batch_axis``/``head_axis`` name mesh axes the batch/head dims are
+    already sharded over (dp / tp in a composed mesh) so the engine keeps
+    those dims sharded instead of all-gathering them at the shard_map
+    boundary — the ring only ever communicates over ``axis``.
+    """
     n = mesh.shape[axis]
     if q.shape[2] % n:
         raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
-        ring_attention, axis_name=axis, causal=causal, sm_scale=sm_scale
+        ring_attention,
+        axis_name=axis,
+        causal=causal,
+        sm_scale=sm_scale,
+        extra_varying=tuple(a for a in (batch_axis, head_axis) if a),
     )
     shard_mapped = _shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

@@ -1,0 +1,101 @@
+"""Long-context training: sequence parallelism wired into the decoder LM.
+
+Ties the two sp attention engines (ring.py: neighbor-hop kv rotation;
+ulysses.py: all-to-all head/sequence exchange) into
+models/transformer.TransformerLM through its ``attention_fn`` hook, and
+builds train steps whose BATCH is sharded over ``dp`` and SEQUENCE over
+``sp`` — the layout that makes million-token contexts fit: every
+positionwise op (embeddings, norms, MLPs, losses) runs on its local
+sequence shard under GSPMD, and only attention communicates, through the
+explicit shard_map engines riding ICI.
+
+The reference has nothing remotely comparable (SURVEY.md §5.7: "long-context
+/ sequence parallelism — absent, nothing to scale"); this module exists
+because the TPU build treats long context as first-class.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring import ring_self_attention
+from .sharding import shard_train_step
+from .tensor import tp_state_sharding
+from .ulysses import ulysses_self_attention
+
+_ENGINES = {
+    "ulysses": ulysses_self_attention,
+    "ring": ring_self_attention,
+}
+
+
+def sp_attention_fn(
+    mesh: Mesh,
+    axis: str = "sp",
+    kind: str = "ulysses",
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+):
+    """Attention override for TransformerLM(attention_fn=...): exact causal
+    attention over a sequence sharded on ``axis``.
+
+    kind="ulysses": one all-to-all per tensor, needs local heads % sp == 0 —
+    wins when heads are plentiful and the exchange fits ICI bisection
+    bandwidth.  kind="ring": kv shards rotate around the ring, any head
+    count — wins for very long sequences or head-poor models.  Both are
+    exact, so checkpoints and losses are interchangeable with the dense path.
+
+    When the mesh also has ``dp_axis``/``tp_axis``, the batch/head dims stay
+    sharded over them through the engine (no all-gather at the shard_map
+    boundary) — attention compute and memory per device really is
+    batch/dp × heads/tp × seq/sp.
+    """
+    try:
+        engine = _ENGINES[kind]
+    except KeyError:
+        raise ValueError(f"unknown sp attention kind {kind!r}; use {sorted(_ENGINES)}")
+    return functools.partial(
+        engine,
+        mesh=mesh,
+        axis=axis,
+        batch_axis=dp_axis if dp_axis in mesh.axis_names else None,
+        head_axis=tp_axis if tp_axis in mesh.axis_names else None,
+    )
+
+
+def sp_batch_sharding(batch: Any, mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"):
+    """[batch, seq] token arrays sharded batch-over-dp, sequence-over-sp."""
+    return jax.tree.map(lambda _: NamedSharding(mesh, P(dp_axis, sp_axis)), batch)
+
+
+def shard_train_step_sp(
+    train_step,
+    mesh: Mesh,
+    state: Any,
+    batch: Any,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+):
+    """jit a TransformerLM train step with dp×sp input sharding.
+
+    The model must have been built with ``attention_fn=sp_attention_fn(mesh,
+    sp_axis, ...)`` — positionwise compute then follows the input sharding
+    under GSPMD while attention communicates through the explicit engine.
+    Parameters follow tensor.py's tp rules (replicated when the mesh has no
+    ``tp`` axis), so sp composes freely with tensor parallelism.
+
+    Returns ``(jitted_step, placed_state, batch_shardings)``.
+    """
+    return shard_train_step(
+        train_step,
+        mesh,
+        state,
+        batch,
+        state_sharding_fn=lambda s: tp_state_sharding(s, mesh, tp_axis),
+        batch_sharding_fn=lambda b: sp_batch_sharding(b, mesh, dp_axis, sp_axis),
+    )

@@ -87,19 +87,25 @@ def shard_train_step(
     axis_mp: str = "mp",
     batch_axis: str = "dp",
     state_sharding_fn=None,
+    batch_sharding_fn=None,
 ):
     """jit the train step with explicit in/out shardings and donated state.
 
     Returns ``(jitted_step, sharded_state, batch_shardings)``; the caller
     device_puts batches with ``batch_shardings`` (or relies on jit's implicit
     transfer) and loops.  ``state_sharding_fn`` overrides the default
-    FSDP-over-``axis_mp`` state layout (tensor.py passes its tp rules).
+    FSDP-over-``axis_mp`` state layout (tensor.py passes its tp rules);
+    ``batch_sharding_fn`` overrides the batch-over-``batch_axis`` input
+    layout (sequence.py passes dp×sp).
     """
     if state_sharding_fn is None:
         state_sh = state_sharding(state, mesh, axis_mp)
     else:
         state_sh = state_sharding_fn(state)
-    batch_sh = batch_tree_sharding(batch, mesh, batch_axis)
+    if batch_sharding_fn is None:
+        batch_sh = batch_tree_sharding(batch, mesh, batch_axis)
+    else:
+        batch_sh = batch_sharding_fn(batch)
     placed_state = jax.device_put(state, state_sh)
     step = jax.jit(
         train_step,

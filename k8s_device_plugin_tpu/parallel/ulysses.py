@@ -95,19 +95,29 @@ def ulysses_self_attention(
     axis: str = "sp",
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Global-view wrapper: [batch, heads, seq, head_dim] arrays, sequence
     sharded over ``mesh`` axis ``axis``; returns the same global shape.
-    Requires heads % mesh.shape[axis] == 0 (the head-scatter step)."""
+    Requires local heads % mesh.shape[axis] == 0 (the head-scatter step).
+
+    ``batch_axis``/``head_axis`` name mesh axes the batch/head dims are
+    already sharded over (dp / tp in a composed mesh) so those dims stay
+    sharded through the exchange instead of being all-gathered at the
+    shard_map boundary; with ``head_axis`` set, the heads each device
+    scatters are its local (tp-sharded) head group.
+    """
     n = mesh.shape[axis]
     if q.shape[2] % n:
         raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
-    if q.shape[1] % n:
+    local_heads = q.shape[1] // (mesh.shape[head_axis] if head_axis else 1)
+    if local_heads % n:
         raise ValueError(
-            f"heads {q.shape[1]} not divisible by {axis}={n}; "
+            f"local heads {local_heads} not divisible by {axis}={n}; "
             "use ring attention for head-poor long-context models"
         )
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
         ulysses_attention, axis_name=axis, causal=causal, sm_scale=sm_scale
     )
