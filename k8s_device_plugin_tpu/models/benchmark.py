@@ -23,12 +23,13 @@ import optax
 
 from .alexnet import AlexNet
 from .bert import Bert, BertConfig
-from .data import synthetic_image_batch, synthetic_token_batch
+from .data import synthetic_image_batch, synthetic_lm_batch, synthetic_token_batch
 from .resnet import ResNet50
 from .train import create_train_state, make_train_step
 from ..parallel import distributed
 from ..parallel.distributed import make_slice_mesh
 from ..parallel.sharding import shard_train_step
+from ..utils import tracing
 
 
 def log(msg: str) -> None:
@@ -57,6 +58,22 @@ def timed_steps(step, state, batch, warmup: int, steps: int) -> tuple:
     return state, loss, time.perf_counter() - t0
 
 
+def _gpt_config(args):
+    from .transformer import GPTConfig
+
+    if args.tiny:
+        return GPTConfig.tiny()
+    return GPTConfig(
+        vocab_size=32000,
+        hidden_size=1024,
+        num_layers=8,
+        num_heads=16,
+        num_kv_heads=4,
+        intermediate_size=2816,
+        max_seq=max(args.seq_len, args.prompt_len + args.decode_tokens),
+    )
+
+
 def build(model_name: str, args, rng):
     if model_name == "alexnet":
         model = AlexNet(num_classes=1000, dtype=jnp.bfloat16)
@@ -70,12 +87,63 @@ def build(model_name: str, args, rng):
         model = Bert(BertConfig.base())
         batch = synthetic_token_batch(rng, args.batch_size, args.seq_len)
         return model, batch, "input_ids", args.batch_size * args.seq_len
+    if model_name == "gpt":
+        from .transformer import TransformerLM
+
+        cfg = _gpt_config(args)
+        model = TransformerLM(cfg)
+        batch = synthetic_lm_batch(rng, args.batch_size, args.seq_len, cfg.vocab_size)
+        return model, batch, "input_ids", args.batch_size * args.seq_len
     raise SystemExit(f"unknown model {model_name!r}")
+
+
+def run_decode(args) -> None:
+    """Autoregressive decode throughput (tokens/sec) through the KV cache —
+    the inference-side companion to the training benchmarks."""
+    from .transformer import TransformerLM, greedy_generate
+
+    cfg = _gpt_config(args)
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(
+        rng, (args.batch_size, args.prompt_len), 0, cfg.vocab_size
+    )
+    params = model.init(rng, prompt)["params"]
+
+    t0 = time.perf_counter()
+    out = greedy_generate(cfg, params, prompt, args.decode_tokens)
+    jax.block_until_ready(out)
+    log(f"decode compile+first run {time.perf_counter() - t0:.1f}s")
+    with tracing.trace(args.trace_dir):
+        t0 = time.perf_counter()
+        out = greedy_generate(cfg, params, prompt, args.decode_tokens)
+        jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    new_tokens = args.batch_size * args.decode_tokens
+    print(
+        json.dumps(
+            {
+                "model": "gpt-decode",
+                "chips": len(jax.devices()),
+                "batch": args.batch_size,
+                "prompt_len": args.prompt_len,
+                "new_tokens": args.decode_tokens,
+                "throughput": round(new_tokens / dt, 2),
+                "unit": "decoded tokens/sec",
+                "ms_per_token": round(dt / args.decode_tokens * 1e3, 3),
+            }
+        ),
+        flush=True,
+    )
 
 
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="tpu-benchmark")
-    p.add_argument("--model", choices=["alexnet", "resnet50", "bert"], default="resnet50")
+    p.add_argument(
+        "--model",
+        choices=["alexnet", "resnet50", "bert", "gpt", "gpt-decode"],
+        default="resnet50",
+    )
     p.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--seq-len", type=int, default=384)
@@ -83,6 +151,14 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--warmup", type=_positive_int, default=5)
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1: all devices)")
     p.add_argument("--mp", type=int, default=1, help="param-sharding axis size")
+    p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode prompt")
+    p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode new tokens")
+    p.add_argument("--tiny", action="store_true", help="tiny gpt config (CPU smoke)")
+    p.add_argument(
+        "--trace-dir",
+        default=tracing.default_trace_dir(),
+        help="write a jax.profiler trace of the timed region here",
+    )
     args = p.parse_args(argv)
 
     # Honor an explicit JAX_PLATFORMS from the pod spec even if the image's
@@ -102,6 +178,10 @@ def main(argv: list[str] | None = None) -> None:
     # slice and the dp axis crosses hosts.
     if distributed.initialize():
         log(f"jax.distributed: process {jax.process_index()}/{jax.process_count()}")
+
+    if args.model == "gpt-decode":
+        run_decode(args)
+        return
 
     devices = jax.devices()
     log(f"devices: {[str(d) for d in devices]}")
@@ -130,7 +210,8 @@ def main(argv: list[str] | None = None) -> None:
     else:
         batch = jax.device_put(batch, batch_sh)
 
-    state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
+    with tracing.trace(args.trace_dir):
+        state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
 
     n_chips = len(devices)
     throughput = items_per_step * args.steps / dt

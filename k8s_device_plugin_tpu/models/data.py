@@ -32,3 +32,11 @@ def synthetic_token_batch(
         "input_ids": jax.random.randint(k_tok, (batch_size, seq_len), 0, vocab_size),
         "labels": jax.random.randint(k_lbl, (batch_size, seq_len), 0, vocab_size),
     }
+
+
+def synthetic_lm_batch(
+    key: jax.Array, batch_size: int, seq_len: int, vocab_size: int
+) -> dict:
+    """Causal-LM batch: labels are the inputs shifted by one position."""
+    ids = jax.random.randint(key, (batch_size, seq_len + 1), 0, vocab_size)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}

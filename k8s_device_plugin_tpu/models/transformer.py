@@ -45,10 +45,18 @@ class GPTConfig:
     # trades recompute FLOPs for activation HBM — the standard long-context
     # memory lever alongside sequence parallelism.
     remat: bool = False
+    # Grouped-query attention: number of kv heads (None = num_heads = MHA;
+    # 1 = MQA).  Shrinks the decode KV cache by num_heads/num_kv_heads —
+    # the HBM lever for long-context inference.
+    num_kv_heads: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_heads if self.num_kv_heads is None else self.num_kv_heads
 
     @staticmethod
     def tiny() -> "GPTConfig":
@@ -114,24 +122,34 @@ class CausalSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, hidden, positions):
         cfg = self.config
+        if cfg.num_heads % cfg.kv_heads:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by kv_heads {cfg.kv_heads}"
+            )
+        group = cfg.num_heads // cfg.kv_heads
         proj = {
             name: nn.DenseGeneral(
-                features=(cfg.num_heads, cfg.head_dim),
+                features=(heads, cfg.head_dim),
                 dtype=cfg.dtype,
                 use_bias=False,
                 name=name,
             )(hidden)
-            for name in ("query", "key", "value")
-        }  # each [batch, seq, heads, head_dim]
+            for name, heads in (
+                ("query", cfg.num_heads),
+                ("key", cfg.kv_heads),
+                ("value", cfg.kv_heads),
+            )
+        }  # [batch, seq, (kv_)heads, head_dim]
         cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
         q = apply_rope(proj["query"], cos, sin)
         k = apply_rope(proj["key"], cos, sin)
         v = proj["value"]
 
         if self.decode:
-            # Fixed-shape cache: [batch, max_seq, heads, head_dim].
+            # Fixed-shape cache: [batch, max_seq, kv_heads, head_dim] — the
+            # cache holds UN-expanded kv heads (the GQA memory win).
             batch = hidden.shape[0]
-            shape = (batch, cfg.max_seq, cfg.num_heads, cfg.head_dim)
+            shape = (batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
             ck = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
             idx = self.variable(
@@ -142,6 +160,9 @@ class CausalSelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
             idx.value = cur + hidden.shape[1]
             k, v = ck.value, cv.value
+            if group > 1:  # expand kv head groups only at compute time
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             # Mask out cache slots at or beyond the write frontier.
             key_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
             q_pos = positions[:, None, :, None]  # [batch, 1, q_len, 1]
@@ -153,6 +174,9 @@ class CausalSelfAttention(nn.Module):
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         else:
+            if group > 1:
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]
             if self.attention_fn is not None:

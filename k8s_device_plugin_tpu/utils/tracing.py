@@ -1,0 +1,80 @@
+"""Profiling/tracing hooks — an aux subsystem the reference lacks entirely
+(SURVEY.md §5.1: no tracing, no pprof, vendored x/net/trace never imported).
+
+Two layers:
+
+- Workload (device) side: ``trace()`` wraps a region in a jax.profiler trace
+  whose output loads in TensorBoard/XProf or Perfetto — XLA op timelines,
+  HBM usage, ICI collective timing.  ``annotate()`` names a region so host
+  Python shows up aligned with device ops.
+- Daemon (host) side: ``timed_rpc`` decorates gRPC servicer methods with
+  wall-time logging + optional metrics-registry observation; cheap enough to
+  leave on (one perf_counter pair per call).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed region into
+    ``trace_dir`` (no-op when trace_dir is falsy, so callers can wire it
+    straight to an optional flag/env)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    log.info("profiler trace -> %s", trace_dir)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-region inside an active trace (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def default_trace_dir(environ=None) -> Optional[str]:
+    """Resolve the conventional trace-dir env (TPU_PLUGIN_TRACE_DIR)."""
+    environ = os.environ if environ is None else environ
+    return environ.get("TPU_PLUGIN_TRACE_DIR") or None
+
+
+def timed_rpc(fn=None, *, observe=None, threshold_ms: float = 0.0):
+    """Decorator for daemon RPC handlers: debug-log wall time per call, and
+    feed ``observe(seconds)`` (e.g. a metrics summary) when provided.
+    ``threshold_ms`` promotes slow calls to WARNING."""
+
+    def wrap(f):
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return f(*args, **kwargs)
+            finally:
+                dt = time.perf_counter() - t0
+                if observe is not None:
+                    observe(dt)
+                if threshold_ms and dt * 1e3 >= threshold_ms:
+                    log.warning("%s took %.1f ms", f.__name__, dt * 1e3)
+                else:
+                    log.debug("%s took %.2f ms", f.__name__, dt * 1e3)
+
+        return inner
+
+    return wrap if fn is None else wrap(fn)

@@ -1,0 +1,86 @@
+"""Tracing subsystem + benchmark-runner gpt paths (CPU smoke)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from k8s_device_plugin_tpu.utils import tracing
+
+
+def test_trace_noop_without_dir():
+    with tracing.trace(None):
+        pass  # must be a cheap no-op
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "trace")
+    with tracing.trace(d):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    files = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert files, "profiler produced no output"
+
+
+def test_annotate_runs_inside_trace(tmp_path):
+    with tracing.trace(str(tmp_path / "t")):
+        with tracing.annotate("test-region"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+
+
+def test_timed_rpc_observes_and_logs(caplog):
+    seen = []
+
+    @tracing.timed_rpc(observe=seen.append)
+    def handler(x):
+        return x + 1
+
+    assert handler(1) == 2
+    assert len(seen) == 1 and seen[0] >= 0
+
+    @tracing.timed_rpc(threshold_ms=0.0)
+    def noisy():
+        return "ok"
+
+    with caplog.at_level(logging.DEBUG, logger="k8s_device_plugin_tpu.utils.tracing"):
+        noisy()
+
+
+def test_default_trace_dir_env():
+    assert tracing.default_trace_dir({}) is None
+    assert tracing.default_trace_dir({"TPU_PLUGIN_TRACE_DIR": "/x"}) == "/x"
+
+
+def test_benchmark_gpt_train_smoke(capsys):
+    from k8s_device_plugin_tpu.models import benchmark
+
+    benchmark.main(
+        [
+            "--model", "gpt", "--tiny",
+            "--batch-size", "8", "--seq-len", "16",
+            "--steps", "2", "--warmup", "1", "--dp", "-1",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "gpt"
+    assert out["throughput"] > 0
+
+
+def test_benchmark_gpt_decode_smoke(capsys, tmp_path):
+    from k8s_device_plugin_tpu.models import benchmark
+
+    benchmark.main(
+        [
+            "--model", "gpt-decode", "--tiny",
+            "--batch-size", "2", "--prompt-len", "4", "--decode-tokens", "8",
+            "--trace-dir", str(tmp_path / "trace"),
+        ]
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "gpt-decode"
+    assert out["new_tokens"] == 8
+    assert out["throughput"] > 0
+    assert os.path.isdir(tmp_path / "trace")
