@@ -1,0 +1,181 @@
+"""The decoder LM run as a GPipe pipeline: pp applied to a real model.
+
+pipeline.py supplies the spatial schedule for any chainable stage; this
+module instantiates it for models/transformer.py's decoder: the embedding
+and LM head live OUTSIDE the pipelined region (replicated — they are cheap
+and shape-changing), while the ``num_layers`` decoder blocks are divided
+into ``pp`` equal stages whose parameters live permanently on their stage's
+devices.  Microbatches stream through the stages; autodiff of the schedule
+gives the GPipe backward pass.
+
+Composition note: the stage axis should be the OUTER (slowest, possibly
+DCN-crossing) mesh axis — stage hops are point-to-point and
+latency-tolerant, unlike tp/sp collectives (docs/parallelism.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from ..models.train import TrainState, softmax_xent
+from ..models.transformer import DecoderBlock, GPTConfig, RMSNorm
+from .pipeline import pipeline_apply, stack_stage_params
+
+
+class DecoderStage(nn.Module):
+    """``layers`` consecutive decoder blocks — one pipeline stage."""
+
+    config: GPTConfig
+    layers: int
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        block_cls = (
+            nn.remat(DecoderBlock, static_argnums=())
+            if self.config.remat
+            else DecoderBlock
+        )
+        for i in range(self.layers):
+            hidden = block_cls(self.config, name=f"block_{i}")(hidden, positions)
+        return hidden
+
+
+class _Embedder(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        return nn.Embed(
+            self.config.vocab_size,
+            self.config.hidden_size,
+            dtype=self.config.dtype,
+            name="embed",
+        )(ids)
+
+
+class _Head(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        hidden = RMSNorm(dtype=self.config.dtype, name="final_norm")(hidden)
+        return nn.Dense(
+            self.config.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head"
+        )(hidden)
+
+
+class PipelinedLM:
+    """Decoder LM with its blocks sharded over a ``pp`` mesh axis.
+
+    Usage:
+        plm = PipelinedLM(cfg, mesh, n_micro=8)
+        params = plm.init(rng, sample_ids)
+        state = plm.create_train_state(params, tx)
+        step = jax.jit(plm.make_train_step(tx), donate_argnums=0)
+    """
+
+    def __init__(self, config: GPTConfig, mesh: Mesh, n_micro: int, axis: str = "pp"):
+        self.config = config
+        self.mesh = mesh
+        self.axis = axis
+        self.n_micro = n_micro
+        self.n_stages = mesh.shape[axis]
+        if config.num_layers % self.n_stages:
+            raise ValueError(
+                f"num_layers {config.num_layers} not divisible by "
+                f"{axis}={self.n_stages} stages"
+            )
+        self.layers_per_stage = config.num_layers // self.n_stages
+        self._embed = _Embedder(config)
+        self._stage = DecoderStage(config, self.layers_per_stage)
+        self._head = _Head(config)
+
+    # ---------------------------------------------------------- parameters
+    def init(self, rng: jax.Array, sample_ids: jax.Array) -> dict:
+        """Parameter pytree: {embed, stages (leading dim = n_stages), head}."""
+        b, s = sample_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        k_embed, k_head, *k_stages = jax.random.split(rng, 2 + self.n_stages)
+        embed = self._embed.init(k_embed, sample_ids)["params"]
+        hidden = self._embed.apply({"params": embed}, sample_ids)
+        stages = [
+            self._stage.init(k, hidden, positions)["params"] for k in k_stages
+        ]
+        head = self._head.init(k_head, hidden)["params"]
+        return {
+            "embed": embed,
+            "stages": stack_stage_params(stages),
+            "head": head,
+        }
+
+    # ------------------------------------------------------------- forward
+    def _microbatch(self, ids: jax.Array) -> jax.Array:
+        b = ids.shape[0]
+        if b % self.n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro={self.n_micro}")
+        return ids.reshape(self.n_micro, b // self.n_micro, *ids.shape[1:])
+
+    def apply(self, params: dict, ids: jax.Array) -> jax.Array:
+        """[batch, seq] ids -> [batch, seq, vocab] float32 logits."""
+        b, s = ids.shape
+        micro_ids = self._microbatch(ids)
+        hidden = self._embed.apply({"params": params["embed"]}, micro_ids)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // self.n_micro, s))
+
+        def stage_fn(stage_params, x):
+            return self._stage.apply({"params": stage_params}, x, positions)
+
+        out = pipeline_apply(
+            stage_fn, params["stages"], hidden, self.mesh, self.axis
+        )
+        logits = self._head.apply({"params": params["head"]}, out)
+        return logits.reshape(b, s, -1)
+
+    def apply_serial(self, params: dict, ids: jax.Array) -> jax.Array:
+        """Pipeline-free reference forward (same params): the numerics
+        oracle for tests — stages applied in order on the full batch."""
+        b, s = ids.shape
+        hidden = self._embed.apply({"params": params["embed"]}, ids)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        for i in range(self.n_stages):
+            stage_i = jax.tree.map(lambda leaf: leaf[i], params["stages"])
+            hidden = self._stage.apply({"params": stage_i}, hidden, positions)
+        return self._head.apply({"params": params["head"]}, hidden)
+
+    # ------------------------------------------------------------ training
+    def create_train_state(self, params: dict, tx) -> TrainState:
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats={},
+        )
+
+    def make_train_step(
+        self,
+        tx,
+        loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_xent,
+    ):
+        def train_step(state: TrainState, batch: dict):
+            def compute_loss(params):
+                logits = self.apply(params, batch["input_ids"])
+                return loss_fn(logits, batch["labels"])
+
+            loss, grads = jax.value_and_grad(compute_loss)(state.params)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            return (
+                state.with_updates(
+                    step=state.step + 1,
+                    params=optax.apply_updates(state.params, updates),
+                    opt_state=new_opt,
+                ),
+                loss,
+            )
+
+        return train_step
