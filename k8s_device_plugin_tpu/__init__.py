@@ -12,8 +12,11 @@ Subpackages
 - ``kubelet``  — the v1beta1 wire contract (proto, constants, gRPC bindings).
 - ``plugin``   — discovery, topology, health, the DevicePlugin server, and the
   lifecycle manager (registration, kubelet-restart recovery, signals).
-- ``models``   — JAX/Flax benchmark workloads (AlexNet, ResNet-50, BERT).
-- ``parallel`` — device-mesh/sharding helpers for the workloads.
+- ``models``   — JAX/Flax benchmark workloads (AlexNet, ResNet-50, BERT, a
+  decoder LM with GQA/sliding-window/KV-cache decode, MoE variant) plus
+  orbax checkpoint/resume.
+- ``parallel`` — the workload-side parallel layer: dp/FSDP/tensor/sequence/
+  expert/pipeline parallelism over jax.sharding meshes, multi-host bootstrap.
 - ``ops``      — Pallas/TPU kernels used by the workloads.
 - ``utils``    — logging and small shared helpers.
 """

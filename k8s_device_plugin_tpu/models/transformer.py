@@ -49,6 +49,11 @@ class GPTConfig:
     # 1 = MQA).  Shrinks the decode KV cache by num_heads/num_kv_heads —
     # the HBM lever for long-context inference.
     num_kv_heads: Optional[int] = None
+    # Sliding-window (Mistral-style) local attention: each token sees only
+    # its `attention_window` most recent positions.  None = full causal.
+    # The flash kernel skips out-of-band tiles in the forward pass, so
+    # forward compute scales O(seq·window) instead of O(seq²).
+    attention_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -126,6 +131,10 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"num_heads {cfg.num_heads} not divisible by kv_heads {cfg.kv_heads}"
             )
+        if cfg.attention_window is not None and cfg.attention_window < 1:
+            raise ValueError(
+                f"attention_window must be >= 1, got {cfg.attention_window}"
+            )
         group = cfg.num_heads // cfg.kv_heads
         proj = {
             name: nn.DenseGeneral(
@@ -163,10 +172,15 @@ class CausalSelfAttention(nn.Module):
             if group > 1:  # expand kv head groups only at compute time
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
-            # Mask out cache slots at or beyond the write frontier.
+            # Mask out cache slots at or beyond the write frontier (and, with
+            # a sliding window, slots that have scrolled out of the band).
             key_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
             q_pos = positions[:, None, :, None]  # [batch, 1, q_len, 1]
             mask = key_pos <= q_pos
+            if cfg.attention_window is not None:
+                mask = jnp.logical_and(
+                    mask, q_pos - key_pos < cfg.attention_window
+                )
             s = jnp.einsum(
                 "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
             ) * (cfg.head_dim ** -0.5)
@@ -180,11 +194,24 @@ class CausalSelfAttention(nn.Module):
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]
             if self.attention_fn is not None:
+                if cfg.attention_window is not None:
+                    # The sp engines compute full causal attention; silently
+                    # training full-window while decode masks to the window
+                    # would be a train/inference mismatch.
+                    raise ValueError(
+                        "attention_window is not supported with a custom "
+                        "attention_fn (sequence-parallel engines are full-"
+                        "causal); unset one of them"
+                    )
                 attn = self.attention_fn(qh, kh, vh, causal=True)
             elif seq_len % 128 == 0:
-                attn = flash_attention(qh, kh, vh, causal=True)
+                attn = flash_attention(
+                    qh, kh, vh, causal=True, window=cfg.attention_window
+                )
             else:
-                attn = mha_reference(qh, kh, vh, causal=True)
+                attn = mha_reference(
+                    qh, kh, vh, causal=True, window=cfg.attention_window
+                )
             attn = attn.transpose(0, 2, 1, 3)
 
         return nn.DenseGeneral(

@@ -46,14 +46,23 @@ def mha_reference(
     v: jax.Array,
     causal: bool = False,
     sm_scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Plain-XLA attention with identical semantics to the kernel.
 
     [batch, heads, seq, head_dim] in, same out; float32 softmax accumulation.
     The numerical oracle for tests and the non-fused fallback path.
+    ``window`` (requires causal): each query attends to the ``window`` most
+    recent positions, itself included — Mistral-style local attention.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            # window=0 would mask every score; softmax over all -inf is NaN.
+            raise ValueError(f"window must be >= 1, got {window}")
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
@@ -61,7 +70,10 @@ def mha_reference(
         seq_q, seq_k = s.shape[-2], s.shape[-1]
         row = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 1)
-        s = jnp.where(row >= col, s, NEG_INF)
+        mask = row >= col
+        if window is not None:
+            mask = jnp.logical_and(mask, row - col < window)
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -83,6 +95,7 @@ def _flash_kernel(
     *,
     sm_scale: float,
     causal: bool,
+    window,
     block_q: int,
     block_kv: int,
     num_kv_blocks: int,
@@ -113,7 +126,10 @@ def _flash_kernel(
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, NEG_INF)
+            mask = row >= col
+            if window is not None:
+                mask = jnp.logical_and(mask, row - col < window)
+            s = jnp.where(mask, s, NEG_INF)
 
         # Online softmax update.  m/l scratch is [block_q, 128]
         # (lane-replicated: TPU vector registers are 128 lanes wide, a
@@ -137,10 +153,17 @@ def _flash_kernel(
 
     if causal:
         # A tile is entirely masked iff its smallest column exceeds its
-        # largest row; skip both matmuls for it.  (The grid still visits the
-        # tile — Pallas grids are rectangular — but it costs only this
-        # comparison.)
-        pl.when((qi * block_q + block_q - 1) >= (ki * block_kv))(_tile)
+        # largest row — or, with a window, its largest column falls entirely
+        # behind the window of its smallest row; skip both matmuls for it.
+        # (The grid still visits the tile — Pallas grids are rectangular —
+        # but it costs only this comparison.)
+        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
+        if window is not None:
+            live = jnp.logical_and(
+                live,
+                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
+            )
+        pl.when(live)(_tile)
     else:
         _tile()
 
@@ -161,6 +184,7 @@ def _flash_impl(
     k: jax.Array,
     v: jax.Array,
     causal: bool,
+    window,
     sm_scale: float,
     block_q: int,
     block_kv: int,
@@ -185,6 +209,7 @@ def _flash_impl(
         _flash_kernel,
         sm_scale=sm_scale,
         causal=causal,
+        window=window,
         block_q=block_q,
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
@@ -230,6 +255,7 @@ def _mha_bwd_chunked(
     lse: jax.Array,
     dout: jax.Array,
     causal: bool,
+    window,
     sm_scale: float,
     block_kv: int,
 ):
@@ -270,7 +296,10 @@ def _mha_bwd_chunked(
             col_ids = start + jax.lax.broadcasted_iota(
                 jnp.int32, (seq_q, block_kv), 1
             )
-            p = jnp.where(row_ids >= col_ids, p, 0.0)
+            mask = row_ids >= col_ids
+            if window is not None:
+                mask = jnp.logical_and(mask, row_ids - col_ids < window)
+            p = jnp.where(mask, p, 0.0)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
         ds = p * (dp - d_row[..., None]) * sm_scale
         dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
@@ -287,20 +316,26 @@ def _mha_bwd_chunked(
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
-    out, _ = _flash_impl(q, k, v, causal, sm_scale, block_q, block_kv, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret):
+    out, _ = _flash_impl(
+        q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
+    )
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
-    out, lse = _flash_impl(q, k, v, causal, sm_scale, block_q, block_kv, interpret)
+def _flash_fwd(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret):
+    out, lse = _flash_impl(
+        q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
+    )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_kv, interpret, residuals, dout):
+def _flash_bwd(causal, window, sm_scale, block_q, block_kv, interpret, residuals, dout):
     q, k, v, out, lse = residuals
-    return _mha_bwd_chunked(q, k, v, out, lse, dout, causal, sm_scale, block_kv)
+    return _mha_bwd_chunked(
+        q, k, v, out, lse, dout, causal, window, sm_scale, block_kv
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -313,6 +348,7 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: float | None = None,
+    window: int | None = None,
     block_q: int = 128,
     block_kv: int = 128,
     interpret: bool | None = None,
@@ -323,11 +359,22 @@ def flash_attention(
     Pallas interpreter elsewhere (so the same code path is testable on the
     8-device CPU mesh).  Blocks clamp to the sequence length for short
     sequences; sequences must divide by the (clamped) blocks.
+
+    ``window`` (requires ``causal``): sliding-window local attention — each
+    query sees only its ``window`` most recent positions.  FORWARD tiles
+    entirely outside the band skip both matmuls, so forward compute scales
+    O(seq·window) once seq >> window; the chunked backward currently masks
+    out-of-band entries but still visits every block (O(seq²) FLOPs).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, q.shape[2])
     block_kv = min(block_kv, k.shape[2])
-    return _flash(q, k, v, causal, sm_scale, block_q, block_kv, interpret)
+    return _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret)
