@@ -51,8 +51,9 @@ class GPTConfig:
     num_kv_heads: Optional[int] = None
     # Sliding-window (Mistral-style) local attention: each token sees only
     # its `attention_window` most recent positions.  None = full causal.
-    # The flash kernel skips out-of-band tiles in the forward pass, so
-    # forward compute scales O(seq·window) instead of O(seq²).
+    # The flash kernel skips out-of-band tiles (forward) and restricts the
+    # chunked backward to each block's query band, so training compute
+    # scales O(seq·window) instead of O(seq²).
     attention_window: Optional[int] = None
 
     @property

@@ -283,28 +283,61 @@ def _mha_bwd_chunked(
     finite = jnp.isfinite(lse)
     lse_safe = jnp.where(finite, lse, 0.0)
 
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (seq_q, block_kv), 0)
+    # With a sliding window only rows [start, start + block_kv - 1 + window)
+    # can touch kv block [start, start + block_kv) — slice just that query
+    # band (static length) so backward FLOPs scale O(seq·window) like the
+    # forward's tile skipping, instead of masking a dense [seq_q, block_kv].
+    banded = (
+        causal
+        and window is not None
+        and seq_q == seq_kv  # band geometry assumes aligned self-attention
+        and block_kv + window - 1 < seq_q
+    )
+    q_rows = min(seq_q, block_kv + window - 1) if banded else seq_q
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (q_rows, block_kv), 0)
 
     def one_block(dq_acc, block_idx):
         start = block_idx * block_kv
         k_blk = jax.lax.dynamic_slice_in_dim(kf, start, block_kv, axis=2)
         v_blk = jax.lax.dynamic_slice_in_dim(vf, start, block_kv, axis=2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * sm_scale
-        p = jnp.exp(s - lse_safe[..., None])
-        p = jnp.where(finite[..., None], p, 0.0)
+        if banded:
+            # Clamped band start: rows [row0, row0 + q_rows) cover every
+            # in-band row for this kv block.
+            row0 = jnp.minimum(start, seq_q - q_rows)
+            q_b = jax.lax.dynamic_slice_in_dim(qf, row0, q_rows, axis=2)
+            do_b = jax.lax.dynamic_slice_in_dim(dof, row0, q_rows, axis=2)
+            dr_b = jax.lax.dynamic_slice_in_dim(d_row, row0, q_rows, axis=2)
+            lse_b = jax.lax.dynamic_slice_in_dim(lse_safe, row0, q_rows, axis=2)
+            fin_b = jax.lax.dynamic_slice_in_dim(finite, row0, q_rows, axis=2)
+            rows_abs = row0 + row_ids
+        else:
+            row0 = 0
+            q_b, do_b, dr_b, lse_b, fin_b = qf, dof, d_row, lse_safe, finite
+            rows_abs = row_ids
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_b, k_blk) * sm_scale
+        p = jnp.exp(s - lse_b[..., None])
+        p = jnp.where(fin_b[..., None], p, 0.0)
         if causal:
             col_ids = start + jax.lax.broadcasted_iota(
-                jnp.int32, (seq_q, block_kv), 1
+                jnp.int32, (q_rows, block_kv), 1
             )
-            mask = row_ids >= col_ids
+            mask = rows_abs >= col_ids
             if window is not None:
-                mask = jnp.logical_and(mask, row_ids - col_ids < window)
+                mask = jnp.logical_and(mask, rows_abs - col_ids < window)
             p = jnp.where(mask, p, 0.0)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
-        ds = p * (dp - d_row[..., None]) * sm_scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_b, v_blk)
+        ds = p * (dp - dr_b[..., None]) * sm_scale
+        dq_contrib = jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
+        if banded:
+            cur = jax.lax.dynamic_slice_in_dim(dq_acc, row0, q_rows, axis=2)
+            dq_acc = jax.lax.dynamic_update_slice_in_dim(
+                dq_acc, cur + dq_contrib, row0, axis=2
+            )
+        else:
+            dq_acc = dq_acc + dq_contrib
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, q_b)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do_b)
         return dq_acc, (dk_blk, dv_blk)
 
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
@@ -361,10 +394,10 @@ def flash_attention(
     sequences; sequences must divide by the (clamped) blocks.
 
     ``window`` (requires ``causal``): sliding-window local attention — each
-    query sees only its ``window`` most recent positions.  FORWARD tiles
-    entirely outside the band skip both matmuls, so forward compute scales
-    O(seq·window) once seq >> window; the chunked backward currently masks
-    out-of-band entries but still visits every block (O(seq²) FLOPs).
+    query sees only its ``window`` most recent positions.  Forward tiles
+    entirely outside the band skip both matmuls, and the chunked backward
+    restricts each kv block to its query band, so both passes scale
+    O(seq·window) instead of O(seq²) once seq >> window.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5

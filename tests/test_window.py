@@ -55,6 +55,25 @@ def test_window_gradients_match_reference():
         assert jnp.allclose(a, b, atol=2e-4), float(jnp.abs(a - b).max())
 
 
+def test_window_banded_backward_matches_reference():
+    """seq >> window with small kv blocks activates the banded backward
+    (q-row slicing per kv block); gradients must still match the oracle."""
+    q, k, v = _qkv(jax.random.PRNGKey(7), shape=(1, 2, 256, 16))
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, window=32, block_q=64, block_kv=64
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return mha_reference(q, k, v, causal=True, window=32).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert jnp.allclose(a, b, atol=2e-4), float(jnp.abs(a - b).max())
+
+
 def test_window_validation():
     q, k, v = _qkv(jax.random.PRNGKey(3), shape=(1, 1, 128, 16))
     with pytest.raises(ValueError, match="causal"):
