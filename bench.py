@@ -3,199 +3,349 @@
 Matches BASELINE.json's metric ("AlexNet/ResNet-50 images/sec/chip in k8s
 pod") and the measurement style of the reference's benchmark pod (synthetic
 data, steady-state timing — reference k8s-pod-example-gpu.yaml runs the
-convnet-benchmarks AlexNet timing script).  The reference publishes no
-numbers ("published": {}), so vs_baseline is reported against our own
-first-round target of parity (1.0 = target met).
+convnet-benchmarks AlexNet timing script).
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+Crash-safe two-stage design (round-1 postmortem: the TPU tunnel can either
+raise `Unable to initialize backend` *or hang indefinitely* inside
+`jax.devices()`, and round 1's single-process bench died with rc=1 and no
+JSON line).  Stage 1 (this process, never imports jax) runs the real bench
+as a subprocess under a hard timeout, falling back through platform
+configurations:
 
-Extra detail (per-model numbers, allocation latency) goes to stderr.
+    1. environment as-is        (TPU via the tunnel, the real measurement)
+    2. JAX_PLATFORMS=""         (let jax auto-pick whatever is available)
+    3. JAX_PLATFORMS="cpu"      (structural smoke run, always works)
+
+Whatever happens, stage 1 prints exactly ONE JSON line on stdout and exits 0:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip",
+     "vs_baseline": N, "platform": "tpu"|"cpu"|"none", "error": null|str,
+     "attempts": [...]}
+
+`vs_baseline` is honest (VERDICT r1 weak #3): the measured value divided by
+the best prior accelerator number found in BENCH_r*.json at the repo root,
+or — when no prior round produced one — the stated round target
+TARGET_IPS (see BASELINE.md "Round targets").  A CPU smoke value is still
+divided by the accelerator target, so a fallback run reports ~0.00x rather
+than pretending the target was met.
+
+Extra detail (per-model numbers, flash-attention speedup, allocation
+latency) goes to stderr.
 """
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import optax
+# Stated round target for resnet50_train_images_per_sec_per_chip until a
+# prior-round TPU measurement exists to supersede it (documented in
+# BASELINE.md).  ~15% bf16 MFU on a v5e-class chip.
+TARGET_IPS = 2000.0
 
-from k8s_device_plugin_tpu.models.benchmark import log, timed_steps
-from k8s_device_plugin_tpu.models.data import synthetic_image_batch
-from k8s_device_plugin_tpu.models.resnet import ResNet50
-from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# (label, JAX_PLATFORMS value or None to leave untouched, timeout seconds).
+# BENCH_TIMEOUT_SCALE (float) shrinks/stretches every timeout — used by the
+# fallback-path tests so they don't wait out the full TPU window.
+_SCALE = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1.0"))
+_ATTEMPTS = [
+    ("as-is", None, 900 * _SCALE),
+    ("auto", "", 600 * _SCALE),
+    ("cpu", "cpu", 480 * _SCALE),
+]
 
 
-def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 5) -> float:
+def _baseline_value(root: str = _REPO_ROOT) -> tuple[float, str]:
+    """Best prior accelerator number from BENCH_r*.json, else TARGET_IPS.
+
+    Only accelerator-platform values count — a prior CPU smoke number must
+    never become the bar an accelerator run is measured against.
+    """
+    best = None
+    best_src = ""
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or {}
+            value = parsed.get("value")
+            platform = parsed.get("platform", "tpu")  # legacy rounds: assume tpu
+            if value and value > 0 and platform not in ("cpu", "none"):
+                if best is None or value > best:
+                    best, best_src = float(value), os.path.basename(path)
+        except (OSError, ValueError, TypeError, AttributeError):
+            # A malformed record must never break the bench's always-emit-
+            # JSON contract; skip it.
+            continue
+    if best is not None:
+        return best, best_src
+    return TARGET_IPS, f"stated target (BASELINE.md), no prior TPU number"
+
+
+# --------------------------------------------------------------------------
+# Stage 2: the actual benchmark (subprocess; jax imported only here)
+# --------------------------------------------------------------------------
+
+
+def _inner() -> None:
+    import jax
+
+    # A TPU-VM sitecustomize (axon) may have programmatically pinned the
+    # hardware platform before we run; the JAX_PLATFORMS env var alone does
+    # not undo that — the config update does.  Without this, the "cpu"
+    # fallback attempt still dials the (possibly hung) tunnel.
+    # "in" not .get(): JAX_PLATFORMS="" (the "auto" attempt) must also
+    # override the pin — None means auto-select to jax.config.
+    if "JAX_PLATFORMS" in os.environ:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"] or None)
+
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_device_plugin_tpu.models.benchmark import log, timed_steps
+    from k8s_device_plugin_tpu.models.data import synthetic_image_batch
+    from k8s_device_plugin_tpu.models.resnet import ResNet50
+    from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
+
     platform = jax.devices()[0].platform
-    if platform == "cpu":
-        # Structural smoke run only (no TPU attached): keep shapes tiny so
-        # the script still exercises the full path.
-        batch_size, image_size, steps, warmup = 8, 64, 3, 1
-        log("no accelerator: running tiny CPU smoke configuration")
-    else:
-        image_size = 224
+    log(f"platform: {platform} ({len(jax.devices())} device(s))")
 
-    rng = jax.random.PRNGKey(0)
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    batch = synthetic_image_batch(rng, batch_size, image_size=image_size, num_classes=1000)
-    tx = optax.sgd(0.1, momentum=0.9)
-    state = create_train_state(rng, model, batch, tx)
-    step = jax.jit(make_train_step(model, tx), donate_argnums=0)
-
-    state, loss, dt = timed_steps(step, state, batch, warmup, steps)
-    ips = batch_size * steps / dt
-    log(f"resnet50 b{batch_size}: {steps} steps in {dt:.2f}s -> {ips:.1f} images/sec")
-    return ips
-
-
-def bench_lm_train() -> float | None:
-    """Secondary: decoder-LM training tokens/sec on one chip (stderr only)."""
-    try:
-        from k8s_device_plugin_tpu.models.transformer import GPTConfig, TransformerLM
-
-        platform = jax.devices()[0].platform
+    def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 5) -> float:
         if platform == "cpu":
-            cfg = GPTConfig.tiny()
-            batch_size, seq, steps, warmup = 4, 64, 3, 1
+            # Structural smoke run only (no TPU attached): keep shapes tiny
+            # so the script still exercises the full path.
+            batch_size, image_size, steps, warmup = 8, 64, 3, 1
+            log("no accelerator: running tiny CPU smoke configuration")
         else:
-            cfg = GPTConfig(
-                vocab_size=32000,
-                hidden_size=1024,
-                num_layers=8,
-                num_heads=16,
-                intermediate_size=2816,
-                max_seq=1024,
-            )
-            batch_size, seq, steps, warmup = 8, 1024, 20, 5
-        model = TransformerLM(cfg)
+            image_size = 224
+
         rng = jax.random.PRNGKey(0)
-        ids = jax.random.randint(rng, (batch_size, seq + 1), 0, cfg.vocab_size)
-        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
-        tx = optax.adamw(1e-3)
-        state = create_train_state(rng, model, batch, tx, input_key="input_ids")
-        step = jax.jit(make_train_step(model, tx, input_key="input_ids"), donate_argnums=0)
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = synthetic_image_batch(rng, batch_size, image_size=image_size, num_classes=1000)
+        tx = optax.sgd(0.1, momentum=0.9)
+        state = create_train_state(rng, model, batch, tx)
+        step = jax.jit(make_train_step(model, tx), donate_argnums=0)
+
         state, loss, dt = timed_steps(step, state, batch, warmup, steps)
-        tps = batch_size * seq * steps / dt
-        log(f"transformer-lm b{batch_size} s{seq}: {tps:.0f} tokens/sec (loss {float(loss):.3f})")
-        return tps
-    except Exception as e:  # secondary metrics must never kill the bench
-        log(f"lm bench failed: {e}")
-        return None
+        ips = batch_size * steps / dt
+        log(f"resnet50 b{batch_size}: {steps} steps in {dt:.2f}s -> {ips:.1f} images/sec")
+        return ips
 
+    def bench_lm_train() -> None:
+        """Secondary: decoder-LM training tokens/sec on one chip (stderr only)."""
+        try:
+            from k8s_device_plugin_tpu.models.transformer import GPTConfig, TransformerLM
 
-def bench_flash_attention() -> float | None:
-    """Secondary: fused flash kernel speedup over plain-XLA attention."""
-    try:
-        from k8s_device_plugin_tpu.ops.flash_attention import (
-            flash_attention,
-            mha_reference,
-        )
+            if platform == "cpu":
+                cfg = GPTConfig.tiny()
+                batch_size, seq, steps, warmup = 4, 64, 3, 1
+            else:
+                cfg = GPTConfig(
+                    vocab_size=32000,
+                    hidden_size=1024,
+                    num_layers=8,
+                    num_heads=16,
+                    intermediate_size=2816,
+                    max_seq=1024,
+                )
+                batch_size, seq, steps, warmup = 8, 1024, 20, 5
+            model = TransformerLM(cfg)
+            rng = jax.random.PRNGKey(0)
+            ids = jax.random.randint(rng, (batch_size, seq + 1), 0, cfg.vocab_size)
+            batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+            tx = optax.adamw(1e-3)
+            state = create_train_state(rng, model, batch, tx, input_key="input_ids")
+            step = jax.jit(make_train_step(model, tx, input_key="input_ids"), donate_argnums=0)
+            state, loss, dt = timed_steps(step, state, batch, warmup, steps)
+            tps = batch_size * seq * steps / dt
+            log(f"transformer-lm b{batch_size} s{seq}: {tps:.0f} tokens/sec (loss {float(loss):.3f})")
+        except Exception as e:  # secondary metrics must never kill the bench
+            log(f"lm bench failed: {e}")
 
-        platform = jax.devices()[0].platform
-        if platform == "cpu":
-            shape = (1, 2, 256, 64)  # interpreter mode: keep it tiny
-            iters = 2
-        else:
-            shape = (4, 16, 2048, 64)
-            iters = 20
-        q = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
-        flash = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
-        ref = jax.jit(lambda q: mha_reference(q, q, q, causal=True))
-        for fn in (flash, ref):
-            jax.block_until_ready(fn(q))  # compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = flash(q)
-        jax.block_until_ready(out)
-        t_flash = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = ref(q)
-        jax.block_until_ready(out)
-        t_ref = time.perf_counter() - t0
-        log(
-            f"flash-attention {shape}: {t_flash/iters*1e3:.2f} ms vs XLA "
-            f"{t_ref/iters*1e3:.2f} ms ({t_ref/max(t_flash,1e-9):.2f}x)"
-        )
-        return t_ref / max(t_flash, 1e-9)
-    except Exception as e:
-        log(f"flash-attention bench failed: {e}")
-        return None
-
-
-def bench_allocation_latency() -> float | None:
-    """Secondary metric from BASELINE.json: chip-allocation latency through
-    the actual plugin gRPC path (fixture-backed, no cluster needed)."""
-    try:
-        import os
-        import tempfile
-        from concurrent import futures
-
-        import grpc
-
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        from tests.fakes import make_fake_tpu_host
-        from k8s_device_plugin_tpu.kubelet.api import (
-            DevicePluginStub,
-            add_device_plugin_servicer,
-            pb,
-        )
-        from k8s_device_plugin_tpu.plugin import discovery
-        from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
-        from k8s_device_plugin_tpu.plugin.server import TpuDevicePlugin
-
-        root = make_fake_tpu_host(tempfile.mkdtemp(), n_chips=4)
-        plugin = TpuDevicePlugin(
-            discover=lambda: discovery.discover(root=root, environ={}),
-            health_checker=ChipHealthChecker(root=root),
-        )
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-        add_device_plugin_servicer(plugin, server)
-        sock = tempfile.mktemp(suffix=".sock")
-        server.add_insecure_port(f"unix://{sock}")
-        server.start()
-        with grpc.insecure_channel(f"unix://{sock}") as ch:
-            stub = DevicePluginStub(ch)
-            req = pb.AllocateRequest(
-                container_requests=[
-                    pb.ContainerAllocateRequest(devicesIDs=["tpu-0", "tpu-1"])
-                ]
+    def bench_flash_attention() -> None:
+        """Secondary: fused flash kernel speedup over plain-XLA attention."""
+        try:
+            from k8s_device_plugin_tpu.ops.flash_attention import (
+                flash_attention,
+                mha_reference,
             )
-            stub.Allocate(req)  # warm
+
+            if platform == "cpu":
+                shape = (1, 2, 256, 64)  # interpreter mode: keep it tiny
+                iters = 2
+            else:
+                shape = (4, 16, 2048, 64)
+                iters = 20
+            b, h, s, d = shape
+            q = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
+            flash = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+            ref = jax.jit(lambda q: mha_reference(q, q, q, causal=True))
+            for fn in (flash, ref):
+                jax.block_until_ready(fn(q))  # compile
             t0 = time.perf_counter()
-            n = 100
-            for _ in range(n):
-                stub.Allocate(req)
-            latency_ms = (time.perf_counter() - t0) / n * 1e3
-        server.stop(grace=None)
-        log(f"plugin Allocate p50 latency: {latency_ms:.2f} ms")
-        return latency_ms
-    except Exception as e:  # bench must never die on the secondary metric
-        log(f"allocation-latency probe failed: {e}")
-        return None
+            for _ in range(iters):
+                out = flash(q)
+            jax.block_until_ready(out)
+            t_flash = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = ref(q)
+            jax.block_until_ready(out)
+            t_ref = time.perf_counter() - t0
+            # Causal attention FLOPs: 2 matmuls * b*h*s*s*d, halved by masking.
+            flops = 2 * 2 * b * h * s * s * d / 2
+            tf_per_s = flops / (t_flash / iters) / 1e12
+            log(
+                f"flash-attention {shape}: {t_flash/iters*1e3:.2f} ms vs XLA "
+                f"{t_ref/iters*1e3:.2f} ms ({t_ref/max(t_flash,1e-9):.2f}x, "
+                f"{tf_per_s:.1f} TFLOP/s)"
+            )
+        except Exception as e:
+            log(f"flash-attention bench failed: {e}")
 
+    def bench_allocation_latency() -> None:
+        """Secondary metric from BASELINE.json: chip-allocation latency through
+        the actual plugin gRPC path (fixture-backed, no cluster needed)."""
+        try:
+            import tempfile
+            from concurrent import futures
 
-def main() -> None:
+            import grpc
+
+            sys.path.insert(0, _REPO_ROOT)
+            from tests.fakes import make_fake_tpu_host
+            from k8s_device_plugin_tpu.kubelet.api import (
+                DevicePluginStub,
+                add_device_plugin_servicer,
+                pb,
+            )
+            from k8s_device_plugin_tpu.plugin import discovery
+            from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
+            from k8s_device_plugin_tpu.plugin.server import TpuDevicePlugin
+
+            root = make_fake_tpu_host(tempfile.mkdtemp(), n_chips=4)
+            plugin = TpuDevicePlugin(
+                discover=lambda: discovery.discover(root=root, environ={}),
+                health_checker=ChipHealthChecker(root=root),
+            )
+            server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+            add_device_plugin_servicer(plugin, server)
+            sock = tempfile.mktemp(suffix=".sock")
+            server.add_insecure_port(f"unix://{sock}")
+            server.start()
+            with grpc.insecure_channel(f"unix://{sock}") as ch:
+                stub = DevicePluginStub(ch)
+                req = pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devicesIDs=["tpu-0", "tpu-1"])
+                    ]
+                )
+                stub.Allocate(req)  # warm
+                t0 = time.perf_counter()
+                n = 100
+                for _ in range(n):
+                    stub.Allocate(req)
+                latency_ms = (time.perf_counter() - t0) / n * 1e3
+            server.stop(grace=None)
+            log(f"plugin Allocate mean latency: {latency_ms:.2f} ms")
+        except Exception as e:  # bench must never die on the secondary metric
+            log(f"allocation-latency probe failed: {e}")
+
     ips = bench_resnet50(batch_size=128)
     bench_lm_train()
     bench_flash_attention()
     bench_allocation_latency()
+    baseline, baseline_src = _baseline_value()
     print(
         json.dumps(
             {
                 "metric": "resnet50_train_images_per_sec_per_chip",
                 "value": round(ips, 2),
                 "unit": "images/sec/chip",
-                # No published reference numbers (BASELINE.md): 1.0 == the
-                # round-1 parity target; scale when a real baseline lands.
-                "vs_baseline": 1.0,
+                "vs_baseline": round(ips / baseline, 4),
+                "baseline": baseline,
+                "baseline_src": baseline_src,
+                "platform": "cpu" if platform == "cpu" else "tpu",
             }
-        )
+        ),
+        flush=True,
     )
+
+
+# --------------------------------------------------------------------------
+# Stage 1: crash-/hang-safe orchestrator (no jax import in this process)
+# --------------------------------------------------------------------------
+
+
+def _try_attempt(label: str, jax_platforms: str | None, timeout: float):
+    """Run `bench.py --inner` in a subprocess; return (json_dict|None, err|None)."""
+    env = dict(os.environ)
+    if jax_platforms is not None:
+        env["JAX_PLATFORMS"] = jax_platforms
+    print(f"bench attempt [{label}] (timeout {timeout:.0f}s)...", file=sys.stderr, flush=True)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=env,
+            cwd=_REPO_ROOT,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{label}: timed out after {timeout:.0f}s (backend hang)"
+    dt = time.monotonic() - t0
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if "metric" in d:
+                    print(f"bench attempt [{label}] ok in {dt:.0f}s", file=sys.stderr, flush=True)
+                    return d, None
+            except ValueError:
+                pass
+    return None, f"{label}: exit={proc.returncode}, no JSON line after {dt:.0f}s"
+
+
+def main() -> None:
+    if "--inner" in sys.argv:
+        _inner()
+        return
+    errors: list[str] = []
+    for label, jax_platforms, timeout in _ATTEMPTS:
+        result, err = _try_attempt(label, jax_platforms, timeout)
+        if result is not None:
+            result["error"] = "; ".join(errors) or None
+            result["attempts"] = [label for label, _, _ in _ATTEMPTS[: len(errors) + 1]]
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(err)
+        print(f"bench attempt failed — {err}", file=sys.stderr, flush=True)
+    baseline, baseline_src = _baseline_value()
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "images/sec/chip",
+                "vs_baseline": 0.0,
+                "baseline": baseline,
+                "baseline_src": baseline_src,
+                "platform": "none",
+                "error": "; ".join(errors),
+                "attempts": [label for label, _, _ in _ATTEMPTS],
+            }
+        ),
+        flush=True,
+    )
+    # Exit 0 unconditionally: the JSON line *is* the result, even on failure.
 
 
 if __name__ == "__main__":

@@ -119,7 +119,12 @@ def run_decode(args) -> None:
         out = greedy_generate(cfg, params, prompt, args.decode_tokens)
         jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    new_tokens = args.batch_size * args.decode_tokens
+    # The timed generate executes prompt_len-1 prefill steps PLUS
+    # decode_tokens decode steps, all through the same one-token compiled
+    # step — so the denominator is total steps, not just decode_tokens
+    # (otherwise long prompts understate tokens/sec).  `steps` says which.
+    steps = args.prompt_len - 1 + args.decode_tokens
+    total_tokens = args.batch_size * steps
     print(
         json.dumps(
             {
@@ -128,9 +133,10 @@ def run_decode(args) -> None:
                 "batch": args.batch_size,
                 "prompt_len": args.prompt_len,
                 "new_tokens": args.decode_tokens,
-                "throughput": round(new_tokens / dt, 2),
-                "unit": "decoded tokens/sec",
-                "ms_per_token": round(dt / args.decode_tokens * 1e3, 3),
+                "steps": steps,
+                "throughput": round(total_tokens / dt, 2),
+                "unit": "generated tokens/sec (prefill+decode steps)",
+                "ms_per_token": round(dt / steps * 1e3, 3),
             }
         ),
         flush=True,

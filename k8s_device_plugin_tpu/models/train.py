@@ -53,19 +53,39 @@ def _takes_train_kwarg(model: nn.Module) -> bool:
     return "train" in inspect.signature(type(model).__call__).parameters
 
 
-def _apply(model, state, params, x, train, rngs):
+def _apply(model, state, params, x, train, rngs, capture_intermediates=False):
     """Model apply that tolerates models with/without batch_stats and the
     `train` kwarg (image models take it; BERT does not).  The kwarg decision
-    is static (signature inspection), never a traced-time fallback."""
+    is static (signature inspection), never a traced-time fallback.
+
+    Returns (out, new_batch_stats, intermediates); the last is {} unless
+    `capture_intermediates` asks for the 'intermediates' collection (where
+    MoE layers sow their load-balance loss — sow is a silent no-op unless
+    the collection is marked mutable here)."""
     variables = {"params": params}
     kwargs = {"train": train} if _takes_train_kwarg(model) else {}
+    mutable = []
     if bool(state.batch_stats):
         variables["batch_stats"] = state.batch_stats
+        mutable.append("batch_stats")
+    if capture_intermediates:
+        mutable.append("intermediates")
+    if mutable:
         out, mutated = model.apply(
-            variables, x, mutable=["batch_stats"], rngs=rngs, **kwargs
+            variables, x, mutable=mutable, rngs=rngs, **kwargs
         )
-        return out, mutated["batch_stats"]
-    return model.apply(variables, x, rngs=rngs, **kwargs), {}
+        return out, mutated.get("batch_stats", {}), mutated.get("intermediates", {})
+    return model.apply(variables, x, rngs=rngs, **kwargs), {}, {}
+
+
+def sown_aux_loss(intermediates: Any) -> jax.Array:
+    """Sum every leaf sown under a name containing 'aux_loss' (e.g. each MoE
+    layer's `moe_aux_loss`).  Returns a scalar (0.0 when none exist)."""
+    total = jnp.zeros(())
+    for path, leaf in jax.tree_util.tree_flatten_with_path(intermediates)[0]:
+        if any("aux_loss" in str(getattr(k, "key", k)) for k in path):
+            total = total + jnp.sum(leaf)
+    return total
 
 
 def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -77,22 +97,32 @@ def make_train_step(
     tx: optax.GradientTransformation,
     input_key: str = "images",
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_xent,
+    aux_loss_coeff: float = 0.0,
 ) -> Callable[[TrainState, dict], tuple[TrainState, jax.Array]]:
-    """Build `(state, batch) -> (state, loss)`; jit/pjit it at the call site."""
+    """Build `(state, batch) -> (state, loss)`; jit/pjit it at the call site.
+
+    aux_loss_coeff > 0 makes the 'intermediates' collection mutable and adds
+    `coeff * sum(sown *aux_loss*)` to the loss — REQUIRED for MoE models
+    (parallel/moe.py sows `moe_aux_loss` per layer; without this the router
+    trains with no load balancing).  GShard/Switch use coeff ≈ 0.01."""
 
     def train_step(state: TrainState, batch: dict):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
 
         def compute_loss(params):
-            logits, new_stats = _apply(
+            logits, new_stats, inters = _apply(
                 model,
                 state,
                 params,
                 batch[input_key],
                 train=True,
                 rngs={"dropout": dropout_rng},
+                capture_intermediates=aux_loss_coeff > 0.0,
             )
-            return loss_fn(logits, batch["labels"]), new_stats
+            loss = loss_fn(logits, batch["labels"])
+            if aux_loss_coeff > 0.0:
+                loss = loss + aux_loss_coeff * sown_aux_loss(inters)
+            return loss, new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             state.params
@@ -116,7 +146,7 @@ def make_eval_step(
     model: nn.Module, input_key: str = "images"
 ) -> Callable[[TrainState, dict], jax.Array]:
     def eval_step(state: TrainState, batch: dict):
-        logits, _ = _apply(model, state, state.params, batch[input_key], train=False, rngs=None)
+        logits, _, _ = _apply(model, state, state.params, batch[input_key], train=False, rngs=None)
         return logits
 
     return eval_step

@@ -22,6 +22,7 @@ chips to.  TPU-first choices:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -303,42 +304,35 @@ class TransformerLM(nn.Module):
         )
 
 
-def greedy_generate(
-    config: GPTConfig,
-    params: Any,
-    prompt: jax.Array,
-    max_new_tokens: int,
-) -> jax.Array:
-    """Greedy autoregressive decode with the fixed-shape KV cache.
+@lru_cache(maxsize=16)
+def _compiled_decode(
+    config: GPTConfig, batch: int, prompt_len: int, max_new_tokens: int
+):
+    """Build (once per shape/config) the jitted greedy-decode loop.
 
-    prompt: [batch, prompt_len] int32.  Returns [batch, prompt_len + new].
-    The whole loop is one jitted `lax.scan` over single-token steps — static
-    shapes throughout, no host round-trips.
+    jit caches are keyed on the function object, so defining the closure
+    inside every generate call would retrace and recompile the whole decode
+    scan each time — the round-1 decode benchmark was timing compiles, not
+    decoding (ADVICE r1).  Caching the closure here makes repeat calls hit
+    the compiled executable.
     """
     model = TransformerLM(config, decode=True)
-    batch, prompt_len = prompt.shape
-    if prompt_len + max_new_tokens > config.max_seq:
-        # dynamic_update_slice would silently clamp cache writes past
-        # max_seq, overwriting the last slot — fail loudly instead.
-        raise ValueError(
-            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
-            f"exceeds max_seq {config.max_seq}"
-        )
-
     # init() runs a forward pass, which writes its dummy token into the cache
-    # and advances cache_index — zero the whole collection so generation
-    # starts from an empty cache at index 0.
-    cache = jax.tree.map(
-        jnp.zeros_like,
-        model.init(
+    # and advances cache_index — we only need the structure; the zeros are
+    # created inside `run` (from ShapeDtypeStructs, so no large host constant
+    # is baked into the compiled program).
+    cache_spec = jax.eval_shape(
+        lambda: model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((batch, 1), jnp.int32),
             jnp.zeros((batch, 1), jnp.int32),
-        )["cache"],
+        )["cache"]
     )
 
     @jax.jit
     def run(params, prompt):
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_spec)
+
         # Prefill one token at a time keeps a single compiled step; the
         # prompt is short in benchmark configs.  [batch, 1] token steps.
         def step(carry, t):
@@ -366,4 +360,31 @@ def greedy_generate(
         seq = jnp.concatenate([prompt[:, :1], toks.T], axis=1)
         return seq
 
-    return run(params, prompt)
+    return run
+
+
+def greedy_generate(
+    config: GPTConfig,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Greedy autoregressive decode with the fixed-shape KV cache.
+
+    prompt: [batch, prompt_len] int32.  Returns [batch, prompt_len + new].
+    The whole loop is one jitted `lax.scan` over single-token steps — static
+    shapes throughout, no host round-trips; the compiled loop is cached per
+    (config, batch, prompt_len, max_new_tokens) so repeated calls don't
+    recompile.
+    """
+    batch, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > config.max_seq:
+        # dynamic_update_slice would silently clamp cache writes past
+        # max_seq, overwriting the last slot — fail loudly instead.
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq {config.max_seq}"
+        )
+    return _compiled_decode(config, batch, prompt_len, max_new_tokens)(
+        params, prompt
+    )

@@ -92,8 +92,11 @@ class MoeMlp(nn.Module):
         combine = jnp.where(total > 0, combine / jnp.maximum(total, 1e-9), 0.0)
         dispatch = (combine > 0).astype(x.dtype)  # [g, s, e, c]
 
-        # Load-balance auxiliary loss (GShard eq.4): mean fraction of tokens
-        # per expert * mean router prob per expert, scaled by e².
+        # Load-balance auxiliary loss (Switch form, N·Σ f·P): mean fraction
+        # of tokens per expert * mean router prob per expert, scaled by e.
+        # sow() is a no-op unless the caller makes 'intermediates' mutable —
+        # models.train.make_train_step(aux_loss_coeff=...) does that and adds
+        # this to the loss; plain apply() silently drops it.
         frac_tokens = jnp.mean(dispatch.sum(axis=-1), axis=1)  # [g, e]
         frac_probs = jnp.mean(probs, axis=1)  # [g, e]
         aux = jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1)) * e

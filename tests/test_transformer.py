@@ -88,3 +88,18 @@ def test_flash_path_used_on_tileable_seq(cfg):
     logits = model.apply({"params": p}, ids)
     assert logits.shape == (1, 128, cfg.vocab_size)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_greedy_generate_caches_compiled_loop(cfg, params):
+    """Repeat generate calls with the same shapes must reuse the compiled
+    scan (ADVICE r1: a fresh jit closure per call retraced every time and
+    the decode benchmark timed compilation, not decoding)."""
+    from k8s_device_plugin_tpu.models.transformer import _compiled_decode
+
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0, cfg.vocab_size)
+    _compiled_decode.cache_clear()
+    first = greedy_generate(cfg, params, prompt, max_new_tokens=4)
+    second = greedy_generate(cfg, params, prompt, max_new_tokens=4)
+    info = _compiled_decode.cache_info()
+    assert info.misses == 1 and info.hits >= 1, info
+    assert jnp.array_equal(first, second)
