@@ -171,31 +171,36 @@ class CausalSelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
             idx.value = cur + hidden.shape[1]
             k, v = ck.value, cv.value
-            if group > 1:  # expand kv head groups only at compute time
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
             # Mask out cache slots at or beyond the write frontier (and, with
             # a sliding window, slots that have scrolled out of the band).
-            key_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
-            q_pos = positions[:, None, :, None]  # [batch, 1, q_len, 1]
+            # Grouped einsum (g = q heads per kv head): the kv cache is read
+            # once per kv head, never expanded group× — decode is KV-cache-
+            # bandwidth-bound, so this is where GQA's HBM win lands.
+            q_len = hidden.shape[1]
+            qg = q.reshape(batch, q_len, cfg.kv_heads, group, cfg.head_dim)
+            key_pos = jnp.arange(cfg.max_seq)[None, None, None, None, :]
+            q_pos = positions[:, None, None, :, None]  # [batch, 1, 1, q_len, 1]
             mask = key_pos <= q_pos
             if cfg.attention_window is not None:
                 mask = jnp.logical_and(
                     mask, q_pos - key_pos < cfg.attention_window
                 )
             s = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+                "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
             ) * (cfg.head_dim ** -0.5)
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            attn = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(
+                batch, q_len, cfg.num_heads, cfg.head_dim
+            )
         else:
-            if group > 1:
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]
             if self.attention_fn is not None:
+                if group > 1:
+                    # sp engines (ring/Ulysses) are MHA-only: expand for them.
+                    kh = jnp.repeat(kh, group, axis=1)
+                    vh = jnp.repeat(vh, group, axis=1)
                 if cfg.attention_window is not None:
                     # The sp engines compute full causal attention; silently
                     # training full-window while decode masks to the window

@@ -49,6 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint", default=DEFAULT_ENDPOINT, help="plugin socket filename")
     p.add_argument("--resource", default=RESOURCE, help="resource name to advertise")
     p.add_argument(
+        "--resources",
+        default="",
+        help="comma-separated resource names sharing one namespace (e.g. "
+        "'google.com/tpu,google.com/tpu-slice'): serve ALL of them through "
+        "the multi-resource lifecycle manager (one plugin server + "
+        "registration each, ≙ the reference's generic dpm lister contract). "
+        "Overrides --resource/--endpoint.",
+    )
+    p.add_argument(
         "--require-chips",
         action="store_true",
         help="exit immediately if no TPU chips are discovered (default: serve an empty list; "
@@ -66,26 +75,72 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _build_multi_manager(args):
+    """--resources path: every listed name gets its own plugin server and
+    registration under one shared kubelet watch (plugin/resources.py)."""
+    from .resources import MultiResourceManager, StaticLister
+
+    pairs = []
+    for full in args.resources.split(","):
+        full = full.strip()
+        if "/" not in full:
+            raise SystemExit(
+                f"--resources entries must be namespace/name, got {full!r}"
+            )
+        pairs.append(tuple(full.rsplit("/", 1)))
+    namespaces = {ns for ns, _ in pairs}
+    if len(namespaces) != 1:
+        # The dpm lister contract scopes one manager to one namespace
+        # (reference dpm/lister.go:13-16).
+        raise SystemExit(
+            f"--resources must share one namespace, got {sorted(namespaces)}"
+        )
+
+    def new_plugin(name: str) -> TpuDevicePlugin:
+        return TpuDevicePlugin(
+            discover=lambda: discovery.discover(root=args.root),
+            health_checker=ChipHealthChecker(root=args.root),
+            metrics=default_plugin_metrics(),
+        )
+
+    lister = StaticLister(
+        [name for _, name in pairs], new_plugin, namespace=namespaces.pop()
+    )
+    return MultiResourceManager(
+        lister, plugin_dir=args.plugin_dir, pulse=args.pulse
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.json_logs)
 
-    plugin = TpuDevicePlugin(
-        discover=lambda: discovery.discover(root=args.root),
-        health_checker=ChipHealthChecker(root=args.root),
-        metrics=default_plugin_metrics(),
-    )
-    inventory = plugin.inventory  # discovery already ran once in the ctor
+    if args.resources:
+        # Multi-resource mode builds one plugin per resource inside the
+        # manager; probe inventory directly rather than via a throwaway plugin.
+        inventory = discovery.discover(root=args.root)
+        served = args.resources
+    else:
+        plugin = TpuDevicePlugin(
+            discover=lambda: discovery.discover(root=args.root),
+            health_checker=ChipHealthChecker(root=args.root),
+            metrics=default_plugin_metrics(),
+        )
+        inventory = plugin.inventory  # discovery already ran once in the ctor
+        served = args.resource
     if args.require_chips and inventory.chip_count == 0:
         log.error("no TPU chips found under %s and --require-chips is set", args.root)
         return 1
-    manager = PluginManager(
-        plugin,
-        plugin_dir=args.plugin_dir,
-        endpoint=args.endpoint,
-        resource=args.resource,
-        pulse=args.pulse,
-    )
+    if args.resources:
+        manager = _build_multi_manager(args)
+    else:
+        manager = PluginManager(
+            plugin,
+            plugin_dir=args.plugin_dir,
+            endpoint=args.endpoint,
+            resource=args.resource,
+            pulse=args.pulse,
+        )
     metrics_server = None
 
     def _on_signal(signum, _frame):
@@ -102,7 +157,7 @@ def main(argv: list[str] | None = None) -> int:
 
     log.info(
         "starting %s plugin: %d chip(s), plugin_dir=%s, pulse=%.1fs",
-        args.resource,
+        served,
         inventory.chip_count,
         args.plugin_dir,
         args.pulse,

@@ -49,6 +49,7 @@ class PluginManager:
         register_retries: int = 3,
         register_retry_delay: float = 3.0,
         watch_poll_interval: float = 1.0,
+        watch_kubelet: bool = True,
     ):
         self.plugin = plugin
         self.plugin_dir = plugin_dir
@@ -58,6 +59,11 @@ class PluginManager:
         self._register_retries = register_retries
         self._register_retry_delay = register_retry_delay
         self._watch_poll_interval = watch_poll_interval
+        # False when a MultiResourceManager owns the (single, shared) kubelet
+        # socket watch and fans events into us via handle_kubelet_* — one
+        # inotify watch per process, not per resource (≙ the reference dpm
+        # Manager owning fsnotify for all plugins, dpm/manager.go:53-84).
+        self._watch_kubelet = watch_kubelet
 
         self._lock = threading.Lock()  # guards _server lifecycle
         self._server: grpc.Server | None = None
@@ -89,12 +95,13 @@ class PluginManager:
 
     def start(self) -> None:
         self._start_and_register()
-        self._watcher = self._make_watcher()
-        self._watcher.start()
-        # Don't return until the watch is armed, or a kubelet restarting
-        # immediately after our startup would go unnoticed.
-        if not self._watcher.ready.wait(timeout=10):
-            log.warning("socket watcher failed to arm within 10s")
+        if self._watch_kubelet:
+            self._watcher = self._make_watcher()
+            self._watcher.start()
+            # Don't return until the watch is armed, or a kubelet restarting
+            # immediately after our startup would go unnoticed.
+            if not self._watcher.ready.wait(timeout=10):
+                log.warning("socket watcher failed to arm within 10s")
         if self.pulse > 0:
             self._heartbeat = threading.Thread(
                 target=self._heartbeat_loop, name="tpu-heartbeat", daemon=True
@@ -112,6 +119,10 @@ class PluginManager:
         dead watcher means restarts would go unnoticed, which IS death."""
         if self._stop.is_set():
             return False
+        if not self._watch_kubelet:
+            # An owning MultiResourceManager holds the watch; we're alive as
+            # long as we haven't been stopped.
+            return True
         return self._watcher is not None and self._watcher.is_alive()
 
     def stop_all(self) -> None:
@@ -165,20 +176,43 @@ class PluginManager:
             log.info("DevicePlugin server stopped")
 
     def _register(self) -> None:
-        """Announce ourselves on the kubelet's Registration socket."""
-        with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as channel:
-            RegistrationStub(channel).Register(
-                pb.RegisterRequest(
-                    version=constants.VERSION,
-                    endpoint=self.endpoint,
-                    resource_name=self.resource,
-                    options=pb.DevicePluginOptions(
-                        pre_start_required=False,
-                        get_preferred_allocation_available=True,
+        """Announce ourselves on the kubelet's Registration socket.
+
+        A kubelet that rejects our API version is the first failure mode
+        operators hit on version skew (the protocol says plugins must detect
+        and handle it — reference api.proto:20-22 "terminate upon
+        registration failure"; the reference dpm only logs the raw error,
+        dpm/plugin.go:148-153).  We surface a dedicated operator-facing
+        message and keep retrying with backoff from _start_and_register —
+        the kubelet may be mid-upgrade and come back compatible.
+        """
+        try:
+            with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as channel:
+                RegistrationStub(channel).Register(
+                    pb.RegisterRequest(
+                        version=constants.VERSION,
+                        endpoint=self.endpoint,
+                        resource_name=self.resource,
+                        options=pb.DevicePluginOptions(
+                            pre_start_required=False,
+                            get_preferred_allocation_available=True,
+                        ),
                     ),
-                ),
-                timeout=10,
-            )
+                    timeout=10,
+                )
+        except grpc.RpcError as e:
+            detail = (e.details() or "") if hasattr(e, "details") else ""
+            if "version" in detail.lower() or e.code() == grpc.StatusCode.INVALID_ARGUMENT:
+                log.error(
+                    "kubelet REJECTED registration of %s: %r — likely device-"
+                    "plugin API version skew (we speak %s); upgrade the plugin "
+                    "or the kubelet. Retrying with backoff in case the kubelet "
+                    "is mid-upgrade.",
+                    self.resource,
+                    detail,
+                    constants.VERSION,
+                )
+            raise
         self.registrations += 1
         self.plugin.metrics.registrations.inc()
         log.info("registered %s with kubelet (endpoint %s)", self.resource, self.endpoint)
@@ -203,8 +237,11 @@ class PluginManager:
                     e,
                 )
                 self._stop_server()
+                # Linear backoff: 1×, 2×, 3×… the base delay — a rejecting
+                # kubelet (version skew) shouldn't be hammered at a fixed
+                # cadence, but must still be re-tried promptly once upgraded.
                 if attempt < self._register_retries and not self._stop.wait(
-                    self._register_retry_delay
+                    self._register_retry_delay * attempt
                 ):
                     continue
                 break
@@ -247,6 +284,11 @@ class PluginManager:
         returns (the create event will bring us back)."""
         log.info("kubelet socket removed; stopping plugin server")
         self._stop_server()
+
+    # Public fan-in points for an owning MultiResourceManager (which holds
+    # the single shared kubelet-socket watch; see resources.py).
+    handle_kubelet_create = _on_kubelet_create
+    handle_kubelet_remove = _on_kubelet_remove
 
     # ------------------------------------------------------------- heartbeat
 

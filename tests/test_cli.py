@@ -132,3 +132,65 @@ def test_daemon_subprocess_exits_when_registration_impossible(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=5)
+
+
+def test_daemon_multi_resource_flag(tmp_path):
+    """--resources serves every name through the multi-resource manager:
+    one socket + registration per resource, clean SIGTERM teardown of all."""
+    import time
+
+    host_root = make_fake_tpu_host(tmp_path / "root", n_chips=4)
+    plugin_dir = str(tmp_path / "dp")
+    os.makedirs(plugin_dir)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "k8s_device_plugin_tpu.plugin.cli",
+                "--root",
+                host_root,
+                "--plugin-dir",
+                plugin_dir,
+                "--resources",
+                "google.com/tpu,google.com/tpu-slice",
+            ],
+            env=env,
+            cwd=REPO_ROOT,
+        )
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(kubelet.requests) < 2:
+            time.sleep(0.1)
+        names = sorted(r.resource_name for r in kubelet.requests)
+        assert names == ["google.com/tpu", "google.com/tpu-slice"]
+        for endpoint in ("google.com_tpu.sock", "google.com_tpu-slice.sock"):
+            stream = kubelet.plugin_stub(endpoint).ListAndWatch(pb.Empty())
+            assert len(next(stream).devices) == 4
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=10) == 0
+        for endpoint in ("google.com_tpu.sock", "google.com_tpu-slice.sock"):
+            assert not os.path.exists(os.path.join(plugin_dir, endpoint))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        kubelet.stop()
+
+
+def test_resources_flag_rejects_mixed_namespaces(tmp_path):
+    import pytest
+
+    host_root = make_fake_tpu_host(tmp_path / "root", n_chips=1)
+    with pytest.raises(SystemExit, match="one namespace"):
+        main(
+            [
+                "--root",
+                host_root,
+                "--plugin-dir",
+                str(tmp_path / "dp"),
+                "--resources",
+                "google.com/tpu,example.com/widget",
+            ]
+        )

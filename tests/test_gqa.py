@@ -81,3 +81,108 @@ def test_gqa_trains(cfg):
     for _ in range(8):
         state, loss = step(state, batch)
     assert float(loss) < float(first)
+
+
+# ---- GQA-native kernel path (VERDICT r1: no jnp.repeat, kv tile shared) ----
+
+
+def _rand_qkv(key, batch, heads, kv_heads, seq, dim, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, heads, seq, dim), dtype)
+    k = jax.random.normal(kk, (batch, kv_heads, seq, dim), dtype)
+    v = jax.random.normal(kv, (batch, kv_heads, seq, dim), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2, 4])
+def test_flash_kernel_gqa_forward_parity(kv_heads):
+    """flash_attention with un-expanded kv heads must equal repeat-then-MHA
+    through mha_reference — through the kernel path, not a repeat shim."""
+    from k8s_device_plugin_tpu.ops.flash_attention import (
+        flash_attention,
+        mha_reference,
+    )
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 4, kv_heads, 256, 64)
+    got = flash_attention(q, k, v, causal=True)
+    group = 4 // kv_heads
+    k_rep = jnp.repeat(k, group, axis=1)
+    v_rep = jnp.repeat(v, group, axis=1)
+    want = mha_reference(q, k_rep, v_rep, causal=True)
+    assert got.shape == q.shape
+    assert jnp.allclose(got, want, atol=2e-3), float(jnp.abs(got - want).max())
+
+
+def test_flash_kernel_gqa_backward_parity():
+    """Gradients through the GQA kernel (custom chunked VJP) must match the
+    plain-XLA repeat-then-MHA gradients for q, k, AND v — dK/dV must sum the
+    whole head group's contribution onto the shared kv head."""
+    from k8s_device_plugin_tpu.ops.flash_attention import (
+        flash_attention,
+        mha_reference,
+    )
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 4, 2, 256, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        k_rep = jnp.repeat(k, 2, axis=1)
+        v_rep = jnp.repeat(v, 2, axis=1)
+        return jnp.sum(mha_reference(q, k_rep, v_rep, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_ref, "qkv"):
+        assert got.shape == want.shape, name
+        assert jnp.allclose(got, want, atol=5e-3), (
+            name,
+            float(jnp.abs(got - want).max()),
+        )
+
+
+def test_flash_kernel_gqa_with_window():
+    """Sliding window + GQA compose in the kernel."""
+    from k8s_device_plugin_tpu.ops.flash_attention import (
+        flash_attention,
+        mha_reference,
+    )
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 4, 2, 256, 32)
+    got = flash_attention(q, k, v, causal=True, window=64)
+    want = mha_reference(
+        q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1),
+        causal=True, window=64,
+    )
+    assert jnp.allclose(got, want, atol=2e-3)
+
+
+def test_flash_kernel_rejects_indivisible_heads():
+    from k8s_device_plugin_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 4, 3, 128, 32)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, v, causal=True)
+
+
+def test_transformer_flash_path_carries_unexpanded_kv(cfg, monkeypatch):
+    """The model's non-decode flash path must hand the kernel kv tensors with
+    kv_heads (not num_heads) — proving the jnp.repeat is gone."""
+    import k8s_device_plugin_tpu.models.transformer as tr
+
+    seen = {}
+    real = tr.flash_attention
+
+    def spy(q, k, v, **kw):
+        seen["q_heads"] = q.shape[1]
+        seen["kv_heads"] = k.shape[1]
+        return real(q, k, v, **kw)
+
+    monkeypatch.setattr(tr, "flash_attention", spy)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (1, 128), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert bool(jnp.isfinite(logits).all())
+    assert seen == {"q_heads": 4, "kv_heads": 2}
