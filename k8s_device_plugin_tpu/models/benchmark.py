@@ -97,6 +97,52 @@ def build(model_name: str, args, rng):
     raise SystemExit(f"unknown model {model_name!r}")
 
 
+def checkpointed_steps(
+    step, state, batch, target_steps: int, ckpt, every: int, warmup: int = 0
+):
+    """Train from the state's current step up to ``target_steps`` (absolute),
+    saving asynchronously every ``every`` steps and once at the end.
+
+    The first ``warmup`` steps run OUTSIDE the timed region (they absorb XLA
+    compilation, like timed_steps' warmup) but are still real training steps
+    — they advance ``state.step`` and participate in the checkpoint cadence,
+    so resume arithmetic stays exact.  The final save is forced so a clean
+    exit always leaves the latest step durable; mid-run kills lose at most
+    ``every`` steps — the preemption contract the e2e test pins.
+    Returns (state, last_loss | None, timed_seconds, steps_timed).
+    """
+    start = int(jax.device_get(state.step))
+    loss = None
+
+    def body(i, state, loss):
+        state, loss = step(state, batch)
+        if (i + 1) % every == 0:
+            # Async save: block on the step result first so the saved state
+            # is the post-step one, then let orbax copy in the background.
+            jax.block_until_ready(loss)
+            ckpt.save(state)
+            log(f"checkpoint queued at step {i + 1}")
+        return state, loss
+
+    warm_until = min(start + warmup, target_steps)
+    for i in range(start, warm_until):
+        state, loss = body(i, state, loss)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(warm_until, target_steps):
+        state, loss = body(i, state, loss)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    # Final forced save — but not at a step that's already durable (a resumed
+    # run that had nothing left to do would hit orbax's step-exists error).
+    if int(jax.device_get(state.step)) != ckpt.latest_step():
+        ckpt.save(state, force=True)
+    ckpt.wait()
+    return state, loss, dt, max(target_steps - warm_until, 0)
+
+
 def run_decode(args) -> None:
     """Autoregressive decode throughput (tokens/sec) through the KV cache —
     the inference-side companion to the training benchmarks."""
@@ -165,6 +211,29 @@ def main(argv: list[str] | None = None) -> None:
         default=tracing.default_trace_dir(),
         help="write a jax.profiler trace of the timed region here",
     )
+    p.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help="orbax checkpoint directory (models/checkpoint.py). When set, "
+        "the run saves every --checkpoint-every steps and at exit, so a "
+        "preempted pod (health fault, node drain — the BASELINE config-5 "
+        "scenario) can resume instead of restarting. ≙ SURVEY §5.4: the "
+        "reference plugin is stateless because the kubelet checkpoints "
+        "device assignments; the WORKLOAD side must checkpoint itself.",
+    )
+    p.add_argument(
+        "--checkpoint-every",
+        type=_positive_int,
+        default=10,
+        help="steps between async checkpoint saves (with --checkpoint-dir)",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="restore the newest checkpoint under --checkpoint-dir before "
+        "training; --steps is then the ABSOLUTE target step, so a resumed "
+        "run finishes the remaining steps",
+    )
     args = p.parse_args(argv)
 
     # Honor an explicit JAX_PLATFORMS from the pod spec even if the image's
@@ -216,27 +285,60 @@ def main(argv: list[str] | None = None) -> None:
     else:
         batch = jax.device_put(batch, batch_sh)
 
-    with tracing.trace(args.trace_dir):
-        state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
+    resumed_from = 0
+    if args.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        if args.resume and ckpt.latest_step() is not None:
+            # Restore AFTER shard_train_step placed the state: orbax lands
+            # every leaf directly in its NamedSharding, no host round-trip.
+            state = ckpt.restore(state)
+            resumed_from = int(jax.device_get(state.step))
+            log(f"resumed from checkpoint step {resumed_from}")
+        if resumed_from >= args.steps:
+            log(
+                f"WARNING: checkpoint already at step {resumed_from} >= "
+                f"--steps {args.steps}; nothing to train. Stale checkpoint "
+                f"dir from a previous run? Clear it (or raise --steps) to "
+                f"re-benchmark."
+            )
+        with tracing.trace(args.trace_dir):
+            state, loss, dt, steps_run = checkpointed_steps(
+                step,
+                state,
+                batch,
+                args.steps,
+                ckpt,
+                args.checkpoint_every,
+                warmup=args.warmup,
+            )
+        ckpt.close()
+    else:
+        with tracing.trace(args.trace_dir):
+            state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
+        steps_run = args.steps
 
     n_chips = len(devices)
-    throughput = items_per_step * args.steps / dt
+    throughput = items_per_step * steps_run / dt if dt > 0 else 0.0
     unit = "tokens/sec" if args.model == "bert" else "images/sec"
-    print(
-        json.dumps(
-            {
-                "model": args.model,
-                "chips": n_chips,
-                "global_batch": args.batch_size,
-                "throughput": round(throughput, 2),
-                "throughput_per_chip": round(throughput / n_chips, 2),
-                "unit": unit,
-                "step_time_ms": round(dt / args.steps * 1e3, 2),
-                "final_loss": float(loss),
-            }
-        ),
-        flush=True,
-    )
+    record = {
+        "model": args.model,
+        "chips": n_chips,
+        "global_batch": args.batch_size,
+        "throughput": round(throughput, 2),
+        "throughput_per_chip": round(throughput / n_chips, 2),
+        "unit": unit,
+        "step_time_ms": round(dt / steps_run * 1e3, 2) if steps_run else 0.0,
+        "final_loss": float(loss) if loss is not None else None,
+        "final_step": int(jax.device_get(state.step)),
+    }
+    if args.checkpoint_dir:
+        record["resumed_from"] = resumed_from
+        # Stale-checkpoint rerun guard: True when this invocation trained
+        # nothing at all (checkpoint was already at/over --steps).
+        record["noop"] = record["final_step"] == resumed_from
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

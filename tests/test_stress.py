@@ -57,6 +57,7 @@ def test_concurrent_allocate_poll_and_health_flips(served_plugin):
     os.makedirs(health_dir, exist_ok=True)
     stop = threading.Event()
     errors: list = []
+    latencies: list = []  # seconds per Allocate RPC, all threads (GIL-safe append)
 
     def allocator(i):
         req = pb.AllocateRequest(
@@ -65,14 +66,17 @@ def test_concurrent_allocate_poll_and_health_flips(served_plugin):
             ]
         )
         while not stop.is_set():
+            t0 = time.perf_counter()
             try:
                 resp = stub.Allocate(req)
+                latencies.append(time.perf_counter() - t0)
                 car = resp.container_responses[0]
                 # Snapshot consistency: env must name exactly the chip asked.
                 assert car.envs["TPU_VISIBLE_CHIPS"] == str(i % N_CHIPS)
             except grpc.RpcError as e:
                 # The flipper makes chips unhealthy; that rejection is the
                 # CORRECT answer, anything else is a bug.
+                latencies.append(time.perf_counter() - t0)
                 if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
                     errors.append(e)
             except Exception as e:  # noqa: BLE001 — collect for the assert
@@ -120,6 +124,19 @@ def test_concurrent_allocate_poll_and_health_flips(served_plugin):
         t.join(timeout=10)
         assert not t.is_alive(), "worker thread hung (deadlock)"
     assert not errors, errors[:3]
+    # Allocation-latency budget (BASELINE.json secondary metric): p99 under
+    # 50 ms even with pollers, health flips, and 8 allocator threads running
+    # — the pod-startup path must never stall behind the health machinery.
+    # Client-side wall clock over GIL-contended threads is noisy on shared
+    # CI (measured ≈21 ms idle), so the budget is env-tunable for loaded
+    # runners; the default stays the documented 50 ms contract.
+    budget_ms = float(os.environ.get("ALLOCATE_P99_BUDGET_MS", "50"))
+    assert len(latencies) > 100, "too few Allocate samples to judge latency"
+    p99 = sorted(latencies)[int(len(latencies) * 0.99)]
+    print(f"Allocate p99 under stress: {p99 * 1e3:.2f} ms over {len(latencies)} calls")
+    assert p99 < budget_ms / 1e3, (
+        f"Allocate p99 {p99*1e3:.1f} ms exceeds the {budget_ms:.0f} ms budget"
+    )
 
 
 def test_stream_survives_interrupt_storm(served_plugin):
