@@ -205,6 +205,41 @@ def _inner() -> None:
                 f"{t_ref/iters*1e3:.2f} ms ({t_ref/max(t_flash,1e-9):.2f}x, "
                 f"{tf_per_s:.1f} TFLOP/s)"
             )
+            if platform != "cpu":
+                # Block sweep (VERDICT r1 next #2): find per-generation
+                # defaults once Mosaic numbers exist.  Stderr only.
+                for bq, bkv in [(128, 128), (128, 256), (128, 512), (256, 256), (256, 512), (512, 256)]:
+                    try:
+                        f = jax.jit(
+                            lambda q, bq=bq, bkv=bkv: flash_attention(
+                                q, q, q, causal=True, block_q=bq, block_kv=bkv
+                            )
+                        )
+                        jax.block_until_ready(f(q))
+                        t0 = time.perf_counter()
+                        for _ in range(iters):
+                            out = f(q)
+                        jax.block_until_ready(out)
+                        t = (time.perf_counter() - t0) / iters
+                        log(f"  block sweep q{bq}/kv{bkv}: {t*1e3:.2f} ms ({flops/t/1e12:.1f} TFLOP/s)")
+                    except Exception as e:
+                        log(f"  block sweep q{bq}/kv{bkv}: failed ({e})")
+                # GQA variant: 4x fewer kv heads must cut kv HBM traffic.
+                try:
+                    hk = shape[1] // 4
+                    kv = jax.random.normal(
+                        jax.random.PRNGKey(1), (b, hk, s, d), jnp.bfloat16
+                    )
+                    g = jax.jit(lambda q, kv: flash_attention(q, kv, kv, causal=True))
+                    jax.block_until_ready(g(q, kv))
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = g(q, kv)
+                    jax.block_until_ready(out)
+                    t = (time.perf_counter() - t0) / iters
+                    log(f"  GQA {shape[1]}q/{hk}kv heads: {t*1e3:.2f} ms ({flops/t/1e12:.1f} TFLOP/s)")
+                except Exception as e:
+                    log(f"  GQA flash bench failed: {e}")
         except Exception as e:
             log(f"flash-attention bench failed: {e}")
 

@@ -263,6 +263,13 @@ def _flash_impl(
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
             pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
         ],
+        # Mosaic grid semantics: bh and q blocks are independent (parallel);
+        # the kv axis carries the online-softmax scratch between iterations
+        # and must stay sequential (arbitrary).  Telling the compiler lets it
+        # overlap/pipeline the parallel axes instead of serializing the grid.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q3, k3, v3)
     return (

@@ -84,7 +84,16 @@ def test_clean_exit_then_resume(tmp_path):
     )
     assert second["resumed_from"] == 4
     assert second["final_step"] == 6
+    assert second["noop"] is False
     assert "resumed from checkpoint step 4" in err
+
+    # Stale-checkpoint rerun (same target): trains nothing, says so loudly.
+    third, err3 = _run(
+        ["--steps", "6", "--checkpoint-dir", ckpt, "--resume", "--checkpoint-every", "2"]
+    )
+    assert third["noop"] is True
+    assert third["final_step"] == 6
+    assert "nothing to train" in err3
 
 
 @pytest.mark.slow

@@ -139,3 +139,31 @@ def test_host_bounds_for_count_fallback():
     assert topology.host_bounds_for_count(4) == (2, 2, 1)
     assert topology.host_bounds_for_count(8) == (2, 4, 1)
     assert topology.host_bounds_for_count(3) == (3, 1, 1)
+
+
+# ------------------------------------------------- committed v5e testdata
+
+
+def test_discovery_against_committed_v5e_tree():
+    """Pin discovery against the static tests/testdata/tpu-vm-v5e tree — a
+    hand-authored v5e host layout, NOT generated by tests/fakes.py, so the
+    discovery code is checked against an independent encoding of the TPU-VM
+    surface (≙ the reference's captured testdata/topology-parsing fixture,
+    reference main_test.go:7-14)."""
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "testdata", "tpu-vm-v5e")
+    inv = discovery.discover(root=root, environ={})
+    assert inv.chip_count == 8
+    assert [c.index for c in inv.chips] == list(range(8))
+    assert inv.accelerator_type == "v5litepod-8"
+    assert inv.host_bounds == (2, 4, 1)
+    assert inv.chips_per_host_bounds_str == "2,4,1"
+    assert inv.worker_id == 0
+    assert inv.worker_hostnames == ("t1v-n-8f2c1d-w-0",)
+    # NUMA split 4+4 from sysfs numa_node.
+    assert [c.numa_node for c in inv.chips] == [0, 0, 0, 0, 1, 1, 1, 1]
+    # Generation decoding from the PCI device id (0x0063 = v5e).
+    assert all(c.generation == "v5e" for c in inv.chips)
+    # Device nodes resolve under the tree's /dev.
+    assert inv.chips[7].device_path.endswith("dev/accel7")
