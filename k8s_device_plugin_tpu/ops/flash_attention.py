@@ -122,9 +122,13 @@ def _flash_kernel(
     qi = pl.program_id(1)
 
     def _tile():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, head_dim]
-        k = k_ref[0].astype(jnp.float32)  # [block_kv, head_dim]
-        v = v_ref[0].astype(jnp.float32)
+        # Inputs stay in their storage dtype (bfloat16 in production):
+        # the MXU multiplies bf16 natively with float32 accumulation via
+        # preferred_element_type — upcasting q/k/v first would demote both
+        # matmuls to the much slower f32 MXU path.
+        q = q_ref[0]  # [block_q, head_dim]
+        k = k_ref[0]  # [block_kv, head_dim]
+        v = v_ref[0]
 
         # Scores tile on the MXU, float32 accumulation.
         s = (
@@ -156,8 +160,11 @@ def _flash_kernel(
         alpha = jnp.where(seen, jnp.exp(jnp.where(seen, m_prev - m_new, 0.0)), 0.0)
 
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        # p·v on the MXU in the inputs' dtype (bf16 weights path); the
+        # f32 statistics (m/l/acc) keep the online softmax exact.
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)

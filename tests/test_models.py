@@ -115,3 +115,36 @@ def test_eval_step_no_stat_mutation(rng):
     state = create_train_state(rng, model, batch, optax.sgd(0.1))
     logits = jax.jit(make_eval_step(model))(state, batch)
     assert logits.shape == (2, 10)
+
+
+def test_measure_two_point_clean_signal_and_noise_fallback(monkeypatch):
+    """Pin the shared two-point timer contract (models/benchmark.py):
+    a delta clearing 3x observed jitter is attributed to the extra units;
+    a delta inside the jitter falls back to scaled single-point."""
+    from k8s_device_plugin_tpu.models import benchmark as bm
+
+    # Deterministic fake clock: each callable "takes" its scripted duration.
+    script = iter([0.010, 0.010, 0.110])  # small, small, big -> dt=0.1
+    clock = [0.0]
+
+    def fake_perf():
+        return clock[0]
+
+    monkeypatch.setattr(bm.time, "perf_counter", fake_perf)
+
+    def make_run():
+        def run():
+            clock[0] += next(script)
+
+        return run
+
+    run = make_run()
+    dt, fell_back = bm.measure_two_point(run, run, n_delta=10, n_big=11)
+    assert not fell_back
+    assert abs(dt - 0.1) < 1e-9
+
+    # Jittery short runs (4ms spread) swallow a 5ms delta -> fallback.
+    script = iter([0.010, 0.014, 0.019])
+    dt, fell_back = bm.measure_two_point(run, run, n_delta=10, n_big=11)
+    assert fell_back
+    assert abs(dt - 0.019 * 10 / 11) < 1e-9
