@@ -120,9 +120,10 @@ def timed_steps(step, state, batch, warmup: int, steps: int) -> tuple:
     covers exactly ``steps`` steps with the constant dispatch+sync overhead
     (tunnel RTT, device_get latency) cancelled out.  ``warmup`` here sizes
     the short program — compilation is excluded by AOT, not by discarded
-    runs.  Returns (state, loss, seconds_for_timed_steps); the state has
-    advanced ``2*warmup + (warmup + steps)`` steps (the short program runs
-    twice to estimate timing jitter — see measure_two_point).
+    runs.  Returns (state, loss, seconds_for_timed_steps); with
+    ``small = max(1, warmup)`` the state advances ``3*small + steps`` steps
+    (the short program runs twice to estimate timing jitter — see
+    measure_two_point).
     """
     small = max(1, warmup)
     big = small + steps

@@ -208,7 +208,11 @@ def _flash_impl(
     block_kv: int,
     interpret: bool,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (out [b,h,sq,d], lse [b,h,sq] float32).
+    """Returns (out [b,h,sq,d], lse_rep [b*h, sq, 128] float32).
+
+    The returned log-sum-exp is the kernel's lane-replicated layout (every
+    lane carries the row's value); the backward kernels read it directly
+    as (1, block_q, 128) tiles, so no cross-lane reshape ever happens.
 
     GQA-native: k/v may have ``kv_heads`` dividing q's ``heads``.  The kv
     BlockSpec index map routes every q head to its group's kv head, so the
@@ -222,11 +226,7 @@ def _flash_impl(
     if heads % kv_heads:
         raise ValueError(f"q heads {heads} not a multiple of kv heads {kv_heads}")
     group = heads // kv_heads
-    if seq_q % block_q or seq_kv % block_kv:
-        raise ValueError(
-            f"seq lengths ({seq_q}, {seq_kv}) must divide by blocks "
-            f"({block_q}, {block_kv}); pad to MXU multiples first"
-        )
+    _check_blocks(seq_q, seq_kv, block_q, block_kv)
     bh = batch * heads
     q3 = q.reshape(bh, seq_q, head_dim)
     k3 = k.reshape(batch * kv_heads, seq_kv, head_dim)
@@ -279,13 +279,300 @@ def _flash_impl(
         ),
         interpret=interpret,
     )(q3, k3, v3)
-    return (
-        out.reshape(batch, heads, seq_q, head_dim),
-        lse[:, :, 0].reshape(batch, heads, seq_q),
-    )
+    return out.reshape(batch, heads, seq_q, head_dim), lse
 
 
 # ------------------------------------------------------------------- backward
+
+
+def _check_blocks(seq_q: int, seq_kv: int, block_q: int, block_kv: int) -> None:
+    if seq_q % block_q or seq_kv % block_kv:
+        raise ValueError(
+            f"seq lengths ({seq_q}, {seq_kv}) must divide by blocks "
+            f"({block_q}, {block_kv}); pad to MXU multiples first"
+        )
+
+
+def _bwd_p_tile(q, k, lse_col, rows, cols, sm_scale, causal, window):
+    """Recompute the probability tile P = exp(S·scale − lse) with masking.
+
+    Shared by both backward kernels.  ``lse_col`` is [block_q, 1] float32;
+    rows/cols are absolute index iotas for the tile.  Returns p
+    ([block_q, block_kv] float32).
+    """
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * sm_scale
+    )
+    # Rows that attended to nothing carry lse == -inf; exp(s - -inf) would
+    # be +inf, so force their P to 0 via the finite mask.
+    finite = lse_col > NEG_INF
+    p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse_col, 0.0)), 0.0)
+    if causal:
+        mask = rows >= cols
+        if window is not None:
+            mask = jnp.logical_and(mask, rows - cols < window)
+        p = jnp.where(mask, p, 0.0)
+    return p
+
+
+def _dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    o_ref,
+    lse_ref,
+    dq_ref,
+    dq_acc,
+    *,
+    sm_scale: float,
+    causal: bool,
+    window,
+    block_q: int,
+    block_kv: int,
+    num_kv_blocks: int,
+):
+    """dQ: grid (b*h, q_blocks, kv_blocks), kv innermost sequential.
+
+    Flash-style recomputation: P is rebuilt one kv tile at a time from the
+    saved lse (never [seq, seq]); dQ accumulates in a float32 VMEM scratch
+    across the kv axis and is written once on the last kv block.
+    """
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        cols = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        lse_col = lse_ref[0][:, :1]
+        p = _bwd_p_tile(q, k, lse_col, rows, cols, sm_scale, causal, window)
+        # delta_i = Σ_d dO·O per row — cheap enough to recompute per tile
+        # (block_q·d mul-adds vs the block_q·block_kv·d matmuls around it).
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
+        if window is not None:
+            live = jnp.logical_and(
+                live,
+                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
+            )
+        pl.when(live)(_tile)
+    else:
+        _tile()
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    o_ref,
+    lse_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc,
+    dv_acc,
+    *,
+    sm_scale: float,
+    causal: bool,
+    window,
+    block_q: int,
+    block_kv: int,
+    num_q_blocks: int,
+    group: int,
+):
+    """dK/dV: grid (b*kv_heads, kv_blocks, group*q_blocks), innermost
+    sequential over the whole (q-head-in-group × q-block) range.
+
+    GQA-native like the forward: one kv tile stays resident while every q
+    head of its group streams past, so the shared kv head's gradient sums
+    the whole group without any repeated K/V in HBM.
+    """
+    ki, t = pl.program_id(1), pl.program_id(2)
+    qi = t % num_q_blocks
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
+
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        cols = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        lse_col = lse_ref[0][:, :1]
+        p = _bwd_p_tile(q, k, lse_col, rows, cols, sm_scale, causal, window)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        # dK += dSᵀ·Q, dV += Pᵀ·dO — contract the q-row axis (dim 0 of both).
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
+        if window is not None:
+            live = jnp.logical_and(
+                live,
+                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
+            )
+        pl.when(live)(_tile)
+    else:
+        _tile()
+
+    @pl.when(t == group * num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(
+    q, k, v, out, lse_rep, dout, causal, window, sm_scale, block_q, block_kv, interpret
+):
+    """Fused flash backward: two Pallas kernels (dQ; dK/dV), both O(seq)
+    memory, both GQA-native.  lse_rep is the forward's lane-replicated
+    [b*h, seq_q, 128] residual — consumed tile-wise, no reshapes."""
+    batch, heads, seq_q, head_dim = q.shape
+    kv_heads, seq_kv = k.shape[1], k.shape[2]
+    group = heads // kv_heads
+    _check_blocks(seq_q, seq_kv, block_q, block_kv)
+    bh = batch * heads
+    q3 = q.reshape(bh, seq_q, head_dim)
+    do3 = dout.reshape(bh, seq_q, head_dim)
+    o3 = out.reshape(bh, seq_q, head_dim)
+    k3 = k.reshape(batch * kv_heads, seq_kv, head_dim)
+    v3 = v.reshape(batch * kv_heads, seq_kv, head_dim)
+    num_q_blocks = seq_q // block_q
+    num_kv_blocks = seq_kv // block_kv
+
+    def kv_index(b, qi, ki):
+        return (b // heads) * kv_heads + (b % heads) // group, ki, 0
+
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, head_dim), kv_index)
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            window=window,
+            block_q=block_q,
+            block_kv=block_kv,
+            num_kv_blocks=num_kv_blocks,
+        ),
+        grid=(bh, num_q_blocks, num_kv_blocks),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, o3, lse_rep)
+
+    # dK/dV grid walks (kv head, kv block, every group member × q block);
+    # index maps route each t to its q row within the group.
+    def q_row(b2, ki, t):
+        g = t // num_q_blocks
+        return (b2 // kv_heads) * heads + (b2 % kv_heads) * group + g
+
+    def q_index(b2, ki, t):
+        return q_row(b2, ki, t), t % num_q_blocks, 0
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            window=window,
+            block_q=block_q,
+            block_kv=block_kv,
+            num_q_blocks=num_q_blocks,
+            group=group,
+        ),
+        grid=(batch * kv_heads, num_kv_blocks, group * num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), q_index),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b2, ki, t: (b2, ki, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b2, ki, t: (b2, ki, 0)),
+            pl.BlockSpec((1, block_q, head_dim), q_index),
+            pl.BlockSpec((1, block_q, head_dim), q_index),
+            pl.BlockSpec((1, block_q, 128), q_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, head_dim), lambda b2, ki, t: (b2, ki, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b2, ki, t: (b2, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * kv_heads, seq_kv, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch * kv_heads, seq_kv, head_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, o3, lse_rep)
+
+    return (
+        dq.reshape(q.shape),
+        dk.reshape(k.shape),
+        dv.reshape(v.shape),
+    )
 
 
 def _mha_bwd_chunked(
@@ -408,23 +695,39 @@ def _mha_bwd_chunked(
     return dq.reshape(q.shape).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl):
     out, _ = _flash_impl(
         q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret):
-    out, lse = _flash_impl(
+def _flash_fwd(
+    q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl
+):
+    out, lse_rep = _flash_impl(
         q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
     )
-    return out, (q, k, v, out, lse)
+    if bwd_impl != "pallas":
+        # The XLA backward only reads one lane — slice the residual down to
+        # [b, h, seq] here rather than holding the 128x lane-replicated
+        # buffer live between forward and backward for every layer.
+        batch, heads, seq_q = q.shape[0], q.shape[1], q.shape[2]
+        return out, (q, k, v, out, lse_rep[:, :, 0].reshape(batch, heads, seq_q))
+    return out, (q, k, v, out, lse_rep)
 
 
-def _flash_bwd(causal, window, sm_scale, block_q, block_kv, interpret, residuals, dout):
+def _flash_bwd(
+    causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl, residuals, dout
+):
     q, k, v, out, lse = residuals
+    if bwd_impl == "pallas":
+        # lse is the lane-replicated [b*h, seq, 128] layout (see _flash_fwd).
+        return _flash_bwd_pallas(
+            q, k, v, out, lse, dout,
+            causal, window, sm_scale, block_q, block_kv, interpret,
+        )
     return _mha_bwd_chunked(
         q, k, v, out, lse, dout, causal, window, sm_scale, block_kv
     )
@@ -444,6 +747,7 @@ def flash_attention(
     block_q: int = 128,
     block_kv: int = 128,
     interpret: bool | None = None,
+    bwd_impl: str = "auto",
 ) -> jax.Array:
     """Fused attention over [batch, heads, seq, head_dim] inputs.
 
@@ -461,6 +765,11 @@ def flash_attention(
     entirely outside the band skip both matmuls, and the chunked backward
     restricts each kv block to its query band, so both passes scale
     O(seq·window) instead of O(seq²) once seq >> window.
+
+    ``bwd_impl``: "pallas" — fused flash backward kernels (dQ; dK/dV),
+    "xla" — the chunked `lax.scan` backward, "auto" (default) — pallas on
+    TPU, xla elsewhere (the interpreter is too slow for the bwd grids in
+    routine test runs; dedicated parity tests exercise the pallas path).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -471,6 +780,12 @@ def flash_attention(
             raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if bwd_impl == "auto":
+        bwd_impl = "xla" if interpret else "pallas"
+    if bwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"bwd_impl must be auto|pallas|xla, got {bwd_impl!r}")
     block_q = min(block_q, q.shape[2])
     block_kv = min(block_kv, k.shape[2])
-    return _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret)
+    return _flash(
+        q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl
+    )
