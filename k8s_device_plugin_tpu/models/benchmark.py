@@ -281,13 +281,13 @@ def run_decode(args) -> None:
     params = model.init(rng, prompt)["params"]
 
     # Two-point timing (see measure_two_point): a 1-new-token generate
-    # covers the constant costs (dispatch/sync RTT, the prompt_len-1
-    # prefill steps); the full generate adds exactly decode_tokens-1 more
-    # decode steps, so the time difference is pure decode and the reported
+    # covers the constant costs (dispatch/sync RTT plus the bulk prefill
+    # pass); the full generate adds exactly decode_tokens-1 more decode
+    # steps, so the time difference is pure decode and the reported
     # tokens/sec is neither RTT- nor prefill-diluted.  decode_tokens == 1
-    # degenerates to single-point with the prefill steps in the denominator.
+    # degenerates to single-point over all generated tokens incl. prefill.
     two_point = args.decode_tokens > 1
-    full_steps = args.prompt_len - 1 + args.decode_tokens
+    full_steps = args.decode_tokens
     t0 = time.perf_counter()
     if two_point:
         _sync(greedy_generate(cfg, params, prompt, 1))
@@ -331,7 +331,7 @@ def run_decode(args) -> None:
                 "throughput": round(total_tokens / dt, 2),
                 "unit": "decoded tokens/sec (two-point, prefill+overhead excluded)"
                 if two_point
-                else "generated tokens/sec (prefill+decode steps)",
+                else "generated tokens/sec (incl. prefill cost)",
                 "ms_per_token": round(dt / steps * 1e3, 3),
             }
         ),
