@@ -170,29 +170,53 @@ class CausalSelfAttention(nn.Module):
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
             idx.value = cur + hidden.shape[1]
-            k, v = ck.value, cv.value
-            # Mask out cache slots at or beyond the write frontier (and, with
-            # a sliding window, slots that have scrolled out of the band).
-            # Grouped einsum (g = q heads per kv head): the kv cache is read
-            # once per kv head, never expanded group× — decode is KV-cache-
-            # bandwidth-bound, so this is where GQA's HBM win lands.
             q_len = hidden.shape[1]
-            qg = q.reshape(batch, q_len, cfg.kv_heads, group, cfg.head_dim)
-            key_pos = jnp.arange(cfg.max_seq)[None, None, None, None, :]
-            q_pos = positions[:, None, None, :, None]  # [batch, 1, 1, q_len, 1]
-            mask = key_pos <= q_pos
-            if cfg.attention_window is not None:
-                mask = jnp.logical_and(
-                    mask, q_pos - key_pos < cfg.attention_window
+            if q_len > 1:
+                # Bulk prefill (static branch): attend causally WITHIN the
+                # provided tokens via the same non-decode path training
+                # uses — O(q_len²) (flash-tiled when 128-aligned) instead
+                # of an [q_len, max_seq] score tensor against the whole
+                # cache.  K/V still land in the cache above.  A multi-token
+                # append into a non-empty cache is outside this contract
+                # (greedy_generate only prefills from an empty cache).
+                qh, kh, vh = (
+                    t.transpose(0, 2, 1, 3) for t in (q, k, v)
                 )
-            s = jnp.einsum(
-                "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
-            ) * (cfg.head_dim ** -0.5)
-            s = jnp.where(mask, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            attn = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(
-                batch, q_len, cfg.num_heads, cfg.head_dim
-            )
+                if q_len % 128 == 0:
+                    attn = flash_attention(
+                        qh, kh, vh, causal=True, window=cfg.attention_window
+                    )
+                else:
+                    attn = mha_reference(
+                        qh, kh, vh, causal=True, window=cfg.attention_window
+                    )
+                attn = attn.transpose(0, 2, 1, 3).reshape(
+                    batch, q_len, cfg.num_heads, cfg.head_dim
+                )
+            else:
+                k, v = ck.value, cv.value
+                # Single-token decode: mask cache slots at or beyond the
+                # write frontier (and, with a sliding window, slots that
+                # scrolled out of the band).  Grouped einsum (g = q heads
+                # per kv head): the kv cache is read once per kv head,
+                # never expanded group× — decode is KV-cache-bandwidth-
+                # bound, so this is where GQA's HBM win lands.
+                qg = q.reshape(batch, q_len, cfg.kv_heads, group, cfg.head_dim)
+                key_pos = jnp.arange(cfg.max_seq)[None, None, None, None, :]
+                q_pos = positions[:, None, None, :, None]  # [b, 1, 1, q_len, 1]
+                mask = key_pos <= q_pos
+                if cfg.attention_window is not None:
+                    mask = jnp.logical_and(
+                        mask, q_pos - key_pos < cfg.attention_window
+                    )
+                s = jnp.einsum(
+                    "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+                ) * (cfg.head_dim ** -0.5)
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+                attn = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(
+                    batch, q_len, cfg.num_heads, cfg.head_dim
+                )
         else:
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]

@@ -39,6 +39,40 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 
+# Per-generation (block_q, block_kv) defaults, matched by device_kind
+# prefix.  A larger kv block amortizes the per-tile online-softmax state
+# update and feeds the p·v matmul a taller [block_kv, head_dim] operand;
+# block_q stays at 128 to bound VMEM (q tile + f32 accumulator + [block_q,
+# block_kv] scores).  v5e value from the round-2 bench sweep
+# (bench.py logs the full sweep each round; re-tune as data accumulates).
+_BLOCK_DEFAULTS = (
+    ("TPU v5 lite", (128, 512)),
+    ("TPU v5e", (128, 512)),
+    ("TPU v5p", (128, 512)),
+    ("TPU v4", (128, 256)),
+    ("TPU v6", (128, 512)),  # unswept: inherit v5e until a v6 sweep exists
+)
+_FALLBACK_BLOCKS = (128, 256)  # unknown TPU generation
+_INTERPRET_BLOCKS = (128, 128)  # CPU interpreter: smallest legal tiles
+
+
+def _default_blocks(interpret: bool) -> tuple[int, int]:
+    if interpret or jax.default_backend() != "tpu":
+        return _INTERPRET_BLOCKS
+    kind = jax.devices()[0].device_kind
+    for prefix, blocks in _BLOCK_DEFAULTS:
+        if kind.startswith(prefix):
+            return blocks
+    return _FALLBACK_BLOCKS
+
+
+def _fit_block(block: int, seq: int) -> int:
+    """Largest size <= block that divides ``seq`` (halving from block)."""
+    b = min(block, seq)
+    while b > 1 and seq % b:
+        b //= 2
+    return b
+
 
 def mha_reference(
     q: jax.Array,
@@ -744,8 +778,8 @@ def flash_attention(
     causal: bool = False,
     sm_scale: float | None = None,
     window: int | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool | None = None,
     bwd_impl: str = "auto",
 ) -> jax.Array:
@@ -757,8 +791,10 @@ def flash_attention(
 
     ``interpret`` defaults to running the compiled kernel on TPU and the
     Pallas interpreter elsewhere (so the same code path is testable on the
-    8-device CPU mesh).  Blocks clamp to the sequence length for short
-    sequences; sequences must divide by the (clamped) blocks.
+    8-device CPU mesh).  ``block_q``/``block_kv`` default per TPU
+    generation (``_BLOCK_DEFAULTS``, keyed on device_kind; 128/128 under
+    the interpreter) and clamp to the sequence length for short sequences;
+    sequences must divide by the (clamped) blocks.
 
     ``window`` (requires ``causal``): sliding-window local attention — each
     query sees only its ``window`` most recent positions.  Forward tiles
@@ -784,8 +820,20 @@ def flash_attention(
         bwd_impl = "xla" if interpret else "pallas"
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"bwd_impl must be auto|pallas|xla, got {bwd_impl!r}")
-    block_q = min(block_q, q.shape[2])
-    block_kv = min(block_kv, k.shape[2])
+    default_q, default_kv = _default_blocks(interpret)
+    # Defaulted blocks FIT the sequence (halve until they divide it) so a
+    # generation default of 512 never rejects a seq that 128 accepted;
+    # explicitly-passed blocks keep the strict divide-or-raise contract.
+    block_q = (
+        _fit_block(default_q, q.shape[2])
+        if block_q is None
+        else min(block_q, q.shape[2])
+    )
+    block_kv = (
+        _fit_block(default_kv, k.shape[2])
+        if block_kv is None
+        else min(block_kv, k.shape[2])
+    )
     return _flash(
         q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl
     )

@@ -96,10 +96,19 @@ def test_jit_and_vmap_compose(rng):
     )
 
 
-def test_non_divisible_seq_rejected(rng):
+def test_non_divisible_seq_rejected_for_explicit_blocks(rng):
     q, k, v = make_qkv(rng, seq=192)  # 192 % 128 != 0
     with pytest.raises(ValueError, match="divide"):
-        flash_attention(q, k, v)
+        flash_attention(q, k, v, block_q=128, block_kv=128)
+
+
+def test_default_blocks_fit_sequence(rng):
+    # Defaulted blocks halve until they divide the sequence (192 -> 64),
+    # so generation defaults never reject a workable length.
+    q, k, v = make_qkv(rng, seq=192)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
 def test_custom_scale(rng):
