@@ -31,6 +31,11 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import flash_attention, mha_reference
 
+# Large-negative logit for top-k filtering: finite (softmax/categorical
+# stay NaN-free even if every logit in a row were filtered) yet far below
+# any real logit after temperature scaling.
+NEG_LOGIT = -1e30
+
 
 @dataclass(frozen=True)
 class GPTConfig:
@@ -335,7 +340,12 @@ class TransformerLM(nn.Module):
 
 @lru_cache(maxsize=16)
 def _compiled_decode(
-    config: GPTConfig, batch: int, prompt_len: int, max_new_tokens: int
+    config: GPTConfig,
+    batch: int,
+    prompt_len: int,
+    max_new_tokens: int,
+    temperature: float | None = None,
+    top_k: int | None = None,
 ):
     """Build (once per shape/config) the jitted greedy-decode loop.
 
@@ -358,8 +368,21 @@ def _compiled_decode(
         )["cache"]
     )
 
+    def pick(logits, key):
+        """Next-token selection from [batch, vocab] logits — greedy when no
+        temperature, else temperature(+top-k) categorical sampling.  The
+        branch is STATIC (part of the compile cache key), so the compiled
+        scan contains exactly one selection path."""
+        if temperature is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / max(temperature, 1e-6)
+        if top_k is not None:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, NEG_LOGIT, scaled)
+        return jax.random.categorical(key, scaled).astype(jnp.int32)
+
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng):
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_spec)
 
         # Bulk prefill: ONE forward over the whole prompt writes all of its
@@ -372,7 +395,9 @@ def _compiled_decode(
             {"params": params, "cache": cache}, prompt, pos, mutable=["cache"]
         )
         cache = mut["cache"]
-        first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+        first = pick(
+            logits[:, -1, :], jax.random.fold_in(rng, prompt_len - 1)
+        )[:, None]
 
         # Decode: single-token steps through the cache, scanned under jit.
         def step(carry, t):
@@ -384,7 +409,7 @@ def _compiled_decode(
                 pos,
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+            nxt = pick(logits[:, -1, :], jax.random.fold_in(rng, t))[:, None]
             return (mut["cache"], nxt), nxt[:, 0]
 
         (_, _), toks = jax.lax.scan(
@@ -414,6 +439,47 @@ def greedy_generate(
     calls don't recompile.
     """
     batch, prompt_len = prompt.shape
+    _check_decode_fits(config, prompt_len, max_new_tokens)
+    return _compiled_decode(config, batch, prompt_len, max_new_tokens)(
+        params, prompt, jax.random.PRNGKey(0)  # unused by the greedy path
+    )
+
+
+def sample_generate(
+    config: GPTConfig,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+) -> jax.Array:
+    """Stochastic autoregressive decode: temperature (+ optional top-k)
+    categorical sampling through the same cached/prefilled scan as
+    :func:`greedy_generate` — the sampler is a static branch in the
+    compiled program, keyed into the compile cache alongside the shapes.
+
+    Deterministic given ``rng`` (keys are folded per position), so runs are
+    reproducible and batch elements draw independent tokens.
+    """
+    if temperature <= 0:
+        raise ValueError(
+            f"temperature must be > 0, got {temperature}; use greedy_generate "
+            "for argmax decoding"
+        )
+    if top_k is not None and not 1 <= top_k <= config.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={config.vocab_size}], got {top_k}"
+        )
+    batch, prompt_len = prompt.shape
+    _check_decode_fits(config, prompt_len, max_new_tokens)
+    return _compiled_decode(
+        config, batch, prompt_len, max_new_tokens, float(temperature), top_k
+    )(params, prompt, rng)
+
+
+def _check_decode_fits(config: GPTConfig, prompt_len: int, max_new_tokens: int):
     if prompt_len + max_new_tokens > config.max_seq:
         # dynamic_update_slice would silently clamp cache writes past
         # max_seq, overwriting the last slot — fail loudly instead.
@@ -421,6 +487,3 @@ def greedy_generate(
             f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
             f"exceeds max_seq {config.max_seq}"
         )
-    return _compiled_decode(config, batch, prompt_len, max_new_tokens)(
-        params, prompt
-    )

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import optax
+import numpy as np
 import pytest
 
 from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
@@ -103,3 +104,39 @@ def test_greedy_generate_caches_compiled_loop(cfg, params):
     info = _compiled_decode.cache_info()
     assert info.misses == 1 and info.hits >= 1, info
     assert jnp.array_equal(first, second)
+
+
+def test_sample_generate_topk1_equals_greedy(cfg, params):
+    from k8s_device_plugin_tpu.models.transformer import sample_generate
+
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    greedy = greedy_generate(cfg, params, prompt, max_new_tokens=4)
+    # top_k=1 keeps only the argmax token; any temperature then samples it.
+    sampled = sample_generate(
+        cfg, params, prompt, 4, rng=jax.random.PRNGKey(0), temperature=0.7, top_k=1
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_sample_generate_deterministic_given_key_and_varies_across_keys(cfg, params):
+    from k8s_device_plugin_tpu.models.transformer import sample_generate
+
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, cfg.vocab_size)
+    a = sample_generate(cfg, params, prompt, 6, rng=jax.random.PRNGKey(1), temperature=5.0)
+    b = sample_generate(cfg, params, prompt, 6, rng=jax.random.PRNGKey(1), temperature=5.0)
+    c = sample_generate(cfg, params, prompt, 6, rng=jax.random.PRNGKey(2), temperature=5.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # At temperature 5 on an untrained model, identical draws across keys
+    # would mean the rng is being ignored.
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert a.shape == (2, 14)
+
+
+def test_sample_generate_rejects_bad_args(cfg, params):
+    from k8s_device_plugin_tpu.models.transformer import sample_generate
+
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        sample_generate(cfg, params, prompt, 2, rng=jax.random.PRNGKey(0), temperature=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        sample_generate(cfg, params, prompt, 2, rng=jax.random.PRNGKey(0), top_k=0)
