@@ -270,7 +270,7 @@ def checkpointed_steps(
 def run_decode(args) -> None:
     """Autoregressive decode throughput (tokens/sec) through the KV cache —
     the inference-side companion to the training benchmarks."""
-    from .transformer import TransformerLM, greedy_generate
+    from .transformer import TransformerLM, greedy_generate, sample_generate
 
     cfg = _gpt_config(args)
     model = TransformerLM(cfg)
@@ -279,6 +279,15 @@ def run_decode(args) -> None:
         rng, (args.batch_size, args.prompt_len), 0, cfg.vocab_size
     )
     params = model.init(rng, prompt)["params"]
+
+    if args.temperature is not None:
+        sample_rng = jax.random.PRNGKey(1)
+
+        def greedy_generate(cfg, params, prompt, n):  # noqa: F811 — same timing path
+            return sample_generate(
+                cfg, params, prompt, n,
+                rng=sample_rng, temperature=args.temperature, top_k=args.top_k,
+            )
 
     # Two-point timing (see measure_two_point): a 1-new-token generate
     # covers the constant costs (dispatch/sync RTT plus the bulk prefill
@@ -323,6 +332,9 @@ def run_decode(args) -> None:
         json.dumps(
             {
                 "model": "gpt-decode",
+                "sampler": "greedy"
+                if args.temperature is None
+                else f"temperature={args.temperature},top_k={args.top_k}",
                 "chips": len(jax.devices()),
                 "batch": args.batch_size,
                 "prompt_len": args.prompt_len,
@@ -355,6 +367,16 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--mp", type=int, default=1, help="param-sharding axis size")
     p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode prompt")
     p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode new tokens")
+    p.add_argument(
+        "--temperature",
+        type=float,
+        default=None,
+        help="gpt-decode: sample with this temperature instead of greedy argmax",
+    )
+    p.add_argument(
+        "--top-k", type=_positive_int, default=None,
+        help="gpt-decode: restrict sampling to the k highest logits",
+    )
     p.add_argument("--tiny", action="store_true", help="tiny gpt config (CPU smoke)")
     p.add_argument(
         "--trace-dir",
