@@ -181,6 +181,20 @@ def build(model_name: str, args, rng):
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
         batch = synthetic_image_batch(rng, args.batch_size, args.image_size)
         return model, batch, "images", args.batch_size
+    if model_name == "vit":
+        from .vit import ViT, ViTConfig
+
+        if args.tiny:
+            cfg = ViTConfig.tiny()
+        else:
+            # 256px/patch16 = 256 tokens — 128-aligned, so the encoder takes
+            # the fused flash path end to end; --image-size overrides.
+            cfg = ViTConfig(image_size=args.image_size if args.image_size != 224 else 256)
+        model = ViT(cfg)
+        batch = synthetic_image_batch(
+            rng, args.batch_size, cfg.image_size, num_classes=cfg.num_classes
+        )
+        return model, batch, "images", args.batch_size
     if model_name == "bert":
         model = Bert(BertConfig.base())
         batch = synthetic_token_batch(rng, args.batch_size, args.seq_len)
@@ -355,7 +369,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="tpu-benchmark")
     p.add_argument(
         "--model",
-        choices=["alexnet", "resnet50", "bert", "gpt", "gpt-decode"],
+        choices=["alexnet", "resnet50", "vit", "bert", "gpt", "gpt-decode"],
         default="resnet50",
     )
     p.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
@@ -377,7 +391,7 @@ def main(argv: list[str] | None = None) -> None:
         "--top-k", type=_positive_int, default=None,
         help="gpt-decode: restrict sampling to the k highest logits",
     )
-    p.add_argument("--tiny", action="store_true", help="tiny gpt config (CPU smoke)")
+    p.add_argument("--tiny", action="store_true", help="tiny model config (CPU smoke; gpt and vit)")
     p.add_argument(
         "--trace-dir",
         default=tracing.default_trace_dir(),

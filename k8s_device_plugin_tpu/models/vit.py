@@ -1,0 +1,141 @@
+"""Vision Transformer (ViT) image classifier in Flax.
+
+The reference delegates all model code to its workload images (SURVEY.md
+§2.4: the plugin ships convnet benchmark pods only); this framework's
+workload layer is first-party, and ViT completes the image-model family
+next to the convnets (alexnet.py, resnet.py): patchify -> encoder stack ->
+classification head, the architecture modern TPU image benchmarks use.
+
+TPU-first choices:
+- Patch embedding is a single strided conv = one big MXU matmul per image;
+  patch 16 on 224-inputs yields 196 tokens, padded with the [CLS] token to
+  197 — attention therefore runs the plain-XLA path unless the token count
+  is 128-aligned, so the default benchmark config uses image 256 / patch 16
+  = 256 tokens + pad-free [CLS]-less mean pooling, which IS 128-aligned and
+  takes the fused flash kernel (ops/flash_attention.py) end to end.
+- bfloat16 activations, float32 layernorm/softmax, learned position
+  embeddings (static shapes; no interpolation inside jit).
+- Mean pooling instead of a [CLS] token keeps the sequence length a
+  multiple of 128 for the kernel and drops a serial gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.flash_attention import flash_attention
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 256
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_tokens(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def base() -> "ViTConfig":
+        """ViT-B/16 on 256px inputs: 256 tokens — flash-kernel aligned."""
+        return ViTConfig()
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        """Structural stand-in for CPU tests."""
+        return ViTConfig(
+            image_size=32,
+            patch_size=8,
+            num_classes=10,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+        )
+
+
+class ViTEncoderLayer(nn.Module):
+    """Pre-LN encoder block (ViT uses pre-norm, unlike BERT's post-norm)."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        x = nn.LayerNorm(dtype=jnp.float32)(hidden).astype(cfg.dtype)
+        proj = {
+            name: nn.DenseGeneral(
+                features=(cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+            )(x)
+            for name in ("query", "key", "value")
+        }
+        seq_len = hidden.shape[1]
+        if seq_len % 128 == 0:
+            q, k, v = (
+                proj[n].transpose(0, 2, 1, 3) for n in ("query", "key", "value")
+            )
+            attn = flash_attention(q, k, v).transpose(0, 2, 1, 3)
+        else:
+            attn = nn.dot_product_attention(
+                proj["query"], proj["key"], proj["value"]
+            )
+        attn = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(attn)
+        hidden = hidden + attn
+
+        x = nn.LayerNorm(dtype=jnp.float32)(hidden).astype(cfg.dtype)
+        x = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(x)
+        return hidden + x
+
+
+class ViT(nn.Module):
+    """Patchify -> pre-LN encoder stack -> mean-pool -> class logits."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.config
+        b, h, w, c = images.shape
+        if h != cfg.image_size or w != cfg.image_size:
+            raise ValueError(
+                f"expected {cfg.image_size}x{cfg.image_size} images, got {h}x{w}"
+            )
+        # One strided conv patchifies and embeds in a single MXU pass:
+        # [b, H/P, W/P, hidden].
+        x = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=cfg.dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(b, cfg.num_tokens, cfg.hidden_size)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, cfg.num_tokens, cfg.hidden_size),
+        )
+        x = x + pos.astype(cfg.dtype)
+
+        for i in range(cfg.num_layers):
+            x = ViTEncoderLayer(cfg, name=f"layer_{i}")(x)
+
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        pooled = jnp.mean(x, axis=1)  # token-mean pooling, 128-friendly
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(pooled)

@@ -148,3 +148,43 @@ def test_measure_two_point_clean_signal_and_noise_fallback(monkeypatch):
     dt, fell_back = bm.measure_two_point(run, run, n_delta=10, n_big=11)
     assert fell_back
     assert abs(dt - 0.019 * 10 / 11) < 1e-9
+
+
+def test_vit_forward_shape_and_flash_alignment(rng):
+    from k8s_device_plugin_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()  # 32px / patch 8 -> 16 tokens (XLA path)
+    model = ViT(cfg)
+    batch = synthetic_image_batch(rng, 2, image_size=cfg.image_size, num_classes=cfg.num_classes)
+    variables = model.init(rng, batch["images"])
+    logits = model.apply(variables, batch["images"])
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+    # base(): 256/16 = 16x16 = 256 tokens, a multiple of 128 — the config
+    # contract that keeps the encoder on the fused flash path.
+    assert ViTConfig.base().num_tokens % 128 == 0
+
+
+def test_vit_train_step_decreases_loss(rng):
+    from k8s_device_plugin_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    model = ViT(cfg)
+    batch = synthetic_image_batch(rng, 4, image_size=cfg.image_size, num_classes=cfg.num_classes)
+    tx = optax.adamw(1e-3)
+    state = create_train_state(rng, model, batch, tx)
+    step = jax.jit(make_train_step(model, tx))
+    state, loss0 = step(state, batch)
+    for _ in range(4):
+        state, loss = step(state, batch)
+    assert float(loss) < float(loss0)
+
+
+def test_vit_rejects_wrong_image_size(rng):
+    from k8s_device_plugin_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    model = ViT(cfg)
+    bad = jnp.zeros((1, cfg.image_size * 2, cfg.image_size * 2, 3))
+    with pytest.raises(ValueError, match="expected"):
+        model.init(jax.random.PRNGKey(0), bad)
