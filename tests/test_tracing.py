@@ -84,3 +84,19 @@ def test_benchmark_gpt_decode_smoke(capsys, tmp_path):
     assert out["new_tokens"] == 8
     assert out["throughput"] > 0
     assert os.path.isdir(tmp_path / "trace")
+
+
+def test_benchmark_sampled_decode_smoke(capsys):
+    from k8s_device_plugin_tpu.models import benchmark
+
+    benchmark.main(
+        [
+            "--model", "gpt-decode", "--tiny",
+            "--batch-size", "2", "--prompt-len", "4", "--decode-tokens", "6",
+            "--temperature", "0.8", "--top-k", "16",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "gpt-decode"
+    assert out["sampler"] == "temperature=0.8,top_k=16"
+    assert out["throughput"] > 0
