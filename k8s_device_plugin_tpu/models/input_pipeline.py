@@ -1,0 +1,135 @@
+"""Host→device input pipeline: prefetching loader for real (non-synthetic)
+training data.
+
+The reference has no input pipeline at all (its benchmark pod trains on
+random data — SURVEY.md §6), and the synthetic batches in data.py keep the
+benchmarks loader-free on purpose.  Real workloads on the allocated chips do
+need one, and on TPU its job is exactly two things:
+
+1. keep the host-side batch production OFF the critical path (a worker
+   thread runs the user's iterator), and
+2. land batches in device/sharded memory AHEAD of the step that consumes
+   them, so the `jax.device_put` H2D copy overlaps the previous step's
+   compute instead of serializing with it.
+
+This is the standard double-buffering recipe (a bounded queue of
+already-device-put batches) expressed framework-side, so every workload
+gets it rather than reimplementing it per model.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+
+# Sentinels — distinct objects, never equal to user batches.
+_END = object()
+
+
+class _Error:
+    """Carries a worker exception (with traceback) across the queue."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch_to_device(
+    batches: Iterable[Any],
+    size: int = 2,
+    sharding: Any | None = None,
+) -> Iterator[Any]:
+    """Iterate ``batches`` with a ``size``-deep device-side prefetch buffer.
+
+    A daemon worker thread pulls from ``batches`` (any iterable of pytrees
+    — numpy arrays, nested dicts), `jax.device_put`s each batch (onto
+    ``sharding`` — a `Sharding` or pytree of them — when given, else the
+    default device), and parks it in a bounded queue.  The consumer gets
+    batches that are already on device, so the H2D copy for batch N+1
+    overlaps the compute of batch N; ``size=2`` (double buffering) is
+    enough to hide the copy whenever one copy is faster than one step.
+
+    Exceptions in the user iterator propagate to the consumer at the point
+    of `next()`; the worker exits on generator close or consumer GC.  The
+    buffer holds device arrays, not host memory — HBM cost is
+    ``size × batch_bytes``.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def close_source() -> None:
+        # Close the user's generator from the worker's every exit path so
+        # its with-blocks/finally run promptly, not at some later GC.
+        close = getattr(batches, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+
+    def worker() -> None:
+        try:
+            for batch in batches:
+                on_device = (
+                    jax.device_put(batch, sharding)
+                    if sharding is not None
+                    else jax.device_put(batch)
+                )
+                if not put(on_device):
+                    return
+            put(_END)
+        except BaseException as e:  # delivered to the consumer, not lost
+            put(_Error(e))
+        finally:
+            close_source()
+
+    # Validation above and thread start here are EAGER (this is a plain
+    # function returning an inner generator, not itself a generator): bad
+    # arguments fail at the call site, and the first batches are already
+    # being produced/device_put while the caller finishes its setup.
+    thread = threading.Thread(target=worker, name="prefetch-to-device", daemon=True)
+    thread.start()
+
+    def consume() -> Iterator[Any]:
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, _Error):
+                    raise item.exc
+                yield item
+        finally:
+            # Generator closed (break / GC / exception in the consumer):
+            # tell the worker to stop instead of blocking on a full queue.
+            stop.set()
+
+    return consume()
+
+
+def batches_from(
+    make_batch: Callable[[int], Any], num_batches: int | None = None
+) -> Iterator[Any]:
+    """Adapter: index-based batch factory -> iterator (``None`` = endless).
+
+    The factory runs on the prefetch worker thread, so host-side work
+    (decode, augment, pack) it does is off the training critical path.
+    """
+    i = 0
+    while num_batches is None or i < num_batches:
+        yield make_batch(i)
+        i += 1

@@ -1,0 +1,134 @@
+"""Prefetching input pipeline: ordering, device placement, sharded puts,
+error propagation, early-close shutdown."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_device_plugin_tpu.models.input_pipeline import batches_from, prefetch_to_device
+
+
+def test_order_and_device_placement():
+    batches = [{"x": np.full((4, 4), i, np.float32)} for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(b["x"]), np.full((4, 4), i))
+
+
+def test_sharded_put_lands_on_mesh():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devices, ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    batches = [np.arange(16, dtype=np.float32).reshape(16, 1)]
+    (out,) = prefetch_to_device(iter(batches), size=1, sharding=sharding)
+    assert out.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(out), batches[0])
+
+
+def test_iterator_error_propagates():
+    def gen():
+        yield np.zeros((2,), np.float32)
+        raise RuntimeError("loader blew up")
+
+    it = prefetch_to_device(gen(), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader blew up"):
+        next(it)
+
+
+def test_early_close_stops_worker():
+    produced = []
+
+    def gen():
+        i = 0
+        while True:
+            produced.append(i)
+            yield np.full((2,), i, np.float32)
+            i += 1
+
+    it = prefetch_to_device(gen(), size=1)
+    next(it)
+    it.close()  # consumer walks away mid-stream
+    n_threads = lambda: sum(
+        t.name == "prefetch-to-device" and t.is_alive()
+        for t in threading.enumerate()
+    )
+    deadline = time.time() + 5
+    while time.time() < deadline and n_threads():
+        time.sleep(0.05)
+    assert n_threads() == 0, "prefetch worker did not shut down after close"
+    # Bounded lookahead: worker can't have run far beyond the buffer.
+    assert len(produced) <= 4
+
+
+def test_batches_from_adapter():
+    it = batches_from(lambda i: {"step": np.int32(i)}, num_batches=3)
+    out = list(prefetch_to_device(it, size=2))
+    assert [int(b["step"]) for b in out] == [0, 1, 2]
+
+
+def test_prefetch_overlaps_production():
+    """With a buffer, slow production overlaps consumption: overlapped wall
+    time must beat an in-test serial measurement by a real margin (the
+    serial baseline absorbs this machine's sleep()/scheduling overshoot,
+    so the assertion doesn't flake on loaded CI)."""
+    n, delay = 5, 0.05
+
+    def gen():
+        for i in range(n):
+            time.sleep(delay)
+            yield np.full((2,), i, np.float32)
+
+    t0 = time.perf_counter()
+    for _ in gen():  # serial baseline: produce then consume, no overlap
+        time.sleep(delay)
+    serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in prefetch_to_device(gen(), size=2):
+        time.sleep(delay)  # pretend to train
+    overlapped = time.perf_counter() - t0
+    # Ideal overlap is ~(n+1)/(2n) of serial (~0.6 here); require < 0.85.
+    assert overlapped < 0.85 * serial, (
+        f"no overlap: {overlapped:.3f}s vs serial {serial:.3f}s"
+    )
+
+
+def test_bad_size_rejected_eagerly():
+    # Plain-function contract: bad arguments fail AT THE CALL SITE, not at
+    # the first next() deep inside a training loop.
+    with pytest.raises(ValueError, match="size"):
+        prefetch_to_device(iter([]), size=0)
+
+
+def test_early_close_closes_source_generator():
+    """The worker must close() the source generator on consumer walk-away,
+    so loader with-blocks/finally run promptly, not at GC."""
+    closed = []
+
+    def gen():
+        try:
+            i = 0
+            while True:
+                yield np.full((2,), i, np.float32)
+                i += 1
+        finally:
+            closed.append(True)
+
+    it = prefetch_to_device(gen(), size=1)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and not closed:
+        time.sleep(0.05)
+    assert closed, "source generator was not closed after consumer close"
