@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring import _shard_map
+from .ring import shard_map_unchecked
 
 
 def stack_stage_params(stage_params: list) -> Any:
@@ -34,6 +34,12 @@ def stack_stage_params(stage_params: list) -> Any:
     All stages must share a tree structure and leaf shapes (same layer type
     per stage — the GPipe regime)."""
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def mse_loss(y: jax.Array, t: jax.Array) -> jax.Array:
+    """Default pipeline objective — the ONE shared definition (1F1B imports
+    it too, so GPipe-vs-1F1B comparisons share an identical loss)."""
+    return jnp.mean((y.astype(jnp.float32) - t.astype(jnp.float32)) ** 2)
 
 
 def pipeline_apply(
@@ -112,14 +118,7 @@ def pipeline_apply(
         jax.tree.map(lambda _: P(axis), stacked_params),
         P(),
     )
-    try:
-        fn = _shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
-        )
-    except TypeError:
-        fn = _shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False
-        )
+    fn = shard_map_unchecked(body, mesh=mesh, in_specs=in_specs, out_specs=P())
     return fn(stacked_params, microbatches)
 
 
@@ -134,7 +133,7 @@ def pipelined_loss_fn(
     (targets shaped like the pipeline output).  Default loss: MSE."""
 
     if loss is None:
-        loss = lambda y, t: jnp.mean((y.astype(jnp.float32) - t.astype(jnp.float32)) ** 2)
+        loss = mse_loss
 
     def fn(stacked_params, microbatches, targets):
         y = pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis)

@@ -1,0 +1,179 @@
+"""1F1B (one-forward-one-backward) pipeline schedule over a ``pp`` mesh axis.
+
+GPipe (pipeline.py) runs ALL forwards then lets autodiff run all backwards,
+so every stage holds residuals for every in-flight microbatch — activation
+memory grows O(n_micro).  1F1B interleaves: a stage starts the backward of
+microbatch m as soon as the cotangent arrives, so at most ``2*n_stages - 1``
+residuals are ever live per stage — activation memory is O(n_stages),
+INDEPENDENT of the microbatch count, which is what lets long accumulation
+horizons (big global batches) fit in HBM.
+
+TPU-first shape, same as the GPipe member: the whole schedule is ONE
+``lax.scan`` inside ``shard_map`` — each tick every device does one forward
+unit and one backward unit (garbage-in/garbage-out outside its active
+window, with stores masked), activations ``ppermute`` rightward and
+cotangents leftward over neighbor ICI links every tick, and the trip count
+``n_micro + 2*n_stages - 1`` is static.  The backward recomputes the
+stage forward from the saved INPUT activation (per-stage rematerialization:
+the residual ring buffer stores inputs, not flax intermediates), which is
+the standard memory/FLOPs trade for hand-scheduled pipelines.
+
+Schedule (stage s of L, microbatch m):
+  forward  at tick  t = m + s
+  backward at tick  t = m + 2L - 1 - s
+so the last stage turns a microbatch around in one tick, and stage 0's
+steady state alternates strictly F,B — the 1F1B invariant.
+
+No reference analogue (SURVEY.md §2.4: the reference ships no parallelism
+code); this completes the pipeline family next to GPipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .pipeline import mse_loss
+from .ring import shard_map_unchecked
+
+
+def residual_buffer_depth(n_micro: int, n_stages: int) -> int:
+    """Live input-residuals per stage under 1F1B: a residual written at
+    tick ``m+s`` is read at ``m+2L-1-s``, so at most ``2L-1`` slots are
+    ever occupied — independent of the microbatch count (the schedule's
+    memory guarantee, pinned by tests)."""
+    return min(n_micro, 2 * n_stages - 1)
+
+
+def pipeline_1f1b_grads(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    loss: Callable[[jax.Array, jax.Array], jax.Array] = mse_loss,
+) -> tuple[jax.Array, Any]:
+    """Pipelined loss + parameter gradients under the 1F1B schedule.
+
+    Args:
+      stage_fn: ``(one_stage_params, x) -> y`` with ``y.shape == x.shape``.
+      stacked_params: pytree with leading dim ``n_stages``
+        (:func:`..pipeline.stack_stage_params`), sharded over ``axis``.
+      microbatches: ``[n_micro, ...]`` activation stream (replicated).
+      targets: ``[n_micro, ...]`` per-microbatch targets (replicated).
+      loss: differentiable ``(y, target) -> scalar``; the total objective
+        is the MEAN over microbatches (matching pipelined_loss_fn).
+
+    Returns ``(loss, grads)``: scalar mean loss (replicated) and gradients
+    shaped/sharded exactly like ``stacked_params``.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(
+            f"stacked_params lead dim {lead} != mesh axis {axis}={n_stages}"
+        )
+    buf_depth = residual_buffer_depth(n_micro, n_stages)
+    ticks = n_micro + 2 * n_stages - 1
+
+    def body(params_local, stream, tgts):
+        params_me = jax.tree.map(lambda leaf: leaf[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        is_last = stage == n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        x_shape = stream.shape[1:]
+        zeros_x = jnp.zeros(x_shape, stream.dtype)
+        init = (
+            zeros_x,  # activation arriving from the left
+            zeros_x,  # cotangent arriving from the right
+            jnp.zeros((buf_depth,) + x_shape, stream.dtype),  # input residuals
+            jax.tree.map(lambda p: jnp.zeros_like(p), params_me),  # grad acc
+            jnp.zeros((), jnp.float32),  # loss acc (last stage only)
+        )
+
+        def tick(carry, t):
+            act_in, ct_in, buf, gacc, lacc = carry
+
+            # ---- backward residual read FIRST ---------------------------
+            # At tick t = m + 2L-1 (stage 0, full buffer) the forward unit
+            # writes microbatch t's input into the very ring slot holding
+            # microbatch m's residual; the read and the write never concern
+            # the same microbatch in one tick (2L-1-s == s has no integer
+            # solution), so reading before writing is always correct and
+            # makes buf_depth = 2L-1 sufficient.
+            mb = t - (2 * n_stages - 1 - stage)
+            active_b = jnp.logical_and(mb >= 0, mb < n_micro)
+            slot = jnp.clip(mb, 0, n_micro - 1) % buf_depth
+            x_saved = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+
+            # ---- forward unit: microbatch mf = t - stage ----------------
+            mf = t - stage
+            active_f = jnp.logical_and(mf >= 0, mf < n_micro)
+            feed = jax.lax.dynamic_index_in_dim(
+                stream, jnp.clip(mf, 0, n_micro - 1), 0, keepdims=False
+            )
+            x = jnp.where(stage == 0, feed, act_in)
+            buf = jax.lax.cond(
+                active_f,
+                lambda b: jax.lax.dynamic_update_index_in_dim(
+                    b, x, jnp.clip(mf, 0, n_micro - 1) % buf_depth, 0
+                ),
+                lambda b: b,
+                buf,
+            )
+            y = stage_fn(params_me, x)
+
+            # ---- backward unit: microbatch mb = t - (2L - 1 - stage) ----
+            tgt = jax.lax.dynamic_index_in_dim(
+                tgts, jnp.clip(mb, 0, n_micro - 1), 0, keepdims=False
+            )
+            # Recompute this stage's forward from the saved input and pull
+            # gradients through it (per-stage remat).
+            y2, vjp = jax.vjp(stage_fn, params_me, x_saved)
+            # Cotangent seed: the last stage differentiates the loss itself
+            # (mean over microbatches -> 1/n_micro factor); inner stages use
+            # the cotangent ppermuted from the right.
+            loss_ct = jax.grad(lambda yy: loss(yy, tgt) / n_micro)(
+                y2.astype(jnp.float32)
+            ).astype(y2.dtype)
+            ct_use = jnp.where(is_last, loss_ct, ct_in)
+            dparams, dx = vjp(ct_use)
+            gmask = active_b.astype(jnp.float32)
+            gacc = jax.tree.map(
+                lambda g, d: g + gmask.astype(d.dtype) * d, gacc, dparams
+            )
+            lacc = lacc + jnp.where(
+                jnp.logical_and(active_b, is_last),
+                loss(y2, tgt).astype(jnp.float32),
+                0.0,
+            )
+
+            # ---- neighbor exchange (collectives run unconditionally) ----
+            act_next = jax.lax.ppermute(y, axis, fwd_perm)
+            ct_next = jax.lax.ppermute(dx, axis, bwd_perm)
+            return (act_next, ct_next, buf, gacc, lacc), None
+
+        (_, _, _, gacc, lacc), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # Loss lives on the last stage only; psum replicates it (others
+        # contributed 0).  Grads go back out stage-sharded.
+        loss_total = jax.lax.psum(lacc, axis) / n_micro
+        grads_out = jax.tree.map(lambda g: g[None], gacc)
+        return loss_total, grads_out
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        P(),
+        P(),
+    )
+    out_specs = (P(), jax.tree.map(lambda _: P(axis), stacked_params))
+    fn = shard_map_unchecked(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )
+    return fn(stacked_params, microbatches, targets)

@@ -36,6 +36,21 @@ except ImportError:  # older: experimental namespace
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def shard_map_unchecked(body, *, mesh, in_specs, out_specs):
+    """`shard_map` with varying-manual-axes checking off, across the JAX
+    kwarg rename (`check_vma` >= 0.8, `check_rep` before).  The single home
+    for this version shim — ulysses/pipeline/1F1B bodies all mix replicated
+    inputs with per-device collectives, which the checker rejects."""
+    try:
+        return _shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:
+        return _shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
 def _mark_varying(tree, axis_name):
     """Tag device-invariant values as varying over ``axis_name`` (shard_map
     tracks varying manual axes; scan carries must agree).  API drifted:

@@ -27,7 +27,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention, mha_reference
-from .ring import _shard_map
+from .ring import shard_map_unchecked
 
 
 def ulysses_attention(
@@ -124,16 +124,9 @@ def ulysses_self_attention(
     # The Pallas call inside the body reports no varying-manual-axes info on
     # its outputs, so shard_map's vma checking must be off (check_rep on
     # pre-0.8 jax spellings).
-    try:
-        shard_mapped = _shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )
-    except TypeError:
-        shard_mapped = _shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False,
-        )
+    shard_mapped = shard_map_unchecked(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
     sharding = NamedSharding(mesh, spec)
     return shard_mapped(
         jax.device_put(q, sharding),

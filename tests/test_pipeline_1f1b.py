@@ -1,0 +1,113 @@
+"""1F1B pipeline schedule: loss/grad parity against the serial oracle.
+
+Runs on the 8-virtual-device CPU mesh (conftest).  The serial reference
+chains every stage on one device and differentiates with plain jax.grad —
+the strongest oracle: it validates the schedule, the ring-buffer residual
+reuse, the cotangent routing, and the grad accumulation masks at once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from k8s_device_plugin_tpu.parallel.pipeline import stack_stage_params
+from k8s_device_plugin_tpu.parallel.pipeline_1f1b import (
+    mse_loss,
+    pipeline_1f1b_grads,
+)
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stages(key, n_stages, d):
+    stages = []
+    for i in range(n_stages):
+        k1, k2, key = jax.random.split(jax.random.fold_in(key, i), 3)
+        stages.append(
+            {
+                "w": jax.random.normal(k1, (d, d), jnp.float32) / np.sqrt(d),
+                "b": jax.random.normal(k2, (d,), jnp.float32) * 0.1,
+            }
+        )
+    return stack_stage_params(stages)
+
+
+def serial_loss(stacked, xs, ts, n_stages):
+    def chain(x):
+        for s in range(n_stages):
+            p = jax.tree.map(lambda leaf: leaf[s], stacked)
+            x = stage_fn(p, x)
+        return x
+    ys = jax.vmap(chain)(xs)
+    per_micro = jax.vmap(mse_loss)(ys, ts)
+    return jnp.mean(per_micro)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 5), (4, 6), (2, 1), (4, 3), (4, 10)])
+def test_1f1b_matches_serial(n_stages, n_micro):
+    d, b = 8, 2
+    devices = np.array(jax.devices()[:n_stages])
+    mesh = Mesh(devices, ("pp",))
+    key = jax.random.PRNGKey(0)
+    stacked = make_stages(key, n_stages, d)
+    xs = jax.random.normal(jax.random.fold_in(key, 100), (n_micro, b, d))
+    ts = jax.random.normal(jax.random.fold_in(key, 200), (n_micro, b, d))
+
+    loss_pp, grads_pp = pipeline_1f1b_grads(
+        stage_fn, stacked, xs, ts, mesh, axis="pp"
+    )
+    loss_ref, grads_ref = jax.value_and_grad(serial_loss)(
+        stacked, xs, ts, n_stages
+    )
+
+    np.testing.assert_allclose(loss_pp, loss_ref, rtol=1e-5, atol=1e-6)
+    for gp, gr in zip(jax.tree.leaves(grads_pp), jax.tree.leaves(grads_ref)):
+        np.testing.assert_allclose(gp, gr, rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_residual_buffer_is_microbatch_independent():
+    """The activation buffer depth is min(n_micro, 2*n_stages-1): growing
+    n_micro must not grow live residual memory — the point of 1F1B."""
+    from k8s_device_plugin_tpu.parallel.pipeline_1f1b import residual_buffer_depth
+
+    n_stages = 4
+    # The module's own formula (used by the kernel) — not local arithmetic.
+    assert residual_buffer_depth(100, n_stages) == 7
+    assert residual_buffer_depth(3, n_stages) == 3
+    # Structural pin via the traced program: at n_micro=23 the scan carry
+    # must hold a depth-7 residual buffer [7, b, d], NOT an O(n_micro) one.
+    d, b, n_micro = 4, 1, 23
+    devices = np.array(jax.devices()[:n_stages])
+    mesh = Mesh(devices, ("pp",))
+    key = jax.random.PRNGKey(1)
+    stacked = make_stages(key, n_stages, d)
+    xs = jax.random.normal(jax.random.fold_in(key, 1), (n_micro, b, d))
+    ts = jax.random.normal(jax.random.fold_in(key, 2), (n_micro, b, d))
+    jaxpr = str(
+        jax.make_jaxpr(
+            lambda p, x, t: pipeline_1f1b_grads(stage_fn, p, x, t, mesh)
+        )(stacked, xs, ts)
+    )
+    assert f"f32[7,{b},{d}]" in jaxpr.replace(" ", ""), (
+        "depth-7 residual buffer not found in the traced program"
+    )
+    # And correctness at a microbatch count far above the buffer depth:
+    loss_pp, _ = pipeline_1f1b_grads(stage_fn, stacked, xs, ts, mesh)
+    loss_ref = serial_loss(stacked, xs, ts, n_stages)
+    np.testing.assert_allclose(loss_pp, loss_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_rejects_mismatched_stage_count():
+    n_stages = 2
+    devices = np.array(jax.devices()[:n_stages])
+    mesh = Mesh(devices, ("pp",))
+    stacked = make_stages(jax.random.PRNGKey(0), 3, 4)  # 3 stages, 2-mesh
+    xs = jnp.zeros((2, 1, 4))
+    with pytest.raises(ValueError, match="lead dim"):
+        pipeline_1f1b_grads(stage_fn, stacked, xs, xs, mesh)
