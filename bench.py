@@ -306,6 +306,29 @@ def _inner() -> None:
                     log(f"  GQA {shape[1]}q/{hk}kv heads: {t*1e3:.2f} ms ({flops/t/1e12:.1f} TFLOP/s)")
                 except Exception as e:
                     log(f"  GQA flash bench failed: {e}")
+                # Fused Pallas backward (dQ + dK/dV kernels) vs the chunked
+                # XLA backward: each chain application is a full fwd+bwd
+                # (dq feeds the next iteration — shape-preserving).
+                for impl in ("pallas", "xla"):
+                    try:
+                        t = timed_chain(
+                            lambda q, impl=impl: jax.grad(
+                                lambda qq: flash_attention(
+                                    qq, qq, qq, causal=True, bwd_impl=impl
+                                ).astype(jnp.float32).sum()
+                            )(q),
+                            q,
+                            max(iters // 2, 2),
+                        )
+                        # fwd 2 matmuls + bwd 5 matmul-equivalents (incl.
+                        # the per-stage recompute), causal-halved.
+                        bwd_flops = 7 * b * h * s * s * d / 2 * 2
+                        log(
+                            f"  fwd+bwd ({impl}): {t*1e3:.2f} ms "
+                            f"({bwd_flops/t/1e12:.1f} TFLOP/s)"
+                        )
+                    except Exception as e:
+                        log(f"  fwd+bwd ({impl}) bench failed: {e}")
         except Exception as e:
             log(f"flash-attention bench failed: {e}")
 
