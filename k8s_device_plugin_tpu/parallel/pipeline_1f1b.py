@@ -48,7 +48,12 @@ def residual_buffer_depth(n_micro: int, n_stages: int) -> int:
     return min(n_micro, 2 * n_stages - 1)
 
 
-def pipeline_1f1b_grads(
+def _identity_head(head_params, y):
+    del head_params
+    return y
+
+
+def pipeline_1f1b_train(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stacked_params: Any,
     microbatches: jax.Array,
@@ -56,21 +61,46 @@ def pipeline_1f1b_grads(
     mesh: Mesh,
     axis: str = "pp",
     loss: Callable[[jax.Array, jax.Array], jax.Array] = mse_loss,
-) -> tuple[jax.Array, Any]:
-    """Pipelined loss + parameter gradients under the 1F1B schedule.
+    head_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    head_params: Any = None,
+    collect_input_grads: bool = True,
+) -> tuple[jax.Array, Any, Any, jax.Array | None]:
+    """Pipelined loss + every gradient a full model needs, 1F1B schedule.
 
     Args:
       stage_fn: ``(one_stage_params, x) -> y`` with ``y.shape == x.shape``.
       stacked_params: pytree with leading dim ``n_stages``
         (:func:`..pipeline.stack_stage_params`), sharded over ``axis``.
-      microbatches: ``[n_micro, ...]`` activation stream (replicated).
+      microbatches: ``[n_micro, ...]`` activation stream (replicated) — the
+        OUTPUT of whatever (embedder) runs before the pipelined region.
       targets: ``[n_micro, ...]`` per-microbatch targets (replicated).
-      loss: differentiable ``(y, target) -> scalar``; the total objective
-        is the MEAN over microbatches (matching pipelined_loss_fn).
+      loss: differentiable ``(pred, target) -> scalar``; the total
+        objective is the MEAN over microbatches.
+      head_fn/head_params: optional differentiable head applied on the
+        last stage's output INSIDE the per-microbatch objective (e.g. the
+        LM head) — replicated params, shape-changing allowed.  Default:
+        identity (targets shaped like the stage output).
 
-    Returns ``(loss, grads)``: scalar mean loss (replicated) and gradients
-    shaped/sharded exactly like ``stacked_params``.
+    Returns ``(loss, stage_grads, head_grads, d_microbatches)``:
+      - loss: scalar mean loss, replicated;
+      - stage_grads: shaped/sharded exactly like ``stacked_params``;
+      - head_grads: like ``head_params`` (zeros-tree when no head);
+      - d_microbatches: ``dLoss/d microbatches`` — feed it to the
+        embedder's vjp so gradients flow into everything upstream of the
+        pipelined region.  ``collect_input_grads=False`` (the head-less
+        wrapper) drops the O(n_micro) collection buffer, its per-tick
+        update, and the stream-sized psum entirely and returns None.
+
+    SPMD cost note: the per-microbatch objective (head forward + backward)
+    is gated with `lax.cond` so only the LAST stage executes it — inner
+    stages run a zeros stub — but warmup/drain ticks on the last stage
+    still compute-and-mask it; with a vocab-sized head that waste is
+    (2L-1)/(n_micro+2L-1) of head FLOPs, amortized away by n_micro.
     """
+    if head_fn is None:
+        head_fn = _identity_head
+    if head_params is None:
+        head_params = {}
     n_stages = mesh.shape[axis]
     n_micro = microbatches.shape[0]
     lead = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -81,7 +111,7 @@ def pipeline_1f1b_grads(
     buf_depth = residual_buffer_depth(n_micro, n_stages)
     ticks = n_micro + 2 * n_stages - 1
 
-    def body(params_local, stream, tgts):
+    def body(params_local, hparams, stream, tgts):
         params_me = jax.tree.map(lambda leaf: leaf[0], params_local)
         stage = jax.lax.axis_index(axis)
         is_last = stage == n_stages - 1
@@ -94,12 +124,18 @@ def pipeline_1f1b_grads(
             zeros_x,  # activation arriving from the left
             zeros_x,  # cotangent arriving from the right
             jnp.zeros((buf_depth,) + x_shape, stream.dtype),  # input residuals
-            jax.tree.map(lambda p: jnp.zeros_like(p), params_me),  # grad acc
+            jax.tree.map(lambda p: jnp.zeros_like(p), params_me),  # stage grads
+            jax.tree.map(lambda p: jnp.zeros_like(p), hparams),  # head grads
+            # stage-0 dx stream (only when the caller wants input grads —
+            # it is the one O(n_micro) buffer in the schedule)
+            jnp.zeros((n_micro,) + x_shape, stream.dtype)
+            if collect_input_grads
+            else None,
             jnp.zeros((), jnp.float32),  # loss acc (last stage only)
         )
 
         def tick(carry, t):
-            act_in, ct_in, buf, gacc, lacc = carry
+            act_in, ct_in, buf, gacc, hacc, dstream, lacc = carry
 
             # ---- backward residual read FIRST ---------------------------
             # At tick t = m + 2L-1 (stage 0, full buffer) the forward unit
@@ -110,7 +146,8 @@ def pipeline_1f1b_grads(
             # makes buf_depth = 2L-1 sufficient.
             mb = t - (2 * n_stages - 1 - stage)
             active_b = jnp.logical_and(mb >= 0, mb < n_micro)
-            slot = jnp.clip(mb, 0, n_micro - 1) % buf_depth
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+            slot = mb_c % buf_depth
             x_saved = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
 
             # ---- forward unit: microbatch mf = t - stage ----------------
@@ -131,49 +168,110 @@ def pipeline_1f1b_grads(
             y = stage_fn(params_me, x)
 
             # ---- backward unit: microbatch mb = t - (2L - 1 - stage) ----
-            tgt = jax.lax.dynamic_index_in_dim(
-                tgts, jnp.clip(mb, 0, n_micro - 1), 0, keepdims=False
-            )
+            tgt = jax.lax.dynamic_index_in_dim(tgts, mb_c, 0, keepdims=False)
             # Recompute this stage's forward from the saved input and pull
             # gradients through it (per-stage remat).
             y2, vjp = jax.vjp(stage_fn, params_me, x_saved)
-            # Cotangent seed: the last stage differentiates the loss itself
-            # (mean over microbatches -> 1/n_micro factor); inner stages use
-            # the cotangent ppermuted from the right.
-            loss_ct = jax.grad(lambda yy: loss(yy, tgt) / n_micro)(
-                y2.astype(jnp.float32)
-            ).astype(y2.dtype)
-            ct_use = jnp.where(is_last, loss_ct, ct_in)
+            # Cotangent seed: the last stage differentiates the full
+            # per-microbatch objective loss(head(y), tgt) — head params
+            # included; inner stages use the ppermuted cotangent.  The
+            # objective (head fwd+bwd — vocab-sized for an LM) is gated
+            # with lax.cond so inner stages run a zeros stub instead of
+            # computing-and-discarding it every tick; the predicate is
+            # per-device-constant, so each device compiles to one path.
+            y2f = y2.astype(jnp.float32)
+
+            def run_objective(args):
+                hp, yy = args
+                return jax.value_and_grad(
+                    lambda hp, yy: loss(head_fn(hp, yy), tgt), argnums=(0, 1)
+                )(hp, yy)
+
+            def stub_objective(args):
+                hp, yy = args
+                return jnp.zeros((), yy.dtype), (
+                    jax.tree.map(jnp.zeros_like, hp),
+                    jnp.zeros_like(yy),
+                )
+
+            lval, (dhp, dy) = jax.lax.cond(
+                is_last, run_objective, stub_objective, (hparams, y2f)
+            )
+            ct_use = jnp.where(is_last, (dy / n_micro).astype(y2.dtype), ct_in)
             dparams, dx = vjp(ct_use)
             gmask = active_b.astype(jnp.float32)
             gacc = jax.tree.map(
                 lambda g, d: g + gmask.astype(d.dtype) * d, gacc, dparams
             )
+            hmask = jnp.logical_and(active_b, is_last).astype(jnp.float32)
+            hacc = jax.tree.map(
+                lambda g, d: g + (hmask / n_micro).astype(d.dtype) * d, hacc, dhp
+            )
+            if dstream is not None:
+                # Stage 0's dx is dLoss/d(stream microbatch mb) — collect.
+                write_dstream = jnp.logical_and(active_b, stage == 0)
+                dstream = jax.lax.cond(
+                    write_dstream,
+                    lambda ds: jax.lax.dynamic_update_index_in_dim(
+                        ds, dx.astype(ds.dtype), mb_c, 0
+                    ),
+                    lambda ds: ds,
+                    dstream,
+                )
             lacc = lacc + jnp.where(
-                jnp.logical_and(active_b, is_last),
-                loss(y2, tgt).astype(jnp.float32),
-                0.0,
+                jnp.logical_and(active_b, is_last), lval.astype(jnp.float32), 0.0
             )
 
             # ---- neighbor exchange (collectives run unconditionally) ----
             act_next = jax.lax.ppermute(y, axis, fwd_perm)
             ct_next = jax.lax.ppermute(dx, axis, bwd_perm)
-            return (act_next, ct_next, buf, gacc, lacc), None
+            return (act_next, ct_next, buf, gacc, hacc, dstream, lacc), None
 
-        (_, _, _, gacc, lacc), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
-        # Loss lives on the last stage only; psum replicates it (others
-        # contributed 0).  Grads go back out stage-sharded.
+        (_, _, _, gacc, hacc, dstream, lacc), _ = jax.lax.scan(
+            tick, init, jnp.arange(ticks)
+        )
+        # Loss/head-grads live on the last stage, dstream on stage 0; the
+        # other devices contributed zeros, so psum replicates all three.
         loss_total = jax.lax.psum(lacc, axis) / n_micro
         grads_out = jax.tree.map(lambda g: g[None], gacc)
-        return loss_total, grads_out
+        head_grads = jax.tree.map(lambda g: jax.lax.psum(g, axis), hacc)
+        dstream_out = (
+            jax.lax.psum(dstream, axis) if dstream is not None else None
+        )
+        return loss_total, grads_out, head_grads, dstream_out
 
     in_specs = (
         jax.tree.map(lambda _: P(axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
         P(),
         P(),
     )
-    out_specs = (P(), jax.tree.map(lambda _: P(axis), stacked_params))
+    out_specs = (
+        P(),
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
+        P() if collect_input_grads else None,
+    )
     fn = shard_map_unchecked(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )
-    return fn(stacked_params, microbatches, targets)
+    return fn(stacked_params, head_params, microbatches, targets)
+
+
+def pipeline_1f1b_grads(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    loss: Callable[[jax.Array, jax.Array], jax.Array] = mse_loss,
+) -> tuple[jax.Array, Any]:
+    """Head-less convenience wrapper: ``(loss, stage_grads)`` — see
+    :func:`pipeline_1f1b_train` for the full-model version.  Skips the
+    O(n_micro) input-grad collection buffer it would never read."""
+    loss_total, grads, _, _ = pipeline_1f1b_train(
+        stage_fn, stacked_params, microbatches, targets, mesh, axis, loss,
+        collect_input_grads=False,
+    )
+    return loss_total, grads

@@ -96,11 +96,16 @@ class PipelinedLM:
         self._stage = DecoderStage(config, self.layers_per_stage)
         self._head = _Head(config)
 
+    @staticmethod
+    def _positions(batch: int, seq: int) -> jax.Array:
+        """[batch, seq] position ids — the one definition every path uses."""
+        return jnp.broadcast_to(jnp.arange(seq)[None, :], (batch, seq))
+
     # ---------------------------------------------------------- parameters
     def init(self, rng: jax.Array, sample_ids: jax.Array) -> dict:
         """Parameter pytree: {embed, stages (leading dim = n_stages), head}."""
         b, s = sample_ids.shape
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        positions = self._positions(b, s)
         k_embed, k_head, *k_stages = jax.random.split(rng, 2 + self.n_stages)
         embed = self._embed.init(k_embed, sample_ids)["params"]
         hidden = self._embed.apply({"params": embed}, sample_ids)
@@ -126,7 +131,7 @@ class PipelinedLM:
         b, s = ids.shape
         micro_ids = self._microbatch(ids)
         hidden = self._embed.apply({"params": params["embed"]}, micro_ids)
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // self.n_micro, s))
+        positions = self._positions(b // self.n_micro, s)
 
         def stage_fn(stage_params, x):
             return self._stage.apply({"params": stage_params}, x, positions)
@@ -142,7 +147,7 @@ class PipelinedLM:
         oracle for tests — stages applied in order on the full batch."""
         b, s = ids.shape
         hidden = self._embed.apply({"params": params["embed"]}, ids)
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        positions = self._positions(b, s)
         for i in range(self.n_stages):
             stage_i = jax.tree.map(lambda leaf: leaf[i], params["stages"])
             hidden = self._stage.apply({"params": stage_i}, hidden, positions)
@@ -161,21 +166,70 @@ class PipelinedLM:
         self,
         tx,
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_xent,
+        schedule: str = "gpipe",
     ):
-        def train_step(state: TrainState, batch: dict):
-            def compute_loss(params):
-                logits = self.apply(params, batch["input_ids"])
-                return loss_fn(logits, batch["labels"])
+        """``schedule``: "gpipe" (autodiff backward after all forwards —
+        simple, O(n_micro) activation memory) or "1f1b" (hand-interleaved
+        schedule, O(n_stages) activation memory — see pipeline_1f1b.py).
+        Both optimize the identical objective; grads for embed/head flow
+        through the 1F1B kernel's d_microbatches/head-grad outputs."""
+        if schedule == "gpipe":
+            def train_step(state: TrainState, batch: dict):
+                def compute_loss(params):
+                    logits = self.apply(params, batch["input_ids"])
+                    return loss_fn(logits, batch["labels"])
 
-            loss, grads = jax.value_and_grad(compute_loss)(state.params)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            return (
-                state.with_updates(
-                    step=state.step + 1,
-                    params=optax.apply_updates(state.params, updates),
-                    opt_state=new_opt,
-                ),
-                loss,
+                loss, grads = jax.value_and_grad(compute_loss)(state.params)
+                return self._apply_updates(tx, state, grads, loss)
+
+            return train_step
+        if schedule != "1f1b":
+            raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+
+        from .pipeline_1f1b import pipeline_1f1b_train
+
+        def train_step_1f1b(state: TrainState, batch: dict):
+            params = state.params
+            micro_ids = self._microbatch(batch["input_ids"])
+            micro_labels = self._microbatch(batch["labels"])
+            s = batch["input_ids"].shape[1]
+            positions = self._positions(micro_ids.shape[1], s)
+            hidden, embed_vjp = jax.vjp(
+                lambda ep: self._embed.apply({"params": ep}, micro_ids),
+                params["embed"],
             )
 
-        return train_step
+            def stage_fn(stage_params, x):
+                return self._stage.apply({"params": stage_params}, x, positions)
+
+            def head_fn(head_params, y):
+                return self._head.apply({"params": head_params}, y)
+
+            loss, g_stages, g_head, d_hidden = pipeline_1f1b_train(
+                stage_fn,
+                params["stages"],
+                hidden,
+                micro_labels,
+                self.mesh,
+                self.axis,
+                loss=loss_fn,
+                head_fn=head_fn,
+                head_params=params["head"],
+            )
+            (g_embed,) = embed_vjp(d_hidden)
+            grads = {"embed": g_embed, "stages": g_stages, "head": g_head}
+            return self._apply_updates(tx, state, grads, loss)
+
+        return train_step_1f1b
+
+    @staticmethod
+    def _apply_updates(tx, state: TrainState, grads, loss):
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return (
+            state.with_updates(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt,
+            ),
+            loss,
+        )

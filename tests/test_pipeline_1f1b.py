@@ -111,3 +111,59 @@ def test_1f1b_rejects_mismatched_stage_count():
     xs = jnp.zeros((2, 1, 4))
     with pytest.raises(ValueError, match="lead dim"):
         pipeline_1f1b_grads(stage_fn, stacked, xs, xs, mesh)
+
+
+def test_pipelined_lm_1f1b_matches_gpipe():
+    """Full-model integration: the 1F1B train step (embed vjp + interleaved
+    stage/head grads) must match the GPipe autodiff train step — same
+    params, same batch, same optimizer — in both loss and updated params."""
+    import optax
+
+    from k8s_device_plugin_tpu.models.transformer import GPTConfig
+    from k8s_device_plugin_tpu.parallel.pipeline_lm import PipelinedLM
+
+    cfg = GPTConfig.tiny()
+    n_stages = 2
+    devices = np.array(jax.devices()[:n_stages])
+    mesh = Mesh(devices, ("pp",))
+    plm = PipelinedLM(cfg, mesh, n_micro=2)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (4, 9), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    params = plm.init(rng, batch["input_ids"][:2])
+    tx = optax.sgd(0.1)
+
+    state_g = plm.create_train_state(params, tx)
+    state_f = plm.create_train_state(params, tx)
+    step_g = jax.jit(plm.make_train_step(tx, schedule="gpipe"))
+    step_f = jax.jit(plm.make_train_step(tx, schedule="1f1b"))
+    state_g, loss_g = step_g(state_g, batch)
+    state_f, loss_f = step_f(state_f, batch)
+
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=2e-4)
+    flat_g = jax.tree_util.tree_leaves_with_path(state_g.params)
+    flat_f = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(state_f.params)
+    )
+    for k, vg in flat_g:
+        vf = flat_f[jax.tree_util.keystr(k)]
+        np.testing.assert_allclose(
+            np.asarray(vf, np.float32),
+            np.asarray(vg, np.float32),
+            rtol=5e-2, atol=2e-5,
+            err_msg=f"param {jax.tree_util.keystr(k)} diverged (1f1b vs gpipe)",
+        )
+
+
+def test_pipelined_lm_rejects_unknown_schedule():
+    import optax
+
+    from k8s_device_plugin_tpu.models.transformer import GPTConfig
+    from k8s_device_plugin_tpu.parallel.pipeline_lm import PipelinedLM
+
+    cfg = GPTConfig.tiny()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    plm = PipelinedLM(cfg, mesh, n_micro=2)
+    with pytest.raises(ValueError, match="schedule"):
+        plm.make_train_step(optax.sgd(0.1), schedule="zb-h1")
