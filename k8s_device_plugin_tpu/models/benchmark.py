@@ -365,6 +365,59 @@ def run_decode(args) -> None:
     )
 
 
+def run_pipelined(args) -> None:
+    """Decoder-LM training through the pipelined path (--pp stages) —
+    the in-pod way to exercise pp on a multi-chip allocation, with either
+    schedule.  Reports tokens/sec like the gpt path."""
+    from ..parallel.mesh import make_mesh
+    from ..parallel.pipeline_lm import PipelinedLM
+
+    if args.model != "gpt":
+        raise SystemExit("--pp requires --model gpt (the pipelined decoder)")
+    cfg = _gpt_config(args)
+    devices = jax.devices()
+    if len(devices) < args.pp:
+        raise SystemExit(f"--pp {args.pp} but only {len(devices)} device(s)")
+    if cfg.num_layers % args.pp:
+        raise SystemExit(
+            f"num_layers {cfg.num_layers} not divisible by --pp {args.pp}"
+        )
+    mesh = make_mesh({"pp": args.pp}, devices=devices[: args.pp])
+    plm = PipelinedLM(cfg, mesh, n_micro=args.n_micro)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(
+        rng, (args.batch_size, args.seq_len + 1), 0, cfg.vocab_size
+    )
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    tx = optax.sgd(0.1, momentum=0.9)
+    micro_rows = max(args.batch_size // args.n_micro, 1)
+    state = plm.create_train_state(
+        plm.init(rng, batch["input_ids"][:micro_rows]), tx
+    )
+    step = jax.jit(
+        plm.make_train_step(tx, schedule=args.pp_schedule), donate_argnums=0
+    )
+    state, loss, dt = timed_steps(step, state, batch, args.warmup, args.steps)
+    tokens = args.batch_size * args.seq_len * args.steps
+    print(
+        json.dumps(
+            {
+                "model": "gpt-pp",
+                "schedule": args.pp_schedule,
+                "chips": len(devices),
+                "pp": args.pp,
+                "n_micro": args.n_micro,
+                "global_batch": args.batch_size,
+                "throughput": round(tokens / dt, 2),
+                "unit": "tokens/sec",
+                "step_time_ms": round(dt / args.steps * 1e3, 2),
+                "final_loss": float(loss),
+            }
+        ),
+        flush=True,
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="tpu-benchmark")
     p.add_argument(
@@ -379,6 +432,26 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--warmup", type=_positive_int, default=5)
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1: all devices)")
     p.add_argument("--mp", type=int, default=1, help="param-sharding axis size")
+    p.add_argument(
+        "--pp",
+        type=int,
+        default=0,
+        help="pipeline stages (gpt only): run the decoder through the "
+        "pipelined-LM path over a pp mesh axis instead of dp/mp",
+    )
+    p.add_argument(
+        "--pp-schedule",
+        choices=["gpipe", "1f1b"],
+        default="gpipe",
+        help="pipeline schedule (with --pp): gpipe (autodiff backward) or "
+        "1f1b (interleaved, O(stages) activation memory)",
+    )
+    p.add_argument(
+        "--n-micro",
+        type=_positive_int,
+        default=4,
+        help="microbatches per step in the pipelined path (with --pp)",
+    )
     p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode prompt")
     p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode new tokens")
     p.add_argument(
@@ -442,6 +515,10 @@ def main(argv: list[str] | None = None) -> None:
 
     if args.model == "gpt-decode":
         run_decode(args)
+        return
+
+    if args.pp > 1:
+        run_pipelined(args)
         return
 
     devices = jax.devices()

@@ -100,3 +100,20 @@ def test_benchmark_sampled_decode_smoke(capsys):
     assert out["model"] == "gpt-decode"
     assert out["sampler"] == "temperature=0.8,top_k=16"
     assert out["throughput"] > 0
+
+
+def test_benchmark_pipelined_1f1b_smoke(capsys):
+    from k8s_device_plugin_tpu.models import benchmark
+
+    benchmark.main(
+        [
+            "--model", "gpt", "--tiny",
+            "--pp", "2", "--pp-schedule", "1f1b", "--n-micro", "2",
+            "--batch-size", "4", "--seq-len", "16",
+            "--steps", "2", "--warmup", "1",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "gpt-pp"
+    assert out["schedule"] == "1f1b"
+    assert out["throughput"] > 0
