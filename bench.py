@@ -208,10 +208,22 @@ def _inner() -> None:
             batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
             tx = optax.adamw(1e-3)
             state = create_train_state(rng, model, batch, tx, input_key="input_ids")
-            step = jax.jit(make_train_step(model, tx, input_key="input_ids"), donate_argnums=0)
+            step = make_train_step(model, tx, input_key="input_ids")
             state, loss, dt = timed_steps(step, state, batch, warmup, steps)
             tps = batch_size * seq * steps / dt
             log(f"transformer-lm b{batch_size} s{seq}: {tps:.0f} tokens/sec (loss {float(loss):.3f})")
+            # Fused LM-head + xent tail (ops/fused_xent.py): same model,
+            # no [b,s,vocab] logits tensor — report the delta.
+            from k8s_device_plugin_tpu.models.train import make_fused_lm_train_step
+
+            state2 = create_train_state(rng, model, batch, tx, input_key="input_ids")
+            fstep = make_fused_lm_train_step(model, tx)
+            state2, floss, fdt = timed_steps(fstep, state2, batch, warmup, steps)
+            ftps = batch_size * seq * steps / fdt
+            log(
+                f"transformer-lm fused-xent: {ftps:.0f} tokens/sec "
+                f"({ftps / max(tps, 1e-9):.2f}x vs naive tail, loss {float(floss):.3f})"
+            )
         except Exception as e:  # secondary metrics must never kill the bench
             log(f"lm bench failed: {e}")
 

@@ -452,6 +452,13 @@ def main(argv: list[str] | None = None) -> None:
         default=4,
         help="microbatches per step in the pipelined path (with --pp)",
     )
+    p.add_argument(
+        "--fused-xent",
+        action="store_true",
+        help="gpt only: fused LM-head + cross-entropy loss tail "
+        "(ops/fused_xent.py) — the [batch, seq, vocab] logits tensor "
+        "never materializes",
+    )
     p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode prompt")
     p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode new tokens")
     p.add_argument(
@@ -513,6 +520,17 @@ def main(argv: list[str] | None = None) -> None:
     if distributed.initialize():
         log(f"jax.distributed: process {jax.process_index()}/{jax.process_count()}")
 
+    # Validate flag combinations BEFORE any model construction so a wrong
+    # pod spec fails in milliseconds with a clear message, and no path can
+    # silently ignore a requested behavior.
+    if args.fused_xent and args.model != "gpt":
+        raise SystemExit("--fused-xent requires --model gpt")
+    if args.fused_xent and args.pp > 1:
+        raise SystemExit(
+            "--fused-xent is not supported with --pp (the pipelined LM head "
+            "runs inside the 1F1B/GPipe objective); drop one of the flags"
+        )
+
     if args.model == "gpt-decode":
         run_decode(args)
         return
@@ -530,9 +548,14 @@ def main(argv: list[str] | None = None) -> None:
     model, batch, input_key, items_per_step = build(args.model, args, rng)
     tx = optax.sgd(0.1, momentum=0.9)
     state = create_train_state(rng, model, batch, tx, input_key=input_key)
-    step, state, batch_sh = shard_train_step(
-        make_train_step(model, tx, input_key=input_key), mesh, state, batch
-    )
+    if args.fused_xent:
+        from .train import make_fused_lm_train_step
+
+        step_fn = make_fused_lm_train_step(model, tx)
+        log("loss tail: fused LM-head + cross-entropy (no logits tensor)")
+    else:
+        step_fn = make_train_step(model, tx, input_key=input_key)
+    step, state, batch_sh = shard_train_step(step_fn, mesh, state, batch)
     if jax.process_count() > 1:
         # Each process owns a slice of the global batch; assemble global
         # arrays from process-local shards (the SPMD multi-host idiom).

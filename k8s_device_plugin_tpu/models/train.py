@@ -142,6 +142,51 @@ def make_train_step(
     return train_step
 
 
+def make_fused_lm_train_step(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    chunk: int = 4096,
+):
+    """Decoder-LM train step whose loss tail is the fused LM-head +
+    cross-entropy (ops/fused_xent.py): the model runs with
+    ``output="hidden"`` and the head kernel is applied chunk-wise inside
+    the loss, so the [batch, seq, vocab] float32 logits tensor — the peak
+    HBM site of LM training — never materializes.  The head's parameters
+    still live at params["lm_head"]["kernel"] (initialized by the normal
+    logits path), so checkpoints are interchangeable with the standard
+    step.  ``chunk`` needs no relation to the vocab size (the op pads and
+    masks the ragged tail).
+    """
+    from ..ops.fused_xent import fused_linear_xent
+
+    def train_step(state: TrainState, batch: dict):
+        def compute_loss(params):
+            hidden = model.apply(
+                {"params": params}, batch["input_ids"], output="hidden"
+            )
+            b, s, d = hidden.shape
+            w = params["lm_head"]["kernel"]
+            return fused_linear_xent(
+                hidden.reshape(b * s, d).astype(w.dtype),
+                w,
+                batch["labels"].reshape(b * s),
+                chunk,
+            )
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        return (
+            state.with_updates(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt_state,
+            ),
+            loss,
+        )
+
+    return train_step
+
+
 def make_eval_step(
     model: nn.Module, input_key: str = "images"
 ) -> Callable[[TrainState, dict], jax.Array]:

@@ -310,7 +310,7 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, output: str = "logits"):
         cfg = self.config
         seq_len = input_ids.shape[-1]
         if positions is None:
@@ -332,6 +332,16 @@ class TransformerLM(nn.Module):
                 name=f"layer_{i}",
             )(hidden, positions)
         hidden = RMSNorm(dtype=cfg.dtype, name="final_norm")(hidden)
+        if output == "hidden":
+            # For the fused LM-head + cross-entropy path (ops/fused_xent.py):
+            # the caller applies params["lm_head"]["kernel"] chunk-wise so
+            # the [batch, seq, vocab] logits tensor never materializes.
+            # The head still initializes below on the "logits" path; a
+            # "hidden"-only init would miss its params, so init always
+            # runs with the default output.
+            return hidden
+        if output != "logits":
+            raise ValueError(f"output must be logits|hidden, got {output!r}")
         # Logits in float32 for a stable softmax/xent.
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head")(
             hidden

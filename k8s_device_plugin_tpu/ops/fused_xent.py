@@ -1,0 +1,161 @@
+"""Fused LM-head + softmax cross-entropy without materializing the logits.
+
+The standard decoder-LM training tail — ``logits = hidden @ W`` then
+``softmax_xent(logits, labels)`` — materializes a float32
+``[batch*seq, vocab]`` tensor.  At the benchmark config (b=8, s=1024,
+V=32000) that is ~1 GiB of HBM for a single intermediate that the loss
+immediately reduces away, and it is the peak-memory site of LM training
+once activations are rematerialized.
+
+This op computes the identical loss with an online log-sum-exp over vocab
+CHUNKS (the flash-attention trick applied to the classifier axis): each
+``[N, chunk]`` logits tile exists only transiently inside a ``lax.scan``
+step, peak extra memory is ``N * chunk`` instead of ``N * V``, and the
+matmuls still hit the MXU at full tile sizes.  The custom VJP recomputes
+each chunk's softmax from the saved log-sum-exp — same recompute-vs-store
+trade as the flash backward (ops/flash_attention.py) — and accumulates
+
+    dH = (P - onehot) @ Wᵀ        chunk-by-chunk
+    dW = Hᵀ @ (P - onehot)        chunk-by-chunk
+
+so no full-vocab probability tensor exists in the backward either.
+
+No reference analogue (the reference ships no model code, SURVEY.md §2.4);
+this is the TPU-first expression of the LM training tail.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def naive_linear_xent(
+    hidden: jax.Array, w: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """The oracle: materialize logits, mean token cross-entropy."""
+    logits = (hidden @ w).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    correct = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - correct)
+
+
+def _col_valid(ci, chunk, vocab, n):
+    """[1, chunk] bool: which columns of chunk ``ci`` are real vocab
+    entries (the last chunk of a padded W carries dead columns)."""
+    del n
+    cols = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+    return cols < vocab
+
+
+def _forward_stats(hidden, w_pad, labels, chunk, vocab):
+    """Online (max, sumexp, correct-logit) over vocab chunks.
+
+    Returns (lse [N] f32, correct [N] f32): everything the loss and the
+    backward need — the [N, V] logits never exist.  ``w_pad`` is padded to
+    a chunk multiple; padded columns are masked to -inf.
+    """
+    n = hidden.shape[0]
+    n_chunks = w_pad.shape[1] // chunk
+    init = (
+        jnp.full((n,), NEG_INF, jnp.float32),  # running max
+        jnp.zeros((n,), jnp.float32),  # running sum of exp
+        jnp.zeros((n,), jnp.float32),  # correct-class logit
+    )
+
+    def step(carry, ci):
+        m, l, correct = carry
+        w_c = jax.lax.dynamic_slice_in_dim(w_pad, ci * chunk, chunk, axis=1)
+        logits = jnp.dot(
+            hidden, w_c, preferred_element_type=jnp.float32
+        )  # [N, chunk]
+        logits = jnp.where(_col_valid(ci, chunk, vocab, n), logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # Collect the label's logit when it falls inside this chunk.
+        local = labels - ci * chunk
+        in_chunk = jnp.logical_and(local >= 0, local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=-1
+        )[:, 0]
+        correct = jnp.where(in_chunk, picked, correct)
+        return (m_new, l, correct), None
+
+    (m, l, correct), _ = jax.lax.scan(step, init, jnp.arange(n_chunks))
+    return m + jnp.log(l), correct
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_core(hidden, w_pad, labels, chunk, vocab):
+    lse, correct = _forward_stats(hidden, w_pad, labels, chunk, vocab)
+    return jnp.mean(lse - correct)
+
+
+def _fused_fwd(hidden, w_pad, labels, chunk, vocab):
+    lse, correct = _forward_stats(hidden, w_pad, labels, chunk, vocab)
+    return jnp.mean(lse - correct), (hidden, w_pad, labels, lse)
+
+
+def _fused_bwd(chunk, vocab, residuals, g):
+    hidden, w_pad, labels, lse = residuals
+    n = hidden.shape[0]
+    n_chunks = w_pad.shape[1] // chunk
+    scale = g / n  # d(mean)/d(per-token) with the incoming cotangent
+
+    def step(carry, ci):
+        dh = carry
+        w_c = jax.lax.dynamic_slice_in_dim(w_pad, ci * chunk, chunk, axis=1)
+        logits = jnp.dot(hidden, w_c, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])  # softmax chunk, recomputed
+        p = jnp.where(_col_valid(ci, chunk, vocab, n), p, 0.0)
+        local = labels - ci * chunk
+        in_chunk = jnp.logical_and(local >= 0, local < chunk)
+        onehot = jnp.where(
+            in_chunk[:, None],
+            jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk, dtype=p.dtype),
+            0.0,
+        )
+        delta = (p - onehot) * scale  # [N, chunk] f32
+        dh = dh + jnp.dot(
+            delta.astype(w_c.dtype), w_c.T, preferred_element_type=jnp.float32
+        )
+        dw_c = jnp.dot(
+            hidden.T, delta.astype(hidden.dtype), preferred_element_type=jnp.float32
+        )
+        return dh, dw_c.astype(w_pad.dtype)
+
+    dh, dw_chunks = jax.lax.scan(
+        step, jnp.zeros(hidden.shape, jnp.float32), jnp.arange(n_chunks)
+    )
+    # scan stacks [n_chunks, d, chunk] -> [d, V_pad]
+    dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(w_pad.shape)
+    return dh.astype(hidden.dtype), dw, None
+
+
+_fused_core.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_linear_xent(hidden, w, labels, chunk: int = 4096):
+    """Mean token cross-entropy of ``hidden @ w`` against ``labels``.
+
+    hidden: [N, d] (flatten batch×seq first), w: [d, V], labels: [N] int.
+    ``chunk`` needs no relation to V: W is padded to a chunk multiple and
+    the ragged tail is masked in both passes (gradients for pad columns
+    are exactly zero and sliced away by autodiff through the pad), so an
+    awkward vocab like 50257 still runs at full tile sizes.  Peak extra
+    memory is N×chunk logits.  Differentiable in ``hidden`` and ``w``.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    vocab = w.shape[1]
+    chunk = min(chunk, vocab)
+    pad = (-vocab) % chunk
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    return _fused_core(hidden, w, labels, chunk, vocab)

@@ -1,0 +1,134 @@
+"""Fused LM-head + cross-entropy: value and gradient parity against the
+materialize-the-logits oracle, plus the no-[N,V]-intermediate guarantee."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_device_plugin_tpu.ops.fused_xent import (
+    fused_linear_xent,
+    naive_linear_xent,
+)
+
+
+def make_case(key, n=12, d=16, v=64, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    hidden = jax.random.normal(k1, (n, d), dtype)
+    # Divide by a same-dtype scalar: bf16 / np.float64 would silently
+    # promote w to float32 and the dtype assertions would test nothing.
+    w = jax.random.normal(k2, (d, v), dtype) / jnp.asarray(np.sqrt(d), dtype)
+    labels = jax.random.randint(k3, (n,), 0, v)
+    return hidden, w, labels
+
+
+@pytest.mark.parametrize("chunk", [16, 32, 64])
+def test_loss_matches_naive(chunk):
+    hidden, w, labels = make_case(jax.random.PRNGKey(0))
+    fused = fused_linear_xent(hidden, w, labels, chunk)
+    naive = naive_linear_xent(hidden, w, labels)
+    np.testing.assert_allclose(fused, naive, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [16, 64])
+def test_grads_match_naive(chunk):
+    hidden, w, labels = make_case(jax.random.PRNGKey(1))
+    gf = jax.grad(
+        lambda h, w: fused_linear_xent(h, w, labels, chunk), argnums=(0, 1)
+    )(hidden, w)
+    gn = jax.grad(
+        lambda h, w: naive_linear_xent(h, w, labels), argnums=(0, 1)
+    )(hidden, w)
+    for a, b, name in zip(gf, gn, ("dhidden", "dw")):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_bfloat16_inputs():
+    hidden, w, labels = make_case(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
+    fused = fused_linear_xent(hidden, w, labels, 32)
+    naive = naive_linear_xent(hidden, w, labels)
+    np.testing.assert_allclose(float(fused), float(naive), rtol=2e-2)
+    gh, gw = jax.grad(
+        lambda h, w: fused_linear_xent(h, w, labels, 32), argnums=(0, 1)
+    )(hidden, w)
+    assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+
+
+def test_no_full_logits_intermediate():
+    """The traced program must never hold an [N, V] f32 array — the op's
+    entire reason to exist.  N=8, V=1024, chunk=128: f32[8,1024] would be
+    the materialized logits; only f32[8,128] tiles may appear."""
+    hidden, w, labels = make_case(jax.random.PRNGKey(3), n=8, d=4, v=1024)
+    jaxpr = str(
+        jax.make_jaxpr(
+            jax.grad(lambda h, w: fused_linear_xent(h, w, labels, 128), (0, 1))
+        )(hidden, w)
+    ).replace(" ", "")
+    assert "f32[8,1024]" not in jaxpr, "full logits tensor materialized"
+    assert "f32[8,128]" in jaxpr  # the chunked tile is there
+
+
+def test_ragged_vocab_pads_and_masks():
+    """chunk needs no relation to V (e.g. a GPT-2-style awkward vocab):
+    the padded tail must not perturb the loss or leak gradients."""
+    hidden, w, labels = make_case(jax.random.PRNGKey(4), v=60)
+    for chunk in (7, 32, 59, 61, 4096):
+        fused = fused_linear_xent(hidden, w, labels, chunk)
+        np.testing.assert_allclose(
+            fused, naive_linear_xent(hidden, w, labels), rtol=1e-6, atol=1e-6,
+            err_msg=f"chunk={chunk}",
+        )
+    gf = jax.grad(
+        lambda h, w: fused_linear_xent(h, w, labels, 32), argnums=(0, 1)
+    )(hidden, w)
+    gn = jax.grad(
+        lambda h, w: naive_linear_xent(h, w, labels), argnums=(0, 1)
+    )(hidden, w)
+    for a, b, name in zip(gf, gn, ("dhidden", "dw")):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, err_msg=name)
+    with pytest.raises(ValueError, match="chunk"):
+        fused_linear_xent(hidden, w, labels, 0)
+
+
+def test_fused_lm_train_step_matches_standard():
+    """End-to-end: one fused-tail train step == one standard train step —
+    same params in, same loss, same updated params (shared head weights)."""
+    import optax
+
+    from k8s_device_plugin_tpu.models.train import (
+        create_train_state,
+        make_fused_lm_train_step,
+        make_train_step,
+    )
+    from k8s_device_plugin_tpu.models.transformer import GPTConfig, TransformerLM
+
+    cfg = GPTConfig.tiny()
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 17), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    tx = optax.sgd(0.1)
+    state_a = create_train_state(rng, model, batch, tx, input_key="input_ids")
+    state_b = create_train_state(rng, model, batch, tx, input_key="input_ids")
+
+    step_std = jax.jit(make_train_step(model, tx, input_key="input_ids"))
+    step_fused = jax.jit(
+        make_fused_lm_train_step(model, tx, chunk=cfg.vocab_size // 4)
+    )
+    state_a, loss_std = step_std(state_a, batch)
+    state_b, loss_fused = step_fused(state_b, batch)
+
+    np.testing.assert_allclose(float(loss_fused), float(loss_std), rtol=1e-5)
+    for (ka, va), (kb, vb) in zip(
+        jax.tree_util.tree_leaves_with_path(state_a.params),
+        jax.tree_util.tree_leaves_with_path(state_b.params),
+    ):
+        assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+        np.testing.assert_allclose(
+            np.asarray(vb, np.float32),
+            np.asarray(va, np.float32),
+            rtol=1e-4, atol=1e-6,
+            err_msg=f"param {jax.tree_util.keystr(ka)} diverged (fused vs std)",
+        )
