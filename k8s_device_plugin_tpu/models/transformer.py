@@ -226,8 +226,13 @@ class CausalSelfAttention(nn.Module):
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             seq_len = hidden.shape[1]
             if self.attention_fn is not None:
-                if group > 1:
-                    # sp engines (ring/Ulysses) are MHA-only: expand for them.
+                if group > 1 and not getattr(
+                    self.attention_fn, "supports_gqa", False
+                ):
+                    # MHA-only sp engines (Ulysses: heads ride the
+                    # all_to_all) need expanded kv; the ring engine is
+                    # GQA-native and advertises supports_gqa, keeping the
+                    # rotating kv shard group-times smaller on the ICI ring.
                     kh = jnp.repeat(kh, group, axis=1)
                     vh = jnp.repeat(vh, group, axis=1)
                 if cfg.attention_window is not None:

@@ -79,15 +79,24 @@ def ring_attention(
     (or ``pmap``) with ``axis_name`` bound.  ``extra_varying`` names any
     other manual axes the inputs are sharded over (dp/tp in a composed
     mesh), so the scan carry's varying-axis types line up.
+
+    Grouped-query attention is native: k/v may carry ``kv_heads`` dividing
+    q's ``heads``.  The rotating kv shard stays UN-expanded — ppermute
+    traffic and kv memory scale with kv_heads, not heads (a group-factor
+    ICI saving; q is reshaped to [b, kv_heads, group, seq, d] and the
+    einsums contract against the shared kv head).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
-    batch, heads, seq_q, _ = q.shape
-    seq_kv = k.shape[2]
+    batch, heads, seq_q, head_dim = q.shape
+    kv_heads, seq_kv = k.shape[1], k.shape[2]
+    if heads % kv_heads:
+        raise ValueError(f"q heads {heads} not a multiple of kv heads {kv_heads}")
+    group = heads // kv_heads
     f32 = jnp.float32
-    qf = q.astype(f32)
+    qf = q.astype(f32).reshape(batch, kv_heads, group, seq_q, head_dim)
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_kv), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_kv), 1)
@@ -96,9 +105,11 @@ def ring_attention(
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry
         src = (rank - t) % n  # which shard's kv we hold at this step
+        # h = kv head, g = member of its q-head group: kv has no g axis, so
+        # one kv shard serves the whole group (GQA-native, no repeat).
         s = (
             jnp.einsum(
-                "bhqd,bhkd->bhqk",
+                "bhgqd,bhkd->bhgqk",
                 qf,
                 k_blk.astype(f32),
                 preferred_element_type=f32,
@@ -118,7 +129,7 @@ def ring_attention(
         alpha = jnp.where(seen, jnp.exp(jnp.where(seen, m - m_new, 0.0)), 0.0)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(f32), preferred_element_type=f32
+            "bhgqk,bhkd->bhgqd", p, v_blk.astype(f32), preferred_element_type=f32
         )
 
         # Rotate kv one hop around the ring (neighbor ICI traffic only).
@@ -131,8 +142,8 @@ def ring_attention(
     # (shard_map tracks varying axes).
     m0, l0, acc0 = _mark_varying(
         (
-            jnp.full((batch, heads, seq_q, 1), NEG_INF, f32),
-            jnp.zeros((batch, heads, seq_q, 1), f32),
+            jnp.full((batch, kv_heads, group, seq_q, 1), NEG_INF, f32),
+            jnp.zeros((batch, kv_heads, group, seq_q, 1), f32),
             jnp.zeros(qf.shape, f32),
         ),
         (axis_name,) + tuple(extra_varying),
@@ -141,7 +152,7 @@ def ring_attention(
         step, (k, v, m0, l0, acc0), jnp.arange(n)
     )
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
-    return (acc / l).astype(q.dtype)
+    return (acc / l).astype(q.dtype).reshape(batch, heads, seq_q, head_dim)
 
 
 def ring_self_attention(
@@ -166,6 +177,20 @@ def ring_self_attention(
     n = mesh.shape[axis]
     if q.shape[2] % n:
         raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}"
+        )
+    if head_axis and k.shape[1] != q.shape[1]:
+        tp_size = mesh.shape[head_axis]
+        if k.shape[1] % tp_size:
+            # GQA kv heads can't shard over the tp axis (e.g. 2 kv heads on
+            # tp=4): expand to full heads here — the pre-GQA behavior —
+            # rather than failing in device_put with an opaque error.  The
+            # ring stays GQA-native whenever the sharding allows it.
+            group = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
     spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
         ring_attention,

@@ -58,13 +58,20 @@ def sp_attention_fn(
         engine = _ENGINES[kind]
     except KeyError:
         raise ValueError(f"unknown sp attention kind {kind!r}; use {sorted(_ENGINES)}")
-    return functools.partial(
+
+    bound = functools.partial(
         engine,
         mesh=mesh,
         axis=axis,
         batch_axis=dp_axis if dp_axis in mesh.axis_names else None,
         head_axis=tp_axis if tp_axis in mesh.axis_names else None,
     )
+    # The ring engine consumes grouped-query k/v natively (the rotating kv
+    # shard stays un-expanded — ring.py); Ulysses redistributes heads with
+    # all_to_all and still needs the caller to expand kv to full heads.
+    # transformer.CausalSelfAttention reads this to skip its GQA repeat.
+    bound.supports_gqa = kind == "ring"
+    return bound
 
 
 def sp_batch_sharding(batch: Any, mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"):

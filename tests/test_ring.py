@@ -83,3 +83,57 @@ def test_ring_rejects_indivisible_seq(rng, sp_mesh):
     q, k, v = make_qkv(rng, seq=20)  # 20 % 8 != 0
     with pytest.raises(ValueError, match="not divisible"):
         ring_self_attention(q, k, v, sp_mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_matches_reference(rng, sp_mesh, causal):
+    """GQA-native ring: kv carries fewer heads and is NEVER expanded — the
+    rotating shard stays kv_heads-sized; parity vs the expanding oracle."""
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 4, 64, 32))
+    k = jax.random.normal(kk, (1, 2, 64, 32))
+    v = jax.random.normal(kv, (1, 2, 64, 32))
+    out = ring_self_attention(q, k, v, sp_mesh, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_grads_match_reference(rng, sp_mesh):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 4, 64, 32))
+    k = jax.random.normal(kk, (1, 2, 64, 32))
+    v = jax.random.normal(kv, (1, 2, 64, 32))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_self_attention(q, k, v, sp_mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch (GQA ring)",
+        )
+
+
+def test_ring_rejects_bad_gqa_heads(rng, sp_mesh):
+    q = jnp.zeros((1, 4, 64, 32))
+    k = jnp.zeros((1, 3, 64, 32))
+    with pytest.raises(ValueError, match="multiple"):
+        ring_self_attention(q, k, k, sp_mesh)
+
+
+def test_ring_gqa_with_indivisible_tp_falls_back_to_expand(rng):
+    """kv_heads=2 on tp=4 can't shard the kv head dim: the engine must
+    expand to full heads (old behavior) instead of dying in device_put."""
+    mesh = make_mesh({"sp": 2, "tp": 4})
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 8, 32, 16))
+    k = jax.random.normal(kk, (1, 2, 32, 16))
+    v = jax.random.normal(kv, (1, 2, 32, 16))
+    out = ring_self_attention(q, k, v, mesh, causal=True, head_axis="tp")
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
