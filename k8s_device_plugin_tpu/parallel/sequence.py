@@ -66,11 +66,12 @@ def sp_attention_fn(
         batch_axis=dp_axis if dp_axis in mesh.axis_names else None,
         head_axis=tp_axis if tp_axis in mesh.axis_names else None,
     )
-    # The ring engine consumes grouped-query k/v natively (the rotating kv
-    # shard stays un-expanded — ring.py); Ulysses redistributes heads with
-    # all_to_all and still needs the caller to expand kv to full heads.
+    # Both engines consume grouped-query k/v natively now — ring keeps the
+    # rotating kv shard un-expanded (ring.py), Ulysses rides kv through its
+    # own group-times-smaller all_to_all when kv_heads divides sp (and both
+    # expand internally in the configs where sharding forbids it).
     # transformer.CausalSelfAttention reads this to skip its GQA repeat.
-    bound.supports_gqa = kind == "ring"
+    bound.supports_gqa = True
     return bound
 
 

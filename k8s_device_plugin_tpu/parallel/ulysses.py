@@ -24,6 +24,7 @@ import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention, mha_reference
@@ -43,6 +44,11 @@ def ulysses_attention(
     Local shard shapes [batch, heads, local_seq, head_dim]; global seq =
     local_seq * n where n = size of ``axis_name``; heads must divide by n.
     Must run inside shard_map (or pmap) with ``axis_name`` bound.
+
+    Grouped-query attention: when ``kv_heads %% n == 0`` the kv tensors ride
+    their own (group-times smaller) all-to-all and the local attention runs
+    GQA-natively through the flash kernel; otherwise kv is expanded to full
+    heads first (the pre-GQA behavior).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -52,6 +58,17 @@ def ulysses_attention(
             f"heads {q.shape[1]} not divisible by {axis_name}={n}; "
             "use ring attention for head-poor long-context models"
         )
+    kv_heads = k.shape[1]
+    if q.shape[1] % kv_heads:
+        raise ValueError(
+            f"q heads {q.shape[1]} not a multiple of kv heads {kv_heads}"
+        )
+    if kv_heads != q.shape[1] and kv_heads % n:
+        # Too few kv heads to scatter over the axis: expand to full heads
+        # (the attention itself would handle GQA; the all-to-all cannot).
+        group = q.shape[1] // kv_heads
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
 
     def scatter_heads(x):
         # [b, h, s/n, d] -> [b, h/n, s, d]: each device trades head blocks
@@ -117,6 +134,13 @@ def ulysses_self_attention(
             f"local heads {local_heads} not divisible by {axis}={n}; "
             "use ring attention for head-poor long-context models"
         )
+    if head_axis and k.shape[1] != q.shape[1] and k.shape[1] % mesh.shape[head_axis]:
+        # GQA kv heads can't shard over the tp axis: expand before placing
+        # (same fallback as ring_self_attention) instead of an opaque
+        # device_put failure.
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
         ulysses_attention, axis_name=axis, causal=causal, sm_scale=sm_scale

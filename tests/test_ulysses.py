@@ -88,3 +88,29 @@ def test_ulysses_rejects_bad_shapes(rng, sp_mesh):
     q, k, v = make_qkv(rng, heads=2, seq=16 * 8)  # 2 heads < 8 devices
     with pytest.raises(ValueError, match="heads .* not divisible"):
         ulysses_self_attention(q, k, v, sp_mesh)
+
+
+def test_ulysses_gqa_native_matches_reference(rng):
+    """kv_heads divisible by sp: kv rides its own smaller all_to_all and
+    the local attention runs GQA-natively."""
+    mesh = make_mesh({"dp": -1, "sp": 2})
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 8, 64, 16))
+    k = jax.random.normal(kk, (1, 2, 64, 16))
+    v = jax.random.normal(kv, (1, 2, 64, 16))
+    out = ulysses_self_attention(q, k, v, mesh, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_indivisible_sp_expands_internally(rng):
+    """kv_heads=2 on sp=8: the kv exchange can't split 2 heads 8 ways, so
+    the body expands to full heads — numerics identical."""
+    mesh = make_mesh({"sp": 8})
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 8, 64, 16))
+    k = jax.random.normal(kk, (1, 2, 64, 16))
+    v = jax.random.normal(kv, (1, 2, 64, 16))
+    out = ulysses_self_attention(q, k, v, mesh)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
