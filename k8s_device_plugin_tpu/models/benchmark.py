@@ -314,8 +314,7 @@ def run_decode(args) -> None:
     t0 = time.perf_counter()
     if two_point:
         _sync(greedy_generate(cfg, params, prompt, 1))
-    out_holder = [greedy_generate(cfg, params, prompt, args.decode_tokens)]
-    _sync(out_holder[0])
+    _sync(greedy_generate(cfg, params, prompt, args.decode_tokens))
     log(f"decode compile+first run {time.perf_counter() - t0:.1f}s")
     with tracing.trace(args.trace_dir):
         if two_point:
@@ -323,10 +322,7 @@ def run_decode(args) -> None:
                 _sync(greedy_generate(cfg, params, prompt, 1))
 
             def exec_full():
-                out_holder[0] = greedy_generate(
-                    cfg, params, prompt, args.decode_tokens
-                )
-                _sync(out_holder[0])
+                _sync(greedy_generate(cfg, params, prompt, args.decode_tokens))
 
             dt, fell_back = measure_two_point(
                 exec_short, exec_full, args.decode_tokens - 1, full_steps
@@ -337,8 +333,7 @@ def run_decode(args) -> None:
                 dt = dt * full_steps / (args.decode_tokens - 1)
         else:
             t0 = time.perf_counter()
-            out_holder[0] = greedy_generate(cfg, params, prompt, args.decode_tokens)
-            _sync(out_holder[0])
+            _sync(greedy_generate(cfg, params, prompt, args.decode_tokens))
             dt = time.perf_counter() - t0
     steps = args.decode_tokens - 1 if two_point else full_steps
     total_tokens = args.batch_size * steps

@@ -115,6 +115,16 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def tiled_causal_attention(qh, kh, vh, window):
+    """Causal attention on [batch, heads, seq, head_dim]: the fused flash
+    kernel when the sequence is 128-tileable, the plain-XLA oracle
+    otherwise (same parameters either way) — the one dispatch rule the
+    training and bulk-prefill paths share."""
+    if qh.shape[2] % 128 == 0:
+        return flash_attention(qh, kh, vh, causal=True, window=window)
+    return mha_reference(qh, kh, vh, causal=True, window=window)
+
+
 class CausalSelfAttention(nn.Module):
     """Causal MHA with RoPE; fused flash kernel on 128-tileable sequences.
 
@@ -187,14 +197,7 @@ class CausalSelfAttention(nn.Module):
                 qh, kh, vh = (
                     t.transpose(0, 2, 1, 3) for t in (q, k, v)
                 )
-                if q_len % 128 == 0:
-                    attn = flash_attention(
-                        qh, kh, vh, causal=True, window=cfg.attention_window
-                    )
-                else:
-                    attn = mha_reference(
-                        qh, kh, vh, causal=True, window=cfg.attention_window
-                    )
+                attn = tiled_causal_attention(qh, kh, vh, cfg.attention_window)
                 attn = attn.transpose(0, 2, 1, 3).reshape(
                     batch, q_len, cfg.num_heads, cfg.head_dim
                 )
@@ -245,14 +248,8 @@ class CausalSelfAttention(nn.Module):
                         "causal); unset one of them"
                     )
                 attn = self.attention_fn(qh, kh, vh, causal=True)
-            elif seq_len % 128 == 0:
-                attn = flash_attention(
-                    qh, kh, vh, causal=True, window=cfg.attention_window
-                )
             else:
-                attn = mha_reference(
-                    qh, kh, vh, causal=True, window=cfg.attention_window
-                )
+                attn = tiled_causal_attention(qh, kh, vh, cfg.attention_window)
             attn = attn.transpose(0, 2, 1, 3)
 
         return nn.DenseGeneral(

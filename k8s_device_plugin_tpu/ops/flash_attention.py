@@ -128,6 +128,20 @@ def mha_reference(
 # --------------------------------------------------------------------- kernel
 
 
+def _tile_live(qi, ki, block_q: int, block_kv: int, window):
+    """Whether a [block_q, block_kv] tile intersects the causal(+window)
+    band: its smallest column must not exceed its largest row, and with a
+    window its largest column must not fall entirely behind the smallest
+    row's window.  Shared by the forward and both backward kernels."""
+    live = (qi * block_q + block_q - 1) >= (ki * block_kv)
+    if window is not None:
+        live = jnp.logical_and(
+            live,
+            (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
+        )
+    return live
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
@@ -204,18 +218,9 @@ def _flash_kernel(
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # A tile is entirely masked iff its smallest column exceeds its
-        # largest row — or, with a window, its largest column falls entirely
-        # behind the window of its smallest row; skip both matmuls for it.
-        # (The grid still visits the tile — Pallas grids are rectangular —
-        # but it costs only this comparison.)
-        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
-        if window is not None:
-            live = jnp.logical_and(
-                live,
-                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
-            )
-        pl.when(live)(_tile)
+        # Dead tiles skip both matmuls (the grid still visits them —
+        # Pallas grids are rectangular — but they cost only this check).
+        pl.when(_tile_live(qi, ki, block_q, block_kv, window))(_tile)
     else:
         _tile()
 
@@ -410,13 +415,7 @@ def _dq_kernel(
         )
 
     if causal:
-        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
-        if window is not None:
-            live = jnp.logical_and(
-                live,
-                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
-            )
-        pl.when(live)(_tile)
+        pl.when(_tile_live(qi, ki, block_q, block_kv, window))(_tile)
     else:
         _tile()
 
@@ -492,13 +491,7 @@ def _dkv_kernel(
         )
 
     if causal:
-        live = (qi * block_q + block_q - 1) >= (ki * block_kv)
-        if window is not None:
-            live = jnp.logical_and(
-                live,
-                (ki * block_kv + block_kv - 1) >= (qi * block_q - (window - 1)),
-            )
-        pl.when(live)(_tile)
+        pl.when(_tile_live(qi, ki, block_q, block_kv, window))(_tile)
     else:
         _tile()
 

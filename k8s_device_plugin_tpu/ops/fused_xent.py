@@ -44,10 +44,9 @@ def naive_linear_xent(
     return jnp.mean(lse - correct)
 
 
-def _col_valid(ci, chunk, vocab, n):
+def _col_valid(ci, chunk, vocab):
     """[1, chunk] bool: which columns of chunk ``ci`` are real vocab
     entries (the last chunk of a padded W carries dead columns)."""
-    del n
     cols = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
     return cols < vocab
 
@@ -73,7 +72,7 @@ def _forward_stats(hidden, w_pad, labels, chunk, vocab):
         logits = jnp.dot(
             hidden, w_c, preferred_element_type=jnp.float32
         )  # [N, chunk]
-        logits = jnp.where(_col_valid(ci, chunk, vocab, n), logits, NEG_INF)
+        logits = jnp.where(_col_valid(ci, chunk, vocab), logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         l = l * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1
@@ -113,7 +112,7 @@ def _fused_bwd(chunk, vocab, residuals, g):
         w_c = jax.lax.dynamic_slice_in_dim(w_pad, ci * chunk, chunk, axis=1)
         logits = jnp.dot(hidden, w_c, preferred_element_type=jnp.float32)
         p = jnp.exp(logits - lse[:, None])  # softmax chunk, recomputed
-        p = jnp.where(_col_valid(ci, chunk, vocab, n), p, 0.0)
+        p = jnp.where(_col_valid(ci, chunk, vocab), p, 0.0)
         local = labels - ci * chunk
         in_chunk = jnp.logical_and(local >= 0, local < chunk)
         onehot = jnp.where(

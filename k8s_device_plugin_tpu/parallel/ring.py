@@ -51,6 +51,13 @@ def shard_map_unchecked(body, *, mesh, in_specs, out_specs):
         )
 
 
+def expand_gqa_kv(q, k, v):
+    """Expand grouped-query k/v to q's full head count (the fallback when a
+    sharding axis can't split kv_heads — ring and Ulysses wrappers share it)."""
+    group = q.shape[1] // k.shape[1]
+    return jnp.repeat(k, group, axis=1), jnp.repeat(v, group, axis=1)
+
+
 def _mark_varying(tree, axis_name):
     """Tag device-invariant values as varying over ``axis_name`` (shard_map
     tracks varying manual axes; scan carries must agree).  API drifted:
@@ -188,9 +195,7 @@ def ring_self_attention(
             # tp=4): expand to full heads here — the pre-GQA behavior —
             # rather than failing in device_put with an opaque error.  The
             # ring stays GQA-native whenever the sharding allows it.
-            group = q.shape[1] // k.shape[1]
-            k = jnp.repeat(k, group, axis=1)
-            v = jnp.repeat(v, group, axis=1)
+            k, v = expand_gqa_kv(q, k, v)
     spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
         ring_attention,

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention, mha_reference
-from .ring import shard_map_unchecked
+from .ring import expand_gqa_kv, shard_map_unchecked
 
 
 def ulysses_attention(
@@ -66,9 +66,7 @@ def ulysses_attention(
     if kv_heads != q.shape[1] and kv_heads % n:
         # Too few kv heads to scatter over the axis: expand to full heads
         # (the attention itself would handle GQA; the all-to-all cannot).
-        group = q.shape[1] // kv_heads
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
+        k, v = expand_gqa_kv(q, k, v)
 
     def scatter_heads(x):
         # [b, h, s/n, d] -> [b, h/n, s, d]: each device trades head blocks
@@ -138,9 +136,7 @@ def ulysses_self_attention(
         # GQA kv heads can't shard over the tp axis: expand before placing
         # (same fallback as ring_self_attention) instead of an opaque
         # device_put failure.
-        group = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
+        k, v = expand_gqa_kv(q, k, v)
     spec = P(batch_axis, head_axis, axis, None)
     body = functools.partial(
         ulysses_attention, axis_name=axis, causal=causal, sm_scale=sm_scale
