@@ -278,11 +278,18 @@ def _inner() -> None:
                 iters = 20
             b, h, s, d = shape
             q = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
+            # Distinct k/v buffers: q,q,q lets Mosaic/XLA alias all three
+            # operands to one HBM buffer and dedupe tile fetches, flattering
+            # the ms and TFLOP/s (round-2 probe: aliased MHA ran 2x faster
+            # than the same kernel on separate tensors — no real model has
+            # q=k=v).
+            kfa = jax.random.normal(jax.random.PRNGKey(2), shape, jnp.bfloat16)
+            vfa = jax.random.normal(jax.random.PRNGKey(3), shape, jnp.bfloat16)
             t_flash = timed_chain(
-                lambda q: flash_attention(q, q, q, causal=True), q, iters
+                lambda q: flash_attention(q, kfa, vfa, causal=True), q, iters
             )
             t_ref = timed_chain(
-                lambda q: mha_reference(q, q, q, causal=True), q, iters
+                lambda q: mha_reference(q, kfa, vfa, causal=True), q, iters
             )
             # Causal attention FLOPs: 2 matmuls * b*h*s*s*d, halved by masking.
             flops = 2 * 2 * b * h * s * s * d / 2
@@ -294,11 +301,13 @@ def _inner() -> None:
             if platform != "cpu":
                 # Block sweep (VERDICT r1 next #2): find per-generation
                 # defaults once Mosaic numbers exist.  Stderr only.
-                for bq, bkv in [(128, 128), (128, 256), (128, 512), (256, 256), (256, 512), (512, 512)]:
+                # Small tiles are grid-overhead-bound on v5e (round-2 sweep);
+                # keep one small config as a canary and sweep the large end.
+                for bq, bkv in [(128, 512), (256, 512), (512, 512), (512, 1024), (512, 2048), (1024, 1024)]:
                     try:
                         t = timed_chain(
                             lambda q, bq=bq, bkv=bkv: flash_attention(
-                                q, q, q, causal=True, block_q=bq, block_kv=bkv
+                                q, kfa, vfa, causal=True, block_q=bq, block_kv=bkv
                             ),
                             q,
                             iters,
@@ -309,11 +318,14 @@ def _inner() -> None:
                 # GQA variant: 4x fewer kv heads must cut kv HBM traffic.
                 try:
                     hk = shape[1] // 4
-                    kv = jax.random.normal(
-                        jax.random.PRNGKey(1), (b, hk, s, d), jnp.bfloat16
+                    kg = jax.random.normal(
+                        jax.random.PRNGKey(4), (b, hk, s, d), jnp.bfloat16
+                    )
+                    vg = jax.random.normal(
+                        jax.random.PRNGKey(5), (b, hk, s, d), jnp.bfloat16
                     )
                     t = timed_chain(
-                        lambda q: flash_attention(q, kv, kv, causal=True), q, iters
+                        lambda q: flash_attention(q, kg, vg, causal=True), q, iters
                     )
                     log(f"  GQA {shape[1]}q/{hk}kv heads: {t*1e3:.2f} ms ({flops/t/1e12:.1f} TFLOP/s)")
                 except Exception as e:
@@ -326,7 +338,7 @@ def _inner() -> None:
                         t = timed_chain(
                             lambda q, impl=impl: jax.grad(
                                 lambda qq: flash_attention(
-                                    qq, qq, qq, causal=True, bwd_impl=impl
+                                    qq, kfa, vfa, causal=True, bwd_impl=impl
                                 ).astype(jnp.float32).sum()
                             )(q),
                             q,

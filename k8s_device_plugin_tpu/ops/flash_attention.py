@@ -40,27 +40,39 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 # Per-generation (block_q, block_kv) defaults, matched by device_kind
-# prefix.  A larger kv block amortizes the per-tile online-softmax state
-# update and feeds the p·v matmul a taller [block_kv, head_dim] operand;
-# block_q stays at 128 to bound VMEM (q tile + f32 accumulator + [block_q,
-# block_kv] scores).  v5e value from the round-2 bench sweep
-# (bench.py logs the full sweep each round; re-tune as data accumulates).
+# prefix, separately for forward and backward.  The forward kernel is
+# grid-overhead-bound at small tiles on v5e — the round-2 idle-machine
+# sweep (median-of-5, 50-iter chains, separate k/v buffers) measured
+# q128/kv512 at 2.53 ms vs q512/kv1024 at 1.23 ms for b4 h16 s2048 d64 —
+# so the fwd default rides the large end; VMEM stays modest (f32 scores
+# tile 512x1024 = 2 MB + double-buffered kv tiles).  The backward kernels
+# keep more operands live per tile (q, k, v, dO, O, lse + two f32
+# accumulators), so they keep the smaller hardware-proven shape until a
+# dedicated bwd sweep lands (bench.py logs both each round; re-tune as
+# data accumulates).
 _BLOCK_DEFAULTS = (
+    ("TPU v5 lite", (512, 1024)),
+    ("TPU v5e", (512, 1024)),
+    ("TPU v5p", (512, 1024)),
+    ("TPU v4", (128, 256)),
+    ("TPU v6", (512, 1024)),  # unswept: inherit v5e until a v6 sweep exists
+)
+_BWD_BLOCK_DEFAULTS = (
     ("TPU v5 lite", (128, 512)),
     ("TPU v5e", (128, 512)),
     ("TPU v5p", (128, 512)),
     ("TPU v4", (128, 256)),
-    ("TPU v6", (128, 512)),  # unswept: inherit v5e until a v6 sweep exists
+    ("TPU v6", (128, 512)),
 )
 _FALLBACK_BLOCKS = (128, 256)  # unknown TPU generation
 _INTERPRET_BLOCKS = (128, 128)  # CPU interpreter: smallest legal tiles
 
 
-def _default_blocks(interpret: bool) -> tuple[int, int]:
+def _default_blocks(interpret: bool, table=_BLOCK_DEFAULTS) -> tuple[int, int]:
     if interpret or jax.default_backend() != "tpu":
         return _INTERPRET_BLOCKS
     kind = jax.devices()[0].device_kind
-    for prefix, blocks in _BLOCK_DEFAULTS:
+    for prefix, blocks in table:
         if kind.startswith(prefix):
             return blocks
     return _FALLBACK_BLOCKS
@@ -722,8 +734,11 @@ def _mha_bwd_chunked(
     return dq.reshape(q.shape).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(
+    q, k, v, causal, window, sm_scale, block_q, block_kv,
+    bwd_block_q, bwd_block_kv, interpret, bwd_impl,
+):
     out, _ = _flash_impl(
         q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
     )
@@ -731,7 +746,8 @@ def _flash(q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_
 
 
 def _flash_fwd(
-    q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl
+    q, k, v, causal, window, sm_scale, block_q, block_kv,
+    bwd_block_q, bwd_block_kv, interpret, bwd_impl,
 ):
     out, lse_rep = _flash_impl(
         q, k, v, causal, window, sm_scale, block_q, block_kv, interpret
@@ -746,17 +762,18 @@ def _flash_fwd(
 
 
 def _flash_bwd(
-    causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl, residuals, dout
+    causal, window, sm_scale, block_q, block_kv, bwd_block_q, bwd_block_kv,
+    interpret, bwd_impl, residuals, dout,
 ):
     q, k, v, out, lse = residuals
     if bwd_impl == "pallas":
         # lse is the lane-replicated [b*h, seq, 128] layout (see _flash_fwd).
         return _flash_bwd_pallas(
             q, k, v, out, lse, dout,
-            causal, window, sm_scale, block_q, block_kv, interpret,
+            causal, window, sm_scale, bwd_block_q, bwd_block_kv, interpret,
         )
     return _mha_bwd_chunked(
-        q, k, v, out, lse, dout, causal, window, sm_scale, block_kv
+        q, k, v, out, lse, dout, causal, window, sm_scale, bwd_block_kv
     )
 
 
@@ -773,6 +790,8 @@ def flash_attention(
     window: int | None = None,
     block_q: int | None = None,
     block_kv: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_kv: int | None = None,
     interpret: bool | None = None,
     bwd_impl: str = "auto",
 ) -> jax.Array:
@@ -784,10 +803,15 @@ def flash_attention(
 
     ``interpret`` defaults to running the compiled kernel on TPU and the
     Pallas interpreter elsewhere (so the same code path is testable on the
-    8-device CPU mesh).  ``block_q``/``block_kv`` default per TPU
-    generation (``_BLOCK_DEFAULTS``, keyed on device_kind; 128/128 under
-    the interpreter) and clamp to the sequence length for short sequences;
-    sequences must divide by the (clamped) blocks.
+    8-device CPU mesh).  ``block_q``/``block_kv`` tile the FORWARD kernel
+    and ``bwd_block_q``/``bwd_block_kv`` the backward kernels; each
+    defaults per TPU generation (``_BLOCK_DEFAULTS`` /
+    ``_BWD_BLOCK_DEFAULTS``, keyed on device_kind; 128/128 under the
+    interpreter) and clamps to the sequence length for short sequences.
+    The passes tile independently because their VMEM working sets differ
+    (backward keeps q, k, v, dO, O, lse and two f32 accumulators live per
+    tile) — a forward-fast shape like 512x2048 is not automatically safe
+    or fast for backward.
 
     ``window`` (requires ``causal``): sliding-window local attention — each
     query sees only its ``window`` most recent positions.  Forward tiles
@@ -814,19 +838,19 @@ def flash_attention(
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"bwd_impl must be auto|pallas|xla, got {bwd_impl!r}")
     default_q, default_kv = _default_blocks(interpret)
+    bwd_default_q, bwd_default_kv = _default_blocks(interpret, _BWD_BLOCK_DEFAULTS)
+
     # Defaulted blocks FIT the sequence (halve until they divide it) so a
     # generation default of 512 never rejects a seq that 128 accepted;
     # explicitly-passed blocks keep the strict divide-or-raise contract.
-    block_q = (
-        _fit_block(default_q, q.shape[2])
-        if block_q is None
-        else min(block_q, q.shape[2])
-    )
-    block_kv = (
-        _fit_block(default_kv, k.shape[2])
-        if block_kv is None
-        else min(block_kv, k.shape[2])
-    )
+    def resolve(explicit, default, seq):
+        return _fit_block(default, seq) if explicit is None else min(explicit, seq)
+
+    fwd_q = resolve(block_q, default_q, q.shape[2])
+    fwd_kv = resolve(block_kv, default_kv, k.shape[2])
+    bwd_q = resolve(bwd_block_q, bwd_default_q, q.shape[2])
+    bwd_kv = resolve(bwd_block_kv, bwd_default_kv, k.shape[2])
     return _flash(
-        q, k, v, causal, window, sm_scale, block_q, block_kv, interpret, bwd_impl
+        q, k, v, causal, window, sm_scale, fwd_q, fwd_kv, bwd_q, bwd_kv,
+        interpret, bwd_impl,
     )
