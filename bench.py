@@ -152,6 +152,7 @@ def _inner() -> None:
     import optax
 
     from k8s_device_plugin_tpu.models.benchmark import (
+        _sync,
         log,
         measure_two_point,
         timed_steps,
@@ -404,6 +405,66 @@ def _inner() -> None:
         except Exception as e:  # bench must never die on the secondary metric
             log(f"allocation-latency probe failed: {e}")
 
+    def bench_decode_quant() -> None:
+        """Secondary: int8-quantized decode throughput vs bf16 (stderr only).
+
+        Decode is weight-bandwidth-bound at small batch, so w8 (int8
+        weights dequantized in-register, ops/quant.py) should approach 2x
+        the bf16 tokens/sec as batch shrinks.  Runs LAST: four decode-scan
+        compiles, and the headline JSON must never wait on them.
+        """
+        try:
+            import dataclasses
+
+            from k8s_device_plugin_tpu.models.transformer import (
+                GPTConfig,
+                TransformerLM,
+                greedy_generate,
+            )
+            from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+            if platform == "cpu":
+                cfg = GPTConfig.tiny()
+                batch, prompt_len, n_new = 2, 4, 4
+            else:
+                cfg = GPTConfig(
+                    vocab_size=32000,
+                    hidden_size=1024,
+                    num_layers=4,
+                    num_heads=16,
+                    intermediate_size=2816,
+                    max_seq=512,
+                    num_kv_heads=4,
+                )
+                batch, prompt_len, n_new = 8, 128, 128
+            rng = jax.random.PRNGKey(0)
+            params = TransformerLM(cfg).init(
+                rng, jnp.zeros((1, 2), jnp.int32)
+            )["params"]
+            qparams = quantize_lm_params(params)
+            prompt = jax.random.randint(rng, (batch, prompt_len), 0, cfg.vocab_size)
+
+            def decode_tps(c, p):
+                short, full = 2, n_new
+                _sync(greedy_generate(c, p, prompt, short))
+                _sync(greedy_generate(c, p, prompt, full))
+                dt, fell_back = measure_two_point(
+                    lambda: _sync(greedy_generate(c, p, prompt, short)),
+                    lambda: _sync(greedy_generate(c, p, prompt, full)),
+                    full - short,
+                    full,
+                )
+                if fell_back:
+                    log("  (decode delta below noise floor; single-point, prefill-diluted)")
+                return batch * (full - short) / dt
+
+            base = decode_tps(cfg, params)
+            log(f"decode bf16: {base:.0f} tokens/sec (b{batch}, {cfg.num_layers}L)")
+            w8 = decode_tps(dataclasses.replace(cfg, quant="w8"), qparams)
+            log(f"decode w8 int8 weights: {w8:.0f} tokens/sec ({w8 / max(base, 1e-9):.2f}x bf16)")
+        except Exception as e:  # secondary metrics must never kill the bench
+            log(f"quantized decode bench failed: {e}")
+
     ips = bench_resnet50(batch_size=128)
     # The headline JSON prints BEFORE the secondary benches: if a slow
     # compile pushes a secondary past the attempt timeout, the kill must
@@ -427,6 +488,7 @@ def _inner() -> None:
     bench_lm_train()
     bench_flash_attention()
     bench_allocation_latency()
+    bench_decode_quant()
 
 
 # --------------------------------------------------------------------------

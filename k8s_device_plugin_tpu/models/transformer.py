@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.flash_attention import flash_attention, mha_reference
+from ..ops.quant import Int8DenseGeneral
 
 # Large-negative logit for top-k filtering: finite (softmax/categorical
 # stay NaN-free even if every logit in a row were filtered) yet far below
@@ -61,6 +62,14 @@ class GPTConfig:
     # chunked backward to each block's query band, so training compute
     # scales O(seq·window) instead of O(seq²).
     attention_window: Optional[int] = None
+    # Post-training int8 quantization mode for every dense site (ops/quant.py):
+    # None = bf16 (training), "w8" = int8 weights dequantized in-register
+    # (the decode bandwidth mode), "w8a8" = dynamic activation quant +
+    # int8 MXU matmuls (the prefill/batch throughput mode; 2x bf16 MXU rate
+    # on v5e).  Params for a quantized config come from
+    # ops.quant.quantize_lm_params on a trained bf16 tree — embeddings and
+    # norms stay full-precision.
+    quant: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -115,6 +124,23 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def dense_site(cfg: GPTConfig, features, *, axis=-1, dtype=None, name: str):
+    """One constructor for every matmul-bearing projection in the model:
+    flax Dense/DenseGeneral when ``cfg.quant`` is None, Int8DenseGeneral
+    (same parameter tree shape, ``kernel`` -> ``kernel_q``/``kernel_scale``)
+    otherwise — training and quantized serving share ALL model code."""
+    dtype = cfg.dtype if dtype is None else dtype
+    if cfg.quant is None:
+        # DenseGeneral(features=int, axis=-1) == Dense: same "kernel"
+        # [in, out] param, same init, same dot — one constructor suffices.
+        return nn.DenseGeneral(
+            features=features, axis=axis, dtype=dtype, use_bias=False, name=name
+        )
+    return Int8DenseGeneral(
+        features=features, axis=axis, mode=cfg.quant, dtype=dtype, name=name
+    )
+
+
 def tiled_causal_attention(qh, kh, vh, window):
     """Causal attention on [batch, heads, seq, head_dim]: the fused flash
     kernel when the sequence is 128-tileable, the plain-XLA oracle
@@ -154,11 +180,8 @@ class CausalSelfAttention(nn.Module):
             )
         group = cfg.num_heads // cfg.kv_heads
         proj = {
-            name: nn.DenseGeneral(
-                features=(heads, cfg.head_dim),
-                dtype=cfg.dtype,
-                use_bias=False,
-                name=name,
+            name: dense_site(
+                cfg, (heads, cfg.head_dim), name=name
             )(hidden)
             for name, heads in (
                 ("query", cfg.num_heads),
@@ -252,13 +275,7 @@ class CausalSelfAttention(nn.Module):
                 attn = tiled_causal_attention(qh, kh, vh, cfg.attention_window)
             attn = attn.transpose(0, 2, 1, 3)
 
-        return nn.DenseGeneral(
-            features=cfg.hidden_size,
-            axis=(-2, -1),
-            dtype=cfg.dtype,
-            use_bias=False,
-            name="out",
-        )(attn)
+        return dense_site(cfg, cfg.hidden_size, axis=(-2, -1), name="out")(attn)
 
 
 class SwiGluMlp(nn.Module):
@@ -269,11 +286,9 @@ class SwiGluMlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False, name="gate")(x)
-        up = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False, name="up")(x)
-        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="down")(
-            nn.silu(gate) * up
-        )
+        gate = dense_site(cfg, cfg.intermediate_size, name="gate")(x)
+        up = dense_site(cfg, cfg.intermediate_size, name="up")(x)
+        return dense_site(cfg, cfg.hidden_size, name="down")(nn.silu(gate) * up)
 
 
 class DecoderBlock(nn.Module):
@@ -345,7 +360,7 @@ class TransformerLM(nn.Module):
         if output != "logits":
             raise ValueError(f"output must be logits|hidden, got {output!r}")
         # Logits in float32 for a stable softmax/xent.
-        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head")(
+        return dense_site(cfg, cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
             hidden
         )
 

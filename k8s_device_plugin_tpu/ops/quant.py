@@ -1,0 +1,181 @@
+"""Post-training int8 quantization for the decode/serving path.
+
+The reference repo has no quantization story (it ships no model code at all
+— reference main.go's job ends at handing device nodes to the workload,
+SURVEY.md §2.4); this module exists because on TPU v5e the int8 MXU runs at
+2x the bf16 rate and decode is HBM-bandwidth-bound, so int8 weights are the
+canonical single-chip serving lever: half the weight bytes per step, and
+optionally int8 x int8 -> int32 matmuls on the MXU.
+
+TPU-first choices:
+
+- Symmetric per-output-channel scales only (no zero points): the MXU
+  consumes plain int8 operands and XLA fuses the per-channel rescale into
+  the matmul epilogue; asymmetric zero-point correction terms would add a
+  second reduction per tile for ~no accuracy gain at 8 bits.
+- Two compute modes.  ``w8``: int8 weights dequantized on the fly
+  (bf16 compute — XLA fuses convert-and-scale into the dot's operand read,
+  so the bf16 weight tensor never lands in HBM); decode reads half the
+  weight bytes.  ``w8a8``: activations are dynamically quantized per row
+  (one amax per token) and the matmul runs int8 x int8 -> int32 on the
+  MXU — the throughput mode for prefill/large-batch serving.
+- Everything is plain XLA (`lax.dot_general` with
+  ``preferred_element_type=int32``): int8 matmul is MXU-native, there is
+  nothing for a hand kernel to add.
+
+Flow: train/load bf16 params -> :func:`quantize_lm_params` (one-time tree
+transform) -> run the SAME model code with ``GPTConfig(quant="w8")`` — the
+transformer's dense sites (models/transformer.py) swap to
+:class:`Int8DenseGeneral`, whose parameter names/shapes match what
+``quantize_lm_params`` emits, so checkpoints stay portable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# int8 symmetric range: [-127, 127] (not -128: symmetric range keeps
+# q = round(w/s) invertible without per-sign handling and costs 0.4% range).
+_QMAX = 127.0
+
+
+def quantize_int8(w: jax.Array, contract_ndim: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a kernel.
+
+    ``w``: [*contract_dims, *feature_dims] (flax DenseGeneral kernel
+    layout); the first ``contract_ndim`` axes are reduced for the scale, so
+    every output channel (remaining axes) gets its own scale.
+
+    Returns ``(q int8 [w.shape], scale float32 [feature_dims])`` with
+    ``q * scale ~= w``.
+    """
+    axes = tuple(range(contract_ndim))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale), -_QMAX, _QMAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_int8` (scale broadcasts over the leading
+    contraction axes)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _normalize_axis(axis: Union[int, Sequence[int]], ndim: int) -> tuple[int, ...]:
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return tuple(a % ndim for a in axes)
+
+
+def int8_dot_general(
+    x: jax.Array,
+    w_q: jax.Array,
+    w_scale: jax.Array,
+    *,
+    axis: Union[int, Sequence[int]] = -1,
+    mode: str = "w8",
+    dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Contract ``x``'s ``axis`` dims against the leading dims of ``w_q``.
+
+    ``mode="w8"``: bf16 compute on dequantized-in-registers weights (the
+    bandwidth mode).  ``mode="w8a8"``: per-row dynamic activation
+    quantization, int8 x int8 -> int32 MXU matmul, rescale by
+    (row scale x channel scale) in the epilogue (the throughput mode).
+    """
+    axes = _normalize_axis(axis, x.ndim)
+    n_contract = len(axes)
+    dims = ((axes, tuple(range(n_contract))), ((), ()))
+    if mode == "w8":
+        w = dequantize_int8(w_q, w_scale, dtype)
+        return jax.lax.dot_general(x.astype(dtype), w, dims)
+    if mode != "w8a8":
+        raise ValueError(f"mode must be w8|w8a8, got {mode!r}")
+    xf = x.astype(jnp.float32)
+    x_amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    x_scale = jnp.where(x_amax > 0, x_amax / _QMAX, 1.0)
+    x_q = jnp.clip(jnp.round(xf / x_scale), -_QMAX, _QMAX).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q, dims, preferred_element_type=jnp.int32
+    ).astype(jnp.float32)
+    # x_scale loses its contracted axes in the product; keep the batch axes.
+    x_scale_out = jnp.squeeze(x_scale, axis=axes)
+    out_batch_ndim = x.ndim - n_contract
+    out = acc * x_scale_out.reshape(
+        x_scale_out.shape + (1,) * (acc.ndim - out_batch_ndim)
+    ) * w_scale
+    return out.astype(dtype)
+
+
+class Int8DenseGeneral(nn.Module):
+    """Drop-in for ``nn.Dense``/``nn.DenseGeneral`` over int8 kernels.
+
+    Parameter layout matches flax's: ``kernel_q`` is
+    [*contracted_input_dims, *features] int8 and ``kernel_scale`` is
+    [*features] float32 — exactly what :func:`quantize_lm_params` produces
+    from the corresponding bf16 ``kernel``, so a quantized tree applies to
+    the same module names.
+
+    Init gives zero weights (an untrained quantized model is meaningless;
+    the module exists to CONSUME post-training-quantized params — round-trip
+    through :func:`quantize_lm_params`).
+    """
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    mode: str = "w8"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        feats = (
+            (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        )
+        axes = _normalize_axis(self.axis, x.ndim)
+        contract = tuple(x.shape[a] for a in axes)
+        w_q = self.param(
+            "kernel_q", nn.initializers.zeros, contract + feats, jnp.int8
+        )
+        w_scale = self.param("kernel_scale", nn.initializers.ones, feats, jnp.float32)
+        return int8_dot_general(
+            x, w_q, w_scale, axis=self.axis, mode=self.mode, dtype=self.dtype
+        )
+
+
+def quantize_lm_params(params: Any) -> Any:
+    """One-time tree transform: every dense ``kernel`` leaf becomes
+    ``kernel_q`` (int8) + ``kernel_scale`` (float32 per output channel).
+
+    Matmul-bearing kernels are recognized structurally: a dict holding a
+    ``kernel`` array (flax Dense/DenseGeneral).  Contraction dims are
+    inferred from the known transformer sites — every kernel is
+    [in..., out...] with ONE output group except attention's ``out``
+    projection, whose kernel is [heads, head_dim, hidden] (two contracted
+    leading dims).  Embeddings (``embedding``) and norm scales pass through
+    untouched: embeds are a gather (no matmul win) and norms are
+    precision-critical.
+    """
+
+    def convert(name, tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "kernel" in tree and hasattr(tree["kernel"], "ndim"):
+            w = tree["kernel"]
+            # Attention's out-projection (module name "out", DenseGeneral
+            # axis=(-2,-1)) has kernel [heads, head_dim, hidden]: two
+            # contracted leading dims.  Every other dense kernel — plain
+            # Dense [in, out] or qkv DenseGeneral [hidden, heads, head_dim]
+            # — contracts exactly one.
+            contract_ndim = 2 if name == "out" and w.ndim == 3 else 1
+            q, scale = quantize_int8(w, contract_ndim)
+            rest = {k: v for k, v in tree.items() if k != "kernel"}
+            return {"kernel_q": q, "kernel_scale": scale, **rest}
+        return {k: convert(k, v) for k, v in tree.items()}
+
+    return convert("", params)

@@ -1,0 +1,188 @@
+"""int8 post-training quantization (ops/quant.py) and its transformer wiring.
+
+The reference has no quantization subsystem (no model code at all — SURVEY.md
+§2.4); these tests pin the TPU-serving path this repo adds: symmetric
+per-channel int8, w8 (weight-only) and w8a8 (int8 matmul) modes, and the
+train-bf16 -> quantize_lm_params -> serve-int8 round trip through the real
+TransformerLM decode loop.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_device_plugin_tpu.models.transformer import (
+    GPTConfig,
+    TransformerLM,
+    greedy_generate,
+)
+from k8s_device_plugin_tpu.ops.quant import (
+    Int8DenseGeneral,
+    dequantize_int8,
+    int8_dot_general,
+    quantize_int8,
+    quantize_lm_params,
+)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def test_quantize_roundtrip_error_bounded(rng):
+    w = jax.random.normal(rng, (64, 32)) * jnp.linspace(0.01, 10.0, 32)
+    q, scale = quantize_int8(w, contract_ndim=1)
+    assert q.dtype == jnp.int8 and scale.shape == (32,)
+    back = dequantize_int8(q, scale, jnp.float32)
+    # Symmetric uniform quantization: error <= scale/2 per element.
+    assert np.all(np.abs(np.asarray(back - w)) <= np.asarray(scale) / 2 + 1e-7)
+
+
+def test_per_channel_scales_beat_per_tensor(rng):
+    # One huge channel must not destroy the small channels' resolution.
+    w = jnp.concatenate(
+        [jax.random.normal(rng, (64, 31)) * 0.01, jnp.full((64, 1), 100.0)], axis=1
+    )
+    q, scale = quantize_int8(w, 1)
+    back = dequantize_int8(q, scale, jnp.float32)
+    # Per-channel max error is scale_ch/2 ~= 1.4% of the 0.01-sigma data; a
+    # per-tensor scale (100/127) would make it ~4000%.
+    rel = np.abs(np.asarray(back[:, :31] - w[:, :31])) / 0.01
+    assert rel.max() < 0.02, "small channels lost resolution to the big one"
+
+
+def test_quantize_zero_kernel(rng):
+    q, scale = quantize_int8(jnp.zeros((8, 4)), 1)
+    assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scale) == 1.0)
+
+
+def test_int8_dot_w8_matches_dequant_matmul(rng):
+    x = jax.random.normal(rng, (5, 64), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (64, 32))
+    q, scale = quantize_int8(w, 1)
+    got = int8_dot_general(x, q, scale, mode="w8", dtype=jnp.float32)
+    want = x @ dequantize_int8(q, scale, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=1e-3)
+
+
+def test_int8_dot_w8a8_close_to_f32(rng):
+    x = jax.random.normal(rng, (8, 128), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (128, 64))
+    q, scale = quantize_int8(w, 1)
+    got = int8_dot_general(x, q, scale, mode="w8a8", dtype=jnp.float32)
+    want = x @ w
+    # 8-bit weights AND activations: ~1% relative error on gaussian data.
+    err = np.abs(np.asarray(got - want)).max() / np.abs(np.asarray(want)).max()
+    assert err < 0.05, f"w8a8 relative error {err:.3f}"
+
+
+def test_int8_dot_multi_axis_contraction(rng):
+    # Attention out-projection shape: [b, s, heads, head_dim] x
+    # [heads, head_dim, hidden] contracting the last two axes.
+    x = jax.random.normal(rng, (2, 3, 4, 8), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (4, 8, 16))
+    q, scale = quantize_int8(w, contract_ndim=2)
+    assert scale.shape == (16,)
+    got = int8_dot_general(x, q, scale, axis=(-2, -1), mode="w8a8", dtype=jnp.float32)
+    want = jnp.einsum("bshd,hdo->bso", x, w)
+    err = np.abs(np.asarray(got - want)).max() / np.abs(np.asarray(want)).max()
+    assert got.shape == (2, 3, 16) and err < 0.05
+
+
+def test_int8_dense_general_module(rng):
+    m = Int8DenseGeneral(features=(4, 8), axis=-1, mode="w8", dtype=jnp.float32)
+    params = m.init(rng, jnp.ones((2, 16)))["params"]
+    assert params["kernel_q"].shape == (16, 4, 8)
+    assert params["kernel_q"].dtype == jnp.int8
+    assert params["kernel_scale"].shape == (4, 8)
+    out = m.apply({"params": params}, jnp.ones((2, 16)))
+    assert out.shape == (2, 4, 8)
+
+
+def test_bad_mode_raises(rng):
+    q, scale = quantize_int8(jnp.ones((4, 4)), 1)
+    with pytest.raises(ValueError, match="mode"):
+        int8_dot_general(jnp.ones((2, 4)), q, scale, mode="int4")
+
+
+def _tiny_cfg(**kw):
+    return dataclasses.replace(GPTConfig.tiny(), **kw)
+
+
+def test_quantize_lm_params_structure(rng):
+    cfg = _tiny_cfg()
+    model = TransformerLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(rng, ids)["params"]
+    qparams = quantize_lm_params(params)
+    l0 = qparams["layer_0"]
+    # qkv: [hidden, heads, head_dim] with per-(head, head_dim) scales.
+    assert l0["attn"]["query"]["kernel_q"].dtype == jnp.int8
+    assert l0["attn"]["query"]["kernel_scale"].shape == (
+        cfg.num_heads,
+        cfg.head_dim,
+    )
+    # out-projection contracts (heads, head_dim): per-hidden scales.
+    assert l0["attn"]["out"]["kernel_scale"].shape == (cfg.hidden_size,)
+    assert l0["mlp"]["down"]["kernel_scale"].shape == (cfg.hidden_size,)
+    assert qparams["lm_head"]["kernel_scale"].shape == (cfg.vocab_size,)
+    # Embedding and norms pass through untouched.
+    assert "embedding" in qparams["embed"]
+    assert qparams["final_norm"]["scale"].shape == (cfg.hidden_size,)
+
+
+@pytest.mark.parametrize("mode", ["w8", "w8a8"])
+def test_quantized_logits_close_to_fp(rng, mode):
+    cfg = _tiny_cfg(hidden_size=128, num_heads=4, intermediate_size=256)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init(rng, ids)["params"]
+    fp_logits = model.apply({"params": params}, ids)
+
+    qcfg = dataclasses.replace(cfg, quant=mode)
+    qmodel = TransformerLM(qcfg)
+    qparams = quantize_lm_params(params)
+    # The quantized module tree must accept the transformed params as-is.
+    q_logits = qmodel.apply({"params": qparams}, ids)
+
+    fp = np.asarray(fp_logits, np.float32)
+    qn = np.asarray(q_logits, np.float32)
+    denom = np.abs(fp).max()
+    assert np.abs(qn - fp).max() / denom < 0.12, (
+        f"{mode} logits diverged: {np.abs(qn - fp).max() / denom:.3f}"
+    )
+
+
+def test_quantized_greedy_generate_runs(rng):
+    cfg = _tiny_cfg(quant="w8")
+    model = TransformerLM(GPTConfig.tiny())
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(rng, ids)["params"]
+    qparams = quantize_lm_params(params)
+    prompt = jax.random.randint(rng, (2, 5), 0, cfg.vocab_size)
+    out = greedy_generate(cfg, qparams, prompt, 4)
+    assert out.shape == (2, 9)
+    # Prompt is preserved; generated ids are in-vocab.
+    assert np.array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+    assert np.asarray(out).min() >= 0 and np.asarray(out).max() < cfg.vocab_size
+
+
+def test_quantized_decode_matches_quantized_forward_argmax(rng):
+    """The cached decode path and the plain forward must pick the same next
+    token under quantization (same parity training enjoys)."""
+    cfg = _tiny_cfg(quant="w8")
+    fp_model = TransformerLM(GPTConfig.tiny())
+    ids = jax.random.randint(rng, (2, 6), 0, cfg.vocab_size)
+    params = fp_model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    qparams = quantize_lm_params(params)
+
+    out = greedy_generate(cfg, qparams, ids, 2)
+    # Oracle: full forward through the quantized model (no cache).
+    qmodel = TransformerLM(cfg)
+    logits = qmodel.apply({"params": qparams}, ids)
+    want_first = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1))
+    np.testing.assert_array_equal(np.asarray(out[:, 6]), want_first)
