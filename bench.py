@@ -462,6 +462,13 @@ def _inner() -> None:
             log(f"decode bf16: {base:.0f} tokens/sec (b{batch}, {cfg.num_layers}L)")
             w8 = decode_tps(dataclasses.replace(cfg, quant="w8"), qparams)
             log(f"decode w8 int8 weights: {w8:.0f} tokens/sec ({w8 / max(base, 1e-9):.2f}x bf16)")
+            full = decode_tps(
+                dataclasses.replace(cfg, quant="w8", quant_kv=True), qparams
+            )
+            log(
+                f"decode w8 + int8 kv cache: {full:.0f} tokens/sec "
+                f"({full / max(base, 1e-9):.2f}x bf16)"
+            )
         except Exception as e:  # secondary metrics must never kill the bench
             log(f"quantized decode bench failed: {e}")
 

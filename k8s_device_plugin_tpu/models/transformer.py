@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.flash_attention import flash_attention, mha_reference
-from ..ops.quant import Int8DenseGeneral
+from ..ops.quant import Int8DenseGeneral, dequantize_kv, quantize_kv
 
 # Large-negative logit for top-k filtering: finite (softmax/categorical
 # stay NaN-free even if every logit in a row were filtered) yet far below
@@ -70,6 +70,12 @@ class GPTConfig:
     # ops.quant.quantize_lm_params on a trained bf16 tree — embeddings and
     # norms stay full-precision.
     quant: Optional[str] = None
+    # int8 KV cache (decode only): cache slabs store int8 with per-token,
+    # per-head scales — half the cache HBM bytes AND half the per-step
+    # cache read traffic, the long-context decode lever (decode is
+    # KV-bandwidth-bound once seq >> hidden).  Orthogonal to `quant`
+    # (weights); either works alone, the serving config sets both.
+    quant_kv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -199,14 +205,34 @@ class CausalSelfAttention(nn.Module):
             # cache holds UN-expanded kv heads (the GQA memory win).
             batch = hidden.shape[0]
             shape = (batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
-            ck = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+            if cfg.quant_kv:
+                # int8 cache slabs + per-(token, head) scales.  Scales init
+                # to 0, so never-written slots dequantize to exactly 0 (and
+                # are masked below regardless).
+                ck = self.variable("cache", "cached_key", jnp.zeros, shape, jnp.int8)
+                cv = self.variable("cache", "cached_value", jnp.zeros, shape, jnp.int8)
+                sshape = (batch, cfg.max_seq, cfg.kv_heads)
+                cks = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros, sshape, jnp.float32
+                )
+                cvs = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros, sshape, jnp.float32
+                )
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                ck.value = jax.lax.dynamic_update_slice(ck.value, kq, (0, cur, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, vq, (0, cur, 0, 0))
+                cks.value = jax.lax.dynamic_update_slice(cks.value, ks, (0, cur, 0))
+                cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs, (0, cur, 0))
+            else:
+                ck = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
             idx.value = cur + hidden.shape[1]
             q_len = hidden.shape[1]
             if q_len > 1:
@@ -225,7 +251,11 @@ class CausalSelfAttention(nn.Module):
                     batch, q_len, cfg.num_heads, cfg.head_dim
                 )
             else:
-                k, v = ck.value, cv.value
+                if cfg.quant_kv:
+                    k = dequantize_kv(ck.value, cks.value, cfg.dtype)
+                    v = dequantize_kv(cv.value, cvs.value, cfg.dtype)
+                else:
+                    k, v = ck.value, cv.value
                 # Single-token decode: mask cache slots at or beyond the
                 # write frontier (and, with a sliding window, slots that
                 # scrolled out of the band).  Grouped einsum (g = q heads

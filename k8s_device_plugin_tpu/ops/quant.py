@@ -43,6 +43,20 @@ import jax.numpy as jnp
 _QMAX = 127.0
 
 
+def _sym_quantize(x: jax.Array, axes: tuple[int, ...]) -> tuple[jax.Array, jax.Array]:
+    """The one symmetric-int8 core both the weight and KV paths share:
+    amax over ``axes`` per remaining coordinate, zero-amax guarded to scale
+    1, round-and-clip to [-127, 127].  Returns (q int8 [x.shape], scale
+    float32 [x.shape minus axes])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / jnp.expand_dims(scale, axes)), -_QMAX, _QMAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
 def quantize_int8(w: jax.Array, contract_ndim: int) -> tuple[jax.Array, jax.Array]:
     """Symmetric per-output-channel int8 quantization of a kernel.
 
@@ -53,19 +67,32 @@ def quantize_int8(w: jax.Array, contract_ndim: int) -> tuple[jax.Array, jax.Arra
     Returns ``(q int8 [w.shape], scale float32 [feature_dims])`` with
     ``q * scale ~= w``.
     """
-    axes = tuple(range(contract_ndim))
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes)
-    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
-    q = jnp.clip(
-        jnp.round(w.astype(jnp.float32) / scale), -_QMAX, _QMAX
-    ).astype(jnp.int8)
-    return q, scale
+    return _sym_quantize(w, tuple(range(contract_ndim)))
 
 
 def dequantize_int8(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
     """Inverse of :func:`quantize_int8` (scale broadcasts over the leading
     contraction axes)."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-token, per-head int8 quantization of a K or V slab.
+
+    ``x``: [batch, tokens, kv_heads, head_dim].  Each (token, head) row gets
+    its own scale over head_dim — the finest granularity that adds no
+    matmul-side work (the scale rides the token axis, which is never
+    contracted against weights).  Returns (int8 [x.shape], float32
+    [batch, tokens, kv_heads]).
+    """
+    return _sym_quantize(x, (-1,))
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_kv`; int8 stays the HBM format — the
+    convert-and-scale fuses into the attention einsum's operand read, so
+    decode reads half the cache bytes."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def _normalize_axis(axis: Union[int, Sequence[int]], ndim: int) -> tuple[int, ...]:
@@ -97,18 +124,14 @@ def int8_dot_general(
         return jax.lax.dot_general(x.astype(dtype), w, dims)
     if mode != "w8a8":
         raise ValueError(f"mode must be w8|w8a8, got {mode!r}")
-    xf = x.astype(jnp.float32)
-    x_amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
-    x_scale = jnp.where(x_amax > 0, x_amax / _QMAX, 1.0)
-    x_q = jnp.clip(jnp.round(xf / x_scale), -_QMAX, _QMAX).astype(jnp.int8)
+    x_q, x_scale = _sym_quantize(x, axes)  # per-row dynamic activation quant
     acc = jax.lax.dot_general(
         x_q, w_q, dims, preferred_element_type=jnp.int32
     ).astype(jnp.float32)
-    # x_scale loses its contracted axes in the product; keep the batch axes.
-    x_scale_out = jnp.squeeze(x_scale, axis=axes)
+    # x_scale keeps only the batch axes; broadcast it over the out channels.
     out_batch_ndim = x.ndim - n_contract
-    out = acc * x_scale_out.reshape(
-        x_scale_out.shape + (1,) * (acc.ndim - out_batch_ndim)
+    out = acc * x_scale.reshape(
+        x_scale.shape + (1,) * (acc.ndim - out_batch_ndim)
     ) * w_scale
     return out.astype(dtype)
 
