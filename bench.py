@@ -153,6 +153,7 @@ def _inner() -> None:
 
     from k8s_device_plugin_tpu.models.benchmark import (
         _sync,
+        chained_tps,
         log,
         measure_two_point,
         timed_steps,
@@ -445,18 +446,9 @@ def _inner() -> None:
             prompt = jax.random.randint(rng, (batch, prompt_len), 0, cfg.vocab_size)
 
             def decode_tps(c, p):
-                short, full = 2, n_new
-                _sync(greedy_generate(c, p, prompt, short))
-                _sync(greedy_generate(c, p, prompt, full))
-                dt, fell_back = measure_two_point(
-                    lambda: _sync(greedy_generate(c, p, prompt, short)),
-                    lambda: _sync(greedy_generate(c, p, prompt, full)),
-                    full - short,
-                    full,
+                return batch * chained_tps(
+                    lambda n: _sync(greedy_generate(c, p, prompt, n)), 2, n_new
                 )
-                if fell_back:
-                    log("  (decode delta below noise floor; single-point, prefill-diluted)")
-                return batch * (full - short) / dt
 
             base = decode_tps(cfg, params)
             log(f"decode bf16: {base:.0f} tokens/sec (b{batch}, {cfg.num_layers}L)")
@@ -471,6 +463,79 @@ def _inner() -> None:
             )
         except Exception as e:  # secondary metrics must never kill the bench
             log(f"quantized decode bench failed: {e}")
+
+    def bench_speculative() -> None:
+        """Secondary: int8 self-speculative decode (stderr only).
+
+        The zero-extra-weights serving config — the draft is the SAME
+        model w8-quantized; greedy verification makes the output exactly
+        the bf16 greedy decode's.  Logs acceptance rate alongside
+        tokens/sec: with synthetic (random-init) weights the draft/target
+        agreement is the pessimistic floor, so read the ratio together
+        with the acceptance number.
+        """
+        try:
+            import dataclasses
+
+            from k8s_device_plugin_tpu.models.speculative import (
+                speculative_generate,
+            )
+            from k8s_device_plugin_tpu.models.transformer import (
+                GPTConfig,
+                TransformerLM,
+                greedy_generate,
+            )
+            from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+            if platform == "cpu":
+                cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+                prompt_len, n_new, gamma = 4, 6, 2
+            else:
+                cfg = GPTConfig(
+                    vocab_size=32000,
+                    hidden_size=1024,
+                    num_layers=4,
+                    num_heads=16,
+                    intermediate_size=2816,
+                    max_seq=512,
+                    num_kv_heads=4,
+                )
+                prompt_len, n_new, gamma = 128, 128, 4
+            rng = jax.random.PRNGKey(0)
+            params = TransformerLM(cfg).init(
+                rng, jnp.zeros((1, 2), jnp.int32)
+            )["params"]
+            d_cfg = dataclasses.replace(cfg, quant="w8")
+            d_params = quantize_lm_params(params)
+            prompt = jax.random.randint(rng, (1, prompt_len), 0, cfg.vocab_size)
+
+            base = chained_tps(
+                lambda n: _sync(greedy_generate(cfg, params, prompt, n)),
+                2,
+                n_new,
+                label="spec-base",
+            )
+            seq, acc = speculative_generate(
+                cfg, params, d_cfg, d_params, prompt, n_new, gamma=gamma
+            )
+            rate = float(jnp.mean(acc.astype(jnp.float32)))
+            spec = chained_tps(
+                lambda n: _sync(
+                    speculative_generate(
+                        cfg, params, d_cfg, d_params, prompt, n, gamma=gamma
+                    )[0]
+                ),
+                2,
+                n_new,
+                label="spec",
+            )
+            log(
+                f"decode b1 bf16: {base:.0f} tokens/sec; w8 self-speculative "
+                f"(gamma={gamma}): {spec:.0f} tokens/sec "
+                f"({spec / max(base, 1e-9):.2f}x, acceptance {rate:.0%})"
+            )
+        except Exception as e:  # secondary metrics must never kill the bench
+            log(f"speculative decode bench failed: {e}")
 
     ips = bench_resnet50(batch_size=128)
     # The headline JSON prints BEFORE the secondary benches: if a slow
@@ -496,6 +561,7 @@ def _inner() -> None:
     bench_flash_attention()
     bench_allocation_latency()
     bench_decode_quant()
+    bench_speculative()
 
 
 # --------------------------------------------------------------------------

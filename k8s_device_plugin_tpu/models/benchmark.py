@@ -84,6 +84,27 @@ def measure_two_point(run_small, run_big, n_delta: int, n_big: int):
     return dt, False
 
 
+def chained_tps(fn, short: int, full: int, label: str = "decode") -> float:
+    """Units/sec from two whole-program lengths (the generate-bench shape).
+
+    ``fn(n)`` must execute an n-unit program AND sync its result
+    (device_get).  Warms/compiles both lengths, then two-point times them
+    so constant prefill/dispatch cost cancels; on a below-noise-floor
+    delta it logs and returns the scaled single-point estimate
+    (overhead-diluted, but honest about it).  Shared by every bench that
+    times a cached generate program (bench.py secondaries) so the
+    warm/measure/fallback dance isn't re-cloned per bench.
+    """
+    fn(short)
+    fn(full)
+    dt, fell_back = measure_two_point(
+        lambda: fn(short), lambda: fn(full), full - short, full
+    )
+    if fell_back:
+        log(f"  ({label} delta below noise floor; single-point)")
+    return (full - short) / dt
+
+
 def multi_step(step, n: int):
     """Wrap ``step: (state, batch) -> (state, loss)`` into an ``n``-step
     `lax.fori_loop` — n training steps in ONE device dispatch.

@@ -1,0 +1,233 @@
+"""Greedy speculative decoding: a cheap draft proposes, the target verifies.
+
+The reference repo has no serving stack at all (its "workload" is an
+external benchmark container, reference k8s-pod-example-gpu.yaml:10-19);
+this module is part of the TPU serving story this framework adds on top of
+the cached decode loop (models/transformer.py).
+
+Why it wins on TPU: single-token decode is weight-bandwidth-bound — every
+step reads the full parameter set from HBM to produce ONE token.  A draft
+model proposes ``gamma`` tokens with cheap steps, then the target scores
+all ``gamma + 1`` positions in ONE cached forward (the ``append_mode=
+"cached"`` multi-token step): the target's weights are read once per
+accepted run instead of once per token.  Greedy verification preserves the
+target's output EXACTLY — token for token, the sequence equals what
+``greedy_generate`` on the target alone would produce (the acceptance rule
+only ever emits tokens the target's own argmax agrees with, plus the
+target's token at the first disagreement) — so the draft can be anything:
+a smaller model, or the SAME model int8-quantized (ops/quant.py), the
+zero-extra-weights "self-speculation" serving config.
+
+Mechanics per iteration (one ``lax.while_loop`` body, all shapes static):
+
+1. draft scan: ``gamma`` single-token cached steps propose d_1..d_γ;
+2. target verify: one (γ+1)-token cached step over [x_t, d_1..d_γ] gives
+   the target argmax T_0..T_γ at every position;
+3. accept a = length of the matching prefix (T_{i-1} == d_i); emit
+   d_1..d_a plus the bonus/correction token T_a  (1..γ+1 tokens/step);
+4. rewind both caches' ``cache_index`` to the consumed length — slots past
+   the rewind point are rewritten before they can ever be read (every
+   future query at position p re-writes slots ≤ p first), so no masking
+   fixup is needed.
+
+Batch is fixed at 1: per-element acceptance lengths diverge under
+batching, and the cache index is a scalar by design (a per-row index would
+un-vectorize every cache update).  Serving parallelism across requests
+belongs to the pods the plugin schedules, not to one decode loop.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import GPTConfig, TransformerLM, decode_cache_spec
+
+
+def _rewind(cache: Any, new_index: jax.Array) -> Any:
+    """Set every layer's scalar ``cache_index`` to ``new_index``."""
+
+    def set_leaf(path, leaf):
+        if any(getattr(p, "key", None) == "cache_index" for p in path):
+            return jnp.asarray(new_index, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+
+def speculative_generate(
+    target_cfg: GPTConfig,
+    target_params: Any,
+    draft_cfg: GPTConfig,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """Greedy speculative decode.  prompt: [1, prompt_len] int32.
+
+    Returns ``(sequence [1, prompt_len + max_new_tokens], accepted
+    [max_new_tokens])`` where ``accepted[i] = 1`` iff token i was a draft
+    proposal the target accepted (0 = emitted by the target itself:
+    the prefill token, correction tokens, and bonus tokens).  The mean of
+    ``accepted`` is the acceptance rate the serving config tunes γ by.
+
+    The sequence is EXACTLY ``greedy_generate(target_cfg, target_params,
+    prompt, max_new_tokens)`` — speculation changes the schedule, never the
+    output (pinned by tests/test_speculative.py against that oracle).
+    """
+    batch, prompt_len = prompt.shape
+    if batch != 1:
+        raise ValueError(f"speculative decode is batch-1 (got batch={batch})")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}"
+        )
+    # Every iteration may write γ+1 cache slots beyond the accepted point
+    # before rewinding, so both caches need headroom past max_new_tokens.
+    need = prompt_len + max_new_tokens + gamma
+    for name, cfg in (("target", target_cfg), ("draft", draft_cfg)):
+        if need > cfg.max_seq:
+            raise ValueError(
+                f"{name} max_seq {cfg.max_seq} < prompt {prompt_len} + "
+                f"max_new {max_new_tokens} + gamma {gamma} headroom"
+            )
+    return _compiled_spec(target_cfg, draft_cfg, prompt_len, max_new_tokens, gamma)(
+        target_params, draft_params, prompt
+    )
+
+
+@lru_cache(maxsize=16)
+def _compiled_spec(
+    target_cfg: GPTConfig,
+    draft_cfg: GPTConfig,
+    prompt_len: int,
+    max_new_tokens: int,
+    gamma: int,
+):
+    """Build (once per shape/config tuple) the jitted speculative loop —
+    same reasoning as transformer._compiled_decode: jit caches key on the
+    function object, so the closure must outlive the call for repeat
+    generates to hit the compiled executable."""
+    target = TransformerLM(target_cfg, decode=True)
+    verifier = TransformerLM(target_cfg, decode=True, append_mode="cached")
+    draft = TransformerLM(draft_cfg, decode=True)
+    # Cache structure computed abstractly OUTSIDE the jitted trace; zeros
+    # built from the specs inside (no host constants baked in).
+    t_spec = decode_cache_spec(target, 1)
+    d_spec = decode_cache_spec(draft, 1)
+
+    @jax.jit
+    def run(target_params, draft_params, prompt):
+        zeros = lambda spec: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec
+        )
+        pos = jnp.arange(prompt_len)[None, :]
+        t_logits, t_mut = target.apply(
+            {"params": target_params, "cache": zeros(t_spec)},
+            prompt,
+            pos,
+            mutable=["cache"],
+        )
+        _, d_mut = draft.apply(
+            {"params": draft_params, "cache": zeros(d_spec)},
+            prompt,
+            pos,
+            mutable=["cache"],
+        )
+        first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+
+        # out buffer has γ+1 slack: an iteration writes its full candidate
+        # block and the next write starts at the accepted point.
+        out = jnp.zeros((max_new_tokens + gamma + 1,), jnp.int32)
+        out = out.at[0].set(first[0])
+        acc = jnp.zeros((max_new_tokens + gamma + 1,), jnp.int32)
+
+        def cond(carry):
+            n_out = carry[0]
+            return n_out < max_new_tokens
+
+        def body(carry):
+            n_out, t_pos, last_tok, t_cache, d_cache, out, acc = carry
+
+            # 1. Draft proposes γ tokens, one cached step each.  The scan
+            # runs γ+1 steps: the last one consumes d_γ (its proposal is
+            # discarded) so the draft cache covers position t_pos+γ — on a
+            # full accept the next round starts past it, and a shorter scan
+            # would leave that slot forever unwritten.
+            def d_step(c, i):
+                d_cache, tok = c
+                logits, mut = draft.apply(
+                    {"params": draft_params, "cache": d_cache},
+                    tok[None, None],
+                    (t_pos + i)[None, None],
+                    mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
+                return (mut["cache"], nxt), nxt
+
+            (d_cache, _), props_ext = jax.lax.scan(
+                d_step, (d_cache, last_tok), jnp.arange(gamma + 1)
+            )
+            props = props_ext[:gamma]  # [γ]
+
+            # 2. Target scores [x_t, d_1..d_γ] in one cached (γ+1)-token step.
+            block = jnp.concatenate([last_tok[None], props])[None, :]  # [1, γ+1]
+            block_pos = (t_pos + jnp.arange(gamma + 1))[None, :]
+            v_logits, t_mut = verifier.apply(
+                {"params": target_params, "cache": t_cache},
+                block,
+                block_pos,
+                mutable=["cache"],
+            )
+            t_toks = jnp.argmax(v_logits[0], axis=-1).astype(jnp.int32)  # [γ+1]
+
+            # 3. a = longest prefix where the target agrees with the draft.
+            matches = (t_toks[:-1] == props).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(matches))
+            # Emit d_1..d_a then the target's own token at position a
+            # (correction on mismatch, bonus when everything matched).
+            idxs = jnp.arange(gamma + 1)
+            emitted = jnp.where(idxs < a, jnp.append(props, 0), t_toks[a])
+            emit_flags = (idxs < a).astype(jnp.int32)  # 1 = draft-accepted
+            out = jax.lax.dynamic_update_slice(out, emitted, (n_out,))
+            acc = jax.lax.dynamic_update_slice(acc, emit_flags, (n_out,))
+
+            # 4. Rewind both caches to the consumed length.
+            consumed = t_pos + a + 1
+            t_cache = _rewind(t_mut["cache"], consumed)
+            d_cache = _rewind(d_cache, consumed)
+            return (
+                n_out + a + 1,
+                consumed,
+                t_toks[a],
+                t_cache,
+                d_cache,
+                out,
+                acc,
+            )
+
+        n_out, _, _, _, _, out, acc = jax.lax.while_loop(
+            cond,
+            body,
+            (
+                jnp.asarray(1, jnp.int32),
+                jnp.asarray(prompt_len, jnp.int32),
+                first[0],
+                _rewind(t_mut["cache"], prompt_len),
+                _rewind(d_mut["cache"], prompt_len),
+                out,
+                acc,
+            ),
+        )
+        seq = jnp.concatenate([prompt[0], out[:max_new_tokens]])[None, :]
+        return seq, acc[:max_new_tokens]
+
+    return run

@@ -172,10 +172,23 @@ class CausalSelfAttention(nn.Module):
     # head_dim] — the hook parallel/sequence.py uses to swap in ring or
     # Ulysses sequence-parallel attention.  Ignored in decode mode.
     attention_fn: Optional[Any] = None
+    # Decode-mode multi-token semantics.  "auto": a q_len > 1 step is a
+    # bulk PREFILL into an empty cache (attends only within the provided
+    # tokens — flash-tiled).  "cached": a q_len > 1 step is an APPEND that
+    # attends against the whole cache with per-query position masks — the
+    # contract speculative verification needs (γ+1 draft tokens scored in
+    # one pass against a non-empty cache, models/speculative.py).
+    append_mode: str = "auto"
 
     @nn.compact
     def __call__(self, hidden, positions):
         cfg = self.config
+        if self.append_mode not in ("auto", "cached"):
+            # A typo here would silently pick the [q_len, max_seq] masked
+            # path for bulk prefill — a large, erroneous memory/time blowup.
+            raise ValueError(
+                f"append_mode must be auto|cached, got {self.append_mode!r}"
+            )
         if cfg.num_heads % cfg.kv_heads:
             raise ValueError(
                 f"num_heads {cfg.num_heads} not divisible by kv_heads {cfg.kv_heads}"
@@ -235,7 +248,7 @@ class CausalSelfAttention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
             idx.value = cur + hidden.shape[1]
             q_len = hidden.shape[1]
-            if q_len > 1:
+            if q_len > 1 and self.append_mode == "auto":
                 # Bulk prefill (static branch): attend causally WITHIN the
                 # provided tokens via the same non-decode path training
                 # uses — O(q_len²) (flash-tiled when 128-aligned) instead
@@ -326,12 +339,17 @@ class DecoderBlock(nn.Module):
     decode: bool = False
     mlp_factory: Optional[Any] = None  # swap-in point for MoE (parallel/moe.py)
     attention_fn: Optional[Any] = None
+    append_mode: str = "auto"
 
     @nn.compact
     def __call__(self, hidden, positions):
         cfg = self.config
         attn = CausalSelfAttention(
-            cfg, decode=self.decode, attention_fn=self.attention_fn, name="attn"
+            cfg,
+            decode=self.decode,
+            attention_fn=self.attention_fn,
+            append_mode=self.append_mode,
+            name="attn",
         )(
             RMSNorm(dtype=cfg.dtype, name="attn_norm")(hidden), positions
         )
@@ -355,6 +373,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
     mlp_factory: Optional[Any] = None
     attention_fn: Optional[Any] = None
+    append_mode: str = "auto"
 
     @nn.compact
     def __call__(self, input_ids, positions=None, output: str = "logits"):
@@ -376,6 +395,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 mlp_factory=self.mlp_factory,
                 attention_fn=self.attention_fn,
+                append_mode=self.append_mode,
                 name=f"layer_{i}",
             )(hidden, positions)
         hidden = RMSNorm(dtype=cfg.dtype, name="final_norm")(hidden)
@@ -393,6 +413,20 @@ class TransformerLM(nn.Module):
         return dense_site(cfg, cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
             hidden
         )
+
+
+def decode_cache_spec(model: TransformerLM, batch: int):
+    """Shape/dtype tree of ``model``'s decode cache for ``batch`` rows,
+    computed abstractly (no params materialize).  Call OUTSIDE jit and
+    build zeros from it inside — shared by the decode loop here and the
+    speculative loop (models/speculative.py)."""
+    return jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch, 1), jnp.int32),
+        )["cache"]
+    )
 
 
 @lru_cache(maxsize=16)
@@ -417,13 +451,7 @@ def _compiled_decode(
     # and advances cache_index — we only need the structure; the zeros are
     # created inside `run` (from ShapeDtypeStructs, so no large host constant
     # is baked into the compiled program).
-    cache_spec = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((batch, 1), jnp.int32),
-            jnp.zeros((batch, 1), jnp.int32),
-        )["cache"]
-    )
+    cache_spec = decode_cache_spec(model, batch)
 
     def pick(logits, key):
         """Next-token selection from [batch, vocab] logits — greedy when no

@@ -1,0 +1,128 @@
+"""Greedy speculative decoding (models/speculative.py).
+
+The load-bearing property: speculation changes the SCHEDULE, never the
+OUTPUT — for any draft, the emitted sequence must equal token-for-token
+what greedy_generate on the target alone produces.  Every test here leans
+on that oracle, which catches acceptance-rule off-by-ones, cache-rewind
+bugs, and stale-slot reads far more sharply than tolerance checks.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_device_plugin_tpu.models.speculative import speculative_generate
+from k8s_device_plugin_tpu.models.transformer import (
+    GPTConfig,
+    TransformerLM,
+    greedy_generate,
+)
+from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def _cfg(**kw):
+    base = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    return dataclasses.replace(base, **kw)
+
+
+def _init(cfg, rng):
+    return TransformerLM(cfg).init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def test_self_draft_accepts_everything(rng):
+    """Draft == target: every proposal matches, so acceptance is total and
+    the output equals the plain greedy decode."""
+    cfg = _cfg()
+    params = _init(cfg, rng)
+    prompt = jax.random.randint(rng, (1, 6), 0, cfg.vocab_size)
+    want = greedy_generate(cfg, params, prompt, 12)
+    got, acc = speculative_generate(cfg, params, cfg, params, prompt, 12, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    acc = np.asarray(acc)
+    # First token comes from the prefill (flag 0); each round then emits
+    # up to γ accepted proposals and one bonus token.  With a perfect
+    # draft the only zeros are the prefill and per-round bonus tokens.
+    assert acc.sum() >= len(acc) // 2
+
+
+def test_unrelated_draft_output_invariant(rng):
+    """A draft with different weights (and depth) must not change the
+    output — only the acceptance rate."""
+    t_cfg = _cfg()
+    d_cfg = _cfg(num_layers=1)
+    t_params = _init(t_cfg, rng)
+    d_params = _init(d_cfg, jax.random.fold_in(rng, 7))
+    prompt = jax.random.randint(rng, (1, 5), 0, t_cfg.vocab_size)
+    want = greedy_generate(t_cfg, t_params, prompt, 10)
+    for gamma in (1, 2, 4):
+        got, acc = speculative_generate(
+            t_cfg, t_params, d_cfg, d_params, prompt, 10, gamma=gamma
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"gamma={gamma}"
+        )
+        assert acc.shape == (10,)
+
+
+def test_quantized_self_draft_output_invariant(rng):
+    """The zero-extra-weights serving config: int8 self-speculation.  The
+    w8 draft usually agrees with the bf16 target (high acceptance), and
+    disagreements are corrected exactly."""
+    cfg = _cfg(hidden_size=128, num_heads=4, intermediate_size=256)
+    params = _init(cfg, rng)
+    d_cfg = dataclasses.replace(cfg, quant="w8")
+    d_params = quantize_lm_params(params)
+    prompt = jax.random.randint(rng, (1, 6), 0, cfg.vocab_size)
+    want = greedy_generate(cfg, params, prompt, 10)
+    got, acc = speculative_generate(cfg, params, d_cfg, d_params, prompt, 10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_acceptance_flags_count_draft_tokens(rng):
+    cfg = _cfg()
+    params = _init(cfg, rng)
+    prompt = jax.random.randint(rng, (1, 4), 0, cfg.vocab_size)
+    _, acc = speculative_generate(cfg, params, cfg, params, prompt, 8, gamma=2)
+    acc = np.asarray(acc)
+    assert acc[0] == 0, "prefill token is the target's, not a draft proposal"
+    assert set(acc.tolist()) <= {0, 1}
+
+
+def test_batch_and_gamma_validation(rng):
+    cfg = _cfg()
+    params = _init(cfg, rng)
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(
+            cfg, params, cfg, params, jnp.zeros((2, 4), jnp.int32), 4
+        )
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(
+            cfg, params, cfg, params, jnp.zeros((1, 4), jnp.int32), 4, gamma=0
+        )
+
+
+def test_max_seq_headroom_guard(rng):
+    cfg = _cfg()  # max_seq = 64
+    params = _init(cfg, rng)
+    prompt = jnp.zeros((1, 40), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq"):
+        speculative_generate(cfg, params, cfg, params, prompt, 22, gamma=4)
+
+
+def test_vocab_mismatch_guard(rng):
+    cfg = _cfg()
+    params = _init(cfg, rng)
+    d_cfg = _cfg(vocab_size=256)
+    d_params = _init(d_cfg, rng)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(
+            cfg, params, d_cfg, d_params, jnp.zeros((1, 4), jnp.int32), 4
+        )
