@@ -1,0 +1,143 @@
+"""LoRA: low-rank adapters on the transformer's dense sites.
+
+The reference repo has no fine-tuning story (no model code at all —
+SURVEY.md §2.4); this module is the parameter-efficient training leg of the
+workload layer: freeze the base weights, train rank-r adapters
+(``W + (alpha/r)·A·B``), then merge back to a plain tree for serving.
+
+TPU-first reasoning: full fine-tuning of an L-layer model holds optimizer
+moments for every parameter — 3× the weight HBM in Adam.  LoRA's moments
+cover only the adapters (<<1% of params at r=8 on a 2048-wide model), so
+the same chip fits a much larger model, and the adapter matmuls
+([*, in]·[in, r]·[r, out]) are tiny MXU side-channels XLA fuses alongside
+the frozen base matmul.  Merging (:func:`merge_lora_params`) restores the
+exact plain parameter layout, so the serving path — including int8 PTQ
+(ops/quant.py) — is untouched.
+
+Wiring mirrors the quant knob: ``GPTConfig(lora_rank=r)`` swaps every
+dense site (models/transformer.py ``dense_site``) to :class:`LoRADense`,
+whose ``kernel`` parameter keeps the plain name/shape — a pretrained bf16
+checkpoint loads into the LoRA model tree as-is (adapters initialize
+fresh: A gaussian, B zero, so step-0 output equals the base model's).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.quant import dense_geometry
+
+
+class LoRADense(nn.Module):
+    """DenseGeneral with a frozen base kernel plus trainable A·B adapters.
+
+    Parameters: ``kernel`` [*contract_dims, *features] (the base — same
+    name/shape as the plain dense site), ``lora_a`` [*contract_dims, rank]
+    (gaussian init, variance 1/fan_in), ``lora_b`` [rank, *features]
+    (zero init — the adapter starts as an exact no-op).
+    """
+
+    features: Union[int, Sequence[int]]
+    rank: int
+    alpha: float = 16.0
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        feats, _, contract, dims = dense_geometry(x, self.axis, self.features)
+        fan_in = math.prod(contract)
+
+        def base_init(key, shape, dtype=jnp.float32):
+            # Match flax DenseGeneral exactly: lecun_normal over the
+            # FLATTENED [fan_in, fan_out] shape, then reshape — the N-D
+            # initializer would compute a different fan_in on multi-dim
+            # sites (qkv [hidden, heads, head_dim]).
+            flat = nn.initializers.lecun_normal()(
+                key, (fan_in, math.prod(feats)), dtype
+            )
+            return flat.reshape(shape)
+
+        kernel = self.param("kernel", base_init, contract + feats)
+        lora_a = self.param(
+            "lora_a",
+            nn.initializers.normal(stddev=1.0 / math.sqrt(fan_in)),
+            contract + (self.rank,),
+        )
+        lora_b = self.param(
+            "lora_b", nn.initializers.zeros, (self.rank,) + feats
+        )
+        xd = x.astype(self.dtype)
+        base = jax.lax.dot_general(xd, kernel.astype(self.dtype), dims)
+        down = jax.lax.dot_general(xd, lora_a.astype(self.dtype), dims)  # [..., r]
+        up = jax.lax.dot_general(
+            down, lora_b.astype(self.dtype), (((down.ndim - 1,), (0,)), ((), ()))
+        )
+        return base + (self.alpha / self.rank) * up
+
+
+def lora_labels(params: Any) -> Any:
+    """Label tree: ``"lora"`` on adapter leaves (``lora_a``/``lora_b``),
+    ``"frozen"`` elsewhere — for ``optax.multi_transform``."""
+
+    def walk(name, leaf_or_tree):
+        if isinstance(leaf_or_tree, dict):
+            return {k: walk(k, v) for k, v in leaf_or_tree.items()}
+        return "lora" if name in ("lora_a", "lora_b") else "frozen"
+
+    return walk("", params)
+
+
+def make_lora_tx(inner):
+    """Wrap an optax transform so ONLY the adapters train.
+
+    ``optax.multi_transform`` routes adapter leaves to ``inner`` and base
+    leaves to ``set_to_zero()``.  (Plain ``optax.masked(inner, mask)`` is
+    NOT enough: masked passes the complement's updates through UNCHANGED —
+    raw gradients — silently fine-tuning the "frozen" base; pinned by
+    tests/test_lora.py.)  Optimizer state exists only for the adapters,
+    which is LoRA's memory win.
+    """
+    import optax
+
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, lora_labels
+    )
+
+
+def merge_lora_params(params: Any, *, alpha: float) -> Any:
+    """Fold every adapter pair into its base kernel and drop the adapters:
+    ``kernel + (alpha/rank)·A·B`` (contracted over rank) — the exact plain
+    layout serving (and ops.quant.quantize_lm_params) expects.
+
+    ``alpha`` is REQUIRED (pass ``cfg.lora_alpha``): rank is recoverable
+    from the tree (``lora_a.shape[-1]``) but alpha is not, and a defaulted
+    mismatch would silently scale every adapter delta wrong.
+    """
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "lora_a" in tree:
+            a, b, kernel = tree["lora_a"], tree["lora_b"], tree["kernel"]
+            rank = a.shape[-1]
+            delta = jax.lax.dot_general(
+                a.astype(jnp.float32),
+                b.astype(jnp.float32),
+                (((a.ndim - 1,), (0,)), ((), ())),
+            )
+            merged = (kernel.astype(jnp.float32) + (alpha / rank) * delta).astype(
+                kernel.dtype
+            )
+            rest = {
+                k: v for k, v in tree.items() if k not in ("kernel", "lora_a", "lora_b")
+            }
+            return {"kernel": merged, **rest}
+        return {k: walk(v) for k, v in tree.items()}
+
+    return walk(params)

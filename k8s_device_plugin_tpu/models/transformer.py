@@ -76,6 +76,14 @@ class GPTConfig:
     # KV-bandwidth-bound once seq >> hidden).  Orthogonal to `quant`
     # (weights); either works alone, the serving config sets both.
     quant_kv: bool = False
+    # LoRA fine-tuning (models/lora.py): rank-r adapters on every dense
+    # site, base kernels frozen (`kernel` keeps its plain name/shape, so a
+    # pretrained checkpoint loads as-is and adapters init as a no-op).
+    # Train with make_lora_tx(inner_tx); merge_lora_params folds adapters
+    # back for serving.  Mutually exclusive with `quant` (quantize AFTER
+    # merging).
+    lora_rank: Optional[int] = None
+    lora_alpha: float = 16.0
 
     @property
     def head_dim(self) -> int:
@@ -136,6 +144,22 @@ def dense_site(cfg: GPTConfig, features, *, axis=-1, dtype=None, name: str):
     (same parameter tree shape, ``kernel`` -> ``kernel_q``/``kernel_scale``)
     otherwise — training and quantized serving share ALL model code."""
     dtype = cfg.dtype if dtype is None else dtype
+    if cfg.quant is not None and cfg.lora_rank is not None:
+        raise ValueError(
+            "quant and lora_rank are mutually exclusive: train the adapters, "
+            "merge_lora_params, then quantize the merged tree"
+        )
+    if cfg.lora_rank is not None:
+        from .lora import LoRADense  # local: lora imports ops, not us
+
+        return LoRADense(
+            features=features,
+            rank=cfg.lora_rank,
+            alpha=cfg.lora_alpha,
+            axis=axis,
+            dtype=dtype,
+            name=name,
+        )
     if cfg.quant is None:
         # DenseGeneral(features=int, axis=-1) == Dense: same "kernel"
         # [in, out] param, same init, same dot — one constructor suffices.

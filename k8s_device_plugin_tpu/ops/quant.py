@@ -100,6 +100,21 @@ def _normalize_axis(axis: Union[int, Sequence[int]], ndim: int) -> tuple[int, ..
     return tuple(a % ndim for a in axes)
 
 
+def dense_geometry(
+    x: jax.Array, axis: Union[int, Sequence[int]], features: Union[int, Sequence[int]]
+) -> tuple[tuple[int, ...], tuple[int, ...], tuple[int, ...], Any]:
+    """The one contraction convention every dense-site module shares
+    (Int8DenseGeneral here, LoRADense in models/lora.py): returns
+    ``(feats, axes, contract, dims)`` — feature dims as a tuple, normalized
+    input contraction axes, their sizes, and the `dot_general` dimension
+    numbers for a [*contract, *feats] kernel."""
+    feats = (features,) if isinstance(features, int) else tuple(features)
+    axes = _normalize_axis(axis, x.ndim)
+    contract = tuple(x.shape[a] for a in axes)
+    dims = ((axes, tuple(range(len(axes)))), ((), ()))
+    return feats, axes, contract, dims
+
+
 def int8_dot_general(
     x: jax.Array,
     w_q: jax.Array,
@@ -157,11 +172,7 @@ class Int8DenseGeneral(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        feats = (
-            (self.features,) if isinstance(self.features, int) else tuple(self.features)
-        )
-        axes = _normalize_axis(self.axis, x.ndim)
-        contract = tuple(x.shape[a] for a in axes)
+        feats, _, contract, _ = dense_geometry(x, self.axis, self.features)
         w_q = self.param(
             "kernel_q", nn.initializers.zeros, contract + feats, jnp.int8
         )

@@ -1,0 +1,176 @@
+"""LoRA adapters (models/lora.py) and their transformer wiring.
+
+Pins the contract chain a fine-tune relies on: pretrained checkpoint loads
+into the LoRA tree (kernel keeps its plain name/shape), adapters start as
+an exact no-op, only adapters receive optimizer updates under the mask,
+and merging restores a plain tree whose outputs match the adapted model.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_device_plugin_tpu.models.lora import (
+    LoRADense,
+    lora_labels,
+    make_lora_tx,
+    merge_lora_params,
+)
+from k8s_device_plugin_tpu.models.transformer import GPTConfig, TransformerLM
+from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def _cfg(**kw):
+    return dataclasses.replace(GPTConfig.tiny(), **kw)
+
+
+def test_lora_dense_params_and_noop_init(rng):
+    m = LoRADense(features=(4, 8), rank=2, axis=-1, dtype=jnp.float32)
+    x = jax.random.normal(rng, (3, 16))
+    params = m.init(rng, x)["params"]
+    assert params["kernel"].shape == (16, 4, 8)
+    assert params["lora_a"].shape == (16, 2)
+    assert params["lora_b"].shape == (2, 4, 8)
+    # B starts at zero -> adapter contributes nothing.
+    out = m.apply({"params": params}, x)
+    base = jnp.einsum("bi,ifo->bfo", x, params["kernel"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=1e-5)
+
+
+def test_pretrained_checkpoint_loads_and_is_noop(rng):
+    """A plain tree's kernels slot into the LoRA tree; step-0 logits match
+    the base model exactly."""
+    cfg = _cfg()
+    base_params = TransformerLM(cfg).init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    lcfg = dataclasses.replace(cfg, lora_rank=4)
+    ids = jax.random.randint(rng, (2, 8), 0, cfg.vocab_size)
+    lora_params = TransformerLM(lcfg).init(rng, ids)["params"]
+
+    # Graft the pretrained kernels into the LoRA tree (the checkpoint-load
+    # path: same names, same shapes).
+    def graft(lp, bp):
+        if isinstance(lp, dict):
+            return {
+                k: (bp[k] if k == "kernel" else graft(v, bp.get(k, v)))
+                for k, v in lp.items()
+            }
+        return bp
+
+    grafted = graft(lora_params, base_params)
+    want = TransformerLM(cfg).apply({"params": base_params}, ids)
+    got = TransformerLM(lcfg).apply({"params": grafted}, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_masked_training_updates_only_adapters(rng):
+    cfg = _cfg(lora_rank=2)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(rng, (2, 9), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    params = model.init(rng, batch["input_ids"])["params"]
+    # Zero-init B makes lora_a's gradient exactly zero at step 0; give B
+    # real values (as after any first step) so BOTH adapters see gradients.
+    params = jax.tree_util.tree_map_with_path(
+        lambda path, x: (
+            jax.random.normal(
+                jax.random.fold_in(rng, abs(hash(str(path))) % 2**31), x.shape, x.dtype
+            )
+            * 0.05
+            if any(getattr(p, "key", None) == "lora_b" for p in path)
+            else x
+        ),
+        params,
+    )
+    labels = lora_labels(params)
+    assert set(jax.tree.leaves(labels)) == {"lora", "frozen"}
+    tx = make_lora_tx(optax.adamw(1e-2))
+    state = tx.init(params)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, batch["input_ids"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]
+        ).mean()
+
+    grads = jax.grad(loss_fn)(params)
+    updates, _ = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+
+    changed = jax.tree_util.tree_map_with_path(
+        lambda path, a, b: (
+            any(getattr(p, "key", None) in ("lora_a", "lora_b") for p in path),
+            bool(np.any(np.asarray(a) != np.asarray(b))),
+        ),
+        params,
+        new_params,
+    )
+    for is_lora, did_change in jax.tree.leaves(changed, is_leaf=lambda x: isinstance(x, tuple)):
+        if is_lora:
+            assert did_change, "adapter leaf never updated"
+        else:
+            assert not did_change, "frozen base leaf was updated"
+
+
+def test_merge_matches_adapted_model(rng):
+    cfg = _cfg(lora_rank=2, lora_alpha=8.0)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(rng, (2, 8), 0, cfg.vocab_size)
+    params = model.init(rng, ids)["params"]
+    # Give the adapters real values (B is zero-init).
+    params = jax.tree_util.tree_map_with_path(
+        lambda path, x: (
+            jax.random.normal(
+                jax.random.fold_in(rng, abs(hash(str(path))) % 2**31), x.shape, x.dtype
+            )
+            * 0.05
+            if any(getattr(p, "key", None) == "lora_b" for p in path)
+            else x
+        ),
+        params,
+    )
+    adapted = model.apply({"params": params}, ids)
+
+    merged = merge_lora_params(params, alpha=cfg.lora_alpha)
+    # Merged tree has NO adapter leaves and applies through the PLAIN model.
+    assert not any(
+        getattr(p, "key", None) in ("lora_a", "lora_b")
+        for path, _ in jax.tree_util.tree_flatten_with_path(merged)[0]
+        for p in path
+    )
+    plain = TransformerLM(dataclasses.replace(cfg, lora_rank=None)).apply(
+        {"params": merged}, ids
+    )
+    np.testing.assert_allclose(
+        np.asarray(plain), np.asarray(adapted), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_merge_then_quantize_serves(rng):
+    """The full lifecycle: LoRA-train -> merge -> int8 PTQ -> decode."""
+    from k8s_device_plugin_tpu.models.transformer import greedy_generate
+
+    cfg = _cfg(lora_rank=2)
+    params = TransformerLM(cfg).init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    merged = merge_lora_params(params, alpha=cfg.lora_alpha)
+    qparams = quantize_lm_params(merged)
+    qcfg = dataclasses.replace(cfg, lora_rank=None, quant="w8")
+    prompt = jax.random.randint(rng, (1, 4), 0, cfg.vocab_size)
+    out = greedy_generate(qcfg, qparams, prompt, 3)
+    assert out.shape == (1, 7)
+
+
+def test_quant_and_lora_mutually_exclusive(rng):
+    cfg = _cfg(lora_rank=2, quant="w8")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        TransformerLM(cfg).init(rng, jnp.zeros((1, 4), jnp.int32))
