@@ -80,6 +80,63 @@ def speculative_generate(
     prompt, max_new_tokens)`` — speculation changes the schedule, never the
     output (pinned by tests/test_speculative.py against that oracle).
     """
+    prompt_len = _validate_spec_args(
+        target_cfg, draft_cfg, prompt, max_new_tokens, gamma
+    )
+    return _compiled_spec(target_cfg, draft_cfg, prompt_len, max_new_tokens, gamma)(
+        target_params, draft_params, prompt
+    )
+
+
+def speculative_sample_generate(
+    target_cfg: GPTConfig,
+    target_params: Any,
+    draft_cfg: GPTConfig,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    gamma: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """Distribution-preserving speculative SAMPLING (Leviathan/Chen-style
+    acceptance-rejection) at the given temperature.
+
+    Per position the draft samples d ~ Q; the target accepts with
+    probability ``min(1, P(d)/Q(d))`` and, at the first rejection, emits a
+    token from the residual ``max(0, P - Q)`` (renormalized) — on a full
+    accept the bonus token is drawn from P directly.  Marginally each
+    emitted token is distributed EXACTLY as target-only sampling at this
+    temperature (pinned statistically by tests/test_speculative.py), so
+    speculation is again purely a throughput knob.
+
+    Same batch-1 / headroom contract and ``(sequence, accepted)`` return
+    as :func:`speculative_generate`.
+    """
+    if temperature <= 0:
+        raise ValueError(
+            f"temperature must be > 0, got {temperature}; use "
+            "speculative_generate for greedy decoding"
+        )
+    prompt_len = _validate_spec_args(
+        target_cfg, draft_cfg, prompt, max_new_tokens, gamma
+    )
+    return _compiled_spec(
+        target_cfg, draft_cfg, prompt_len, max_new_tokens, gamma,
+        float(temperature),
+    )(target_params, draft_params, prompt, rng)
+
+
+def _validate_spec_args(
+    target_cfg: GPTConfig,
+    draft_cfg: GPTConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    gamma: int,
+) -> int:
+    """Shared precondition checks for both speculative entry points;
+    returns the prompt length."""
     batch, prompt_len = prompt.shape
     if batch != 1:
         raise ValueError(f"speculative decode is batch-1 (got batch={batch})")
@@ -99,9 +156,7 @@ def speculative_generate(
                 f"{name} max_seq {cfg.max_seq} < prompt {prompt_len} + "
                 f"max_new {max_new_tokens} + gamma {gamma} headroom"
             )
-    return _compiled_spec(target_cfg, draft_cfg, prompt_len, max_new_tokens, gamma)(
-        target_params, draft_params, prompt
-    )
+    return prompt_len
 
 
 @lru_cache(maxsize=16)
@@ -111,6 +166,7 @@ def _compiled_spec(
     prompt_len: int,
     max_new_tokens: int,
     gamma: int,
+    temperature: float | None = None,
 ):
     """Build (once per shape/config tuple) the jitted speculative loop —
     same reasoning as transformer._compiled_decode: jit caches key on the
@@ -124,8 +180,12 @@ def _compiled_spec(
     t_spec = decode_cache_spec(target, 1)
     d_spec = decode_cache_spec(draft, 1)
 
+    sampling = temperature is not None
+
     @jax.jit
-    def run(target_params, draft_params, prompt):
+    def run(target_params, draft_params, prompt, rng=None):
+        # `sampling` is a trace-time Python bool: the greedy program
+        # carries no PRNG key and pays no per-iteration splits.
         zeros = lambda spec: jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), spec
         )
@@ -142,7 +202,12 @@ def _compiled_spec(
             pos,
             mutable=["cache"],
         )
-        first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+        if sampling:
+            first = jax.random.categorical(
+                jax.random.fold_in(rng, 0), t_logits[:, -1, :] / temperature
+            ).astype(jnp.int32)  # [1]
+        else:
+            first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)
 
         # out buffer has γ+1 slack: an iteration writes its full candidate
         # block and the next write starts at the accepted point.
@@ -155,7 +220,11 @@ def _compiled_spec(
             return n_out < max_new_tokens
 
         def body(carry):
-            n_out, t_pos, last_tok, t_cache, d_cache, out, acc = carry
+            if sampling:
+                n_out, t_pos, last_tok, t_cache, d_cache, out, acc, key = carry
+                key, kd, ka, kt = jax.random.split(key, 4)
+            else:
+                n_out, t_pos, last_tok, t_cache, d_cache, out, acc = carry
 
             # 1. Draft proposes γ tokens, one cached step each.  The scan
             # runs γ+1 steps: the last one consumes d_γ (its proposal is
@@ -170,10 +239,19 @@ def _compiled_spec(
                     (t_pos + i)[None, None],
                     mutable=["cache"],
                 )
-                nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
-                return (mut["cache"], nxt), nxt
+                row = logits[0, -1, :]
+                if sampling:
+                    scaled = row / temperature
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(kd, i), scaled
+                    ).astype(jnp.int32)
+                    q = jax.nn.softmax(scaled)
+                else:
+                    nxt = jnp.argmax(row).astype(jnp.int32)
+                    q = jnp.zeros((0,), jnp.float32)  # unused in greedy
+                return (mut["cache"], nxt), (nxt, q)
 
-            (d_cache, _), props_ext = jax.lax.scan(
+            (d_cache, _), (props_ext, q_ext) = jax.lax.scan(
                 d_step, (d_cache, last_tok), jnp.arange(gamma + 1)
             )
             props = props_ext[:gamma]  # [γ]
@@ -187,15 +265,39 @@ def _compiled_spec(
                 block_pos,
                 mutable=["cache"],
             )
-            t_toks = jnp.argmax(v_logits[0], axis=-1).astype(jnp.int32)  # [γ+1]
 
-            # 3. a = longest prefix where the target agrees with the draft.
-            matches = (t_toks[:-1] == props).astype(jnp.int32)
-            a = jnp.sum(jnp.cumprod(matches))
+            if sampling:
+                # 3s. Acceptance-rejection: accept d_{j+1} w.p. min(1,
+                # P_j(d)/Q_j(d)); at the first rejection sample the
+                # residual max(0, P_a - Q_a), on a full accept sample the
+                # bonus from P_γ.  Each emitted token is marginally a draw
+                # from P — target-only sampling, just cheaper.
+                p_all = jax.nn.softmax(v_logits[0] / temperature)  # [γ+1, V]
+                jj = jnp.arange(gamma)
+                p_d = p_all[jj, props]
+                q_d = q_ext[jj, props]
+                u = jax.random.uniform(ka, (gamma,))
+                accept = (u * q_d < p_d).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(accept))
+                p_a = jnp.take(p_all, a, axis=0)  # [V]
+                q_a = jnp.take(q_ext, a, axis=0)
+                resid = jnp.where(a < gamma, jnp.clip(p_a - q_a, min=0.0), p_a)
+                norm = jnp.sum(resid)
+                tail_p = jnp.where(norm > 0, resid / norm, p_a)
+                tail_tok = jax.random.categorical(kt, jnp.log(tail_p)).astype(
+                    jnp.int32
+                )
+            else:
+                # 3. a = longest prefix where the target argmax agrees.
+                t_toks = jnp.argmax(v_logits[0], axis=-1).astype(jnp.int32)
+                matches = (t_toks[:-1] == props).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(matches))
+                tail_tok = t_toks[a]
+
             # Emit d_1..d_a then the target's own token at position a
-            # (correction on mismatch, bonus when everything matched).
+            # (correction on rejection, bonus when everything matched).
             idxs = jnp.arange(gamma + 1)
-            emitted = jnp.where(idxs < a, jnp.append(props, 0), t_toks[a])
+            emitted = jnp.where(idxs < a, jnp.append(props, 0), tail_tok)
             emit_flags = (idxs < a).astype(jnp.int32)  # 1 = draft-accepted
             out = jax.lax.dynamic_update_slice(out, emitted, (n_out,))
             acc = jax.lax.dynamic_update_slice(acc, emit_flags, (n_out,))
@@ -204,29 +306,30 @@ def _compiled_spec(
             consumed = t_pos + a + 1
             t_cache = _rewind(t_mut["cache"], consumed)
             d_cache = _rewind(d_cache, consumed)
-            return (
+            nxt_carry = (
                 n_out + a + 1,
                 consumed,
-                t_toks[a],
+                tail_tok,
                 t_cache,
                 d_cache,
                 out,
                 acc,
             )
+            return nxt_carry + ((key,) if sampling else ())
 
-        n_out, _, _, _, _, out, acc = jax.lax.while_loop(
-            cond,
-            body,
-            (
-                jnp.asarray(1, jnp.int32),
-                jnp.asarray(prompt_len, jnp.int32),
-                first[0],
-                _rewind(t_mut["cache"], prompt_len),
-                _rewind(d_mut["cache"], prompt_len),
-                out,
-                acc,
-            ),
+        init = (
+            jnp.asarray(1, jnp.int32),
+            jnp.asarray(prompt_len, jnp.int32),
+            first[0],
+            _rewind(t_mut["cache"], prompt_len),
+            _rewind(d_mut["cache"], prompt_len),
+            out,
+            acc,
         )
+        if sampling:
+            init = init + (jax.random.fold_in(rng, 1),)
+        final = jax.lax.while_loop(cond, body, init)
+        out, acc = final[5], final[6]
         seq = jnp.concatenate([prompt[0], out[:max_new_tokens]])[None, :]
         return seq, acc[:max_new_tokens]
 

@@ -14,7 +14,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from k8s_device_plugin_tpu.models.speculative import speculative_generate
+from k8s_device_plugin_tpu.models.speculative import (
+    speculative_generate,
+    speculative_sample_generate,
+)
 from k8s_device_plugin_tpu.models.transformer import (
     GPTConfig,
     TransformerLM,
@@ -115,6 +118,77 @@ def test_max_seq_headroom_guard(rng):
     prompt = jnp.zeros((1, 40), jnp.int32)
     with pytest.raises(ValueError, match="max_seq"):
         speculative_generate(cfg, params, cfg, params, prompt, 22, gamma=4)
+
+
+def test_sample_spec_preserves_target_distribution(rng):
+    """The acceptance-rejection variant must leave each token marginally
+    distributed as target-only sampling.  Two-sample check on token #2
+    (the first token that actually flows through accept/reject): total
+    variation between N speculative draws and N direct target draws stays
+    within sampling noise, at a sharp temperature where a wrong
+    distribution (e.g. the draft's own) would show immediately."""
+    from k8s_device_plugin_tpu.models.transformer import sample_generate
+
+    cfg = _cfg(vocab_size=32)
+    d_cfg = _cfg(vocab_size=32, num_layers=1)
+    t_params = _init(cfg, rng)
+    d_params = _init(d_cfg, jax.random.fold_in(rng, 3))
+    prompt = jax.random.randint(rng, (1, 4), 0, cfg.vocab_size)
+    temp, n = 0.3, 1200
+
+    spec_tok2 = np.array(
+        [
+            np.asarray(
+                speculative_sample_generate(
+                    cfg, t_params, d_cfg, d_params, prompt, 2,
+                    rng=jax.random.PRNGKey(1000 + i), temperature=temp, gamma=2,
+                )[0]
+            )[0, 5]
+            for i in range(n)
+        ]
+    )
+    direct_tok2 = np.array(
+        [
+            np.asarray(
+                sample_generate(
+                    cfg, t_params, prompt, 2,
+                    rng=jax.random.PRNGKey(5000 + i), temperature=temp,
+                )
+            )[0, 5]
+            for i in range(n)
+        ]
+    )
+
+    def hist(x):
+        return np.bincount(x, minlength=cfg.vocab_size) / len(x)
+
+    tv_target = 0.5 * np.abs(hist(spec_tok2) - hist(direct_tok2)).sum()
+    assert tv_target < 0.11, f"TV(spec, target-only) = {tv_target:.3f}"
+
+
+def test_sample_spec_deterministic_and_valid(rng):
+    cfg = _cfg()
+    params = _init(cfg, rng)
+    d_params = _init(_cfg(num_layers=1), jax.random.fold_in(rng, 9))
+    prompt = jax.random.randint(rng, (1, 5), 0, cfg.vocab_size)
+    kw = dict(rng=jax.random.PRNGKey(7), temperature=0.8, gamma=3)
+    a1, f1 = speculative_sample_generate(
+        cfg, params, _cfg(num_layers=1), d_params, prompt, 8, **kw
+    )
+    a2, f2 = speculative_sample_generate(
+        cfg, params, _cfg(num_layers=1), d_params, prompt, 8, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    out = np.asarray(a1)
+    assert out.shape == (1, 13)
+    np.testing.assert_array_equal(out[:, :5], np.asarray(prompt))
+    assert out.min() >= 0 and out.max() < cfg.vocab_size
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_sample_generate(
+            cfg, params, cfg, params, prompt, 4,
+            rng=jax.random.PRNGKey(0), temperature=0.0,
+        )
 
 
 def test_vocab_mismatch_guard(rng):
