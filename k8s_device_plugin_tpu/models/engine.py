@@ -1,0 +1,288 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The reference stops at mounting device nodes into a pod (reference
+main.go:139-159); this is the workload-side request server that runs ON
+those chips.  Design split, TPU-shaped:
+
+- **Device side** (jitted once): a fixed-[slots] single-token decode step
+  over the paged cache (models/transformer.py ``PagedConfig``) — every
+  slot advances every step, idle slots compute masked garbage into the
+  reserved scratch page.  Static shapes, no recompiles as requests come
+  and go.
+- **Host side** (this module, plain Python between steps): admission,
+  page allocation/free, per-slot bookkeeping.  State edits are row-wise
+  ``.at[slot].set`` updates on the cache tree — O(layers) small
+  dispatches per request event, never per token.
+
+Prefill bridges through the dense path: an admitted prompt runs the
+ordinary dense-cache prefill (one MXU-shaped pass, compiled per prompt
+length), and its K/V rows are grafted into the allocated pages.  Decode
+then proceeds fully paged.  Page 0 is reserved as the idle-slot scratch
+target: idle rows keep appending there (their page-table rows are zero
+and gather indices clamp), so they can never collide with a live page.
+
+Capacity model: a request needs ``ceil((prompt + max_new) / page_size)``
+pages, allocated at admission (no mid-flight allocation → no deadlock);
+requests queue when the pool is dry and admit as finished requests free
+their pages — continuous batching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import GPTConfig, PagedConfig, TransformerLM, decode_cache_spec
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and, when finished, its output tokens."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    rid: int = -1
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+
+class ServingEngine:
+    """Batch-continuous greedy decoding server (single host, one model).
+
+    ``cfg`` is the model config WITHOUT paging; the engine derives the
+    paged decode config.  ``params`` may be any serving tree the config
+    accepts (bf16, or int8 via ``cfg.quant``).
+    """
+
+    def __init__(
+        self,
+        cfg: GPTConfig,
+        params: Any,
+        paged: PagedConfig,
+        *,
+        max_slots: int = 4,
+        eos_id: Optional[int] = None,
+    ):
+        if cfg.paged is not None:
+            raise ValueError("pass the base config; the engine adds paging")
+        self.paged = paged
+        self.cfg = dataclasses.replace(cfg, paged=paged)
+        # Dense prefill bridge shares max_seq with the paged logical view.
+        self.dense_cfg = dataclasses.replace(cfg, paged=None, max_seq=paged.max_len)
+        self.params = params
+        self.max_slots = max_slots
+        self.eos_id = eos_id
+
+        model = TransformerLM(self.cfg, decode=True)
+        spec = decode_cache_spec(model, max_slots)
+        self.cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        self._layer_names = [f"layer_{i}" for i in range(cfg.num_layers)]
+
+        @jax.jit
+        def step(params, cache, tokens, positions):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                tokens,
+                positions,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return nxt, mut["cache"]
+
+        self._step = step
+        self._dense = TransformerLM(self.dense_cfg, decode=True)
+
+        # Page 0 is the idle-slot scratch target — never allocated.
+        self.free_pages: deque[int] = deque(range(1, paged.num_pages))
+        self.slots: list[Optional[Request]] = [None] * max_slots
+        self._slot_pages: list[list[int]] = [[] for _ in range(max_slots)]
+        self._slot_last: list[int] = [0] * max_slots  # last emitted token
+        self._slot_len: list[int] = [0] * max_slots  # consumed positions
+        self.queue: deque[Request] = deque()
+        self._next_rid = 0
+        self._prefill_cache: dict[int, Any] = {}
+
+    # ------------------------------------------------------------- admission
+
+    def submit(self, prompt, max_new_tokens: int) -> Request:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        need = len(prompt) + max_new_tokens
+        if need > self.paged.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"paged max_len {self.paged.max_len}"
+            )
+        # Admissibility, not just addressability: the request must fit the
+        # ALLOCATABLE pool (page 0 is reserved), else it would block the
+        # FIFO head forever.
+        allocatable = (self.paged.num_pages - 1) * self.paged.page_size
+        if need > allocatable:
+            raise ValueError(
+                f"request needs {need} cache slots but the pool only ever "
+                f"has {allocatable} ({self.paged.num_pages - 1} allocatable "
+                f"pages x {self.paged.page_size})"
+            )
+        req = Request(prompt, max_new_tokens, rid=self._next_rid)
+        self._next_rid += 1
+        self.queue.append(req)
+        return req
+
+    def _prefill_fn(self, prompt_len: int):
+        """Jitted dense prefill, cached per prompt length on THIS instance
+        (a process-global lru_cache would pin the engine — params tree and
+        page pools included — beyond its lifetime)."""
+        fn = self._prefill_cache.get(prompt_len)
+        if fn is not None:
+            return fn
+        spec = decode_cache_spec(self._dense, 1)
+
+        def run(params, prompt):
+            cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+            pos = jnp.arange(prompt_len)[None, :]
+            logits, mut = self._dense.apply(
+                {"params": params, "cache": cache}, prompt, pos, mutable=["cache"]
+            )
+            first = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
+            return first, mut["cache"]
+
+        fn = jax.jit(run)
+        self._prefill_cache[prompt_len] = fn
+        return fn
+
+    def _graft(self, slot: int, dense_cache: Any, pages: list[int], plen: int):
+        """Scatter a prefilled dense cache's rows into the allocated pages
+        and point the slot's table/length at them — ONE page-indexed
+        scatter per pool per layer (not per page: eager `.at` updates are
+        copy-on-write, so per-page updates would round-trip the whole pool
+        once per page).  Pages covering the prompt are written whole; tail
+        slots past plen carry zeros, which later appends overwrite before
+        any masked read can see them."""
+        ps = self.paged.page_size
+        n_cover = math.ceil(plen / ps)
+        cover = jnp.asarray(pages[:n_cover], jnp.int32)
+        pad = n_cover * ps - plen
+        row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
+        row[: len(pages)] = pages
+        for name in self._layer_names:
+            att = self.cache[name]["attn"]
+            src = dense_cache[name]["attn"]
+
+            def paged_rows(slab):
+                rows = slab[0, : n_cover * ps - pad]
+                if pad:
+                    rows = jnp.pad(rows, ((0, pad), (0, 0), (0, 0)))
+                return rows.reshape(n_cover, ps, *rows.shape[1:])
+
+            self.cache[name]["attn"] = {
+                **att,
+                "pool_key": att["pool_key"]
+                .at[cover]
+                .set(paged_rows(src["cached_key"])),
+                "pool_value": att["pool_value"]
+                .at[cover]
+                .set(paged_rows(src["cached_value"])),
+                "page_table": att["page_table"].at[slot].set(jnp.asarray(row)),
+                "seq_lens": att["seq_lens"].at[slot].set(plen),
+            }
+
+    def _clear_slot(self, slot: int):
+        for name in self._layer_names:
+            att = self.cache[name]["attn"]
+            self.cache[name]["attn"] = {
+                **att,
+                "page_table": att["page_table"].at[slot].set(0),
+                "seq_lens": att["seq_lens"].at[slot].set(0),
+            }
+        self.free_pages.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.slots[slot] = None
+        self._slot_last[slot] = 0
+        self._slot_len[slot] = 0
+
+    def _admit(self) -> list[Request]:
+        """Admit queued requests into free slots; returns any that finished
+        at admission already (EOS or max_new_tokens == 1 on the prefill
+        token) so step() can report them."""
+        finished = []
+        for slot in range(self.max_slots):
+            if self.slots[slot] is not None or not self.queue:
+                continue
+            req = self.queue[0]
+            n_pages = math.ceil(
+                (len(req.prompt) + req.max_new_tokens) / self.paged.page_size
+            )
+            if n_pages > len(self.free_pages):
+                break  # FIFO: wait for pages rather than starving the head
+            self.queue.popleft()
+            pages = [self.free_pages.popleft() for _ in range(n_pages)]
+            plen = len(req.prompt)
+            first, dense_cache = self._prefill_fn(plen)(
+                self.params, jnp.asarray(req.prompt, jnp.int32)[None, :]
+            )
+            self._graft(slot, dense_cache, pages, plen)
+            self.slots[slot] = req
+            self._slot_pages[slot] = pages
+            first = int(first)
+            req.tokens.append(first)
+            self._slot_last[slot] = first
+            self._slot_len[slot] = plen
+            self._maybe_finish(slot)
+            if req.done:
+                finished.append(req)
+        return finished
+
+    def _maybe_finish(self, slot: int):
+        req = self.slots[slot]
+        if req is None:
+            return
+        if len(req.tokens) >= req.max_new_tokens or (
+            self.eos_id is not None and req.tokens and req.tokens[-1] == self.eos_id
+        ):
+            req.done = True
+            self._clear_slot(slot)
+
+    # ----------------------------------------------------------------- steps
+
+    def step(self) -> list[Request]:
+        """Admit what fits, advance every active slot one token; returns
+        every request that finished this step (including ones done at
+        admission — EOS/max_new on the prefill token)."""
+        finished = self._admit()
+        active = [s for s in range(self.max_slots) if self.slots[s] is not None]
+        if not active:
+            return finished
+        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
+        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
+        nxt, self.cache = self._step(self.params, self.cache, tokens, positions)
+        nxt = np.asarray(nxt)
+        for s in active:
+            req = self.slots[s]
+            tok = int(nxt[s])
+            req.tokens.append(tok)
+            self._slot_last[s] = tok
+            self._slot_len[s] += 1
+            self._maybe_finish(s)
+            if req.done:
+                finished.append(req)
+        return finished
+
+    def run(self, requests: list[tuple[list[int], int]]) -> list[Request]:
+        """Submit all, step until drained, return in submission order."""
+        subs = [self.submit(p, n) for p, n in requests]
+        guard = 0
+        while not all(r.done for r in subs):
+            self.step()
+            guard += 1
+            if guard > 100_000:
+                raise RuntimeError("engine failed to drain")
+        return subs

@@ -39,6 +39,27 @@ NEG_LOGIT = -1e30
 
 
 @dataclass(frozen=True)
+class PagedConfig:
+    """Paged-KV-cache geometry (vLLM-style, static-shape TPU variant).
+
+    The decode cache becomes a shared page pool ``[num_pages, page_size,
+    kv_heads, head_dim]`` plus a per-slot page table ``[batch,
+    max_pages_per_seq]`` and length vector — sequences of different lengths
+    share one physical pool, so HBM capacity is allocated by USE, not by
+    worst-case ``max_seq`` per row (the continuous-batching memory model;
+    models/engine.py schedules slots/pages host-side).
+    """
+
+    page_size: int = 16
+    num_pages: int = 256
+    max_pages_per_seq: int = 16
+
+    @property
+    def max_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+
+@dataclass(frozen=True)
 class GPTConfig:
     vocab_size: int = 32000
     hidden_size: int = 2048
@@ -84,6 +105,12 @@ class GPTConfig:
     # merging).
     lora_rank: Optional[int] = None
     lora_alpha: float = 16.0
+    # Paged KV cache for continuous-batching serving (models/engine.py):
+    # decode reads/writes page-table-indirected pool slabs instead of one
+    # dense [batch, max_seq] cache.  Single-token decode steps only — the
+    # engine prefills through the dense path and grafts the rows into
+    # pages.  Mutually exclusive with quant_kv this round.
+    paged: Optional[PagedConfig] = None
 
     @property
     def head_dim(self) -> int:
@@ -171,6 +198,35 @@ def dense_site(cfg: GPTConfig, features, *, axis=-1, dtype=None, name: str):
     )
 
 
+def cached_group_attention(q, k, v, positions, window, num_heads):
+    """Masked grouped-query attention against a cache view.
+
+    q: [batch, q_len, num_heads, head_dim]; k/v: [batch, L, kv_heads,
+    head_dim] (a dense cache or a gathered page view — the one attention
+    both decode cache layouts share).  Each query at absolute position
+    ``positions[b, i]`` sees cache slots ``<= position`` (and within the
+    sliding window when set); the kv heads are read once per group via a
+    grouped einsum — never expanded.
+    """
+    batch, q_len, _, head_dim = q.shape
+    length, kv_heads = k.shape[1], k.shape[2]
+    group = num_heads // kv_heads
+    qg = q.reshape(batch, q_len, kv_heads, group, head_dim)
+    key_pos = jnp.arange(length)[None, None, None, None, :]
+    q_pos = positions[:, None, None, :, None]  # [b, 1, 1, q_len, 1]
+    mask = key_pos <= q_pos
+    if window is not None:
+        mask = jnp.logical_and(mask, q_pos - key_pos < window)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * (head_dim ** -0.5)
+    s = jnp.where(mask, s, NEG_LOGIT)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(
+        batch, q_len, num_heads, head_dim
+    )
+
+
 def tiled_causal_attention(qh, kh, vh, window):
     """Causal attention on [batch, heads, seq, head_dim]: the fused flash
     kernel when the sequence is 128-tileable, the plain-XLA oracle
@@ -237,7 +293,50 @@ class CausalSelfAttention(nn.Module):
         k = apply_rope(proj["key"], cos, sin)
         v = proj["value"]
 
-        if self.decode:
+        if self.decode and cfg.paged is not None:
+            # Paged cache: one shared pool, page-table indirection per slot
+            # (PagedConfig).  Single-token steps only — the serving engine
+            # (models/engine.py) prefills via the dense path and grafts
+            # rows into pages, and it reserves page 0 as the idle-slot
+            # scratch target so inactive rows never collide with live
+            # pages.
+            if cfg.quant_kv:
+                raise ValueError("paged + quant_kv is not supported yet")
+            if hidden.shape[1] != 1:
+                raise ValueError(
+                    f"paged decode is single-token (got q_len {hidden.shape[1]})"
+                )
+            pg = cfg.paged
+            batch = hidden.shape[0]
+            pool_shape = (pg.num_pages, pg.page_size, cfg.kv_heads, cfg.head_dim)
+            pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, k.dtype)
+            pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, v.dtype)
+            table = self.variable(
+                "cache",
+                "page_table",
+                jnp.zeros,
+                (batch, pg.max_pages_per_seq),
+                jnp.int32,
+            )
+            lens = self.variable("cache", "seq_lens", jnp.zeros, (batch,), jnp.int32)
+            cur = lens.value  # this token's position per row
+            row = jnp.arange(batch)
+            page = table.value[row, cur // pg.page_size]
+            off = cur % pg.page_size
+            pk.value = pk.value.at[page, off].set(k[:, 0])
+            pv.value = pv.value.at[page, off].set(v[:, 0])
+            lens.value = cur + 1
+            # Gather each row's pages into its logical [max_len] view.
+            kr = pk.value[table.value].reshape(
+                batch, pg.max_len, cfg.kv_heads, cfg.head_dim
+            )
+            vr = pv.value[table.value].reshape(
+                batch, pg.max_len, cfg.kv_heads, cfg.head_dim
+            )
+            attn = cached_group_attention(
+                q, kr, vr, positions, cfg.attention_window, cfg.num_heads
+            )
+        elif self.decode:
             # Fixed-shape cache: [batch, max_seq, kv_heads, head_dim] — the
             # cache holds UN-expanded kv heads (the GQA memory win).
             batch = hidden.shape[0]
@@ -293,27 +392,12 @@ class CausalSelfAttention(nn.Module):
                     v = dequantize_kv(cv.value, cvs.value, cfg.dtype)
                 else:
                     k, v = ck.value, cv.value
-                # Single-token decode: mask cache slots at or beyond the
-                # write frontier (and, with a sliding window, slots that
-                # scrolled out of the band).  Grouped einsum (g = q heads
-                # per kv head): the kv cache is read once per kv head,
-                # never expanded group× — decode is KV-cache-bandwidth-
-                # bound, so this is where GQA's HBM win lands.
-                qg = q.reshape(batch, q_len, cfg.kv_heads, group, cfg.head_dim)
-                key_pos = jnp.arange(cfg.max_seq)[None, None, None, None, :]
-                q_pos = positions[:, None, None, :, None]  # [b, 1, 1, q_len, 1]
-                mask = key_pos <= q_pos
-                if cfg.attention_window is not None:
-                    mask = jnp.logical_and(
-                        mask, q_pos - key_pos < cfg.attention_window
-                    )
-                s = jnp.einsum(
-                    "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
-                ) * (cfg.head_dim ** -0.5)
-                s = jnp.where(mask, s, -1e30)
-                p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-                attn = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(
-                    batch, q_len, cfg.num_heads, cfg.head_dim
+                # Cache-append decode: mask slots at or beyond each query's
+                # position; the kv cache is read once per kv head (grouped
+                # einsum, never expanded group×) — decode is KV-cache-
+                # bandwidth-bound, so this is where GQA's HBM win lands.
+                attn = cached_group_attention(
+                    q, k, v, positions, cfg.attention_window, cfg.num_heads
                 )
         else:
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
