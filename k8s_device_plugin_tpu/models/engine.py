@@ -68,6 +68,7 @@ class ServingEngine:
         *,
         max_slots: int = 4,
         eos_id: Optional[int] = None,
+        prefix_sharing: bool = True,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -107,6 +108,22 @@ class ServingEngine:
         self.queue: deque[Request] = deque()
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
+        # Prefix sharing: K/V are a deterministic function of (params,
+        # prompt tokens), so FULL pages covering a common prompt prefix are
+        # byte-identical across requests and can be shared read-only —
+        # decode only ever writes at the growing frontier, which lives in a
+        # private page.  The registry is a per-page trie keyed
+        # (parent_page, page_chunk) — O(prompt) to match/register, vs
+        # O(prompt²/page_size) for whole-prefix keys — with -1 as the root
+        # parent.  Pages are refcounted and registry links die with their
+        # last user (this serves the concurrent shared-system-prompt case,
+        # not a persistent prompt cache; freed-parent links cannot go
+        # stale: any sequence holding a child page holds its whole prefix
+        # chain, so a child always dies no later than its parent).
+        self.prefix_sharing = prefix_sharing
+        self._page_refs: dict[int, int] = {}
+        self._prefix_pages: dict[tuple[int, tuple], int] = {}
+        self._page_keys: dict[int, list[tuple[int, tuple]]] = {}
 
     # ------------------------------------------------------------- admission
 
@@ -159,41 +176,58 @@ class ServingEngine:
         self._prefill_cache[prompt_len] = fn
         return fn
 
-    def _graft(self, slot: int, dense_cache: Any, pages: list[int], plen: int):
-        """Scatter a prefilled dense cache's rows into the allocated pages
-        and point the slot's table/length at them — ONE page-indexed
-        scatter per pool per layer (not per page: eager `.at` updates are
-        copy-on-write, so per-page updates would round-trip the whole pool
-        once per page).  Pages covering the prompt are written whole; tail
-        slots past plen carry zeros, which later appends overwrite before
-        any masked read can see them."""
+    def _graft(
+        self,
+        slot: int,
+        dense_cache: Any,
+        pages: list[int],
+        plen: int,
+        n_shared: int,
+    ):
+        """Scatter a prefilled dense cache's rows into the PRIVATE prompt
+        pages and point the slot's table/length at the full chain — ONE
+        page-indexed scatter per pool per layer (not per page: eager `.at`
+        updates are copy-on-write, so per-page updates would round-trip
+        the whole pool once per page).
+
+        Shared prefix pages (the first ``n_shared``) are never rewritten:
+        a concurrent request is reading them, and K/V from a prefill
+        compiled at a different prompt length are not guaranteed bitwise
+        identical — rewriting could perturb an in-flight generation.
+        Private pages are written whole; tail slots past plen carry zeros,
+        which later appends overwrite before any masked read can see
+        them."""
         ps = self.paged.page_size
         n_cover = math.ceil(plen / ps)
-        cover = jnp.asarray(pages[:n_cover], jnp.int32)
-        pad = n_cover * ps - plen
         row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
         row[: len(pages)] = pages
+        lo_tok = n_shared * ps  # first private-covered token position
+        n_priv_cover = n_cover - n_shared
+        cover = jnp.asarray(pages[n_shared:n_cover], jnp.int32)
+        pad = n_cover * ps - plen
         for name in self._layer_names:
             att = self.cache[name]["attn"]
             src = dense_cache[name]["attn"]
 
             def paged_rows(slab):
-                rows = slab[0, : n_cover * ps - pad]
+                rows = slab[0, lo_tok:plen]
                 if pad:
                     rows = jnp.pad(rows, ((0, pad), (0, 0), (0, 0)))
-                return rows.reshape(n_cover, ps, *rows.shape[1:])
+                return rows.reshape(n_priv_cover, ps, *rows.shape[1:])
 
-            self.cache[name]["attn"] = {
+            new_att = {
                 **att,
-                "pool_key": att["pool_key"]
-                .at[cover]
-                .set(paged_rows(src["cached_key"])),
-                "pool_value": att["pool_value"]
-                .at[cover]
-                .set(paged_rows(src["cached_value"])),
                 "page_table": att["page_table"].at[slot].set(jnp.asarray(row)),
                 "seq_lens": att["seq_lens"].at[slot].set(plen),
             }
+            if n_priv_cover > 0:
+                new_att["pool_key"] = (
+                    att["pool_key"].at[cover].set(paged_rows(src["cached_key"]))
+                )
+                new_att["pool_value"] = (
+                    att["pool_value"].at[cover].set(paged_rows(src["cached_value"]))
+                )
+            self.cache[name]["attn"] = new_att
 
     def _clear_slot(self, slot: int):
         for name in self._layer_names:
@@ -203,11 +237,32 @@ class ServingEngine:
                 "page_table": att["page_table"].at[slot].set(0),
                 "seq_lens": att["seq_lens"].at[slot].set(0),
             }
-        self.free_pages.extend(self._slot_pages[slot])
+        for page in self._slot_pages[slot]:
+            self._page_refs[page] -= 1
+            if self._page_refs[page] == 0:
+                del self._page_refs[page]
+                for key in self._page_keys.pop(page, []):
+                    self._prefix_pages.pop(key, None)
+                self.free_pages.append(page)
         self._slot_pages[slot] = []
         self.slots[slot] = None
         self._slot_last[slot] = 0
         self._slot_len[slot] = 0
+
+    def _match_prefix(self, prompt: list[int]) -> list[int]:
+        """Longest chain of live registered pages whose token chunks equal
+        this prompt's leading FULL pages (trie walk: O(prompt))."""
+        ps = self.paged.page_size
+        pages: list[int] = []
+        parent = -1
+        for i in range(len(prompt) // ps):
+            chunk = tuple(prompt[i * ps : (i + 1) * ps])
+            page = self._prefix_pages.get((parent, chunk))
+            if page is None:
+                break
+            pages.append(page)
+            parent = page
+        return pages
 
     def _admit(self) -> list[Request]:
         """Admit queued requests into free slots; returns any that finished
@@ -218,18 +273,36 @@ class ServingEngine:
             if self.slots[slot] is not None or not self.queue:
                 continue
             req = self.queue[0]
+            plen = len(req.prompt)
             n_pages = math.ceil(
-                (len(req.prompt) + req.max_new_tokens) / self.paged.page_size
+                (plen + req.max_new_tokens) / self.paged.page_size
             )
-            if n_pages > len(self.free_pages):
+            shared = self._match_prefix(req.prompt) if self.prefix_sharing else []
+            n_private = n_pages - len(shared)
+            if n_private > len(self.free_pages):
                 break  # FIFO: wait for pages rather than starving the head
             self.queue.popleft()
-            pages = [self.free_pages.popleft() for _ in range(n_pages)]
-            plen = len(req.prompt)
+            private = [self.free_pages.popleft() for _ in range(n_private)]
+            pages = shared + private
+            for page in shared:
+                self._page_refs[page] += 1
+            for page in private:
+                self._page_refs[page] = 1
+            if self.prefix_sharing:
+                # Register this prompt's full pages (shared or fresh) as
+                # trie links so later same-prefix requests can ride them.
+                ps = self.paged.page_size
+                parent = -1
+                for i in range(plen // ps):
+                    key = (parent, tuple(req.prompt[i * ps : (i + 1) * ps]))
+                    if key not in self._prefix_pages:
+                        self._prefix_pages[key] = pages[i]
+                        self._page_keys.setdefault(pages[i], []).append(key)
+                    parent = pages[i]
             first, dense_cache = self._prefill_fn(plen)(
                 self.params, jnp.asarray(req.prompt, jnp.int32)[None, :]
             )
-            self._graft(slot, dense_cache, pages, plen)
+            self._graft(slot, dense_cache, pages, plen, len(shared))
             self.slots[slot] = req
             self._slot_pages[slot] = pages
             first = int(first)

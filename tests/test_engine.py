@@ -141,6 +141,46 @@ def test_capacity_validation(rng):
         tight_eng.submit([1, 2, 3, 4], 8)
 
 
+def test_prefix_sharing_shares_pages_and_preserves_outputs(rng):
+    """Two concurrent requests with a common 2-page prompt prefix share
+    those pages (refcounted), outputs stay request-exact, and every page
+    returns to the pool at the end."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    common = [5, 9, 13, 2, 40, 41, 42, 43]  # exactly 2 full pages
+    jobs = [(common + [7], 4), (common + [300], 4)]
+    r1 = eng.submit(*jobs[0])
+    r2 = eng.submit(*jobs[1])
+    eng.step()  # both admitted in one pass
+    # Each needs ceil(13/4) = 4 pages; the second shares the 2 prefix
+    # pages, so 6 distinct pages are out, not 8.
+    assert len(eng.free_pages) == (paged.num_pages - 1) - 6
+    while not (r1.done and r2.done):
+        eng.step()
+    assert r1.tokens == _oracle(cfg, params, jobs[0][0], 4)
+    assert r2.tokens == _oracle(cfg, params, jobs[1][0], 4)
+    assert len(eng.free_pages) == paged.num_pages - 1
+    assert not eng._page_refs and not eng._prefix_pages
+
+
+def test_prefix_sharing_disabled_allocates_fully(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2, prefix_sharing=False)
+    common = [5, 9, 13, 2, 40, 41, 42, 43]
+    r1 = eng.submit(common + [7], 4)
+    r2 = eng.submit(common + [300], 4)
+    eng.step()
+    assert len(eng.free_pages) == (paged.num_pages - 1) - 8
+    while not (r1.done and r2.done):
+        eng.step()
+    assert r1.tokens == _oracle(cfg, params, common + [7], 4)
+    assert r2.tokens == _oracle(cfg, params, common + [300], 4)
+
+
 def test_step_reports_admission_finished_requests(rng):
     """A request done at admission (max_new=1: the prefill token is the
     whole answer) must still appear in a step() return value."""
