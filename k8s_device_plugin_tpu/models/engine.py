@@ -359,3 +359,105 @@ class ServingEngine:
             if guard > 100_000:
                 raise RuntimeError("engine failed to drain")
         return subs
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """In-pod serving demo/benchmark (≙ the per-family benchmark pods in
+    deploy/): synthetic weights + synthetic request stream through the
+    continuous-batching engine; prints one JSON summary line.
+
+    ``k8s-pod-serve-gpt.yaml`` runs this against allocated chips; the same
+    command works on any backend (tiny CPU smoke by default).
+    """
+    import argparse
+    import json
+    import os
+    import sys
+    import time
+
+    # A TPU-VM sitecustomize may pin the platform programmatically; the
+    # env var alone does not undo that — the config update does (same
+    # treatment as the repo-root bench.py's inner process: "" means
+    # auto-select).  Best-effort: a failed update must not kill the pod.
+    if "JAX_PLATFORMS" in os.environ:
+        try:
+            jax.config.update(
+                "jax_platforms", os.environ["JAX_PLATFORMS"] or None
+            )
+        except Exception as e:  # pragma: no cover - defensive
+            print(f"jax_platforms update failed: {e}", file=sys.stderr)
+
+    p = argparse.ArgumentParser(prog="tpu-serving-engine")
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--quant", choices=["w8", "w8a8"], default=None)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=128)
+    p.add_argument("--max-pages-per-seq", type=int, default=16)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--max-new", type=int, default=32)
+    args = p.parse_args(argv)
+
+    cfg = GPTConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        intermediate_size=args.hidden * 3,
+        max_seq=args.page_size * args.max_pages_per_seq,
+        num_kv_heads=args.kv_heads,
+    )
+    rng = jax.random.PRNGKey(0)
+    params = TransformerLM(cfg).init(rng, jnp.zeros((1, 2), jnp.int32))["params"]
+    if args.quant:
+        from ..ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+        cfg = dataclasses.replace(cfg, quant=args.quant)
+    paged = PagedConfig(args.page_size, args.num_pages, args.max_pages_per_seq)
+    eng = ServingEngine(cfg, params, paged, max_slots=args.slots)
+
+    # Half the stream shares a system-prompt prefix (exercises page sharing).
+    common = list(range(1, args.prompt_len // 2 + 1))
+    jobs = []
+    for i in range(args.requests):
+        tail = [(37 * i + j) % args.vocab for j in range(args.prompt_len // 2)]
+        prompt = (common + tail) if i % 2 == 0 else [(11 * i + j) % args.vocab for j in range(args.prompt_len)]
+        jobs.append((prompt, args.max_new))
+
+    # Warmup: compile the fixed-slot step and the prefill for this prompt
+    # length OUTSIDE the timed region (max_new=2 forces one decode step),
+    # so the JSON line reports steady-state serving throughput, not XLA
+    # compilation — the same honesty rule every bench in this repo follows
+    # (BASELINE.md "Measurement methodology").
+    eng.run([(jobs[0][0], 2)])
+
+    t0 = time.time()
+    done = eng.run(jobs)
+    dt = time.time() - t0
+    tokens = sum(len(r.tokens) for r in done)
+    print(
+        json.dumps(
+            {
+                "metric": "engine_decode_tokens_per_sec",
+                "value": round(tokens / dt, 2),
+                "unit": "tokens/sec",
+                "requests": len(done),
+                "slots": args.slots,
+                "quant": args.quant,
+                "tokens": tokens,
+                "wall_s": round(dt, 2),
+            }
+        ),
+        file=sys.stdout,
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,44 @@ def test_eos_stops_early(rng):
     assert req.done and req.tokens == [first]
 
 
+def test_engine_cli_smoke():
+    """The in-pod serving entry point (deploy/k8s-pod-serve-gpt.yaml)
+    prints one parseable JSON throughput line."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}  # hermetic: never dial a TPU
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "k8s_device_plugin_tpu.models.engine",
+            "--hidden=64",
+            "--layers=2",
+            "--heads=4",
+            "--kv-heads=2",
+            "--vocab=512",
+            "--page-size=4",
+            "--num-pages=32",
+            "--max-pages-per-seq=8",
+            "--slots=2",
+            "--requests=3",
+            "--prompt-len=8",
+            "--max-new=6",
+        ],
+        capture_output=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    rec = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert rec["metric"] == "engine_decode_tokens_per_sec"
+    assert rec["value"] > 0 and rec["requests"] == 3
+    assert rec["tokens"] == 3 * 6
+
+
 def test_capacity_validation(rng):
     cfg = _cfg()
     params = _params(cfg, rng)
