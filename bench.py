@@ -143,10 +143,11 @@ def _inner() -> None:
     # hardware platform before we run; the JAX_PLATFORMS env var alone does
     # not undo that — the config update does.  Without this, the "cpu"
     # fallback attempt still dials the (possibly hung) tunnel.
-    # "in" not .get(): JAX_PLATFORMS="" (the "auto" attempt) must also
-    # override the pin — None means auto-select to jax.config.
-    if "JAX_PLATFORMS" in os.environ:
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"] or None)
+    # empty_is_auto: JAX_PLATFORMS="" (the "auto" attempt) must also
+    # override the pin, meaning auto-select.
+    from k8s_device_plugin_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env(empty_is_auto=True)
 
     import jax.numpy as jnp
     import optax

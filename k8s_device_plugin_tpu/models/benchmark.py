@@ -522,12 +522,9 @@ def main(argv: list[str] | None = None) -> None:
     # site hooks programmatically pinned a platform (the CPU-control pod
     # k8s-pod-example-cpu.yaml depends on this: ≙ the reference pinning its
     # control run off-GPU with HIP_VISIBLE_DEVICES=-1).
-    env_platform = os.environ.get("JAX_PLATFORMS")
-    if env_platform:
-        try:
-            jax.config.update("jax_platforms", env_platform)
-        except Exception as e:
-            log(f"could not pin platform {env_platform!r}: {e}")
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env(empty_is_auto=False, log=log)
 
     # Multi-host (k8s-job-resnet50-2host.yaml): stitch processes over DCN,
     # derived from the plugin-injected TPU_WORKER_* env (or explicit JAX_*

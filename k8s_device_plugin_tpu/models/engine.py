@@ -43,10 +43,14 @@ from .transformer import GPTConfig, PagedConfig, TransformerLM, decode_cache_spe
 
 @dataclasses.dataclass
 class Request:
-    """One generation request and, when finished, its output tokens."""
+    """One generation request and, when finished, its output tokens.
+
+    ``temperature`` 0 means greedy; > 0 samples that request's tokens at
+    that temperature (slots mix freely in one jitted step)."""
 
     prompt: list[int]
     max_new_tokens: int
+    temperature: float = 0.0
     rid: int = -1
     tokens: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -69,6 +73,7 @@ class ServingEngine:
         max_slots: int = 4,
         eos_id: Optional[int] = None,
         prefix_sharing: bool = True,
+        rng: Optional[jax.Array] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -86,14 +91,20 @@ class ServingEngine:
         self._layer_names = [f"layer_{i}" for i in range(cfg.num_layers)]
 
         @jax.jit
-        def step(params, cache, tokens, positions):
+        def step(params, cache, tokens, positions, temps, key):
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
                 positions,
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            row = logits[:, -1, :]
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            # One categorical over the batch samples each row independently;
+            # temp<=0 rows take the argmax (their scaled logits are unused).
+            scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
+            sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
             return nxt, mut["cache"]
 
         self._step = step
@@ -105,9 +116,11 @@ class ServingEngine:
         self._slot_pages: list[list[int]] = [[] for _ in range(max_slots)]
         self._slot_last: list[int] = [0] * max_slots  # last emitted token
         self._slot_len: list[int] = [0] * max_slots  # consumed positions
+        self._slot_temp: list[float] = [0.0] * max_slots  # 0 = greedy
         self.queue: deque[Request] = deque()
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
+        self._rng = jax.random.PRNGKey(0) if rng is None else rng
         # Prefix sharing: K/V are a deterministic function of (params,
         # prompt tokens), so FULL pages covering a common prompt prefix are
         # byte-identical across requests and can be shared read-only —
@@ -127,12 +140,14 @@ class ServingEngine:
 
     # ------------------------------------------------------------- admission
 
-    def submit(self, prompt, max_new_tokens: int) -> Request:
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         need = len(prompt) + max_new_tokens
         if need > self.paged.max_len:
             raise ValueError(
@@ -149,7 +164,7 @@ class ServingEngine:
                 f"has {allocatable} ({self.paged.num_pages - 1} allocatable "
                 f"pages x {self.paged.page_size})"
             )
-        req = Request(prompt, max_new_tokens, rid=self._next_rid)
+        req = Request(prompt, max_new_tokens, temperature, rid=self._next_rid)
         self._next_rid += 1
         self.queue.append(req)
         return req
@@ -169,8 +184,9 @@ class ServingEngine:
             logits, mut = self._dense.apply(
                 {"params": params, "cache": cache}, prompt, pos, mutable=["cache"]
             )
-            first = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
-            return first, mut["cache"]
+            # Last-position logits come back raw: the sampler (greedy or
+            # per-request temperature) is the host's choice at admission.
+            return logits[0, -1, :], mut["cache"]
 
         fn = jax.jit(run)
         self._prefill_cache[prompt_len] = fn
@@ -248,6 +264,7 @@ class ServingEngine:
         self.slots[slot] = None
         self._slot_last[slot] = 0
         self._slot_len[slot] = 0
+        self._slot_temp[slot] = 0.0
 
     def _match_prefix(self, prompt: list[int]) -> list[int]:
         """Longest chain of live registered pages whose token chunks equal
@@ -299,16 +316,23 @@ class ServingEngine:
                         self._prefix_pages[key] = pages[i]
                         self._page_keys.setdefault(pages[i], []).append(key)
                     parent = pages[i]
-            first, dense_cache = self._prefill_fn(plen)(
+            last_logits, dense_cache = self._prefill_fn(plen)(
                 self.params, jnp.asarray(req.prompt, jnp.int32)[None, :]
             )
             self._graft(slot, dense_cache, pages, plen, len(shared))
             self.slots[slot] = req
             self._slot_pages[slot] = pages
-            first = int(first)
+            if req.temperature > 0:
+                self._rng, sub = jax.random.split(self._rng)
+                first = int(
+                    jax.random.categorical(sub, last_logits / req.temperature)
+                )
+            else:
+                first = int(jnp.argmax(last_logits))
             req.tokens.append(first)
             self._slot_last[slot] = first
             self._slot_len[slot] = plen
+            self._slot_temp[slot] = req.temperature
             self._maybe_finish(slot)
             if req.done:
                 finished.append(req)
@@ -336,7 +360,11 @@ class ServingEngine:
             return finished
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
-        nxt, self.cache = self._step(self.params, self.cache, tokens, positions)
+        temps = jnp.asarray(self._slot_temp, jnp.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        nxt, self.cache = self._step(
+            self.params, self.cache, tokens, positions, temps, sub
+        )
         nxt = np.asarray(nxt)
         for s in active:
             req = self.slots[s]
@@ -371,36 +399,31 @@ def main(argv: Optional[list[str]] = None) -> None:
     """
     import argparse
     import json
-    import os
     import sys
     import time
 
-    # A TPU-VM sitecustomize may pin the platform programmatically; the
-    # env var alone does not undo that — the config update does (same
-    # treatment as the repo-root bench.py's inner process: "" means
-    # auto-select).  Best-effort: a failed update must not kill the pod.
-    if "JAX_PLATFORMS" in os.environ:
-        try:
-            jax.config.update(
-                "jax_platforms", os.environ["JAX_PLATFORMS"] or None
-            )
-        except Exception as e:  # pragma: no cover - defensive
-            print(f"jax_platforms update failed: {e}", file=sys.stderr)
+    from ..utils.platform import honor_jax_platforms_env
+    from .benchmark import _positive_int
+
+    # Empty JAX_PLATFORMS in a pod spec is a no-op, not a platform reset.
+    honor_jax_platforms_env(
+        empty_is_auto=False, log=lambda m: print(m, file=sys.stderr)
+    )
 
     p = argparse.ArgumentParser(prog="tpu-serving-engine")
-    p.add_argument("--hidden", type=int, default=512)
-    p.add_argument("--layers", type=int, default=4)
-    p.add_argument("--heads", type=int, default=8)
-    p.add_argument("--kv-heads", type=int, default=4)
-    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--hidden", type=_positive_int, default=512)
+    p.add_argument("--layers", type=_positive_int, default=4)
+    p.add_argument("--heads", type=_positive_int, default=8)
+    p.add_argument("--kv-heads", type=_positive_int, default=4)
+    p.add_argument("--vocab", type=_positive_int, default=32000)
     p.add_argument("--quant", choices=["w8", "w8a8"], default=None)
-    p.add_argument("--page-size", type=int, default=16)
-    p.add_argument("--num-pages", type=int, default=128)
-    p.add_argument("--max-pages-per-seq", type=int, default=16)
-    p.add_argument("--slots", type=int, default=4)
-    p.add_argument("--requests", type=int, default=8)
-    p.add_argument("--prompt-len", type=int, default=32)
-    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--page-size", type=_positive_int, default=16)
+    p.add_argument("--num-pages", type=_positive_int, default=128)
+    p.add_argument("--max-pages-per-seq", type=_positive_int, default=16)
+    p.add_argument("--slots", type=_positive_int, default=4)
+    p.add_argument("--requests", type=_positive_int, default=8)
+    p.add_argument("--prompt-len", type=_positive_int, default=32)
+    p.add_argument("--max-new", type=_positive_int, default=32)
     args = p.parse_args(argv)
 
     cfg = GPTConfig(
@@ -430,12 +453,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         prompt = (common + tail) if i % 2 == 0 else [(11 * i + j) % args.vocab for j in range(args.prompt_len)]
         jobs.append((prompt, args.max_new))
 
-    # Warmup: compile the fixed-slot step and the prefill for this prompt
-    # length OUTSIDE the timed region (max_new=2 forces one decode step),
+    # Warmup: compile the fixed-slot step and EVERY distinct prompt-length
+    # prefill OUTSIDE the timed region (max_new=2 forces one decode step),
     # so the JSON line reports steady-state serving throughput, not XLA
     # compilation — the same honesty rule every bench in this repo follows
     # (BASELINE.md "Measurement methodology").
-    eng.run([(jobs[0][0], 2)])
+    warm_lens: dict[int, list[int]] = {}
+    for prompt, _ in jobs:
+        warm_lens.setdefault(len(prompt), prompt)
+    eng.run([(prompt, 2) for prompt in warm_lens.values()])
 
     t0 = time.time()
     done = eng.run(jobs)

@@ -1,0 +1,45 @@
+"""One shared JAX platform-selection override.
+
+A TPU-VM image's site hooks may pin the hardware platform
+programmatically BEFORE user code runs; the ``JAX_PLATFORMS`` env var
+alone does not undo a programmatic pin — ``jax.config.update`` does.
+Every entry point that must honor the pod-spec env (repo-root
+``bench.py``'s measurement subprocess, the in-pod benchmark runner, the
+serving-engine CLI) routes through here so the semantics can't drift.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+
+def honor_jax_platforms_env(
+    *,
+    empty_is_auto: bool,
+    log: Optional[Callable[[str], None]] = None,
+) -> None:
+    """Apply ``JAX_PLATFORMS`` from the environment over any programmatic pin.
+
+    ``empty_is_auto``: what ``JAX_PLATFORMS=""`` means.  True — reset to
+    automatic backend selection (bench.py's fallback ladder needs this to
+    un-pin a wedged accelerator); False — treat empty as unset and leave
+    any existing pin alone (the benchmark/serving CLIs: an empty var in a
+    pod spec should be a no-op, not a reset).
+
+    Best-effort by contract: a failed update is reported through ``log``
+    (when given) and never raises — no entry point should die over
+    platform plumbing.
+    """
+    import jax
+
+    if "JAX_PLATFORMS" not in os.environ:
+        return
+    value = os.environ["JAX_PLATFORMS"]
+    if not value and not empty_is_auto:
+        return
+    try:
+        jax.config.update("jax_platforms", value or None)
+    except Exception as e:  # pragma: no cover - defensive
+        if log is not None:
+            log(f"could not apply JAX_PLATFORMS={value!r}: {e}")
