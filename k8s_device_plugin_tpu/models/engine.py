@@ -169,28 +169,51 @@ class ServingEngine:
         self.queue.append(req)
         return req
 
-    def _prefill_fn(self, prompt_len: int):
-        """Jitted dense prefill, cached per prompt length on THIS instance
-        (a process-global lru_cache would pin the engine — params tree and
-        page pools included — beyond its lifetime)."""
-        fn = self._prefill_cache.get(prompt_len)
+    def _prefill_fn(self, bucket_len: int):
+        """Jitted dense prefill for one LENGTH BUCKET, cached on THIS
+        instance (a process-global lru_cache would pin the engine — params
+        tree and page pools included — beyond its lifetime)."""
+        fn = self._prefill_cache.get(bucket_len)
         if fn is not None:
             return fn
         spec = decode_cache_spec(self._dense, 1)
 
-        def run(params, prompt):
+        def run(params, prompt, last_idx):
             cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
-            pos = jnp.arange(prompt_len)[None, :]
+            pos = jnp.arange(bucket_len)[None, :]
             logits, mut = self._dense.apply(
                 {"params": params, "cache": cache}, prompt, pos, mutable=["cache"]
             )
-            # Last-position logits come back raw: the sampler (greedy or
-            # per-request temperature) is the host's choice at admission.
-            return logits[0, -1, :], mut["cache"]
+            # Slice the true last position INSIDE the program (last_idx is
+            # a traced scalar, so one compiled program serves every length
+            # in the bucket while XLA returns a single [vocab] row instead
+            # of materializing [bucket, vocab]).  The sampler (greedy or
+            # per-request temperature) stays the host's choice at
+            # admission.
+            return logits[0, last_idx], mut["cache"]
 
         fn = jax.jit(run)
-        self._prefill_cache[prompt_len] = fn
+        self._prefill_cache[bucket_len] = fn
         return fn
+
+    def _prefill(self, prompt: list[int]):
+        """Run the dense prefill at the next power-of-two length bucket.
+
+        Padding is sound because attention is causal — positions >= plen
+        cannot influence logits[plen-1] — and _graft copies only rows
+        [:plen] into pages, so the padded tail's garbage K/V never leaves
+        the throwaway dense cache.  Bucketing bounds the number of
+        compiled prefill programs at O(log max_len) for arbitrary
+        request-length mixes.
+        """
+        plen = len(prompt)
+        bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
+        padded = prompt + [0] * (bucket - plen)
+        return self._prefill_fn(bucket)(
+            self.params,
+            jnp.asarray(padded, jnp.int32)[None, :],
+            jnp.asarray(plen - 1, jnp.int32),
+        )
 
     def _graft(
         self,
@@ -316,9 +339,7 @@ class ServingEngine:
                         self._prefix_pages[key] = pages[i]
                         self._page_keys.setdefault(pages[i], []).append(key)
                     parent = pages[i]
-            last_logits, dense_cache = self._prefill_fn(plen)(
-                self.params, jnp.asarray(req.prompt, jnp.int32)[None, :]
-            )
+            last_logits, dense_cache = self._prefill(req.prompt)
             self._graft(slot, dense_cache, pages, plen, len(shared))
             self.slots[slot] = req
             self._slot_pages[slot] = pages
