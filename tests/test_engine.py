@@ -118,6 +118,32 @@ def test_eos_stops_early(rng):
     assert req.done and req.tokens == [first]
 
 
+def test_engine_composes_with_gqa_window_and_quant(rng):
+    """The serving engine must work for the model features decode supports:
+    GQA (grouped cache), sliding-window masking, and int8 weights — each
+    against its own dense oracle."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    prompt = [3, 141, 59, 7, 7]
+
+    # GQA + sliding window.
+    cfg = _cfg(num_kv_heads=2, attention_window=4)
+    params = _params(cfg, rng)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    [req] = eng.run([(prompt, 7)])
+    assert req.tokens == _oracle(cfg, params, prompt, 7)
+
+    # int8 weights (w8) through the paged decode path.
+    base = GPTConfig.tiny()
+    bparams = TransformerLM(base).init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    qcfg = dataclasses.replace(base, max_seq=32, quant="w8")
+    qparams = quantize_lm_params(bparams)
+    qeng = ServingEngine(qcfg, qparams, paged, max_slots=1)
+    [qreq] = qeng.run([(prompt, 6)])
+    assert qreq.tokens == _oracle(qcfg, qparams, prompt, 6)
+
+
 def test_mixed_greedy_and_sampled_slots(rng):
     """A sampling request sharing the batch must not perturb a greedy
     neighbor (its tokens still match the dense oracle exactly), sampled
