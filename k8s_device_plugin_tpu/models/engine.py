@@ -38,7 +38,41 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.metrics import MetricsRegistry
 from .transformer import GPTConfig, PagedConfig, TransformerLM, decode_cache_spec
+
+
+class EngineMetrics:
+    """Prometheus series for the serving engine (same registry machinery
+    the plugin daemon exposes on its --metrics-port).  Pass a shared
+    registry to co-expose with other subsystems, or let each engine own
+    one and mount it on a utils.metrics.MetricsServer."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.requests = registry.counter(
+            "tpu_engine_requests_total",
+            "Requests admitted into a decode slot",
+        )
+        self.tokens = registry.counter(
+            "tpu_engine_tokens_total", "Tokens emitted across all requests"
+        )
+        self.steps = registry.counter(
+            "tpu_engine_steps_total", "Jitted decode steps executed"
+        )
+        self.active_slots = registry.gauge(
+            "tpu_engine_active_slots", "Slots currently serving a request"
+        )
+        self.queued = registry.gauge(
+            "tpu_engine_queued_requests", "Requests waiting for slots/pages"
+        )
+        self.free_pages = registry.gauge(
+            "tpu_engine_free_pages", "Unallocated KV-cache pages"
+        )
+        self.shared_pages = registry.gauge(
+            "tpu_engine_shared_pages",
+            "Pages currently referenced by more than one request (prefix sharing)",
+        )
 
 
 @dataclasses.dataclass
@@ -74,6 +108,7 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         prefix_sharing: bool = True,
         rng: Optional[jax.Array] = None,
+        metrics: Optional[EngineMetrics] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -121,6 +156,7 @@ class ServingEngine:
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        self.metrics = metrics
         # Prefix sharing: K/V are a deterministic function of (params,
         # prompt tokens), so FULL pages covering a common prompt prefix are
         # byte-identical across requests and can be shared read-only —
@@ -167,6 +203,9 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens, temperature, rid=self._next_rid)
         self._next_rid += 1
         self.queue.append(req)
+        # Scrapes happen on the MetricsServer thread: reflect queue
+        # pressure immediately, not at the owner's next step().
+        self._update_gauges()
         return req
 
     def _prefill_fn(self, bucket_len: int):
@@ -354,6 +393,9 @@ class ServingEngine:
             self._slot_last[slot] = first
             self._slot_len[slot] = plen
             self._slot_temp[slot] = req.temperature
+            if self.metrics:
+                self.metrics.requests.inc()
+                self.metrics.tokens.inc()
             self._maybe_finish(slot)
             if req.done:
                 finished.append(req)
@@ -378,6 +420,7 @@ class ServingEngine:
         finished = self._admit()
         active = [s for s in range(self.max_slots) if self.slots[s] is not None]
         if not active:
+            self._update_gauges()
             return finished
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
@@ -396,7 +439,23 @@ class ServingEngine:
             self._maybe_finish(s)
             if req.done:
                 finished.append(req)
+        if self.metrics:
+            self.metrics.steps.inc()
+            self.metrics.tokens.inc(len(active))
+        self._update_gauges()
         return finished
+
+    def _update_gauges(self) -> None:
+        if not self.metrics:
+            return
+        self.metrics.active_slots.set(
+            sum(1 for s in self.slots if s is not None)
+        )
+        self.metrics.queued.set(len(self.queue))
+        self.metrics.free_pages.set(len(self.free_pages))
+        self.metrics.shared_pages.set(
+            sum(1 for c in self._page_refs.values() if c > 1)
+        )
 
     def run(self, requests: list[tuple[list[int], int]]) -> list[Request]:
         """Submit all, step until drained, return in submission order."""

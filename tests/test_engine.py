@@ -118,6 +118,33 @@ def test_eos_stops_early(rng):
     assert req.done and req.tokens == [first]
 
 
+def test_engine_metrics(rng):
+    """Engine series land in the shared Prometheus registry with honest
+    values: tokens == emitted, pages/slots gauges return to idle, and the
+    shared-pages gauge sees prefix sharing."""
+    from k8s_device_plugin_tpu.models.engine import EngineMetrics
+    from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    metrics = EngineMetrics(MetricsRegistry())
+    eng = ServingEngine(cfg, params, paged, max_slots=2, metrics=metrics)
+    common = [5, 9, 13, 2]
+    r1 = eng.submit(common + [7], 3)
+    r2 = eng.submit(common + [8], 3)
+    eng.step()
+    assert metrics.shared_pages.value() == 1  # the shared prefix page
+    while not (r1.done and r2.done):
+        eng.step()
+    assert metrics.requests.value() == 2
+    assert metrics.tokens.value() == len(r1.tokens) + len(r2.tokens)
+    assert metrics.active_slots.value() == 0
+    assert metrics.free_pages.value() == paged.num_pages - 1
+    text = metrics.registry.render()
+    assert "tpu_engine_tokens_total" in text and "tpu_engine_free_pages" in text
+
+
 def test_engine_composes_with_gqa_window_and_quant(rng):
     """The serving engine must work for the model features decode supports:
     GQA (grouped cache), sliding-window masking, and int8 weights — each
