@@ -1,0 +1,34 @@
+"""Console-script wrappers for the workload-side CLIs.
+
+The plugin daemon installs with zero ML dependencies; the benchmark and
+serving CLIs need the ``workloads`` extra (jax/flax/optax — see
+pyproject.toml).  These wrappers turn a bare-install invocation into a
+pointer at the extra instead of an unhandled ModuleNotFoundError from a
+module-top ``import jax``.
+"""
+
+from __future__ import annotations
+
+
+def _require_workloads(script: str) -> None:
+    try:
+        import jax  # noqa: F401
+    except ModuleNotFoundError as e:
+        raise SystemExit(
+            f"{script} needs the ML workload dependencies: "
+            f"pip install 'k8s-device-plugin-tpu[workloads]' (missing: {e.name})"
+        )
+
+
+def benchmark() -> None:
+    _require_workloads("tpu-benchmark")
+    from .models.benchmark import main
+
+    main()
+
+
+def serving_engine() -> None:
+    _require_workloads("tpu-serving-engine")
+    from .models.engine import main
+
+    main()
