@@ -152,6 +152,9 @@ class ServingEngine:
         self._slot_last: list[int] = [0] * max_slots  # last emitted token
         self._slot_len: list[int] = [0] * max_slots  # consumed positions
         self._slot_temp: list[float] = [0.0] * max_slots  # 0 = greedy
+        # Logical index of _slot_pages[s][0] in the device table row (> 0
+        # once leading pages were reclaimed by a sliding window).
+        self._slot_page_base: list[int] = [0] * max_slots
         self.queue: deque[Request] = deque()
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
@@ -173,6 +176,11 @@ class ServingEngine:
         self._page_refs: dict[int, int] = {}
         self._prefix_pages: dict[tuple[int, tuple], int] = {}
         self._page_keys: dict[int, list[tuple[int, tuple]]] = {}
+        # Keys in which a page is the PARENT: windowed reclamation can free
+        # a parent before its children, and a freed id may be reallocated
+        # and re-registered with different content — surviving child links
+        # would then form a stale chain, so they die with the parent.
+        self._child_keys: dict[int, list[tuple[int, tuple]]] = {}
 
     # ------------------------------------------------------------- admission
 
@@ -316,17 +324,35 @@ class ServingEngine:
                 "seq_lens": att["seq_lens"].at[slot].set(0),
             }
         for page in self._slot_pages[slot]:
-            self._page_refs[page] -= 1
-            if self._page_refs[page] == 0:
-                del self._page_refs[page]
-                for key in self._page_keys.pop(page, []):
-                    self._prefix_pages.pop(key, None)
-                self.free_pages.append(page)
+            self._release_page(page)
         self._slot_pages[slot] = []
         self.slots[slot] = None
         self._slot_last[slot] = 0
         self._slot_len[slot] = 0
         self._slot_temp[slot] = 0.0
+        self._slot_page_base[slot] = 0
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reference; at zero, tear down every trie link touching
+        the page (keys registered FOR it and keys in which it is the
+        PARENT — a freed id can be reallocated and re-registered with
+        different content, so a surviving child link would let a later
+        prompt walk into another request's K/V) and return it to the
+        pool.  The ONE page-free path: _clear_slot and windowed
+        reclamation both come through here."""
+        self._page_refs[page] -= 1
+        if self._page_refs[page] > 0:
+            return
+        del self._page_refs[page]
+        for key in self._page_keys.pop(page, []):
+            self._prefix_pages.pop(key, None)
+        for key in self._child_keys.pop(page, []):
+            child = self._prefix_pages.pop(key, None)
+            if child is not None:
+                keys = self._page_keys.get(child)
+                if keys and key in keys:
+                    keys.remove(key)
+        self.free_pages.append(page)
 
     def _match_prefix(self, prompt: list[int]) -> list[int]:
         """Longest chain of live registered pages whose token chunks equal
@@ -377,6 +403,8 @@ class ServingEngine:
                     if key not in self._prefix_pages:
                         self._prefix_pages[key] = pages[i]
                         self._page_keys.setdefault(pages[i], []).append(key)
+                        if parent != -1:
+                            self._child_keys.setdefault(parent, []).append(key)
                     parent = pages[i]
             last_logits, dense_cache = self._prefill(req.prompt)
             self._graft(slot, dense_cache, pages, plen, len(shared))
@@ -439,11 +467,57 @@ class ServingEngine:
             self._maybe_finish(s)
             if req.done:
                 finished.append(req)
+            elif self.cfg.attention_window is not None:
+                self._reclaim_windowed(s)
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(len(active))
         self._update_gauges()
         return finished
+
+    def _reclaim_windowed(self, slot: int) -> None:
+        """Free pages that scrolled fully out of a sliding attention
+        window.  A query at position p sees keys in (p - window, p]; once
+        every position in a page is below ``len - window`` no future query
+        can see it — visibility only moves forward — so the page returns
+        to the pool mid-flight (bounded cache memory for long windowed
+        decodes).  Its table entry points at the scratch page: gathers of
+        masked positions read garbage that the window mask discards, and
+        the append frontier is always ahead of the reclaimed region."""
+        window = self.cfg.attention_window
+        ps = self.paged.page_size
+        horizon = self._slot_len[slot] - window
+        # horizon // ps = TOTAL pages ever dead for this slot; subtract the
+        # already-reclaimed count (the page list is trimmed in place, so
+        # reusing the total as an increment would double-free live pages —
+        # caught by the windowed-oracle test).
+        n_dead = max(
+            0,
+            min(
+                horizon // ps - self._slot_page_base[slot],
+                len(self._slot_pages[slot]),
+            ),
+        )
+        if n_dead <= 0:
+            return
+        dead, self._slot_pages[slot] = (
+            self._slot_pages[slot][:n_dead],
+            self._slot_pages[slot][n_dead:],
+        )
+        # The logical page indices shift only in OUR bookkeeping; the
+        # device table keeps absolute logical positions, so dead entries
+        # are re-pointed at scratch (a sliced device update — no host
+        # round-trip) rather than compacted.
+        lo = self._slot_page_base[slot]
+        for name in self._layer_names:
+            att = self.cache[name]["attn"]
+            self.cache[name]["attn"] = {
+                **att,
+                "page_table": att["page_table"].at[slot, lo : lo + n_dead].set(0),
+            }
+        self._slot_page_base[slot] += n_dead
+        for page in dead:
+            self._release_page(page)
 
     def _update_gauges(self) -> None:
         if not self.metrics:

@@ -118,6 +118,56 @@ def test_eos_stops_early(rng):
     assert req.done and req.tokens == [first]
 
 
+def test_windowed_page_reclamation(rng):
+    """With a sliding window, pages that scroll fully out of visibility
+    are freed MID-FLIGHT (bounded cache for long windowed decodes) and
+    the output still matches the dense windowed oracle exactly."""
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=2, num_pages=16, max_pages_per_seq=10)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    prompt = [3, 141, 59]
+    req = eng.submit(prompt, 12)  # needs ceil(15/2) = 8 pages up front
+    eng.step()
+    after_admit = len(eng.free_pages)
+    mid_flight = []
+    while not req.done:
+        eng.step()
+        mid_flight.append(len(eng.free_pages))
+    assert req.tokens == _oracle(cfg, params, prompt, 12)
+    assert max(mid_flight[:-1]) > after_admit, (
+        "no page was reclaimed while the request was still decoding"
+    )
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_windowed_reclaim_keeps_trie_parents_live(rng):
+    """Reclaiming a prefix page must tear down trie links in which it is
+    the PARENT too: the freed id can be reallocated and re-registered
+    with different content, and a surviving child link would route a
+    later same-suffix prompt into another request's K/V.  Invariant: every
+    registered key's parent page is live (or the root)."""
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=2, num_pages=16, max_pages_per_seq=10)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    # Two full prompt pages -> registers (-1, c0)->P0 and (P0, c1)->P1.
+    req = eng.submit([5, 9, 13, 2], 12)
+    saw_partial_free = False
+    while not req.done:
+        eng.step()
+        for parent, _ in eng._prefix_pages:
+            assert parent == -1 or parent in eng._page_refs, (
+                "registry key survives its freed parent"
+            )
+        if eng._prefix_pages and len(eng.free_pages) > 0:
+            saw_partial_free = True
+    assert saw_partial_free, "reclaim never freed a page while links were live"
+    # Serve the same prompt again on recycled pages: must still be exact.
+    req2 = eng.run([([5, 9, 13, 2], 6)])[0]
+    assert req2.tokens == _oracle(cfg, params, [5, 9, 13, 2], 6)
+
+
 def test_engine_metrics(rng):
     """Engine series land in the shared Prometheus registry with honest
     values: tokens == emitted, pages/slots gauges return to idle, and the
