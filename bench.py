@@ -54,7 +54,12 @@ _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 # fallback-path tests so they don't wait out the full TPU window.
 _SCALE = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1.0"))
 _ATTEMPTS = [
-    ("as-is", None, 900 * _SCALE),
+    # The as-is window covers the headline (~200s compile+run) plus the
+    # secondary ladder (LM train, flash sweeps, fused bwd, alloc latency,
+    # quantized decode, speculative decode — each guarded, each logging to
+    # stderr as it lands).  The headline JSON prints before any secondary,
+    # so a timeout only costs the tail of the stderr detail.
+    ("as-is", None, 1400 * _SCALE),
     ("auto", "", 600 * _SCALE),
     ("cpu", "cpu", 480 * _SCALE),
 ]
@@ -412,7 +417,8 @@ def _inner() -> None:
 
         Decode is weight-bandwidth-bound at small batch, so w8 (int8
         weights dequantized in-register, ops/quant.py) should approach 2x
-        the bf16 tokens/sec as batch shrinks.  Runs LAST: four decode-scan
+        the bf16 tokens/sec as batch shrinks.  Runs late (before the
+        speculative bench): six decode-scan
         compiles, and the headline JSON must never wait on them.
         """
         try:
@@ -429,10 +435,13 @@ def _inner() -> None:
                 cfg = GPTConfig.tiny()
                 batch, prompt_len, n_new = 2, 4, 4
             else:
+                # 2 layers: decode throughput per layer is what the quant
+                # modes change; fewer layers halve the 6 decode-scan
+                # compiles this secondary pays inside the attempt window.
                 cfg = GPTConfig(
                     vocab_size=32000,
                     hidden_size=1024,
-                    num_layers=4,
+                    num_layers=2,
                     num_heads=16,
                     intermediate_size=2816,
                     max_seq=512,
@@ -495,7 +504,7 @@ def _inner() -> None:
                 cfg = GPTConfig(
                     vocab_size=32000,
                     hidden_size=1024,
-                    num_layers=4,
+                    num_layers=2,
                     num_heads=16,
                     intermediate_size=2816,
                     max_seq=512,
