@@ -112,6 +112,13 @@ class ServingEngine:
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
+        if paged.use_kernel and cfg.attention_window is not None:
+            # Fail at the config boundary, not at the first jitted decode
+            # step after pools were allocated and prompts prefetched.
+            raise ValueError(
+                "PagedConfig.use_kernel is full-causal; unset "
+                "attention_window or use the gather path"
+            )
         self.paged = paged
         self.cfg = dataclasses.replace(cfg, paged=paged)
         # Dense prefill bridge shares max_seq with the paged logical view.

@@ -53,6 +53,13 @@ class PagedConfig:
     page_size: int = 16
     num_pages: int = 256
     max_pages_per_seq: int = 16
+    # Read pages through the Pallas paged-attention kernel
+    # (ops/paged_attention.py: scalar-prefetched page table, O(len) HBM
+    # traffic) instead of materializing the gathered [max_len] view.
+    # Opt-in until a hardware round proves the Mosaic lowering (BASELINE.md
+    # queue); interpreter-mode parity is pinned either way.  Full-causal
+    # only — combine with attention_window and the model raises.
+    use_kernel: bool = False
 
     @property
     def max_len(self) -> int:
@@ -326,16 +333,35 @@ class CausalSelfAttention(nn.Module):
             pk.value = pk.value.at[page, off].set(k[:, 0])
             pv.value = pv.value.at[page, off].set(v[:, 0])
             lens.value = cur + 1
-            # Gather each row's pages into its logical [max_len] view.
-            kr = pk.value[table.value].reshape(
-                batch, pg.max_len, cfg.kv_heads, cfg.head_dim
-            )
-            vr = pv.value[table.value].reshape(
-                batch, pg.max_len, cfg.kv_heads, cfg.head_dim
-            )
-            attn = cached_group_attention(
-                q, kr, vr, positions, cfg.attention_window, cfg.num_heads
-            )
+            if pg.use_kernel:
+                if cfg.attention_window is not None:
+                    raise ValueError(
+                        "PagedConfig.use_kernel is full-causal; unset "
+                        "attention_window or use the gather path"
+                    )
+                from ..ops.paged_attention import paged_attention
+
+                # Pages stream straight from the pool via the scalar-
+                # prefetched table; valid slots per row = position + 1
+                # (this token's K/V were just written above).
+                attn = paged_attention(
+                    q[:, 0],
+                    pk.value,
+                    pv.value,
+                    table.value,
+                    positions[:, 0] + 1,
+                )[:, None]
+            else:
+                # Gather each row's pages into its logical [max_len] view.
+                kr = pk.value[table.value].reshape(
+                    batch, pg.max_len, cfg.kv_heads, cfg.head_dim
+                )
+                vr = pv.value[table.value].reshape(
+                    batch, pg.max_len, cfg.kv_heads, cfg.head_dim
+                )
+                attn = cached_group_attention(
+                    q, kr, vr, positions, cfg.attention_window, cfg.num_heads
+                )
         elif self.decode:
             # Fixed-shape cache: [batch, max_seq, kv_heads, head_dim] — the
             # cache holds UN-expanded kv heads (the GQA memory win).

@@ -1,0 +1,191 @@
+"""Pallas paged-attention decode kernel: attention over page-table-
+indirected KV pools.
+
+The gather path (models/transformer.py paged decode) materializes every
+slot's logical [max_len] K/V view in HBM before the attention einsum —
+correct, but it writes (and re-reads) max_len bytes per slot per step even
+when a sequence occupies two pages.  This kernel reads pages DIRECTLY from
+the pool: the page table rides Pallas's scalar-prefetch lane, so each grid
+step's BlockSpec index map picks its physical page (`table[b, p]`) and the
+DMA engine streams exactly the pages a slot owns — O(len) HBM traffic per
+slot, no intermediate view.
+
+Design (same language as ops/flash_attention.py):
+
+- grid (batch, kv_heads, pages): batch/head parallel, the page axis
+  sequential, carrying lane-replicated [groups, 128] online-softmax
+  state (running max / denominator) plus an f32 output accumulator;
+- GQA-native: one kv head's page is resident while its whole q-head
+  group scores against it ([groups, head_dim] q tile);
+- pages past a slot's length skip both matmuls via `pl.when` (the grid
+  is rectangular; dead pages cost one predicate);
+- per-position masking inside the frontier page via iota < len.
+
+Status: validated for parity against the gather path under the Pallas
+interpreter (tests/test_paged_attention.py); opt-in for the serving
+engine via ``PagedConfig`` once a hardware round proves the Mosaic
+lowering (BASELINE.md hardware queue).  Reference analogue: none — the
+reference delegates all compute to the workload image (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+# TPU vector registers are 8 sublanes x 128 lanes; a q tile shorter than 8
+# rows would be sub-sublane, so the head group is padded up to this.
+_MIN_GROUP_TILE = 8
+
+
+def _paged_kernel(
+    table_ref,  # scalar-prefetch: [batch, pages] int32
+    lens_ref,  # scalar-prefetch: [batch] int32
+    q_ref,  # [1, 1, group_pad, head_dim]
+    k_ref,  # [1, page_size, 1, head_dim]
+    v_ref,
+    o_ref,  # [1, 1, group_pad, head_dim]
+    m_ref,  # VMEM [group_pad, 128] f32, lane-replicated running max
+    l_ref,  # VMEM [group_pad, 128] f32, running denominator
+    acc_ref,  # VMEM [group_pad, head_dim] f32
+    *,
+    page_size: int,
+    num_pages: int,
+    sm_scale: float,
+):
+    b, p = pl.program_id(0), pl.program_id(2)
+    length = lens_ref[b]  # valid cache slots: positions [0, length)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    def _page():
+        q = q_ref[0, 0]  # [group_pad, head_dim]
+        k = k_ref[0, :, 0, :]  # [page_size, head_dim]
+        v = v_ref[0, :, 0, :]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # [group_pad, page_size]
+        # Mask positions at/past the frontier (the partial last page).
+        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        seen = m_new > NEG_INF
+        prob = jnp.where(seen, jnp.exp(s - jnp.where(seen, m_new, 0.0)), 0.0)
+        alpha = jnp.where(seen, jnp.exp(jnp.where(seen, m_prev - m_new, 0.0)), 0.0)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(prob, axis=-1, keepdims=True), l_ref.shape
+        )
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            prob.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    # Pages wholly past the frontier skip both matmuls.
+    pl.when(p * page_size < length)(_page)
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    page_table: jax.Array,
+    lens: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-token decode attention over a paged KV pool.
+
+    q: [batch, num_heads, head_dim] — the current token's queries.
+    pool_k/pool_v: [num_pool_pages, page_size, kv_heads, head_dim].
+    page_table: [batch, pages_per_seq] int32 physical page ids.
+    lens: [batch] int32 — valid cache slots per row (the current token's
+    K/V must already be written: ``lens = position + 1``).
+
+    Returns [batch, num_heads, head_dim].  GQA-native: ``kv_heads`` must
+    divide ``num_heads``; each group shares its kv head's resident page.
+    """
+    batch, num_heads, head_dim = q.shape
+    kv_heads, page_size = pool_k.shape[2], pool_k.shape[1]
+    pages_per_seq = page_table.shape[1]
+    if num_heads % kv_heads:
+        raise ValueError(f"num_heads {num_heads} not a multiple of kv_heads {kv_heads}")
+    group = num_heads // kv_heads
+    if sm_scale is None:
+        sm_scale = head_dim ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    group_pad = max(group, _MIN_GROUP_TILE)
+    q4 = q.reshape(batch, kv_heads, group, head_dim)
+    if group_pad != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, group_pad - group), (0, 0)))
+
+    kernel = functools.partial(
+        _paged_kernel,
+        page_size=page_size,
+        num_pages=pages_per_seq,
+        sm_scale=sm_scale,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, kv_heads, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group_pad, head_dim),
+                lambda b, h, p, table, lens: (b, h, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, head_dim),
+                lambda b, h, p, table, lens: (table[b, p], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, head_dim),
+                lambda b, h, p, table, lens: (table[b, p], 0, h, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group_pad, head_dim),
+            lambda b, h, p, table, lens: (b, h, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, 128), jnp.float32),
+            pltpu.VMEM((group_pad, 128), jnp.float32),
+            pltpu.VMEM((group_pad, head_dim), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, kv_heads, group_pad, head_dim), q.dtype
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(page_table, lens, q4, pool_k, pool_v)
+    return out[:, :, :group, :].reshape(batch, num_heads, head_dim)

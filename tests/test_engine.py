@@ -50,6 +50,21 @@ def test_single_request_matches_dense_decode(rng):
     assert req.tokens == _oracle(cfg, params, prompt, 8)
 
 
+def test_paged_kernel_path_matches_dense(rng):
+    """PagedConfig(use_kernel=True): decode reads pages through the Pallas
+    paged-attention kernel instead of the gather view — same tokens."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(
+        page_size=4, num_pages=16, max_pages_per_seq=8, use_kernel=True
+    )
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    jobs = [([3, 141, 59, 265, 35], 8), ([9, 10], 5)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n)
+
+
 def test_page_boundary_crossing(rng):
     """Tiny pages force every request across several page boundaries."""
     cfg = _cfg()
