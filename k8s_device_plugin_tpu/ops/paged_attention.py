@@ -7,8 +7,12 @@ correct, but it writes (and re-reads) max_len bytes per slot per step even
 when a sequence occupies two pages.  This kernel reads pages DIRECTLY from
 the pool: the page table rides Pallas's scalar-prefetch lane, so each grid
 step's BlockSpec index map picks its physical page (`table[b, p]`) and the
-DMA engine streams exactly the pages a slot owns — O(len) HBM traffic per
-slot, no intermediate view.
+DMA engine streams the pages a slot points at — no intermediate view.
+`pl.when` gates only the kernel body, NOT the pipeline's block copies, so
+O(len)-not-O(max_len) traffic additionally requires that a row's dead
+TAIL entries alias one page (the serving engine guarantees this: idle and
+reclaimed entries all point at scratch page 0, whose repeated index skips
+re-fetch).
 
 Design (same language as ops/flash_attention.py):
 
@@ -127,6 +131,11 @@ def paged_attention(
 
     Returns [batch, num_heads, head_dim].  GQA-native: ``kv_heads`` must
     divide ``num_heads``; each group shares its kv head's resident page.
+
+    Traffic note: table entries past a row's live pages are read by the
+    pipeline regardless of the dead-page predicate (see module docstring)
+    — point them all at one scratch page (as models/engine.py does) to
+    keep per-row traffic O(len).
     """
     batch, num_heads, head_dim = q.shape
     kv_heads, page_size = pool_k.shape[2], pool_k.shape[1]

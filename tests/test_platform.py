@@ -1,0 +1,59 @@
+"""utils/platform.py: the one JAX_PLATFORMS override every entry point
+shares (bench.py subprocess, benchmark runner, serving CLI)."""
+
+import k8s_device_plugin_tpu.utils.platform as platform_mod
+from k8s_device_plugin_tpu.utils.platform import honor_jax_platforms_env
+
+
+class _FakeConfig:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def update(self, key, value):
+        if self.fail:
+            raise RuntimeError("backend already initialized")
+        self.calls.append((key, value))
+
+
+def _run(monkeypatch, env_value, *, empty_is_auto, fail=False):
+    fake = _FakeConfig(fail=fail)
+
+    class _FakeJax:
+        config = fake
+
+    monkeypatch.setattr(platform_mod, "os", platform_mod.os)
+    if env_value is None:
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    else:
+        monkeypatch.setenv("JAX_PLATFORMS", env_value)
+    monkeypatch.setitem(__import__("sys").modules, "jax", _FakeJax)
+    logs = []
+    honor_jax_platforms_env(empty_is_auto=empty_is_auto, log=logs.append)
+    return fake.calls, logs
+
+
+def test_unset_env_is_noop(monkeypatch):
+    calls, logs = _run(monkeypatch, None, empty_is_auto=True)
+    assert calls == [] and logs == []
+
+
+def test_explicit_value_applies(monkeypatch):
+    calls, _ = _run(monkeypatch, "cpu", empty_is_auto=False)
+    assert calls == [("jax_platforms", "cpu")]
+
+
+def test_empty_is_auto_resets_pin(monkeypatch):
+    calls, _ = _run(monkeypatch, "", empty_is_auto=True)
+    assert calls == [("jax_platforms", None)]
+
+
+def test_empty_is_noop_when_not_auto(monkeypatch):
+    calls, _ = _run(monkeypatch, "", empty_is_auto=False)
+    assert calls == []
+
+
+def test_failure_logs_and_never_raises(monkeypatch):
+    calls, logs = _run(monkeypatch, "cpu", empty_is_auto=False, fail=True)
+    assert calls == []
+    assert len(logs) == 1 and "cpu" in logs[0]
