@@ -1,5 +1,29 @@
-"""Pallas TPU kernels for the benchmark workloads' hot ops."""
+"""Pallas TPU kernels and fused ops for the benchmark/serving workloads."""
 
 from .flash_attention import flash_attention, mha_reference
+from .fused_xent import fused_linear_xent, naive_linear_xent
+from .paged_attention import paged_attention
+from .quant import (
+    Int8DenseGeneral,
+    dequantize_int8,
+    dequantize_kv,
+    int8_dot_general,
+    quantize_int8,
+    quantize_kv,
+    quantize_lm_params,
+)
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "fused_linear_xent",
+    "naive_linear_xent",
+    "paged_attention",
+    "Int8DenseGeneral",
+    "dequantize_int8",
+    "dequantize_kv",
+    "int8_dot_general",
+    "quantize_int8",
+    "quantize_kv",
+    "quantize_lm_params",
+]

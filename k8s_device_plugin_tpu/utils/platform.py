@@ -40,6 +40,6 @@ def honor_jax_platforms_env(
         return
     try:
         jax.config.update("jax_platforms", value or None)
-    except Exception as e:  # pragma: no cover - defensive
+    except Exception as e:
         if log is not None:
             log(f"could not apply JAX_PLATFORMS={value!r}: {e}")

@@ -1,6 +1,8 @@
 """utils/platform.py: the one JAX_PLATFORMS override every entry point
 shares (bench.py subprocess, benchmark runner, serving CLI)."""
 
+import sys
+
 import k8s_device_plugin_tpu.utils.platform as platform_mod
 from k8s_device_plugin_tpu.utils.platform import honor_jax_platforms_env
 
@@ -22,12 +24,11 @@ def _run(monkeypatch, env_value, *, empty_is_auto, fail=False):
     class _FakeJax:
         config = fake
 
-    monkeypatch.setattr(platform_mod, "os", platform_mod.os)
     if env_value is None:
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     else:
         monkeypatch.setenv("JAX_PLATFORMS", env_value)
-    monkeypatch.setitem(__import__("sys").modules, "jax", _FakeJax)
+    monkeypatch.setitem(sys.modules, "jax", _FakeJax)
     logs = []
     honor_jax_platforms_env(empty_is_auto=empty_is_auto, log=logs.append)
     return fake.calls, logs
