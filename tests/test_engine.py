@@ -268,6 +268,27 @@ def test_mixed_greedy_and_sampled_slots(rng):
         eng.submit([1, 2], 4, temperature=-1.0)
 
 
+def test_staggered_submission_mid_flight(rng):
+    """True continuous batching: requests arriving WHILE others decode
+    join live slots without perturbing them."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=3)
+    early = eng.submit([3, 141, 59], 10)
+    for _ in range(3):
+        eng.step()
+    assert not early.done
+    late1 = eng.submit([400, 2, 2, 17], 5)
+    late2 = eng.submit([9], 6)
+    while not (early.done and late1.done and late2.done):
+        eng.step()
+    assert early.tokens == _oracle(cfg, params, [3, 141, 59], 10)
+    assert late1.tokens == _oracle(cfg, params, [400, 2, 2, 17], 5)
+    assert late2.tokens == _oracle(cfg, params, [9], 6)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
 def test_engine_fuzz_random_schedules(rng):
     """Randomized geometries and request mixes (including a non-power-of-
     two page size) must all reproduce the dense oracle — the blanket net
