@@ -281,8 +281,17 @@ def test_staggered_submission_mid_flight(rng):
     assert not early.done
     late1 = eng.submit([400, 2, 2, 17], 5)
     late2 = eng.submit([9], 6)
-    while not (early.done and late1.done and late2.done):
+    eng.step()
+    # The join must be concurrent: all three slots serving while `early`
+    # is still mid-decode (a serializing-admission regression would still
+    # produce correct tokens, so occupancy is the property to pin).
+    assert all(s is not None for s in eng.slots) and not early.done
+    for _ in range(1000):
         eng.step()
+        if early.done and late1.done and late2.done:
+            break
+    else:
+        raise AssertionError("engine failed to drain the staggered requests")
     assert early.tokens == _oracle(cfg, params, [3, 141, 59], 10)
     assert late1.tokens == _oracle(cfg, params, [400, 2, 2, 17], 5)
     assert late2.tokens == _oracle(cfg, params, [9], 6)
