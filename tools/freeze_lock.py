@@ -1,0 +1,83 @@
+"""Regenerate requirements.lock from the CURRENT environment.
+
+≙ the reference's exact-revision pinning (`Gopkg.lock`, reference
+Gopkg.toml:22-28): the lockfile is the transitive closure of the
+pyproject dependencies (runtime + the `workloads` extra), captured at the
+versions this build was validated against, so a rebuilt image cannot
+silently float every dependency.  Run on the image/environment the wheel
+is validated on:
+
+    python tools/freeze_lock.py > requirements.lock  # or in-place default
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from importlib.metadata import PackageNotFoundError, distribution
+
+ROOTS = [
+    # [project].dependencies
+    "grpcio",
+    "protobuf",
+    # [project.optional-dependencies].workloads
+    "jax",
+    "jaxlib",
+    "flax",
+    "optax",
+    "einops",
+    "orbax-checkpoint",
+]
+
+HEADER = """\
+# Exact-revision lockfile for the plugin runtime + workloads extra
+# (transitive closure of pyproject dependencies, captured from the
+# image this build is validated on; = reference Gopkg.lock).
+# Regenerate: python tools/freeze_lock.py
+"""
+
+
+def _norm(name: str) -> str:
+    return re.sub(r"[-_.]+", "-", name).lower()
+
+
+def closure(roots=ROOTS) -> list[str]:
+    seen: set[str] = set()
+    pins: list[tuple[str, str]] = []
+
+    def walk(name: str) -> None:
+        n = _norm(name)
+        if n in seen:
+            return
+        try:
+            d = distribution(n)
+        except PackageNotFoundError:
+            return  # environment marker'd dep absent here; skip
+        seen.add(n)
+        pins.append((d.metadata["Name"], d.version))
+        for req in d.requires or []:
+            if "extra ==" in req:
+                continue  # optional extras are not part of the install
+            dep = re.split(r"[ ;\[<>=!~(]", req.strip())[0]
+            walk(dep)
+
+    for root in roots:
+        walk(root)
+    return sorted(f"{n}=={v}" for n, v in pins)
+
+
+def main() -> None:
+    body = HEADER + "\n".join(closure()) + "\n"
+    if len(sys.argv) > 1 and sys.argv[1] == "-":
+        sys.stdout.write(body)
+    else:
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "requirements.lock")
+        with open(path, "w") as f:
+            f.write(body)
+        print(f"wrote {os.path.normpath(path)} ({len(closure())} pins)")
+
+
+if __name__ == "__main__":
+    main()
