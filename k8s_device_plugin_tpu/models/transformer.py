@@ -334,22 +334,20 @@ class CausalSelfAttention(nn.Module):
             pv.value = pv.value.at[page, off].set(v[:, 0])
             lens.value = cur + 1
             if pg.use_kernel:
-                if cfg.attention_window is not None:
-                    raise ValueError(
-                        "PagedConfig.use_kernel is full-causal; unset "
-                        "attention_window or use the gather path"
-                    )
                 from ..ops.paged_attention import paged_attention
 
                 # Pages stream straight from the pool via the scalar-
                 # prefetched table; valid slots per row = position + 1
-                # (this token's K/V were just written above).
+                # (this token's K/V were just written above).  A sliding
+                # window masks inside the kernel (and skips wholly-dead
+                # pages), mirroring the gather path's mask.
                 attn = paged_attention(
                     q[:, 0],
                     pk.value,
                     pv.value,
                     table.value,
                     positions[:, 0] + 1,
+                    window=cfg.attention_window,
                 )[:, None]
             else:
                 # Gather each row's pages into its logical [max_len] view.

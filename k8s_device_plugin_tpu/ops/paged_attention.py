@@ -10,9 +10,10 @@ step's BlockSpec index map picks its physical page (`table[b, p]`) and the
 DMA engine streams the pages a slot points at — no intermediate view.
 `pl.when` gates only the kernel body, NOT the pipeline's block copies, so
 O(len)-not-O(max_len) traffic additionally requires that a row's dead
-TAIL entries alias one page (the serving engine guarantees this: idle and
-reclaimed entries all point at scratch page 0, whose repeated index skips
-re-fetch).
+TAIL entries alias one page (the serving engine guarantees this: idle,
+window-reclaimed, and not-yet-written entries all point at scratch page
+0, whose repeated index skips re-fetch — the table frontier is published
+lazily as each sequence grows).
 
 Design (same language as ops/flash_attention.py):
 
@@ -62,9 +63,15 @@ def _paged_kernel(
     page_size: int,
     num_pages: int,
     sm_scale: float,
+    window: int | None,
 ):
     b, p = pl.program_id(0), pl.program_id(2)
     length = lens_ref[b]  # valid cache slots: positions [0, length)
+    # Sliding window: the (single) query sits at position length-1 and sees
+    # keys in (length-1-window, length-1] — i.e. col >= length - window —
+    # matching the gather path's `q_pos - key_pos < window` mask
+    # (models/transformer.py cached_group_attention).
+    lo = length - window if window is not None else 0
 
     @pl.when(p == 0)
     def _init():
@@ -82,9 +89,13 @@ def _paged_kernel(
             )
             * sm_scale
         )  # [group_pad, page_size]
-        # Mask positions at/past the frontier (the partial last page).
+        # Mask positions at/past the frontier (the partial last page) and,
+        # under a sliding window, positions that scrolled out of it.
         col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < length, s, NEG_INF)
+        valid = col < length
+        if window is not None:
+            valid = jnp.logical_and(valid, col >= lo)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
@@ -101,8 +112,12 @@ def _paged_kernel(
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    # Pages wholly past the frontier skip both matmuls.
-    pl.when(p * page_size < length)(_page)
+    # Pages wholly past the frontier — or wholly scrolled out of the
+    # window — skip both matmuls.
+    live = p * page_size < length
+    if window is not None:
+        live = jnp.logical_and(live, (p + 1) * page_size > lo)
+    pl.when(live)(_page)
 
     @pl.when(p == num_pages - 1)
     def _finish():
@@ -119,6 +134,7 @@ def paged_attention(
     lens: jax.Array,
     *,
     sm_scale: float | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Single-token decode attention over a paged KV pool.
@@ -132,10 +148,18 @@ def paged_attention(
     Returns [batch, num_heads, head_dim].  GQA-native: ``kv_heads`` must
     divide ``num_heads``; each group shares its kv head's resident page.
 
+    ``window``: sliding attention window — the query sees only the last
+    ``window`` positions (same semantics as the gather path / flash
+    kernel's window mask); pages wholly outside it skip compute, and the
+    serving engine additionally re-points their table entries at scratch
+    so they skip fetch too (windowed page reclamation).
+
     Traffic note: table entries past a row's live pages are read by the
     pipeline regardless of the dead-page predicate (see module docstring)
-    — point them all at one scratch page (as models/engine.py does) to
-    keep per-row traffic O(len).
+    — point them all at one scratch page to keep per-row traffic O(len).
+    models/engine.py does exactly this: idle rows, window-reclaimed
+    entries, AND not-yet-written generation pages all alias scratch page
+    0 (the table frontier extends lazily as the sequence grows).
     """
     batch, num_heads, head_dim = q.shape
     kv_heads, page_size = pool_k.shape[2], pool_k.shape[1]
@@ -153,11 +177,14 @@ def paged_attention(
     if group_pad != group:
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, group_pad - group), (0, 0)))
 
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     kernel = functools.partial(
         _paged_kernel,
         page_size=page_size,
         num_pages=pages_per_seq,
         sm_scale=sm_scale,
+        window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -201,12 +201,30 @@ def quantize_lm_params(params: Any) -> Any:
             return tree
         if "kernel" in tree and hasattr(tree["kernel"], "ndim"):
             w = tree["kernel"]
-            # Attention's out-projection (module name "out", DenseGeneral
-            # axis=(-2,-1)) has kernel [heads, head_dim, hidden]: two
-            # contracted leading dims.  Every other dense kernel — plain
-            # Dense [in, out] or qkv DenseGeneral [hidden, heads, head_dim]
-            # — contracts exactly one.
-            contract_ndim = 2 if name == "out" and w.ndim == 3 else 1
+            # Contraction dims are inferred by site name, which is only
+            # sound for the sites this transform knows.  2-D kernels are
+            # unambiguous ([in, out], contract 1).  For 3-D+ the layout is
+            # name-dependent — attention's out-projection (DenseGeneral
+            # axis=(-2,-1)) is [heads, head_dim, hidden] with TWO
+            # contracted leading dims, qkv DenseGeneral is
+            # [hidden, heads, head_dim] with one — so any OTHER 3-D+
+            # kernel (a future MoE expert kernel [experts, in, out], a
+            # renamed projection) must fail loudly here rather than get
+            # per-channel scales computed over the wrong axes and a
+            # silently wrong quantized tree.
+            if w.ndim <= 2:
+                contract_ndim = 1
+            elif name == "out" and w.ndim == 3:
+                contract_ndim = 2
+            elif name in ("query", "key", "value") and w.ndim == 3:
+                contract_ndim = 1
+            else:
+                raise ValueError(
+                    f"quantize_lm_params: unknown {w.ndim}-D kernel site "
+                    f"{name!r} — contraction axes cannot be inferred from "
+                    "the name; quantize it explicitly with quantize_int8(w, "
+                    "contract_ndim) and splice the result into the tree"
+                )
             q, scale = quantize_int8(w, contract_ndim)
             rest = {k: v for k, v in tree.items() if k != "kernel"}
             return {"kernel_q": q, "kernel_scale": scale, **rest}

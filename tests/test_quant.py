@@ -137,6 +137,22 @@ def test_quantize_lm_params_structure(rng):
     assert qparams["final_norm"]["scale"].shape == (cfg.hidden_size,)
 
 
+def test_unknown_3d_kernel_site_raises(rng):
+    """A 3-D+ kernel under an unknown module name must fail loudly: the
+    contraction axes are name-inferred, and guessing wrong would emit a
+    numerically wrong quantized tree with no error (ADVICE r2)."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    tree = {
+        "experts": {"kernel": jnp.ones((4, 8, 16), jnp.float32)},
+    }
+    with pytest.raises(ValueError, match="unknown 3-D kernel site"):
+        quantize_lm_params(tree)
+    # 2-D kernels under any name stay quantizable (plain Dense).
+    out = quantize_lm_params({"whatever": {"kernel": jnp.ones((8, 16))}})
+    assert out["whatever"]["kernel_q"].dtype == jnp.int8
+
+
 @pytest.mark.parametrize("mode", ["w8", "w8a8"])
 def test_quantized_logits_close_to_fp(rng, mode):
     cfg = _tiny_cfg(hidden_size=128, num_heads=4, intermediate_size=256)
