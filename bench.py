@@ -68,8 +68,14 @@ _ATTEMPTS = [
 # __recv) rather than raise, which would burn the as-is + auto windows
 # (25 min) before the CPU fallback fires.  A 120s subprocess that must
 # print a device platform decides whether the accelerator attempts are
-# worth their timeouts at all.
+# worth their timeouts at all.  The probe RETRIES with backoff
+# (VERDICT r2 next #1): the relay wedges are sometimes transient, and a
+# round's one driver-visible bench must not concede to CPU because of a
+# single bad probe minute.  3 probes: fast-fail costs ~4 min, fully hung
+# probes ~10 min before the CPU fallback starts.
 _PROBE_TIMEOUT = 120 * _SCALE
+_PROBE_RETRIES = 3
+_PROBE_BACKOFF = 120 * _SCALE  # sleep between failed probes
 _PROBE_CODE = (
     "import jax, numpy as np\n"
     "d = jax.devices()[0]\n"
@@ -168,10 +174,29 @@ def _inner() -> None:
     from k8s_device_plugin_tpu.models.resnet import ResNet50
     from k8s_device_plugin_tpu.models.train import create_train_state, make_train_step
 
+    from k8s_device_plugin_tpu.utils.platform import peak_bf16_flops
+
     platform = jax.devices()[0].platform
     log(f"platform: {platform} ({len(jax.devices())} device(s))")
+    peak = peak_bf16_flops(jax.devices()[0]) if platform != "cpu" else None
 
-    def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 5) -> float:
+    # ResNet-50 at 224x224: ~4.1 GFLOP forward per image (the standard
+    # published figure); training (fwd + bwd) ~= 3x forward.  Used only
+    # for MFU reporting — throughput stays the headline metric.
+    RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+
+    def mfu_of(ips: float) -> float | None:
+        if peak is None or ips <= 0:
+            return None
+        return ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+
+    # steps=60: the constant relay RTT rides every single-dispatch program,
+    # and the two-point delta usually falls below the jitter floor, so the
+    # reported rate is the big program's single-point estimate — at 20
+    # steps that diluted the headline ~5% (r3 session: 1949 ips at 20
+    # steps vs 2051 at 60, identical code).  60 steps puts the constant
+    # part under ~2% of program time.
+    def bench_resnet50(batch_size: int, steps: int = 60, warmup: int = 5) -> float:
         if platform == "cpu":
             # Structural smoke run only (no TPU attached): keep shapes tiny
             # so the script still exercises the full path.
@@ -220,6 +245,23 @@ def _inner() -> None:
             state, loss, dt = timed_steps(step, state, batch, warmup, steps)
             tps = batch_size * seq * steps / dt
             log(f"transformer-lm b{batch_size} s{seq}: {tps:.0f} tokens/sec (loss {float(loss):.3f})")
+            if peak is not None:
+                # 6 FLOPs per matmul param per token (fwd+bwd) plus the
+                # causal-halved attention matmuls (6*L*seq*hidden);
+                # embedding gathers excluded.
+                from jax.tree_util import tree_flatten_with_path
+
+                n_matmul = sum(
+                    leaf.size
+                    for path, leaf in tree_flatten_with_path(state.params)[0]
+                    if getattr(leaf, "ndim", 0) >= 2
+                    and "emb" not in str(path).lower()
+                )
+                fpt = 6 * n_matmul + 6 * cfg.num_layers * seq * cfg.hidden_size
+                log(
+                    f"transformer-lm MFU: {tps * fpt / peak:.1%} "
+                    f"({n_matmul/1e6:.0f}M matmul params)"
+                )
             # Fused LM-head + xent tail (ops/fused_xent.py): same model,
             # no [b,s,vocab] logits tensor — report the delta.
             from k8s_device_plugin_tpu.models.train import make_fused_lm_train_step
@@ -553,6 +595,9 @@ def _inner() -> None:
     # not cost the round its one hardware number (stage 1 salvages the
     # partial stdout of a timed-out attempt).
     baseline, baseline_src = _baseline_value()
+    mfu = mfu_of(ips)
+    if mfu is not None:
+        log(f"resnet50 MFU: {mfu:.1%} of {peak/1e12:.0f} TFLOP/s bf16 peak")
     print(
         json.dumps(
             {
@@ -562,6 +607,7 @@ def _inner() -> None:
                 "vs_baseline": round(ips / baseline, 4),
                 "baseline": baseline,
                 "baseline_src": baseline_src,
+                "mfu": round(mfu, 4) if mfu is not None else None,
                 "platform": "cpu" if platform == "cpu" else "tpu",
             }
         ),
@@ -641,7 +687,20 @@ def main() -> None:
         return
     errors: list[str] = []
     attempts = _ATTEMPTS
-    if not _accelerator_alive():
+    alive = False
+    for i in range(_PROBE_RETRIES):
+        if _accelerator_alive():
+            alive = True
+            break
+        if i + 1 < _PROBE_RETRIES:
+            print(
+                f"probe {i + 1}/{_PROBE_RETRIES} failed; retrying in "
+                f"{_PROBE_BACKOFF:.0f}s (relay wedges are sometimes transient)",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(_PROBE_BACKOFF)
+    if not alive:
         print(
             "accelerator probe failed (backend dead or hung) — skipping "
             "accelerator attempts, going straight to the CPU fallback",
@@ -649,7 +708,9 @@ def main() -> None:
             flush=True,
         )
         errors.append(
-            f"probe: accelerator backend dead or hung within {_PROBE_TIMEOUT:.0f}s"
+            f"probe: accelerator backend dead or hung "
+            f"({_PROBE_RETRIES}x {_PROBE_TIMEOUT:.0f}s probes over "
+            f"{(_PROBE_RETRIES - 1) * _PROBE_BACKOFF / 60:.0f}+ min)"
         )
         attempts = [a for a in _ATTEMPTS if a[0] == "cpu"]
     tried: list[str] = []

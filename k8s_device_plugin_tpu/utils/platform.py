@@ -14,6 +14,31 @@ import os
 from typing import Callable, Optional
 
 
+# Peak dense bf16 matmul throughput per chip, by device_kind substring
+# (first match wins; more specific substrings first).  Public figures:
+# v4 275, v5e 197, v5p 459, v6e/Trillium 918, v3 123, v2 45 TFLOP/s.
+# Used for MFU reporting (bench.py) — an unknown generation yields None
+# and MFU is simply omitted, never guessed.
+PEAK_BF16_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v6", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_bf16_flops(device) -> Optional[float]:
+    """Per-chip peak bf16 FLOP/s for a jax device, or None if unknown."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for sub, peak in PEAK_BF16_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
 def honor_jax_platforms_env(
     *,
     empty_is_auto: bool,
