@@ -115,6 +115,14 @@ class EngineMetrics:
             "tpu_engine_shared_pages",
             "Pages currently referenced by more than one request (prefix sharing)",
         )
+        self.spec_proposed = registry.counter(
+            "tpu_engine_spec_proposed_total",
+            "Draft tokens proposed by speculative rounds",
+        )
+        self.spec_accepted = registry.counter(
+            "tpu_engine_spec_accepted_total",
+            "Draft tokens the target accepted (rate = accepted/proposed)",
+        )
 
 
 @dataclasses.dataclass
@@ -156,9 +164,37 @@ class ServingEngine:
         prefix_sharing: bool = True,
         rng: Optional[jax.Array] = None,
         metrics: Optional[EngineMetrics] = None,
+        spec_gamma: int = 0,
+        draft_params: Any = None,
+        draft_cfg: Optional[GPTConfig] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
+        if spec_gamma < 0:
+            raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
+        if spec_gamma > 0:
+            # Shared-pool speculation: the draft writes its (approximate)
+            # K/V at the frontier and the verify pass overwrites those
+            # same positions with exact target K/V before any later read,
+            # so the draft needs NO cache of its own — but that only
+            # works when both models address the pool identically, i.e.
+            # same architecture (self-speculation: the draft is the same
+            # model quantized, ops/quant.py).
+            if draft_params is None:
+                raise ValueError("spec_gamma > 0 requires draft_params")
+            if draft_cfg is None:
+                draft_cfg = dataclasses.replace(cfg, quant="w8")
+            same = dataclasses.replace(
+                draft_cfg, quant=None, quant_kv=False
+            ) == dataclasses.replace(cfg, quant=None, quant_kv=False)
+            if not same:
+                raise ValueError(
+                    "engine speculation is shared-pool self-speculation: "
+                    "draft_cfg must match the target architecture (only "
+                    "quant/quant_kv may differ)"
+                )
+        self._spec_gamma = spec_gamma
+        self.draft_params = draft_params
         self.paged = paged
         self.cfg = dataclasses.replace(cfg, paged=paged)
         # Dense prefill bridge shares max_seq with the paged logical view.
@@ -220,6 +256,73 @@ class ServingEngine:
         self._step = step
         self._step_plain = step_plain
         self._dense = TransformerLM(self.dense_cfg, decode=True)
+
+        if spec_gamma > 0:
+            draft_model = TransformerLM(
+                dataclasses.replace(draft_cfg, paged=paged), decode=True
+            )
+            # Local alias: the jitted closure must not capture self.
+            layer_names = self._layer_names
+            gamma = spec_gamma
+
+            @functools.partial(jax.jit, donate_argnums=(2,))
+            def spec_round(params, dparams, cache, tokens, positions):
+                """One speculative round for every slot at once.
+
+                tokens/positions: [slots, 1] (positions = each row's
+                current length L).  gamma greedy draft steps propose
+                d_1..d_gamma per slot (writing draft K/V at L..L+gamma-1),
+                then ONE (gamma+1)-token target pass scores
+                [last, d_1..d_gamma] at L..L+gamma — overwriting every
+                draft-written slot with exact target K/V, which is what
+                makes the shared pool sound.  Returns (proposals
+                [slots, gamma], target argmax [slots, gamma+1], cache);
+                acceptance and length rewind are host bookkeeping.
+                """
+
+                def d_step(carry, i):
+                    c, tok = carry
+                    logits, mut = draft_model.apply(
+                        {"params": dparams, "cache": c},
+                        tok,
+                        positions + i,
+                        mutable=["cache"],
+                    )
+                    nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(
+                        jnp.int32
+                    )[:, None]
+                    return (mut["cache"], nxt), nxt
+
+                (cache, _), props = jax.lax.scan(
+                    d_step, (cache, tokens), jnp.arange(gamma)
+                )
+                props = jnp.moveaxis(props[..., 0], 0, 1)  # [slots, gamma]
+                # The draft advanced every row's seq_lens to L+gamma;
+                # rewind to L so the verify append writes L..L+gamma.
+                L = positions[:, 0]
+                cache = {
+                    name: {
+                        **cache[name],
+                        "attn": {**cache[name]["attn"], "seq_lens": L},
+                    }
+                    for name in layer_names
+                }
+                block = jnp.concatenate([tokens, props], axis=1)
+                block_pos = positions + jnp.arange(gamma + 1)[None, :]
+                logits, mut = model.apply(
+                    {"params": params, "cache": cache},
+                    block,
+                    block_pos,
+                    mutable=["cache"],
+                )
+                t_toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return props, t_toks, mut["cache"]
+
+            self._spec_round = spec_round
+        # Host-visible speculation counters (also exported via metrics):
+        # acceptance rate = accepted / proposed, the gamma-tuning signal.
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
         # Page 0 is the idle-slot scratch target — never allocated.
         self.free_pages: deque[int] = deque(range(1, paged.num_pages))
@@ -300,11 +403,25 @@ class ServingEngine:
             )
         if top_p is not None and not 0 < top_p <= 1:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        need = len(prompt) + max_new_tokens
+        if self._spec_gamma and temperature > 0:
+            raise ValueError(
+                "speculative engine mode is greedy-only (the per-slot "
+                "acceptance-rejection sampler is not implemented); submit "
+                "with temperature=0 or run a non-speculative engine"
+            )
+        # Speculative rounds write up to gamma positions past the accepted
+        # point before the host rewinds, so every capacity bound carries
+        # that headroom (= models/speculative.py's max_seq check).
+        need = len(prompt) + max_new_tokens + self._spec_gamma
         if need > self.paged.max_len:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
-                f"paged max_len {self.paged.max_len}"
+                f"prompt {len(prompt)} + max_new {max_new_tokens}"
+                + (
+                    f" + spec headroom {self._spec_gamma}"
+                    if self._spec_gamma
+                    else ""
+                )
+                + f" exceeds paged max_len {self.paged.max_len}"
             )
         # Admissibility, not just addressability: the request must fit the
         # ALLOCATABLE pool (page 0 is reserved), else it would block the
@@ -416,10 +533,11 @@ class ServingEngine:
         n_cover = math.ceil(plen / ps)
         # Publish only the pages the NEXT decode step can touch: those
         # covering positions [0, plen] (the first decode write lands at
-        # position plen).  The rest of the chain stays at scratch page 0
-        # until the frontier reaches it (_extend_frontier) so the kernel's
-        # pipeline never streams unwritten generation pages.
-        n_publish = min(plen // ps + 1, len(pages))
+        # position plen; a speculative round writes up to plen+gamma).
+        # The rest of the chain stays at scratch page 0 until the
+        # frontier reaches it (_extend_frontier) so the kernel's pipeline
+        # never streams unwritten generation pages.
+        n_publish = min((plen + self._spec_gamma) // ps + 1, len(pages))
         row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
         row[:n_publish] = pages[:n_publish]
         self._slot_visible[slot] = n_publish
@@ -532,7 +650,8 @@ class ServingEngine:
                 req = self.queue[0]
                 plen = len(req.prompt)
                 n_pages = math.ceil(
-                    (plen + req.max_new_tokens) / self.paged.page_size
+                    (plen + req.max_new_tokens + self._spec_gamma)
+                    / self.paged.page_size
                 )
                 shared = (
                     self._match_prefix(req.prompt) if self.prefix_sharing else []
@@ -651,6 +770,8 @@ class ServingEngine:
         if not active:
             self._update_gauges()
             return finished
+        if self._spec_gamma:
+            return self._spec_step(active, finished)
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
@@ -693,23 +814,99 @@ class ServingEngine:
         self._update_gauges()
         return finished
 
-    def _extend_frontier(self, slot: int) -> None:
-        """Publish the page covering the NEXT write position into the
-        device table the moment the frontier crosses into it — one tiny
-        .at[slot, idx].set per layer per page_size tokens (amortized
-        O(1/page_size) dispatches per token)."""
-        need = self._slot_len[slot] // self.paged.page_size + 1
-        if need <= self._slot_visible[slot]:
-            return
-        idx = need - 1  # logical page index to publish
-        page = self._slot_pages[slot][idx - self._slot_page_base[slot]]
+    def _spec_step(self, active: list[int], finished: list[Request]) -> list[Request]:
+        """One speculative round: gamma draft steps + one verify pass
+        advance every active slot by 1..gamma+1 tokens.  Greedy
+        verification makes each slot's output EXACTLY its non-speculative
+        greedy decode (pinned against the dense oracle in
+        tests/test_engine.py); speculation changes only the schedule."""
+        for s in active:
+            self._extend_frontier(s)  # round writes up to len+gamma
+        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
+        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
+        props, t_toks, self.cache = self._spec_round(
+            self.params, self.draft_params, self.cache, tokens, positions
+        )
+        props = np.asarray(props)
+        t_toks = np.asarray(t_toks)
+        gamma = self._spec_gamma
+        emitted_total = 0
+        for s in active:
+            req = self.slots[s]
+            a = 0
+            while a < gamma and props[s, a] == t_toks[s, a]:
+                a += 1
+            # Emit d_1..d_a then the target's own token at position a
+            # (correction on rejection, bonus on full accept).  All a+1
+            # tokens are consumed unless a finish condition truncates —
+            # and truncation only ever coincides with req.done, so live
+            # slots always consume exactly a+1.
+            self.spec_proposed += gamma
+            self.spec_accepted += a
+            if self.metrics:
+                self.metrics.spec_proposed.inc(gamma)
+                self.metrics.spec_accepted.inc(a)
+            round_toks = [int(props[s, j]) for j in range(a)] + [
+                int(t_toks[s, a])
+            ]
+            consumed = 0
+            for tok in round_toks:
+                req.tokens.append(tok)
+                self._slot_last[s] = tok
+                consumed += 1
+                emitted_total += 1
+                if len(req.tokens) >= req.max_new_tokens or (
+                    self.eos_id is not None and tok == self.eos_id
+                ):
+                    break
+            self._slot_len[s] += consumed
+            self._maybe_finish(s)
+            if req.done:
+                finished.append(req)
+            else:
+                self._extend_frontier(s)
+                if self.cfg.attention_window is not None:
+                    self._reclaim_windowed(s)
+        # The round left every row's device length at L+gamma+1; re-align
+        # all rows to the host truth in one vector write per layer (idle
+        # and just-cleared rows are 0 in _slot_len, matching _clear_slot).
+        # A FRESH array per layer: sharing one across layers would hand
+        # the next round's donation the same buffer twice, which XLA
+        # rejects (donate(a), donate(a)).
         for name in self._layer_names:
             att = self.cache[name]["attn"]
             self.cache[name]["attn"] = {
                 **att,
-                "page_table": att["page_table"].at[slot, idx].set(page),
+                "seq_lens": jnp.array(self._slot_len, jnp.int32),
             }
-        self._slot_visible[slot] = need
+        if self.metrics:
+            self.metrics.steps.inc()
+            self.metrics.tokens.inc(emitted_total)
+        self._update_gauges()
+        return finished
+
+    def _extend_frontier(self, slot: int) -> None:
+        """Publish every page the next step can write — up to the one
+        covering position len+gamma (gamma=0 without speculation) — into
+        the device table the moment the frontier approaches it: tiny
+        .at[slot, idx].set updates per layer, amortized O(1/page_size)
+        dispatches per token."""
+        need = (
+            self._slot_len[slot] + self._spec_gamma
+        ) // self.paged.page_size + 1
+        need = min(
+            need, self._slot_page_base[slot] + len(self._slot_pages[slot])
+        )
+        while self._slot_visible[slot] < need:
+            idx = self._slot_visible[slot]  # logical page index to publish
+            page = self._slot_pages[slot][idx - self._slot_page_base[slot]]
+            for name in self._layer_names:
+                att = self.cache[name]["attn"]
+                self.cache[name]["attn"] = {
+                    **att,
+                    "page_table": att["page_table"].at[slot, idx].set(page),
+                }
+            self._slot_visible[slot] = idx + 1
 
     def _reclaim_windowed(self, slot: int) -> None:
         """Free pages that scrolled fully out of a sliding attention
@@ -836,7 +1033,23 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--top-p", type=float, default=None,
         help="restrict sampling to the smallest nucleus with mass >= p",
     )
+    p.add_argument(
+        "--spec-gamma",
+        type=int,
+        default=0,
+        help="speculative decoding: gamma int8 self-draft proposals per "
+        "verify pass (shared-pool; output stays exactly the greedy "
+        "decode). Incompatible with --quant and --temperature.",
+    )
     args = p.parse_args(argv)
+    if args.spec_gamma and args.quant:
+        raise SystemExit(
+            "--spec-gamma uses the int8 SELF-draft against the bf16 "
+            "target; an already-quantized target (--quant) leaves nothing "
+            "to verify against — drop one of the flags"
+        )
+    if args.spec_gamma and args.temperature > 0:
+        raise SystemExit("--spec-gamma is greedy-only; drop --temperature")
 
     cfg = GPTConfig(
         vocab_size=args.vocab,
@@ -860,7 +1073,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.max_pages_per_seq,
         use_kernel=args.use_kernel,
     )
-    eng = ServingEngine(cfg, params, paged, max_slots=args.slots)
+    spec_kw = {}
+    if args.spec_gamma:
+        from ..ops.quant import quantize_lm_params
+
+        spec_kw = dict(
+            spec_gamma=args.spec_gamma,
+            draft_params=quantize_lm_params(params),
+        )
+    eng = ServingEngine(cfg, params, paged, max_slots=args.slots, **spec_kw)
     sample_kw = dict(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
     )
@@ -882,6 +1103,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     for prompt, _ in jobs:
         warm_lens.setdefault(len(prompt), prompt)
     eng.run([(prompt, 2) for prompt in warm_lens.values()], **sample_kw)
+    # Warmup rounds ran real speculative traffic; the reported acceptance
+    # must cover the timed region only (same warmup-exclusion rule as the
+    # throughput number).
+    eng.spec_proposed = eng.spec_accepted = 0
 
     t0 = time.time()
     done = eng.run(jobs, **sample_kw)
@@ -901,6 +1126,12 @@ def main(argv: Optional[list[str]] = None) -> None:
                 if args.temperature <= 0
                 else f"temperature={args.temperature},top_k={args.top_k},"
                 f"top_p={args.top_p}",
+                "spec_gamma": args.spec_gamma,
+                "spec_acceptance": round(
+                    eng.spec_accepted / max(eng.spec_proposed, 1), 3
+                )
+                if args.spec_gamma
+                else None,
                 "tokens": tokens,
                 "wall_s": round(dt, 2),
             }

@@ -30,10 +30,14 @@ Mechanics per iteration (one ``lax.while_loop`` body, all shapes static):
    future query at position p re-writes slots ≤ p first), so no masking
    fixup is needed.
 
-Batch is fixed at 1: per-element acceptance lengths diverge under
-batching, and the cache index is a scalar by design (a per-row index would
-un-vectorize every cache update).  Serving parallelism across requests
-belongs to the pods the plugin schedules, not to one decode loop.
+Batch is fixed at 1 HERE: per-element acceptance lengths diverge under
+batching, and the dense cache index is a scalar by design (a per-row index
+would un-vectorize every cache update).  The PAGED serving engine
+(models/engine.py) lifts exactly this limit — its per-slot ``seq_lens``
+vector makes per-row rewind free, so ``ServingEngine(spec_gamma=...)``
+runs this same draft/verify/rewind scheme across every slot at once over
+one shared pool (greedy mode).  This module remains the offline batch-1
+path and the home of distribution-preserving speculative SAMPLING.
 """
 
 from __future__ import annotations

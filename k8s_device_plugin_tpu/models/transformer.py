@@ -302,19 +302,16 @@ class CausalSelfAttention(nn.Module):
 
         if self.decode and cfg.paged is not None:
             # Paged cache: one shared pool, page-table indirection per slot
-            # (PagedConfig).  Single-token steps only — the serving engine
+            # (PagedConfig).  Single-token decode steps, plus multi-token
+            # appends for the speculative verify pass — the serving engine
             # (models/engine.py) prefills via the dense path and grafts
             # rows into pages, and it reserves page 0 as the idle-slot
             # scratch target so inactive rows never collide with live
             # pages.
             if cfg.quant_kv:
                 raise ValueError("paged + quant_kv is not supported yet")
-            if hidden.shape[1] != 1:
-                raise ValueError(
-                    f"paged decode is single-token (got q_len {hidden.shape[1]})"
-                )
             pg = cfg.paged
-            batch = hidden.shape[0]
+            batch, q_len = hidden.shape[:2]
             pool_shape = (pg.num_pages, pg.page_size, cfg.kv_heads, cfg.head_dim)
             pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, k.dtype)
             pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, v.dtype)
@@ -326,14 +323,30 @@ class CausalSelfAttention(nn.Module):
                 jnp.int32,
             )
             lens = self.variable("cache", "seq_lens", jnp.zeros, (batch,), jnp.int32)
-            cur = lens.value  # this token's position per row
-            row = jnp.arange(batch)
-            page = table.value[row, cur // pg.page_size]
-            off = cur % pg.page_size
-            pk.value = pk.value.at[page, off].set(k[:, 0])
-            pv.value = pv.value.at[page, off].set(v[:, 0])
-            lens.value = cur + 1
-            if pg.use_kernel:
+            cur = lens.value  # first written position per row
+            if q_len == 1:
+                row = jnp.arange(batch)
+                page = table.value[row, cur // pg.page_size]
+                off = cur % pg.page_size
+                pk.value = pk.value.at[page, off].set(k[:, 0])
+                pv.value = pv.value.at[page, off].set(v[:, 0])
+            else:
+                # Multi-token paged append (the speculative verify pass):
+                # scatter q_len consecutive positions per row through the
+                # table in one update.  Rows at different lens may share
+                # scratch page 0 (idle slots) — garbage there is masked.
+                offs = cur[:, None] + jnp.arange(q_len)[None, :]  # [b, q]
+                page = table.value[
+                    jnp.arange(batch)[:, None], offs // pg.page_size
+                ]
+                pk.value = pk.value.at[page, offs % pg.page_size].set(k)
+                pv.value = pv.value.at[page, offs % pg.page_size].set(v)
+            lens.value = cur + q_len
+            # The kernel is single-token by design; multi-token appends
+            # (the speculative verify pass) ride the gather path below —
+            # its per-query masks handle in-block causality — so
+            # use_kernel engines still spec.
+            if pg.use_kernel and q_len == 1:
                 from ..ops.paged_attention import paged_attention
 
                 # Pages stream straight from the pool via the scalar-

@@ -460,6 +460,94 @@ def test_admission_burst_batches_prefills(rng):
         assert req.tokens == _oracle(cfg, params, prompt, n), prompt
 
 
+def test_spec_engine_matches_dense_oracle(rng):
+    """Shared-pool speculative engine (VERDICT r2 weak #4): gamma int8
+    self-draft proposals + one multi-token verify per round, concurrent
+    slots — every request's output must be EXACTLY its dense greedy
+    decode, and the pool must drain clean."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, spec_gamma=2, draft_params=qparams
+    )
+    jobs = [([3, 141, 59], 8), ([9, 10], 5), ([400, 2, 2, 17], 6)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert eng.spec_proposed > 0
+    assert 0 <= eng.spec_accepted <= eng.spec_proposed
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_spec_engine_composes_with_window_and_kernel(rng):
+    """Speculation + sliding window + the paged kernel (single-token
+    draft steps ride the kernel, the multi-token verify rides the gather
+    path) — still token-exact vs the dense windowed oracle."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    paged = PagedConfig(
+        page_size=2, num_pages=24, max_pages_per_seq=12, use_kernel=True
+    )
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, spec_gamma=3, draft_params=qparams
+    )
+    jobs = [([3, 141, 59], 9), ([9, 10], 6)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_spec_engine_eos_stops_mid_round(rng):
+    """EOS accepted mid-round must truncate the round's emissions exactly
+    where the dense decode would stop."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    prompt = [3, 141, 59]
+    oracle = _oracle(cfg, params, prompt, 8)
+    eos = oracle[2]
+    stop = oracle.index(eos) + 1
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=1, eos_id=eos,
+        spec_gamma=3, draft_params=qparams,
+    )
+    [req] = eng.run([(prompt, 8)])
+    assert req.done and req.tokens == oracle[:stop]
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_spec_engine_validation(rng):
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    with pytest.raises(ValueError, match="draft_params"):
+        ServingEngine(cfg, params, paged, spec_gamma=2)
+    with pytest.raises(ValueError, match="architecture"):
+        ServingEngine(
+            cfg, params, paged, spec_gamma=2, draft_params=qparams,
+            draft_cfg=dataclasses.replace(cfg, num_layers=1),
+        )
+    eng = ServingEngine(
+        cfg, params, paged, spec_gamma=2, draft_params=qparams
+    )
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit([1, 2], 4, temperature=1.0)
+
+
 def test_concurrent_submit_while_stepping(rng):
     """submit() is documented thread-safe against the stepping thread
     (ADVICE r2: RPC-handler + engine-loop topology).  Hammer admissions
