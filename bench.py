@@ -406,6 +406,81 @@ def _inner() -> None:
         except Exception as e:
             log(f"flash-attention bench failed: {e}")
 
+    def bench_paged_kernel() -> None:
+        """Secondary: Pallas paged-attention kernel vs the gather path at
+        serving shapes (stderr only) — the r2 VERDICT's kernel-vs-gather
+        table, captured wherever the bench runs on real hardware.  Also
+        the kernel's first Mosaic compile proof: any lowering failure
+        logs instead of killing the bench."""
+        try:
+            from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
+
+            if platform == "cpu":
+                configs = [("cpu-smoke", 2, 8, 4, 64, 8, 4, 20)]
+                iters = 2
+            else:
+                configs = [
+                    ("b4 len512 ps16", 4, 16, 4, 64, 16, 64, 512),
+                    ("b8 len1024 ps16", 8, 16, 4, 64, 16, 128, 1024),
+                    ("b8 len2048 ps32", 8, 16, 4, 64, 32, 64, 2048),
+                ]
+                iters = 30
+            for (label, b, h, kv, d, ps, mpp, fill) in configs:
+                n_pool = b * mpp + 1
+                ks = jax.random.split(jax.random.PRNGKey(0), 4)
+                q0 = jax.random.normal(ks[0], (b, h, d), jnp.bfloat16)
+                pk = jax.random.normal(
+                    ks[1], (n_pool, ps, kv, d), jnp.bfloat16
+                )
+                pv = jax.random.normal(
+                    ks[2], (n_pool, ps, kv, d), jnp.bfloat16
+                )
+                # Scrambled non-contiguous pages — the serving layout.
+                perm = jax.random.permutation(ks[3], n_pool - 1) + 1
+                import numpy as np
+
+                table = np.zeros((b, mpp), np.int32)
+                need = -(-fill // ps)
+                table[:, :need] = np.asarray(perm)[: b * need].reshape(b, need)
+                table = jnp.asarray(table)
+                lens = jnp.full((b,), fill, jnp.int32)
+
+                def gather_ref(q):
+                    kr = pk[table].reshape(b, mpp * ps, kv, d)
+                    vr = pv[table].reshape(b, mpp * ps, kv, d)
+                    qg = q.reshape(b, kv, h // kv, 1, d)
+                    s = jnp.einsum(
+                        "bhgqd,bkhd->bhgqk", qg, kr,
+                        preferred_element_type=jnp.float32,
+                    ) * (d ** -0.5)
+                    mask = (
+                        jnp.arange(mpp * ps)[None, None, None, None, :]
+                        < lens[:, None, None, None, None]
+                    )
+                    s = jnp.where(mask, s, -1e30)
+                    p = jax.nn.softmax(s, axis=-1).astype(vr.dtype)
+                    return jnp.einsum("bhgqk,bkhd->bhgqd", p, vr).reshape(
+                        b, h, d
+                    )
+
+                t_k = timed_chain(
+                    lambda q: paged_attention(
+                        q, pk, pv, table, lens,
+                        interpret=(platform == "cpu"),
+                    ).astype(q.dtype),
+                    q0,
+                    iters,
+                )
+                t_g = timed_chain(
+                    lambda q: gather_ref(q).astype(q.dtype), q0, iters
+                )
+                log(
+                    f"paged-attention {label}: kernel {t_k*1e6:.0f} us vs "
+                    f"gather {t_g*1e6:.0f} us ({t_g/t_k:.2f}x)"
+                )
+        except Exception as e:  # secondary metrics must never kill the bench
+            log(f"paged-kernel bench failed: {e}")
+
     def bench_allocation_latency() -> None:
         """Secondary metric from BASELINE.json: chip-allocation latency through
         the actual plugin gRPC path (fixture-backed, no cluster needed)."""
@@ -615,6 +690,7 @@ def _inner() -> None:
     )
     bench_lm_train()
     bench_flash_attention()
+    bench_paged_kernel()
     bench_allocation_latency()
     bench_decode_quant()
     bench_speculative()
