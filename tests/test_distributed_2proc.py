@@ -1,0 +1,91 @@
+"""REAL two-process jax.distributed formation from plugin-injected env.
+
+tests/test_distributed.py covers the env→ProcessGroupConfig derivation with
+the jax call mocked; this module spawns TWO actual processes that each call
+``distributed.initialize()`` exactly as a pod's workload would
+(deploy/k8s-job-resnet50-2host.yaml), form a process group over localhost
+DCN, build a global mesh spanning both processes' devices, and reduce a
+cross-process global array — the multi-host SPMD path end to end, minus
+only the TPU chips (CPU backend; ≙ SURVEY.md §5.8's DCN story).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from k8s_device_plugin_tpu.parallel import distributed
+
+wid, port = sys.argv[1], sys.argv[2]
+env = {{
+    "TPU_WORKER_HOSTNAMES": "localhost,localhost",
+    "TPU_WORKER_ID": wid,
+    "JAX_COORDINATOR_PORT": port,
+}}
+assert distributed.initialize(env, initialization_timeout=60)
+assert jax.process_count() == 2, jax.process_count()
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+pid = jax.process_index()
+local = np.full((1, 4), float(pid + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local
+)
+out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+total = float(np.asarray(jax.device_get(out)))
+assert total == 12.0, total  # (1+2) rows x 4 cols
+print("WORKER_OK", pid, total, flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_group_forms_and_reduces():
+    port = str(_free_port())
+    script = os.path.join(tempfile.mkdtemp(), "worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER.format(repo=REPO))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(wid), port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=REPO,
+        )
+        for wid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{err[-2000:]}"
+        assert "WORKER_OK" in out, (out, err[-2000:])
