@@ -35,9 +35,9 @@ batching, and the dense cache index is a scalar by design (a per-row index
 would un-vectorize every cache update).  The PAGED serving engine
 (models/engine.py) lifts exactly this limit — its per-slot ``seq_lens``
 vector makes per-row rewind free, so ``ServingEngine(spec_gamma=...)``
-runs this same draft/verify/rewind scheme across every slot at once over
-one shared pool (greedy mode).  This module remains the offline batch-1
-path and the home of distribution-preserving speculative SAMPLING.
+runs this same draft/verify/rewind scheme (greedy verification AND the
+acceptance-rejection sampler) across every slot at once over one shared
+pool.  This module remains the offline batch-1 path.
 """
 
 from __future__ import annotations
