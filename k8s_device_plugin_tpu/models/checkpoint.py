@@ -66,6 +66,36 @@ class CheckpointManager:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
+    def restore_params(self, step: Optional[int] = None) -> Any:
+        """Restore ONLY the parameter tree of a saved TrainState — the
+        train->serve handoff: a serving process wants the weights without
+        reconstructing the optimizer that trained them (it has no tx, and
+        the opt state can dwarf the params).  Non-param subtrees restore
+        as ``ocp.PLACEHOLDER`` (never read off disk), so peak memory is
+        the weights, not the whole TrainState.  Restores as-saved (host
+        arrays); the serving jit moves them to device on first use."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, str(step), "default")
+        tree = ocp.StandardCheckpointer().metadata(path).item_metadata.tree
+        # Non-param subtrees become PLACEHOLDER leaves — the PyTree handler
+        # (unlike Standard) skips reading them entirely.
+        skeleton = {
+            k: (
+                jax.tree.map(
+                    lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), v
+                )
+                if k == "params"
+                else jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
+            )
+            for k, v in tree.items()
+        }
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ck:
+            return ck.restore(path, args=ocp.args.PyTreeRestore(skeleton))[
+                "params"
+            ]
+
     def wait(self) -> None:
         """Block until queued async saves are durable (call before exit)."""
         self._mgr.wait_until_finished()

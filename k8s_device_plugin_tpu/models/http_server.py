@@ -1,0 +1,297 @@
+"""HTTP serving front-end over the continuous-batching engine.
+
+The reference ends at mounting device nodes into a pod (reference
+main.go:139-159); its "serving story" is an external benchmark container.
+This module is the in-pod endpoint that turns the paged
+continuous-batching engine (models/engine.py) into an actual service —
+the topology the engine's thread-safety contract was built for: HTTP
+handler threads call ``engine.submit()`` concurrently while ONE owner
+thread loops ``engine.step()``, and request completion is broadcast back
+to the waiting handlers.
+
+TPU-shaped by construction: the owner loop keeps exactly one jitted
+fixed-shape decode step hot regardless of how many requests are in
+flight; admission, completion, and HTTP never touch the compiled path.
+
+API (token-level — the framework is tokenizer-agnostic, matching the
+rest of the models/ stack which benchmarks on synthetic ids):
+
+    POST /generate   {"prompt": [int, ...], "max_new_tokens": N,
+                      "temperature": t?, "top_k": k?, "top_p": p?}
+      -> 200 {"tokens": [int, ...], "rid": R}
+    GET /healthz     -> 200 "ok" while the engine loop is alive
+    GET /metrics     -> Prometheus exposition (when a registry is wired)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils.metrics import MetricsRegistry
+from .engine import ServingEngine
+
+
+class EngineServer:
+    """Threaded HTTP server owning a ServingEngine and its step loop.
+
+    One daemon thread runs the engine (the ONLY thread that calls
+    ``step()``); ThreadingHTTPServer handler threads submit and then wait
+    on a condition the loop notifies after every step.  ``port=0`` picks
+    a free port (tests); ``.port`` reports it.
+    """
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        registry: Optional[MetricsRegistry] = None,
+        request_timeout_s: float = 600.0,
+    ):
+        self.engine = engine
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._loop_alive = False
+        self._timeout = request_timeout_s
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] != "/generate":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = body["prompt"]
+                    max_new = int(body.get("max_new_tokens", 16))
+                    kwargs = {}
+                    if "temperature" in body:
+                        kwargs["temperature"] = float(body["temperature"])
+                    if "top_k" in body:
+                        kwargs["top_k"] = int(body["top_k"])
+                    if "top_p" in body:
+                        kwargs["top_p"] = float(body["top_p"])
+                except (KeyError, TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    req = server.engine.submit(prompt, max_new, **kwargs)
+                except ValueError as e:  # validation: capacity, sampler args
+                    self._reply(422, {"error": str(e)})
+                    return
+                except TypeError as e:  # e.g. non-iterable / nested prompt
+                    self._reply(400, {"error": f"bad prompt: {e}"})
+                    return
+                with server._cond:
+                    server._cond.notify_all()  # wake an idle loop
+                    finished = server._cond.wait_for(
+                        lambda: req.done, timeout=server._timeout
+                    )
+                if not finished:
+                    self._reply(504, {"error": "generation timed out", "rid": req.rid})
+                    return
+                self._reply(200, {"tokens": req.tokens, "rid": req.rid})
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    ok = server._loop_alive and not server._stop.is_set()
+                    self._reply(200 if ok else 503, {"status": "ok" if ok else "down"})
+                elif path == "/metrics" and registry is not None:
+                    body = registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def _reply(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet under load tests
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread: Optional[threading.Thread] = None
+        self._loop_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _loop(self) -> None:
+        """The engine owner thread: step while there is work, sleep on the
+        condition while idle (a submit notifies)."""
+        self._loop_alive = True
+        try:
+            while not self._stop.is_set():
+                with self._cond:
+                    has_work = bool(self.engine.queue) or any(
+                        s is not None for s in self.engine.slots
+                    )
+                    if not has_work:
+                        # Idle: wait for a submit (or shutdown poke).
+                        self._cond.wait(timeout=0.1)
+                        continue
+                self.engine.step()  # outside the lock: submit never blocks on jit
+                with self._cond:
+                    self._cond.notify_all()
+        finally:
+            self._loop_alive = False
+            with self._cond:
+                self._cond.notify_all()  # release any waiters on shutdown
+
+    def start(self) -> "EngineServer":
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="engine-loop", daemon=True
+        )
+        self._loop_thread.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="engine-http", daemon=True
+        )
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+
+    def serve_forever(self) -> None:
+        """Block until interrupted (the in-pod entry point's main loop)."""
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """In-pod HTTP serving entry (≙ deploy/k8s-pod-serve-gpt.yaml's batch
+    CLI, but long-running): synthetic weights unless a checkpoint is
+    given, engine + loop + HTTP on --http-port, metrics co-hosted."""
+    import argparse
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.platform import honor_jax_platforms_env
+    from .benchmark import _positive_int
+    from .engine import EngineMetrics
+    from .transformer import GPTConfig, PagedConfig, TransformerLM
+
+    honor_jax_platforms_env(
+        empty_is_auto=False, log=lambda m: print(m, file=sys.stderr)
+    )
+
+    p = argparse.ArgumentParser(prog="tpu-serving-http")
+    p.add_argument("--hidden", type=_positive_int, default=512)
+    p.add_argument("--layers", type=_positive_int, default=4)
+    p.add_argument("--heads", type=_positive_int, default=8)
+    p.add_argument("--kv-heads", type=_positive_int, default=4)
+    p.add_argument("--vocab", type=_positive_int, default=32000)
+    p.add_argument("--quant", choices=["w8", "w8a8"], default=None)
+    p.add_argument("--page-size", type=_positive_int, default=16)
+    p.add_argument("--num-pages", type=_positive_int, default=128)
+    p.add_argument("--max-pages-per-seq", type=_positive_int, default=16)
+    p.add_argument("--slots", type=_positive_int, default=4)
+    p.add_argument("--use-kernel", action="store_true")
+    p.add_argument("--spec-gamma", type=int, default=0)
+    p.add_argument("--http-port", type=int, default=8000)
+    p.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help="restore params from an orbax checkpoint (models/checkpoint.py) "
+        "instead of random init — the train->serve handoff",
+    )
+    args = p.parse_args(argv)
+    if args.spec_gamma and args.quant:
+        raise SystemExit(
+            "--spec-gamma uses the int8 SELF-draft against the bf16 "
+            "target; an already-quantized target (--quant) leaves nothing "
+            "to verify against — drop one of the flags"
+        )
+
+    cfg = GPTConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        intermediate_size=args.hidden * 3,
+        max_seq=args.page_size * args.max_pages_per_seq,
+        num_kv_heads=args.kv_heads,
+    )
+    if args.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        params = CheckpointManager(args.checkpoint_dir).restore_params()
+        print(f"restored params from {args.checkpoint_dir}", file=sys.stderr)
+    else:
+        rng = jax.random.PRNGKey(0)
+        params = TransformerLM(cfg).init(
+            rng, jnp.zeros((1, 2), jnp.int32)
+        )["params"]
+    import dataclasses
+
+    spec_kw = {}
+    if args.spec_gamma:
+        from ..ops.quant import quantize_lm_params
+
+        spec_kw = dict(
+            spec_gamma=args.spec_gamma, draft_params=quantize_lm_params(params)
+        )
+    if args.quant:
+        from ..ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+        cfg = dataclasses.replace(cfg, quant=args.quant)
+    paged = PagedConfig(
+        args.page_size,
+        args.num_pages,
+        args.max_pages_per_seq,
+        use_kernel=args.use_kernel,
+    )
+    registry = MetricsRegistry()
+    engine = ServingEngine(
+        cfg,
+        params,
+        paged,
+        max_slots=args.slots,
+        metrics=EngineMetrics(registry),
+        **spec_kw,
+    )
+    server = EngineServer(
+        engine, port=args.http_port, registry=registry
+    ).start()
+    print(
+        f"serving on :{server.port} (POST /generate, GET /healthz /metrics)",
+        file=sys.stderr,
+        flush=True,
+    )
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,3 +32,10 @@ def serving_engine() -> None:
     from .models.engine import main
 
     main()
+
+
+def serving_http() -> None:
+    _require_workloads("tpu-serving-http")
+    from .models.http_server import main
+
+    main()

@@ -68,6 +68,23 @@ def test_resume_continues_identically(tmp_path):
     assert _trees_equal(resumed.params, cont.params)
 
 
+def test_restore_params_only(tmp_path):
+    """The train->serve handoff: restore just the parameter tree of a
+    saved TrainState, no optimizer reconstruction required."""
+    import numpy as np
+
+    _, batch, _, state, step = _setup(GPTConfig.tiny())
+    state, _ = step(state, batch)
+    with CheckpointManager(tmp_path / "ck") as mgr:
+        mgr.save(state, force=True)
+    params = CheckpointManager(tmp_path / "ck").restore_params()
+    want = jax.tree.leaves(state.params)
+    got = jax.tree.leaves(params)
+    assert len(want) == len(got)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g))
+
+
 def test_retention_keeps_newest(tmp_path):
     cfg = GPTConfig.tiny()
     _, batch, _, state, step = _setup(cfg)
