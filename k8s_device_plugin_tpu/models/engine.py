@@ -170,6 +170,13 @@ class ServingEngine:
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
+        if paged.use_kernel and cfg.quant_kv:
+            # Fail at the config boundary, not at the first jitted step.
+            raise ValueError(
+                "use_kernel + quant_kv is not supported (the Pallas paged "
+                "kernel streams bf16 pages); use the gather path for int8 "
+                "paged KV"
+            )
         if spec_gamma < 0:
             raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
         if spec_gamma > 0:
@@ -184,14 +191,19 @@ class ServingEngine:
                 raise ValueError("spec_gamma > 0 requires draft_params")
             if draft_cfg is None:
                 draft_cfg = dataclasses.replace(cfg, quant="w8")
-            same = dataclasses.replace(
-                draft_cfg, quant=None, quant_kv=False
-            ) == dataclasses.replace(cfg, quant=None, quant_kv=False)
+            # Only the WEIGHT format may differ: quant_kv is part of the
+            # shared pool's storage format (int8 pools + scale pools), so
+            # a draft/target mismatch would have the draft writing the
+            # wrong dtype into — and reading raw codes out of — the very
+            # pages the target owns.
+            same = dataclasses.replace(draft_cfg, quant=None) == (
+                dataclasses.replace(cfg, quant=None)
+            )
             if not same:
                 raise ValueError(
                     "engine speculation is shared-pool self-speculation: "
-                    "draft_cfg must match the target architecture (only "
-                    "quant/quant_kv may differ)"
+                    "draft_cfg must match the target architecture and "
+                    "cache format (only quant may differ)"
                 )
         self._spec_gamma = spec_gamma
         self.draft_params = draft_params
@@ -675,7 +687,9 @@ class ServingEngine:
             def paged_rows(slab):
                 rows = slab[row_idx, lo_tok:plen]
                 if pad:
-                    rows = jnp.pad(rows, ((0, pad), (0, 0), (0, 0)))
+                    rows = jnp.pad(
+                        rows, ((0, pad),) + ((0, 0),) * (rows.ndim - 1)
+                    )
                 return rows.reshape(n_priv_cover, ps, *rows.shape[1:])
 
             new_att = {
@@ -690,6 +704,17 @@ class ServingEngine:
                 new_att["pool_value"] = (
                     att["pool_value"].at[cover].set(paged_rows(src["cached_value"]))
                 )
+                if "pool_key_scale" in att:  # int8 KV: scales ride along
+                    new_att["pool_key_scale"] = (
+                        att["pool_key_scale"]
+                        .at[cover]
+                        .set(paged_rows(src["cached_key_scale"]))
+                    )
+                    new_att["pool_value_scale"] = (
+                        att["pool_value_scale"]
+                        .at[cover]
+                        .set(paged_rows(src["cached_value_scale"]))
+                    )
             self.cache[name]["attn"] = new_att
 
     def _clear_slot(self, slot: int):
@@ -1138,6 +1163,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--kv-heads", type=_positive_int, default=4)
     p.add_argument("--vocab", type=_positive_int, default=32000)
     p.add_argument("--quant", choices=["w8", "w8a8"], default=None)
+    p.add_argument(
+        "--quant-kv",
+        action="store_true",
+        help="int8 paged KV pools (halved cache bandwidth; gather path)",
+    )
     p.add_argument("--page-size", type=_positive_int, default=16)
     p.add_argument("--num-pages", type=_positive_int, default=128)
     p.add_argument("--max-pages-per-seq", type=_positive_int, default=16)
@@ -1198,6 +1228,8 @@ def main(argv: Optional[list[str]] = None) -> None:
 
         params = quantize_lm_params(params)
         cfg = dataclasses.replace(cfg, quant=args.quant)
+    if args.quant_kv:
+        cfg = dataclasses.replace(cfg, quant_kv=True)
     paged = PagedConfig(
         args.page_size,
         args.num_pages,

@@ -213,6 +213,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--kv-heads", type=_positive_int, default=4)
     p.add_argument("--vocab", type=_positive_int, default=32000)
     p.add_argument("--quant", choices=["w8", "w8a8"], default=None)
+    p.add_argument(
+        "--quant-kv",
+        action="store_true",
+        help="int8 paged KV pools (halved cache bandwidth; gather path)",
+    )
     p.add_argument("--page-size", type=_positive_int, default=16)
     p.add_argument("--num-pages", type=_positive_int, default=128)
     p.add_argument("--max-pages-per-seq", type=_positive_int, default=16)
@@ -267,6 +272,8 @@ def main(argv: Optional[list[str]] = None) -> None:
 
         params = quantize_lm_params(params)
         cfg = dataclasses.replace(cfg, quant=args.quant)
+    if args.quant_kv:
+        cfg = dataclasses.replace(cfg, quant_kv=True)
     paged = PagedConfig(
         args.page_size,
         args.num_pages,

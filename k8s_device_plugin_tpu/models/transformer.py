@@ -308,13 +308,34 @@ class CausalSelfAttention(nn.Module):
             # rows into pages, and it reserves page 0 as the idle-slot
             # scratch target so inactive rows never collide with live
             # pages.
-            if cfg.quant_kv:
-                raise ValueError("paged + quant_kv is not supported yet")
             pg = cfg.paged
+            if pg.use_kernel and cfg.quant_kv:
+                raise ValueError(
+                    "use_kernel + quant_kv is not supported (the Pallas "
+                    "paged kernel streams bf16 pages); use the gather path "
+                    "for int8 paged KV"
+                )
             batch, q_len = hidden.shape[:2]
             pool_shape = (pg.num_pages, pg.page_size, cfg.kv_heads, cfg.head_dim)
-            pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, k.dtype)
-            pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, v.dtype)
+            if cfg.quant_kv:
+                # int8 page pools + per-(slot, head) scale pools: the same
+                # KV-bandwidth halving the dense cache gets, for paged
+                # serving (long context is exactly where the pool is big).
+                pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, jnp.int8)
+                pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, jnp.int8)
+                sshape = (pg.num_pages, pg.page_size, cfg.kv_heads)
+                psk = self.variable(
+                    "cache", "pool_key_scale", jnp.zeros, sshape, jnp.float32
+                )
+                psv = self.variable(
+                    "cache", "pool_value_scale", jnp.zeros, sshape, jnp.float32
+                )
+                k_store, ks = quantize_kv(k)
+                v_store, vs = quantize_kv(v)
+            else:
+                pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, k.dtype)
+                pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, v.dtype)
+                k_store, v_store = k, v
             table = self.variable(
                 "cache",
                 "page_table",
@@ -328,8 +349,11 @@ class CausalSelfAttention(nn.Module):
                 row = jnp.arange(batch)
                 page = table.value[row, cur // pg.page_size]
                 off = cur % pg.page_size
-                pk.value = pk.value.at[page, off].set(k[:, 0])
-                pv.value = pv.value.at[page, off].set(v[:, 0])
+                pk.value = pk.value.at[page, off].set(k_store[:, 0])
+                pv.value = pv.value.at[page, off].set(v_store[:, 0])
+                if cfg.quant_kv:
+                    psk.value = psk.value.at[page, off].set(ks[:, 0])
+                    psv.value = psv.value.at[page, off].set(vs[:, 0])
             else:
                 # Multi-token paged append (the speculative verify pass):
                 # scatter q_len consecutive positions per row through the
@@ -339,8 +363,11 @@ class CausalSelfAttention(nn.Module):
                 page = table.value[
                     jnp.arange(batch)[:, None], offs // pg.page_size
                 ]
-                pk.value = pk.value.at[page, offs % pg.page_size].set(k)
-                pv.value = pv.value.at[page, offs % pg.page_size].set(v)
+                pk.value = pk.value.at[page, offs % pg.page_size].set(k_store)
+                pv.value = pv.value.at[page, offs % pg.page_size].set(v_store)
+                if cfg.quant_kv:
+                    psk.value = psk.value.at[page, offs % pg.page_size].set(ks)
+                    psv.value = psv.value.at[page, offs % pg.page_size].set(vs)
             lens.value = cur + q_len
             # The kernel is single-token by design; multi-token appends
             # (the speculative verify pass) ride the gather path below —
@@ -370,6 +397,23 @@ class CausalSelfAttention(nn.Module):
                 vr = pv.value[table.value].reshape(
                     batch, pg.max_len, cfg.kv_heads, cfg.head_dim
                 )
+                if cfg.quant_kv:
+                    # int8 stays the HBM format; the dequant fuses into
+                    # the gather/einsum reads (≙ the dense quant_kv path).
+                    kr = dequantize_kv(
+                        kr,
+                        psk.value[table.value].reshape(
+                            batch, pg.max_len, cfg.kv_heads
+                        ),
+                        cfg.dtype,
+                    )
+                    vr = dequantize_kv(
+                        vr,
+                        psv.value[table.value].reshape(
+                            batch, pg.max_len, cfg.kv_heads
+                        ),
+                        cfg.dtype,
+                    )
                 attn = cached_group_attention(
                     q, kr, vr, positions, cfg.attention_window, cfg.num_heads
                 )

@@ -460,6 +460,56 @@ def test_admission_burst_batches_prefills(rng):
         assert req.tokens == _oracle(cfg, params, prompt, n), prompt
 
 
+def test_engine_with_int8_paged_kv(rng):
+    """quant_kv on the paged engine: int8 page pools + per-(slot, head)
+    scale pools, grafted from the dense int8 prefill and appended
+    quantized — tokens match the dense quant_kv oracle exactly, and the
+    pools really are int8."""
+    cfg = _cfg(quant_kv=True)
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    att = eng.cache["layer_0"]["attn"]
+    assert att["pool_key"].dtype == jnp.int8
+    assert att["pool_key_scale"].shape == (32, 4, cfg.kv_heads)
+    jobs = [([3, 141, 59], 7), ([9, 10], 5)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_engine_int8_kv_composes_with_window_and_spec(rng):
+    """quant_kv + sliding window + speculation on one engine: the draft
+    writes quantized approximate K/V, the verify overwrites quantized
+    target K/V, reclamation frees scrolled pages — tokens still match
+    the dense windowed quant_kv oracle."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg(quant_kv=True, attention_window=4)
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    paged = PagedConfig(page_size=2, num_pages=24, max_pages_per_seq=12)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, spec_gamma=2, draft_params=qparams
+    )
+    jobs = [([3, 141, 59], 9), ([9, 10], 6)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_kernel_plus_quant_kv_rejected(rng):
+    cfg = _cfg(quant_kv=True)
+    params = _params(cfg, rng)
+    paged = PagedConfig(
+        page_size=4, num_pages=16, max_pages_per_seq=8, use_kernel=True
+    )
+    with pytest.raises(ValueError, match="quant_kv"):
+        ServingEngine(cfg, params, paged, max_slots=1)
+
+
 def test_spec_engine_matches_dense_oracle(rng):
     """Shared-pool speculative engine (VERDICT r2 weak #4): gamma int8
     self-draft proposals + one multi-token verify per round, concurrent
