@@ -274,6 +274,24 @@ def _inner() -> None:
                 f"transformer-lm fused-xent: {ftps:.0f} tokens/sec "
                 f"({ftps / max(tps, 1e-9):.2f}x vs naive tail, loss {float(floss):.3f})"
             )
+            if platform != "cpu":
+                # Chunk-size sweep (r2 VERDICT weak #7: 0.95x at the default
+                # — tune or gate).  Stderr table; the winning chunk becomes
+                # the default once a hardware run picks one.
+                for chunk in (cfg.vocab_size // 8, cfg.vocab_size // 2, cfg.vocab_size):
+                    try:
+                        s3 = create_train_state(
+                            rng, model, batch, tx, input_key="input_ids"
+                        )
+                        cstep = make_fused_lm_train_step(model, tx, chunk=chunk)
+                        s3, _, cdt = timed_steps(cstep, s3, batch, warmup, steps)
+                        ctps = batch_size * seq * steps / cdt
+                        log(
+                            f"  fused-xent chunk {chunk}: {ctps:.0f} tokens/sec "
+                            f"({ctps / max(tps, 1e-9):.2f}x vs naive)"
+                        )
+                    except Exception as e:
+                        log(f"  fused-xent chunk {chunk}: failed ({e})")
         except Exception as e:  # secondary metrics must never kill the bench
             log(f"lm bench failed: {e}")
 
