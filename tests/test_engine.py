@@ -721,6 +721,58 @@ def test_engine_fuzz_random_schedules(rng):
         assert len(eng._prefill_cache) <= 6, trial
 
 
+def test_engine_feature_matrix_fuzz(rng):
+    """Randomized blanket over the COMPOSED feature matrix: window x
+    kernel x quant_kv x speculation x sampling, random geometries and
+    request mixes — greedy requests must reproduce the dense oracle for
+    that config exactly, pools must drain, and restricted sampling must
+    stay inside its top-k."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    npr = np.random.RandomState(13)
+    for trial in range(4):
+        window = int(npr.choice([0, 4]))
+        use_kernel = bool(npr.randint(2))
+        quant_kv = bool(npr.randint(2)) and not use_kernel
+        spec = int(npr.choice([0, 2]))
+        cfg = _cfg(
+            attention_window=window or None, quant_kv=quant_kv
+        )
+        params = _params(cfg, rng)
+        paged = PagedConfig(
+            page_size=int(npr.choice([2, 4])),
+            num_pages=32,
+            max_pages_per_seq=12,
+            use_kernel=use_kernel,
+        )
+        kw = {}
+        if spec:
+            kw = dict(spec_gamma=spec, draft_params=quantize_lm_params(params))
+        eng = ServingEngine(
+            cfg, params, paged, max_slots=2,
+            rng=jax.random.PRNGKey(trial), **kw,
+        )
+        jobs = []
+        for _ in range(3):
+            plen = int(npr.choice([2, 5]))
+            jobs.append((npr.randint(0, cfg.vocab_size, size=plen).tolist(),
+                         int(npr.choice([3, 6]))))
+        subs = [eng.submit(p, n) for p, n in jobs]
+        # One sampled request rides along (top_k=1 => oracle-exact even
+        # through speculation's acceptance-rejection path).
+        sampled = eng.submit(jobs[0][0], 4, temperature=5.0, top_k=1)
+        guard = 0
+        while not (all(r.done for r in subs) and sampled.done):
+            eng.step()
+            guard += 1
+            assert guard < 2000, (trial, "engine failed to drain")
+        label = (trial, window, use_kernel, quant_kv, spec)
+        for (prompt, n), req in zip(jobs, subs):
+            assert req.tokens == _oracle(cfg, params, prompt, n), label
+        assert sampled.tokens == _oracle(cfg, params, jobs[0][0], 4), label
+        assert len(eng.free_pages) == paged.num_pages - 1, label
+
+
 def test_engine_cli_smoke():
     """The in-pod serving entry point (deploy/k8s-pod-serve-gpt.yaml)
     prints one parseable JSON throughput line."""
