@@ -50,6 +50,19 @@ from .transformer import (
 )
 
 
+def _pow2_int(text: str) -> int:
+    """argparse type: positive power of two (chunk sizes must tile the
+    power-of-two length buckets)."""
+    import argparse
+
+    value = int(text)
+    if value < 1 or value & (value - 1):
+        raise argparse.ArgumentTypeError(
+            f"must be a positive power of two, got {value}"
+        )
+    return value
+
+
 def filter_top_k_top_p(scaled, top_k, top_p):
     """Mask ``scaled`` logits [batch, vocab] to each row's top-k tokens and
     smallest nucleus with mass >= top_p — with PER-ROW traced ``top_k``
@@ -167,6 +180,7 @@ class ServingEngine:
         spec_gamma: int = 0,
         draft_params: Any = None,
         draft_cfg: Optional[GPTConfig] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -179,6 +193,14 @@ class ServingEngine:
             )
         if spec_gamma < 0:
             raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
+        if prefill_chunk is not None and (
+            prefill_chunk < 1 or prefill_chunk & (prefill_chunk - 1)
+        ):
+            # Power of two so chunks tile every power-of-two length bucket.
+            raise ValueError(
+                f"prefill_chunk must be a power of two, got {prefill_chunk}"
+            )
+        self._prefill_chunk = prefill_chunk
         if spec_gamma > 0:
             # Shared-pool speculation: the draft writes its (approximate)
             # K/V at the frontier and the verify pass overwrites those
@@ -267,7 +289,13 @@ class ServingEngine:
 
         self._step = step
         self._step_plain = step_plain
-        self._dense = TransformerLM(self.dense_cfg, decode=True)
+        # ALL prefill runs through the multi-token CACHED append (the
+        # speculative verifier's path): each chunk attends against the
+        # K/V of every previous chunk via position masks, so a prompt can
+        # be consumed across several bounded dispatches — or one.
+        self._dense_chunk = TransformerLM(
+            self.dense_cfg, decode=True, append_mode="cached"
+        )
 
         if spec_gamma > 0:
             draft_model = TransformerLM(
@@ -487,6 +515,15 @@ class ServingEngine:
         # frontier reaches them — per-row traffic is O(len), not
         # O(allocated).
         self._slot_visible: list[int] = [0] * max_slots
+        # A reserved slot decodes only after its prefill job grafted it
+        # (chunked prefill spans several step() calls; until ready the
+        # slot behaves exactly like an idle one in the jitted step).
+        self._slot_ready: list[bool] = [False] * max_slots
+        self._pending: list[dict] = []  # in-flight prefill jobs
+        # Private pages of not-yet-grafted requests: the prefix-sharing
+        # match refuses them (see _match_prefix) until _activate removes
+        # them post-graft.
+        self._pending_pages: set[int] = set()
         self.queue: deque[Request] = deque()
         # submit() is documented callable from other threads (the serving
         # topology: an RPC handler enqueues while the owner thread loops
@@ -580,67 +617,101 @@ class ServingEngine:
             self._update_gauges()
         return req
 
-    def _prefill_fn(self, bucket_len: int, batch: int):
-        """Jitted dense prefill for one (LENGTH BUCKET, BATCH BUCKET)
-        pair, cached on THIS instance (a process-global lru_cache would
-        pin the engine — params tree and page pools included — beyond its
-        lifetime)."""
-        fn = self._prefill_cache.get((bucket_len, batch))
+    def _prefill_chunk_fn(self, chunk: int, batch: int):
+        """Jitted CHUNK prefill: one multi-token cached append of ``chunk``
+        tokens at traced offset pos0 into a carried dense cache.  One
+        compiled program per (chunk, batch) pair serves every chunk index
+        of every bucket (the unchunked path is simply chunk == bucket).
+        Cached on THIS instance (a process-global lru_cache would pin the
+        engine — params tree and page pools included — beyond its
+        lifetime).  The carried cache is donated: the host rebinds
+        job["cache"] from the output, so without donation every chunk
+        would copy the whole [batch, max_len] dense cache."""
+        key = (chunk, batch)
+        fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
-        spec = decode_cache_spec(self._dense, batch)
 
-        def run(params, prompts, last_idx):
-            cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        def run(params, cache, tokens, pos0, last_idx):
             pos = jnp.broadcast_to(
-                jnp.arange(bucket_len)[None, :], (batch, bucket_len)
+                pos0 + jnp.arange(chunk)[None, :], (batch, chunk)
             )
-            logits, mut = self._dense.apply(
-                {"params": params, "cache": cache}, prompts, pos,
+            logits, mut = self._dense_chunk.apply(
+                {"params": params, "cache": cache}, tokens, pos,
                 mutable=["cache"],
             )
-            # Slice each row's true last position INSIDE the program
-            # (last_idx is traced, so one compiled program serves every
-            # length in the bucket while XLA returns [batch, vocab] rows
-            # instead of materializing [batch, bucket, vocab]).  The
-            # sampler (greedy or per-request temperature/top-k/top-p)
-            # stays the host's choice at admission.
-            return logits[jnp.arange(batch), last_idx], mut["cache"]
+            # Each row's true-last-position logits, valid only when
+            # last_idx falls inside this chunk (the host keeps the row
+            # from the covering chunk).
+            sel = jnp.clip(last_idx - pos0, 0, chunk - 1)
+            return logits[jnp.arange(batch), sel], mut["cache"]
 
-        fn = jax.jit(run)
-        self._prefill_cache[(bucket_len, batch)] = fn
+        fn = jax.jit(run, donate_argnums=(1,))
+        self._prefill_cache[key] = fn
         return fn
 
-    def _prefill_batch(self, prompts: list[list[int]]):
-        """Run ONE dense prefill over all same-length-bucket prompts.
+    def _start_prefill(self, items: list[tuple[int, "Request", list[int], int]]):
+        """Create one prefill JOB for a same-length-bucket admission group.
 
         Length padding is sound because attention is causal — positions
         >= plen cannot influence logits[plen-1] — and _graft copies only
         rows [:plen] into pages, so the padded tail's garbage K/V never
         leaves the throwaway dense cache.  The batch dim is padded to a
         power of two (repeating the first prompt; its extra rows are
-        discarded), so an admission burst of N prompts costs ONE
-        MXU-shaped dispatch instead of N serial ones, and the number of
-        compiled prefill programs stays O(log max_len * log max_slots)
-        for arbitrary request mixes.
+        discarded), so an admission burst of N prompts costs ONE dispatch
+        per chunk instead of N serial prefills, and the number of
+        compiled prefill programs stays O(log max_len * log max_slots).
 
-        Returns (last_logits [n, vocab], dense_cache, bucket) covering
-        exactly the ``n = len(prompts)`` real prompts (cache rows beyond
-        n are padding).
+        Without ``prefill_chunk`` the job is a single full-bucket chunk
+        and completes on its first advance (same step() call it was
+        admitted in); with chunking, step() advances ONE chunk per call,
+        so active slots stall at most one chunk's compute per step while
+        a long prompt streams in.
         """
+        prompts = [it[1].prompt for it in items]
         longest = max(len(p) for p in prompts)
         bucket = min(1 << (longest - 1).bit_length(), self.paged.max_len)
+        chunk = min(self._prefill_chunk or bucket, bucket)
         n = len(prompts)
         batch = 1 << (n - 1).bit_length()
         rows = [p + [0] * (bucket - len(p)) for p in prompts]
         rows += [rows[0]] * (batch - n)
         last_idx = [len(p) - 1 for p in prompts] + [0] * (batch - n)
-        logits, cache = self._prefill_fn(bucket, batch)(
-            self.params,
-            jnp.asarray(rows, jnp.int32),
-            jnp.asarray(last_idx, jnp.int32),
+        spec = decode_cache_spec(self._dense_chunk, batch)
+        self._pending.append(
+            {
+                "items": items,
+                "bucket": bucket,
+                "chunk": chunk,
+                "batch": batch,
+                "rows": jnp.asarray(rows, jnp.int32),
+                "last_idx_host": last_idx,
+                "last_idx": jnp.asarray(last_idx, jnp.int32),
+                "cache": jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), spec
+                ),
+                "pos": 0,
+                "logits": [None] * n,
+            }
         )
-        return logits[:n], cache
+
+    def _advance_prefill(self, job: dict) -> bool:
+        """Run ONE chunk of a pending prefill job; True when complete."""
+        chunk, pos = job["chunk"], job["pos"]
+        fn = self._prefill_chunk_fn(chunk, job["batch"])
+        tokens = jax.lax.slice_in_dim(job["rows"], pos, pos + chunk, axis=1)
+        logits_rows, job["cache"] = fn(
+            self.params,
+            job["cache"],
+            tokens,
+            jnp.asarray(pos, jnp.int32),
+            job["last_idx"],
+        )
+        for i in range(len(job["items"])):
+            if pos <= job["last_idx_host"][i] < pos + chunk:
+                job["logits"][i] = logits_rows[i]
+        job["pos"] = pos + chunk
+        return job["pos"] >= job["bucket"]
 
     def _graft(
         self,
@@ -736,6 +807,7 @@ class ServingEngine:
         self._slot_topp[slot] = 1.0
         self._slot_page_base[slot] = 0
         self._slot_visible[slot] = 0
+        self._slot_ready[slot] = False
 
     def _release_page(self, page: int) -> None:
         """Drop one reference; at zero, tear down every trie link touching
@@ -762,9 +834,23 @@ class ServingEngine:
                         keys.remove(key)
             self.free_pages.append(page)
 
-    def _match_prefix(self, prompt: list[int]) -> list[int]:
+    def _match_prefix(
+        self,
+        prompt: list[int],
+        bucket: int,
+        burst_pages: dict[int, int],
+    ) -> list[int]:
         """Longest chain of live registered pages whose token chunks equal
-        this prompt's leading FULL pages (trie walk: O(prompt))."""
+        this prompt's leading FULL pages (trie walk: O(prompt)).
+
+        A page may only be shared once its content is guaranteed written
+        before this request's first decode step: pages of ACTIVATED
+        requests always qualify; pages of a still-pending prefill job do
+        NOT (the owner's graft is deferred — sharing them would decode
+        against zeros), EXCEPT pages admitted in this same burst with the
+        same length bucket — those land in the same job, whose _activate
+        grafts every item before any of them decodes.
+        """
         ps = self.paged.page_size
         pages: list[int] = []
         parent = -1
@@ -773,6 +859,11 @@ class ServingEngine:
             page = self._prefix_pages.get((parent, chunk))
             if page is None:
                 break
+            if page in burst_pages:
+                if burst_pages[page] != bucket:
+                    break  # different bucket -> different job -> unsafe
+            elif page in self._pending_pages:
+                break  # owner's job from an earlier step not grafted yet
             pages.append(page)
             parent = page
         return pages
@@ -789,6 +880,7 @@ class ServingEngine:
         the dense prefills by length bucket and grafts each row.
         """
         admitted: list[tuple[int, Request, list[int], int]] = []
+        burst_pages: dict[int, int] = {}  # page -> length bucket, this burst
         for slot in range(self.max_slots):
             # Queue peek/pop under the lock (submit() appends from other
             # threads); everything after the pop touches owner-only state.
@@ -797,12 +889,15 @@ class ServingEngine:
                     continue
                 req = self.queue[0]
                 plen = len(req.prompt)
+                bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
                 n_pages = math.ceil(
                     (plen + req.max_new_tokens + self._spec_gamma)
                     / self.paged.page_size
                 )
                 shared = (
-                    self._match_prefix(req.prompt) if self.prefix_sharing else []
+                    self._match_prefix(req.prompt, bucket, burst_pages)
+                    if self.prefix_sharing
+                    else []
                 )
                 n_private = n_pages - len(shared)
                 if n_private > len(self.free_pages):
@@ -818,6 +913,10 @@ class ServingEngine:
                     self._page_refs[page] += 1
                 for page in private:
                     self._page_refs[page] = 1
+                    # Ungrafted until _activate: shareable within this
+                    # burst's same-bucket group only.
+                    burst_pages[page] = bucket
+                    self._pending_pages.add(page)
                 if self.prefix_sharing:
                     # Register this prompt's full pages (shared or fresh) as
                     # trie links so later same-prefix requests can ride them
@@ -839,62 +938,70 @@ class ServingEngine:
                 self._slot_pages[slot] = pages
             admitted.append((slot, req, pages, len(shared)))
 
-        finished: list[Request] = []
         if not admitted:
-            return finished
-        # Group by length bucket; each group is ONE batched prefill.
+            return []
+        # Group by length bucket; each group becomes ONE prefill job
+        # (advanced chunk-by-chunk from step()).
         groups: dict[int, list[tuple[int, Request, list[int], int]]] = {}
         for item in admitted:
             plen = len(item[1].prompt)
             bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
             groups.setdefault(bucket, []).append(item)
         for items in groups.values():
-            logits_rows, dense_cache = self._prefill_batch(
-                [it[1].prompt for it in items]
+            self._start_prefill(items)
+        return []
+
+    def _activate(self, job: dict) -> list[Request]:
+        """Graft a completed prefill job's K/V into pages, sample each
+        request's first token, and mark the slots ready to decode."""
+        finished: list[Request] = []
+        for row_idx, (slot, req, pages, n_shared) in enumerate(job["items"]):
+            plen = len(req.prompt)
+            self._graft(
+                slot, job["cache"], pages, plen, n_shared, row_idx=row_idx
             )
-            for row_idx, (slot, req, pages, n_shared) in enumerate(items):
-                plen = len(req.prompt)
-                self._graft(
-                    slot, dense_cache, pages, plen, n_shared, row_idx=row_idx
+            # Grafted: the private pages are now real K/V and may be
+            # prefix-shared by any later request.
+            self._pending_pages.difference_update(pages[n_shared:])
+            last_logits = job["logits"][row_idx]
+            # A greedy slot's token is the argmax regardless of
+            # top_k/top_p, so normalize them to "off" — otherwise one
+            # greedy+top_k request would drag the whole batch onto the
+            # filtered (sorting) step path for zero output change.
+            if req.temperature > 0:
+                topk = (
+                    req.top_k
+                    if req.top_k is not None
+                    else self.cfg.vocab_size
                 )
-                last_logits = logits_rows[row_idx]
-                # A greedy slot's token is the argmax regardless of
-                # top_k/top_p, so normalize them to "off" — otherwise one
-                # greedy+top_k request would drag the whole batch onto the
-                # filtered (sorting) step path for zero output change.
-                if req.temperature > 0:
-                    topk = (
-                        req.top_k
-                        if req.top_k is not None
-                        else self.cfg.vocab_size
-                    )
-                    topp = req.top_p if req.top_p is not None else 1.0
-                else:
-                    topk, topp = self.cfg.vocab_size, 1.0
-                if req.temperature > 0:
-                    # Same filter math as the jitted step — the admission
-                    # token must come from the same restricted distribution.
-                    self._rng, sub = jax.random.split(self._rng)
-                    filtered = filter_top_k_top_p(
-                        (last_logits / req.temperature)[None, :],
-                        jnp.asarray([topk], jnp.int32),
-                        jnp.asarray([topp], jnp.float32),
-                    )
-                    first = int(jax.random.categorical(sub, filtered[0]))
-                else:
-                    first = int(jnp.argmax(last_logits))
-                req.tokens.append(first)
-                self._slot_last[slot] = first
-                self._slot_len[slot] = plen
-                self._slot_temp[slot] = req.temperature
-                self._slot_topk[slot] = topk
-                self._slot_topp[slot] = topp
-                if self.metrics:
-                    self.metrics.requests.inc()
-                    self.metrics.tokens.inc()
-                self._maybe_finish(slot)
-                if req.done:
-                    finished.append(req)
+                topp = req.top_p if req.top_p is not None else 1.0
+            else:
+                topk, topp = self.cfg.vocab_size, 1.0
+            if req.temperature > 0:
+                # Same filter math as the jitted step — the admission
+                # token must come from the same restricted distribution.
+                self._rng, sub = jax.random.split(self._rng)
+                filtered = filter_top_k_top_p(
+                    (last_logits / req.temperature)[None, :],
+                    jnp.asarray([topk], jnp.int32),
+                    jnp.asarray([topp], jnp.float32),
+                )
+                first = int(jax.random.categorical(sub, filtered[0]))
+            else:
+                first = int(jnp.argmax(last_logits))
+            req.tokens.append(first)
+            self._slot_last[slot] = first
+            self._slot_len[slot] = plen
+            self._slot_temp[slot] = req.temperature
+            self._slot_topk[slot] = topk
+            self._slot_topp[slot] = topp
+            self._slot_ready[slot] = True
+            if self.metrics:
+                self.metrics.requests.inc()
+                self.metrics.tokens.inc()
+            self._maybe_finish(slot)
+            if req.done:
+                finished.append(req)
         return finished
 
     def _maybe_finish(self, slot: int):
@@ -914,7 +1021,19 @@ class ServingEngine:
         every request that finished this step (including ones done at
         admission — EOS/max_new on the prefill token)."""
         finished = self._admit()
-        active = [s for s in range(self.max_slots) if self.slots[s] is not None]
+        # Advance every in-flight prefill job by ONE chunk (an unchunked
+        # job completes right here, in the same step() it was admitted):
+        # chunking bounds how long active slots stall per step while a
+        # long prompt streams in.
+        for job in list(self._pending):
+            if self._advance_prefill(job):
+                self._pending.remove(job)
+                finished.extend(self._activate(job))
+        active = [
+            s
+            for s in range(self.max_slots)
+            if self.slots[s] is not None and self._slot_ready[s]
+        ]
         if not active:
             self._update_gauges()
             return finished
@@ -1204,6 +1323,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         "decode, sampled slots marginally exact filtered samples). "
         "Incompatible with --quant.",
     )
+    p.add_argument(
+        "--prefill-chunk",
+        type=_pow2_int,
+        default=None,
+        help="stream prompts into the prefill in chunks of this many "
+        "tokens (power of two), bounding how long active slots stall "
+        "per step during a long admission",
+    )
     args = p.parse_args(argv)
     if args.spec_gamma and args.quant:
         raise SystemExit(
@@ -1244,7 +1371,10 @@ def main(argv: Optional[list[str]] = None) -> None:
             spec_gamma=args.spec_gamma,
             draft_params=quantize_lm_params(params),
         )
-    eng = ServingEngine(cfg, params, paged, max_slots=args.slots, **spec_kw)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=args.slots,
+        prefill_chunk=args.prefill_chunk, **spec_kw,
+    )
     sample_kw = dict(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
     )

@@ -199,7 +199,7 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     from ..utils.platform import honor_jax_platforms_env
     from .benchmark import _positive_int
-    from .engine import EngineMetrics
+    from .engine import EngineMetrics, _pow2_int
     from .transformer import GPTConfig, PagedConfig, TransformerLM
 
     honor_jax_platforms_env(
@@ -224,6 +224,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--slots", type=_positive_int, default=4)
     p.add_argument("--use-kernel", action="store_true")
     p.add_argument("--spec-gamma", type=int, default=0)
+    p.add_argument(
+        "--prefill-chunk",
+        type=_pow2_int,
+        default=None,
+        help="stream prompts into the prefill in chunks (power of two)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--checkpoint-dir",
@@ -287,6 +293,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         paged,
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
+        prefill_chunk=args.prefill_chunk,
         **spec_kw,
     )
     server = EngineServer(

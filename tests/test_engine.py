@@ -438,13 +438,13 @@ def test_admission_burst_batches_prefills(rng):
     paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
     eng = ServingEngine(cfg, params, paged, max_slots=4)
     calls = []
-    orig = eng._prefill_batch
+    orig = eng._start_prefill
 
-    def counting(prompts):
-        calls.append(len(prompts))
-        return orig(prompts)
+    def counting(items):
+        calls.append(len(items))
+        return orig(items)
 
-    eng._prefill_batch = counting
+    eng._start_prefill = counting
     jobs = [
         ([3, 141, 59], 5),        # bucket 4
         ([400, 2, 2, 17], 5),     # bucket 4
@@ -719,6 +719,107 @@ def test_engine_fuzz_random_schedules(rng):
         # buckets {4, 8} and admission-burst sizes in {1, 2, 4}, so at
         # most 6 prefill programs compiled (O(log lens x log slots)).
         assert len(eng._prefill_cache) <= 6, trial
+
+
+def test_chunked_prefill_matches_oracle(rng):
+    """prefill_chunk streams a long prompt into the dense bridge across
+    several bounded dispatches (multi-token cached appends) — output
+    identical to the one-shot prefill, for chunk sizes below, at, and
+    above the bucket."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    prompt = [3, 141, 59, 265, 35, 7, 7, 3, 1, 2, 9, 4]  # bucket 16
+    want = _oracle(cfg, params, prompt, 6)
+    for chunk in (4, 16, 32):
+        eng = ServingEngine(
+            cfg, params, paged, max_slots=2, prefill_chunk=chunk
+        )
+        [req] = eng.run([(prompt, 6)])
+        assert req.tokens == want, chunk
+
+
+def test_chunked_prefill_interleaves_with_decode(rng):
+    """While a long prompt streams in chunk by chunk, an already-active
+    slot must KEEP emitting one token per step (the stall-bounding
+    property chunking exists for), and the late request still matches
+    its oracle."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2, prefill_chunk=4)
+    early = eng.submit([3, 141, 59], 12)
+    eng.step()  # admit + first decode token
+    assert len(early.tokens) >= 1 and not early.done
+    long_prompt = [7, 7, 3, 1, 2, 9, 4, 11, 13, 2, 5, 8]  # bucket 16 -> 4 chunks
+    late = eng.submit(long_prompt, 4)
+    progressed = []
+    for _ in range(4):  # the 4 chunk steps
+        before = len(early.tokens)
+        eng.step()
+        progressed.append(len(early.tokens) - before)
+        if late.tokens:
+            break
+    assert all(p >= 1 for p in progressed), (
+        f"active slot stalled during chunked prefill: {progressed}"
+    )
+    assert late.tokens, "late request never activated"
+    while not (early.done and late.done):
+        eng.step()
+    assert early.tokens == _oracle(cfg, params, [3, 141, 59], 12)
+    assert late.tokens == _oracle(cfg, params, long_prompt, 4)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_chunked_prefill_prefix_share_waits_for_graft(rng):
+    """A later request must NOT prefix-share pages whose owner's chunked
+    prefill hasn't grafted yet (it would decode against zeros): B (small
+    bucket, finishes prefill first) arrives while A (large bucket) is
+    still streaming in — B's tokens must still match its oracle, and
+    sharing must resume once the owner has activated."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    ps = 4
+    paged = PagedConfig(page_size=ps, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=3, prefill_chunk=4)
+    a_prompt = [3, 141, 59, 265, 35, 7, 7, 3, 1, 2, 9, 4]  # bucket 16: 4 chunks
+    a = eng.submit(a_prompt, 4)
+    eng.step()  # job A advances 1 chunk (not done)
+    assert not eng._slot_ready[0]
+    b_prompt = a_prompt[:ps] + [99]  # shares A's first FULL page; bucket 8
+    b = eng.submit(b_prompt, 4)
+    while not (a.done and b.done):
+        eng.step()
+    assert a.tokens == _oracle(cfg, params, a_prompt, 4)
+    assert b.tokens == _oracle(cfg, params, b_prompt, 4)
+    # After A ran to completion its pages were freed; a fresh same-prefix
+    # pair admitted together (same bucket -> same job) still shares.
+    c = eng.submit(a_prompt, 3)
+    d = eng.submit(a_prompt[:ps] + [98, 97, 96, 95], 3)  # bucket 8 vs 16
+    while not (c.done and d.done):
+        eng.step()
+    assert c.tokens == _oracle(cfg, params, a_prompt, 3)
+    assert d.tokens == _oracle(
+        cfg, params, a_prompt[:ps] + [98, 97, 96, 95], 3
+    )
+
+
+def test_chunked_prefill_composes_with_spec_and_window(rng):
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    qparams = quantize_lm_params(params)
+    paged = PagedConfig(page_size=2, num_pages=32, max_pages_per_seq=14)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, prefill_chunk=4,
+        spec_gamma=2, draft_params=qparams,
+    )
+    jobs = [([3, 141, 59, 265, 35, 7, 7, 3, 1], 8), ([9, 10], 5)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
 
 
 def test_engine_feature_matrix_fuzz(rng):
