@@ -199,7 +199,9 @@ def build(model_name: str, args, rng):
         batch = synthetic_image_batch(rng, args.batch_size, args.image_size)
         return model, batch, "images", args.batch_size
     if model_name == "resnet50":
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        model = ResNet50(
+            num_classes=1000, dtype=jnp.bfloat16, stem=args.stem
+        )
         batch = synthetic_image_batch(rng, args.batch_size, args.image_size)
         return model, batch, "images", args.batch_size
     if model_name == "vit":
@@ -486,6 +488,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument(
         "--top-k", type=_positive_int, default=None,
         help="gpt-decode: restrict sampling to the k highest logits",
+    )
+    p.add_argument(
+        "--stem",
+        choices=["conv7", "space_to_depth"],
+        default="conv7",
+        help="resnet50 stem: standard 7x7/s2 conv or the space-to-depth "
+        "packing (geometry-equivalent, MXU-friendlier — models/resnet.py)",
     )
     p.add_argument("--tiny", action="store_true", help="tiny model config (CPU smoke; gpt and vit)")
     p.add_argument(

@@ -61,11 +61,41 @@ class ResNet(nn.Module):
     # A/Bs it; default stays float32, the configuration the 2051 ips
     # r3 headline was measured with.
     norm_dtype: Any = jnp.float32
+    # "conv7" (the standard 7x7/s2 stem) or "space_to_depth": pack 2x2
+    # pixel blocks into channels ([H,W,3] -> [H/2,W/2,12]) and run a
+    # 4x4/s1 conv — the same receptive-field geometry (a zero-padded 7x7
+    # kernel maps onto it exactly; tests/test_models.py pins the
+    # equivalence), but the MXU sees 12 input channels instead of 3 and
+    # a quarter the spatial positions, so the stem tiles instead of
+    # running ~3/8ths empty.  Opt-in pending a hardware A/B.
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, images, *, train: bool = False):
         x = images.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        if self.stem == "space_to_depth":
+            n, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"stem='space_to_depth' packs 2x2 pixel blocks and "
+                    f"needs even spatial dims, got {h}x{w}; use stem="
+                    f"'conv7' for odd sizes"
+                )
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            x = nn.Conv(
+                self.width, (4, 4), strides=(1, 1), use_bias=False,
+                dtype=self.dtype, name="Conv_stem",
+            )(x)
+        elif self.stem == "conv7":
+            x = nn.Conv(
+                self.width, (7, 7), strides=(2, 2), use_bias=False,
+                dtype=self.dtype, name="Conv_stem",
+            )(x)
+        else:
+            raise ValueError(
+                f"stem must be 'conv7' or 'space_to_depth', got {self.stem!r}"
+            )
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
             dtype=self.norm_dtype,
