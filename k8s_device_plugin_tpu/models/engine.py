@@ -153,6 +153,9 @@ class Request:
     temperature: float = 0.0
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
+    # request decodes through; None = base model.
+    adapter: Optional[int] = None
     rid: int = -1
     tokens: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -193,6 +196,11 @@ class ServingEngine:
             )
         if spec_gamma < 0:
             raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
+        if cfg.lora_serve and spec_gamma > 0:
+            # The self-draft is the same model int8-quantized, and quant is
+            # mutually exclusive with LoRA (quantize after merging) — there
+            # is no coherent draft for a multi-adapter batch.
+            raise ValueError("lora_serve is not supported with spec_gamma")
         if prefill_chunk is not None and (
             prefill_chunk < 1 or prefill_chunk & (prefill_chunk - 1)
         ):
@@ -250,11 +258,12 @@ class ServingEngine:
         # copy.  Host-side .at[slot].set bookkeeping always runs on the
         # returned tree, never the donated argument.
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, tokens, positions, temps, topks, topps, key):
+        def step(params, cache, tokens, positions, temps, topks, topps, aids, key):
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
                 positions,
+                adapter_ids=aids,
                 mutable=["cache"],
             )
             row = logits[:, -1, :]
@@ -273,11 +282,12 @@ class ServingEngine:
         # (greedy/temperature-only serving, the default), so the common
         # case never pays for the feature.
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def step_plain(params, cache, tokens, positions, temps, key):
+        def step_plain(params, cache, tokens, positions, temps, aids, key):
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
                 positions,
+                adapter_ids=aids,
                 mutable=["cache"],
             )
             row = logits[:, -1, :]
@@ -500,6 +510,9 @@ class ServingEngine:
         self._slot_last: list[int] = [0] * max_slots  # last emitted token
         self._slot_len: list[int] = [0] * max_slots  # consumed positions
         self._slot_temp: list[float] = [0.0] * max_slots  # 0 = greedy
+        # Per-slot adapter id (-1 = base model); traced into the step so
+        # slots switch adapters with no recompile (multi-LoRA serving).
+        self._slot_aid: list[int] = [-1] * max_slots
         # Per-slot sampler restrictions; vocab / 1.0 mean "off" so idle
         # slots are no-ops in the shared filter.
         self._slot_topk: list[int] = [cfg.vocab_size] * max_slots
@@ -566,10 +579,21 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        adapter: Optional[int] = None,
     ) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        if adapter is not None:
+            if not self.cfg.lora_serve:
+                raise ValueError(
+                    "adapter requires an engine built with cfg.lora_serve"
+                )
+            if not 0 <= adapter < self.cfg.lora_serve:
+                raise ValueError(
+                    f"adapter must be in [0, {self.cfg.lora_serve}), "
+                    f"got {adapter}"
+                )
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if temperature < 0:
@@ -608,7 +632,7 @@ class ServingEngine:
         with self._lock:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
-                rid=self._next_rid,
+                adapter=adapter, rid=self._next_rid,
             )
             self._next_rid += 1
             self.queue.append(req)
@@ -632,12 +656,13 @@ class ServingEngine:
         if fn is not None:
             return fn
 
-        def run(params, cache, tokens, pos0, last_idx):
+        def run(params, cache, tokens, pos0, last_idx, aids):
             pos = jnp.broadcast_to(
                 pos0 + jnp.arange(chunk)[None, :], (batch, chunk)
             )
             logits, mut = self._dense_chunk.apply(
                 {"params": params, "cache": cache}, tokens, pos,
+                adapter_ids=aids,
                 mutable=["cache"],
             )
             # Each row's true-last-position logits, valid only when
@@ -677,6 +702,10 @@ class ServingEngine:
         rows = [p + [0] * (bucket - len(p)) for p in prompts]
         rows += [rows[0]] * (batch - n)
         last_idx = [len(p) - 1 for p in prompts] + [0] * (batch - n)
+        aids = [
+            it[1].adapter if it[1].adapter is not None else -1 for it in items
+        ]
+        aids += [aids[0]] * (batch - n)  # pad rows are discarded anyway
         spec = decode_cache_spec(self._dense_chunk, batch)
         self._pending.append(
             {
@@ -687,6 +716,7 @@ class ServingEngine:
                 "rows": jnp.asarray(rows, jnp.int32),
                 "last_idx_host": last_idx,
                 "last_idx": jnp.asarray(last_idx, jnp.int32),
+                "aids": jnp.asarray(aids, jnp.int32),
                 "cache": jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), spec
                 ),
@@ -706,6 +736,7 @@ class ServingEngine:
             tokens,
             jnp.asarray(pos, jnp.int32),
             job["last_idx"],
+            job["aids"],
         )
         for i in range(len(job["items"])):
             if pos <= job["last_idx_host"][i] < pos + chunk:
@@ -805,6 +836,7 @@ class ServingEngine:
         self._slot_temp[slot] = 0.0
         self._slot_topk[slot] = self.cfg.vocab_size
         self._slot_topp[slot] = 1.0
+        self._slot_aid[slot] = -1
         self._slot_page_base[slot] = 0
         self._slot_visible[slot] = 0
         self._slot_ready[slot] = False
@@ -834,11 +866,22 @@ class ServingEngine:
                         keys.remove(key)
             self.free_pages.append(page)
 
+    @staticmethod
+    def _trie_root(adapter: Optional[int]) -> int:
+        """Root pseudo-parent for the prefix trie: K/V are a function of
+        (params, adapter, tokens), so each adapter gets its own root (-1 =
+        base model, -(2+i) = adapter i) and chains never cross adapters.
+        Pseudo-roots are never real pages, so they are never freed and
+        take no _child_keys bookkeeping (their links die with the child
+        page, exactly like the old -1 root's)."""
+        return -1 if adapter is None else -(2 + adapter)
+
     def _match_prefix(
         self,
         prompt: list[int],
         bucket: int,
         burst_pages: dict[int, int],
+        adapter: Optional[int] = None,
     ) -> list[int]:
         """Longest chain of live registered pages whose token chunks equal
         this prompt's leading FULL pages (trie walk: O(prompt)).
@@ -853,7 +896,7 @@ class ServingEngine:
         """
         ps = self.paged.page_size
         pages: list[int] = []
-        parent = -1
+        parent = self._trie_root(adapter)
         for i in range(len(prompt) // ps):
             chunk = tuple(prompt[i * ps : (i + 1) * ps])
             page = self._prefix_pages.get((parent, chunk))
@@ -895,7 +938,9 @@ class ServingEngine:
                     / self.paged.page_size
                 )
                 shared = (
-                    self._match_prefix(req.prompt, bucket, burst_pages)
+                    self._match_prefix(
+                        req.prompt, bucket, burst_pages, req.adapter
+                    )
                     if self.prefix_sharing
                     else []
                 )
@@ -925,13 +970,13 @@ class ServingEngine:
                     # content is written by its first owner's graft before
                     # any decode step reads it.
                     ps = self.paged.page_size
-                    parent = -1
+                    parent = self._trie_root(req.adapter)
                     for i in range(plen // ps):
                         key = (parent, tuple(req.prompt[i * ps : (i + 1) * ps]))
                         if key not in self._prefix_pages:
                             self._prefix_pages[key] = pages[i]
                             self._page_keys.setdefault(pages[i], []).append(key)
-                            if parent != -1:
+                            if parent >= 0:
                                 self._child_keys.setdefault(parent, []).append(key)
                         parent = pages[i]
                 self.slots[slot] = req
@@ -995,6 +1040,9 @@ class ServingEngine:
             self._slot_temp[slot] = req.temperature
             self._slot_topk[slot] = topk
             self._slot_topp[slot] = topp
+            self._slot_aid[slot] = (
+                req.adapter if req.adapter is not None else -1
+            )
             self._slot_ready[slot] = True
             if self.metrics:
                 self.metrics.requests.inc()
@@ -1042,6 +1090,7 @@ class ServingEngine:
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
+        aids = jnp.asarray(self._slot_aid, jnp.int32)
         self._rng, sub = jax.random.split(self._rng)
         if any(
             self.slots[s] is not None
@@ -1055,11 +1104,11 @@ class ServingEngine:
             topps = jnp.asarray(self._slot_topp, jnp.float32)
             nxt, self.cache = self._step(
                 self.params, self.cache, tokens, positions, temps, topks,
-                topps, sub,
+                topps, aids, sub,
             )
         else:
             nxt, self.cache = self._step_plain(
-                self.params, self.cache, tokens, positions, temps, sub
+                self.params, self.cache, tokens, positions, temps, aids, sub
             )
         nxt = np.asarray(nxt)
         for s in active:

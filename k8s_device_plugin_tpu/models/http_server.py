@@ -75,6 +75,10 @@ class EngineServer:
                         kwargs["top_k"] = int(body["top_k"])
                     if "top_p" in body:
                         kwargs["top_p"] = float(body["top_p"])
+                    if "adapter" in body and body["adapter"] is not None:
+                        # Multi-LoRA serving: pick a stacked adapter by
+                        # index (engines built with cfg.lora_serve).
+                        kwargs["adapter"] = int(body["adapter"])
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
@@ -237,7 +241,34 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="restore params from an orbax checkpoint (models/checkpoint.py) "
         "instead of random init — the train->serve handoff",
     )
+    p.add_argument(
+        "--adapters",
+        default="",
+        help="comma-separated orbax checkpoint dirs of trained LoRA trees "
+        "(GPTConfig(lora_rank=r) layouts, models/lora.py) served as stacked "
+        'adapters over the base weights; requests pick one with "adapter": i '
+        "(index in this list) or omit it for the base model",
+    )
+    p.add_argument(
+        "--lora-rank",
+        type=_positive_int,
+        default=None,
+        help="expected adapter rank r of the --adapters trees (optional "
+        "cross-check; the served rank is always read from the trees)",
+    )
     args = p.parse_args(argv)
+    if args.adapters and args.quant:
+        raise SystemExit(
+            "--adapters serves bf16 base + LoRA deltas; quantize after "
+            "merging instead (--quant is mutually exclusive)"
+        )
+    if args.adapters and args.spec_gamma:
+        # Same conflict ServingEngine.__init__ raises, surfaced BEFORE the
+        # checkpoint loads and draft quantization it would waste.
+        raise SystemExit(
+            "--adapters is not supported with --spec-gamma (the int8 "
+            "self-draft has no coherent multi-adapter form)"
+        )
     if args.spec_gamma and args.quant:
         raise SystemExit(
             "--spec-gamma uses the int8 SELF-draft against the bf16 "
@@ -272,6 +303,28 @@ def main(argv: Optional[list[str]] = None) -> None:
 
         spec_kw = dict(
             spec_gamma=args.spec_gamma, draft_params=quantize_lm_params(params)
+        )
+    if args.adapters:
+        from .checkpoint import CheckpointManager
+        from .lora import lora_rank_of, stack_lora_adapters
+
+        dirs = [d for d in args.adapters.split(",") if d]
+        trees = [CheckpointManager(d).restore_params() for d in dirs]
+        # The served rank ALWAYS comes from the trees — a mis-set flag
+        # would silently scale every delta by alpha/wrong_rank (flax never
+        # re-checks loaded param shapes, and rank only appears as a
+        # contracted dim, so every matmul would still shape-check).
+        rank = lora_rank_of(trees[0])
+        if args.lora_rank is not None and args.lora_rank != rank:
+            raise SystemExit(
+                f"--lora-rank {args.lora_rank} does not match the adapter "
+                f"trees' actual rank {rank}"
+            )
+        params = stack_lora_adapters(params, trees)
+        cfg = dataclasses.replace(cfg, lora_rank=rank, lora_serve=len(trees))
+        print(
+            f"serving {len(trees)} LoRA adapter(s) over the base weights",
+            file=sys.stderr,
         )
     if args.quant:
         from ..ops.quant import quantize_lm_params

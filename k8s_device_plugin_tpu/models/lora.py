@@ -33,6 +33,22 @@ import jax.numpy as jnp
 from ..ops.quant import dense_geometry
 
 
+def _flat_lecun_init(fan_in: int, feats):
+    """Base-kernel initializer matching flax DenseGeneral exactly:
+    lecun_normal over the FLATTENED [fan_in, fan_out] shape, then reshape —
+    the N-D initializer would compute a different fan_in on multi-dim sites
+    (qkv [hidden, heads, head_dim]).  Shared by LoRADense and
+    MultiLoRADense so the two classes can never diverge on init."""
+
+    def init(key, shape, dtype=jnp.float32):
+        flat = nn.initializers.lecun_normal()(
+            key, (fan_in, math.prod(feats)), dtype
+        )
+        return flat.reshape(shape)
+
+    return init
+
+
 class LoRADense(nn.Module):
     """DenseGeneral with a frozen base kernel plus trainable A·B adapters.
 
@@ -52,18 +68,9 @@ class LoRADense(nn.Module):
     def __call__(self, x):
         feats, _, contract, dims = dense_geometry(x, self.axis, self.features)
         fan_in = math.prod(contract)
-
-        def base_init(key, shape, dtype=jnp.float32):
-            # Match flax DenseGeneral exactly: lecun_normal over the
-            # FLATTENED [fan_in, fan_out] shape, then reshape — the N-D
-            # initializer would compute a different fan_in on multi-dim
-            # sites (qkv [hidden, heads, head_dim]).
-            flat = nn.initializers.lecun_normal()(
-                key, (fan_in, math.prod(feats)), dtype
-            )
-            return flat.reshape(shape)
-
-        kernel = self.param("kernel", base_init, contract + feats)
+        kernel = self.param(
+            "kernel", _flat_lecun_init(fan_in, feats), contract + feats
+        )
         lora_a = self.param(
             "lora_a",
             nn.initializers.normal(stddev=1.0 / math.sqrt(fan_in)),
@@ -79,6 +86,143 @@ class LoRADense(nn.Module):
             down, lora_b.astype(self.dtype), (((down.ndim - 1,), (0,)), ((), ()))
         )
         return base + (self.alpha / self.rank) * up
+
+
+class MultiLoRADense(nn.Module):
+    """Dense site serving ``n_adapters`` LoRA adapters side by side.
+
+    The multi-tenant serving form of :class:`LoRADense`: ONE base kernel
+    (plain name/shape — a pretrained checkpoint loads as-is) plus stacked
+    adapters ``lora_a_stack`` [n, *contract, r] / ``lora_b_stack``
+    [n, r, *features], and a per-ROW ``adapter_ids`` [batch] input picking
+    which adapter each row applies (-1 = base model only).  The
+    continuous-batching engine (models/engine.py) uses this to serve many
+    fine-tunes from one set of base weights in one jitted step: the id
+    vector is traced, so slots switch adapters with no recompile.
+
+    TPU-first reasoning: the gather ``stack[ids]`` moves only
+    [batch, fan_in, r] adapter bytes per site (rank``r`` is tiny), and the
+    per-row delta is two batched skinny matmuls XLA fuses alongside the
+    shared base matmul — versus materializing a merged [fan_in, fan_out]
+    weight per tenant, which would multiply weight HBM by the tenant count
+    and kill batch-sharing entirely.  Reference analogue: none (SURVEY.md
+    §2.4 — no model code in the reference).
+    """
+
+    features: Union[int, Sequence[int]]
+    rank: int
+    n_adapters: int
+    alpha: float = 16.0
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, adapter_ids=None):
+        if adapter_ids is None:
+            raise ValueError(
+                "MultiLoRADense needs adapter_ids [batch] (-1 = no adapter); "
+                "pass adapter_ids= through the model apply"
+            )
+        feats, _, contract, dims = dense_geometry(x, self.axis, self.features)
+        fan_in = math.prod(contract)
+        kernel = self.param(
+            "kernel", _flat_lecun_init(fan_in, feats), contract + feats
+        )
+        a_stack = self.param(
+            "lora_a_stack",
+            nn.initializers.normal(stddev=1.0 / math.sqrt(fan_in)),
+            (self.n_adapters,) + contract + (self.rank,),
+        )
+        b_stack = self.param(
+            "lora_b_stack",
+            nn.initializers.zeros,
+            (self.n_adapters, self.rank) + feats,
+        )
+        xd = x.astype(self.dtype)
+        base = jax.lax.dot_general(xd, kernel.astype(self.dtype), dims)
+
+        ids = jnp.clip(adapter_ids, 0, self.n_adapters - 1)
+        a_sel = a_stack[ids].astype(self.dtype)  # [b, *contract, r]
+        b_sel = b_stack[ids].astype(self.dtype)  # [b, r, *feats]
+        (x_contract, _), _ = dims
+        # Same contraction the base dot uses, but batched over rows: the
+        # stacked operand's contract dims sit one axis right of the
+        # unbatched adapter's (leading n/batch dim).
+        n_c = len(contract)
+        down = jax.lax.dot_general(
+            xd, a_sel, ((x_contract, tuple(range(1, 1 + n_c))), ((0,), (0,)))
+        )  # [b, *keep, r]
+        up = jax.lax.dot_general(
+            down, b_sel, (((down.ndim - 1,), (1,)), ((0,), (0,)))
+        )  # [b, *keep, *feats]
+        # -1 rows ride the base model untouched; the clip above only keeps
+        # the gather in bounds for them.
+        gate = (adapter_ids >= 0).astype(self.dtype) * (self.alpha / self.rank)
+        return base + gate.reshape((-1,) + (1,) * (up.ndim - 1)) * up
+
+
+def stack_lora_adapters(
+    base_params: Any, adapter_trees: Sequence[Any]
+) -> Any:
+    """Build the :class:`MultiLoRADense` serving tree from ``n`` trained
+    LoRA trees (each a ``GPTConfig(lora_rank=r)`` tree from
+    :func:`make_lora_tx` training) over one shared base.
+
+    Every dense site gains ``lora_a_stack``/``lora_b_stack`` stacked in
+    ``adapter_trees`` order (ids follow that order at submit time); base
+    kernels come from ``base_params``.  Trees must agree on rank.
+    """
+    if not adapter_trees:
+        raise ValueError("need at least one adapter tree")
+
+    def walk(base, adapters):
+        if not isinstance(base, dict):
+            return base
+        if any("lora_a" in (a or {}) for a in adapters):
+            a_s = [a["lora_a"] for a in adapters]
+            b_s = [a["lora_b"] for a in adapters]
+            ranks = {a.shape[-1] for a in a_s}
+            if len(ranks) != 1:
+                raise ValueError(f"adapter ranks disagree: {sorted(ranks)}")
+            out = {
+                k: v
+                for k, v in base.items()
+                if k not in ("lora_a", "lora_b")
+            }
+            out["lora_a_stack"] = jnp.stack(a_s)
+            out["lora_b_stack"] = jnp.stack(b_s)
+            return out
+        return {
+            k: walk(v, [a.get(k, {}) if isinstance(a, dict) else {} for a in adapters])
+            for k, v in base.items()
+        }
+
+    return walk(base_params, list(adapter_trees))
+
+
+def lora_rank_of(params: Any) -> int:
+    """Rank of the adapters in a LoRA tree (``lora_a`` leaves) or a stacked
+    serving tree (``lora_a_stack``) — the authoritative value config flags
+    must agree with (a mis-set rank silently mis-scales every delta by
+    alpha/rank)."""
+    found: list[int] = []
+
+    def walk(t):
+        if not isinstance(t, dict):
+            return
+        for k, v in t.items():
+            if k in ("lora_a", "lora_a_stack"):
+                found.append(int(v.shape[-1]))
+            else:
+                walk(v)
+
+    walk(params)
+    if not found:
+        raise ValueError("tree has no LoRA adapters (no lora_a leaves)")
+    ranks = set(found)
+    if len(ranks) != 1:
+        raise ValueError(f"adapter ranks disagree across sites: {sorted(ranks)}")
+    return found[0]
 
 
 def lora_labels(params: Any) -> Any:

@@ -56,9 +56,10 @@ class PagedConfig:
     # Read pages through the Pallas paged-attention kernel
     # (ops/paged_attention.py: scalar-prefetched page table, O(len) HBM
     # traffic) instead of materializing the gathered [max_len] view.
+    # Sliding windows mask inside the kernel (attention_window composes);
+    # int8 KV pools (quant_kv) do not — the kernel streams bf16 pages.
     # Opt-in until a hardware round proves the Mosaic lowering (BASELINE.md
-    # queue); interpreter-mode parity is pinned either way.  Full-causal
-    # only — combine with attention_window and the model raises.
+    # queue); interpreter-mode parity is pinned either way.
     use_kernel: bool = False
 
     @property
@@ -112,6 +113,13 @@ class GPTConfig:
     # merging).
     lora_rank: Optional[int] = None
     lora_alpha: float = 16.0
+    # Multi-LoRA serving (models/lora.py MultiLoRADense): number of stacked
+    # adapters every dense site carries (0 = off).  Requires lora_rank; the
+    # model then takes a per-row ``adapter_ids`` [batch] input (-1 = base
+    # only) and the serving engine maps each request's adapter choice onto
+    # its slot — many fine-tunes, one set of base weights, one jitted step.
+    # Build the params with lora.stack_lora_adapters.
+    lora_serve: int = 0
     # Paged KV cache for continuous-batching serving (models/engine.py):
     # decode reads/writes page-table-indirected pool slabs instead of one
     # dense [batch, max_seq] cache.  Single-token decode steps only — the
@@ -183,6 +191,20 @@ def dense_site(cfg: GPTConfig, features, *, axis=-1, dtype=None, name: str):
             "quant and lora_rank are mutually exclusive: train the adapters, "
             "merge_lora_params, then quantize the merged tree"
         )
+    if cfg.lora_serve:
+        if cfg.lora_rank is None:
+            raise ValueError("lora_serve requires lora_rank")
+        from .lora import MultiLoRADense
+
+        return MultiLoRADense(
+            features=features,
+            rank=cfg.lora_rank,
+            n_adapters=cfg.lora_serve,
+            alpha=cfg.lora_alpha,
+            axis=axis,
+            dtype=dtype,
+            name=name,
+        )
     if cfg.lora_rank is not None:
         from .lora import LoRADense  # local: lora imports ops, not us
 
@@ -203,6 +225,15 @@ def dense_site(cfg: GPTConfig, features, *, axis=-1, dtype=None, name: str):
     return Int8DenseGeneral(
         features=features, axis=axis, mode=cfg.quant, dtype=dtype, name=name
     )
+
+
+def _site_call(mod, x, cfg: GPTConfig, adapter_ids):
+    """Apply a dense site built by :func:`dense_site`.  Multi-LoRA serving
+    sites (``cfg.lora_serve``) additionally take the traced per-row adapter
+    id vector; every other site kind has the plain one-argument call."""
+    if cfg.lora_serve:
+        return mod(x, adapter_ids)
+    return mod(x)
 
 
 def cached_group_attention(q, k, v, positions, window, num_heads):
@@ -268,7 +299,7 @@ class CausalSelfAttention(nn.Module):
     append_mode: str = "auto"
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, adapter_ids=None):
         cfg = self.config
         if self.append_mode not in ("auto", "cached"):
             # A typo here would silently pick the [q_len, max_seq] masked
@@ -286,9 +317,12 @@ class CausalSelfAttention(nn.Module):
             )
         group = cfg.num_heads // cfg.kv_heads
         proj = {
-            name: dense_site(
-                cfg, (heads, cfg.head_dim), name=name
-            )(hidden)
+            name: _site_call(
+                dense_site(cfg, (heads, cfg.head_dim), name=name),
+                hidden,
+                cfg,
+                adapter_ids,
+            )
             for name, heads in (
                 ("query", cfg.num_heads),
                 ("key", cfg.kv_heads),
@@ -507,7 +541,12 @@ class CausalSelfAttention(nn.Module):
                 attn = tiled_causal_attention(qh, kh, vh, cfg.attention_window)
             attn = attn.transpose(0, 2, 1, 3)
 
-        return dense_site(cfg, cfg.hidden_size, axis=(-2, -1), name="out")(attn)
+        return _site_call(
+            dense_site(cfg, cfg.hidden_size, axis=(-2, -1), name="out"),
+            attn,
+            cfg,
+            adapter_ids,
+        )
 
 
 class SwiGluMlp(nn.Module):
@@ -516,11 +555,20 @@ class SwiGluMlp(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         cfg = self.config
-        gate = dense_site(cfg, cfg.intermediate_size, name="gate")(x)
-        up = dense_site(cfg, cfg.intermediate_size, name="up")(x)
-        return dense_site(cfg, cfg.hidden_size, name="down")(nn.silu(gate) * up)
+        gate = _site_call(
+            dense_site(cfg, cfg.intermediate_size, name="gate"), x, cfg, adapter_ids
+        )
+        up = _site_call(
+            dense_site(cfg, cfg.intermediate_size, name="up"), x, cfg, adapter_ids
+        )
+        return _site_call(
+            dense_site(cfg, cfg.hidden_size, name="down"),
+            nn.silu(gate) * up,
+            cfg,
+            adapter_ids,
+        )
 
 
 class DecoderBlock(nn.Module):
@@ -531,7 +579,7 @@ class DecoderBlock(nn.Module):
     append_mode: str = "auto"
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, adapter_ids=None):
         cfg = self.config
         attn = CausalSelfAttention(
             cfg,
@@ -540,13 +588,22 @@ class DecoderBlock(nn.Module):
             append_mode=self.append_mode,
             name="attn",
         )(
-            RMSNorm(dtype=cfg.dtype, name="attn_norm")(hidden), positions
+            RMSNorm(dtype=cfg.dtype, name="attn_norm")(hidden),
+            positions,
+            adapter_ids,
         )
         hidden = hidden + attn
+        if cfg.lora_serve and self.mlp_factory is not None:
+            # A swapped-in MLP (MoE) has the plain one-argument call and
+            # would silently skip its adapters.
+            raise ValueError("lora_serve is not supported with mlp_factory")
         mlp_mod = (
             self.mlp_factory() if self.mlp_factory is not None else SwiGluMlp(cfg, name="mlp")
         )
-        mlp = mlp_mod(RMSNorm(dtype=cfg.dtype, name="mlp_norm")(hidden))
+        norm_h = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(hidden)
+        mlp = (
+            mlp_mod(norm_h, adapter_ids) if cfg.lora_serve else mlp_mod(norm_h)
+        )
         return hidden + mlp
 
 
@@ -565,13 +622,19 @@ class TransformerLM(nn.Module):
     append_mode: str = "auto"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, output: str = "logits"):
+    def __call__(
+        self, input_ids, positions=None, output: str = "logits", adapter_ids=None
+    ):
         cfg = self.config
         seq_len = input_ids.shape[-1]
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(seq_len)[None, :], input_ids.shape
             )
+        if cfg.lora_serve and adapter_ids is None:
+            # Base-model default so init/eval_shape paths need no vector;
+            # the serving engine always passes its per-slot ids.
+            adapter_ids = jnp.full((input_ids.shape[0],), -1, jnp.int32)
         hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(
             input_ids
         )
@@ -586,7 +649,7 @@ class TransformerLM(nn.Module):
                 attention_fn=self.attention_fn,
                 append_mode=self.append_mode,
                 name=f"layer_{i}",
-            )(hidden, positions)
+            )(hidden, positions, adapter_ids)
         hidden = RMSNorm(dtype=cfg.dtype, name="final_norm")(hidden)
         if output == "hidden":
             # For the fused LM-head + cross-entropy path (ops/fused_xent.py):
@@ -599,8 +662,11 @@ class TransformerLM(nn.Module):
         if output != "logits":
             raise ValueError(f"output must be logits|hidden, got {output!r}")
         # Logits in float32 for a stable softmax/xent.
-        return dense_site(cfg, cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
-            hidden
+        return _site_call(
+            dense_site(cfg, cfg.vocab_size, dtype=jnp.float32, name="lm_head"),
+            hidden,
+            cfg,
+            adapter_ids,
         )
 
 

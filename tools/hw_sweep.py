@@ -1,0 +1,256 @@
+"""Hardware sweep session: the queue items bench.py doesn't carry.
+
+Run on a live TPU (never by the driver — this is the builder's measurement
+tool; results land in BASELINE.md and drive default flips):
+
+    python tools/hw_sweep.py [paged_parity] [bwd_sweep] [engine_ab]
+
+Sections (default: all), each guarded so one failure doesn't kill the rest:
+
+- ``paged_parity``  — Mosaic-compiled paged-attention kernel vs an f32
+  gather oracle at serving shapes, full-causal AND windowed (BASELINE.md
+  queue: "parity vs host oracle, then kernel-vs-gather ms").
+- ``bwd_sweep``     — flash-attention backward tile sweep over
+  ``bwd_block_q``/``bwd_block_kv`` (queue: "512-class bwd tiles are
+  unswept").
+- ``engine_ab``     — ServingEngine steady-state decode step, gather vs
+  Pallas kernel.  Through the relay every host-driven step pays a
+  constant ~70-90 ms dispatch RTT that a real TPU VM does not pay, so the
+  honest comparison is the per-step DELTA between the two paths (both pay
+  identical RTT and identical non-attention work); raw ms are printed
+  with that caveat.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, flush=True)
+
+
+def section(name):
+    def deco(fn):
+        def wrapped():
+            t0 = time.time()
+            log(f"=== {name} ===")
+            try:
+                fn()
+            except Exception as e:  # keep the session alive for later sections
+                log(f"{name} FAILED: {type(e).__name__}: {e}")
+            log(f"=== {name} done ({time.time() - t0:.0f}s) ===")
+
+        wrapped.__name__ = name
+        return wrapped
+
+    return deco
+
+
+def _gather_oracle(q, pk, pv, table, lens, window=None):
+    """f32 reference decode attention over the paged pool."""
+    b, h, d = q.shape
+    kv = pk.shape[2]
+    ps = pk.shape[1]
+    mpp = table.shape[1]
+    kr = pk[table].reshape(b, mpp * ps, kv, d).astype(jnp.float32)
+    vr = pv[table].reshape(b, mpp * ps, kv, d).astype(jnp.float32)
+    qg = q.astype(jnp.float32).reshape(b, kv, h // kv, 1, d)
+    s = jnp.einsum("bhgqd,bkhd->bhgqk", qg, kr) * (d**-0.5)
+    pos = jnp.arange(mpp * ps)[None, :]
+    mask = pos < lens[:, None]
+    if window is not None:
+        mask &= pos > lens[:, None] - 1 - window
+    s = jnp.where(mask[:, None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhgqk,bkhd->bhgqd", p, vr).reshape(b, h, d)
+
+
+@section("paged_parity")
+def paged_parity():
+    from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
+
+    # Serving shapes; fill deliberately NOT page-aligned so the partial
+    # last page's masking is exercised on real Mosaic.
+    for (label, b, h, kv, d, ps, mpp, fill, window) in [
+        ("b4 full-causal", 4, 16, 4, 64, 16, 32, 403, None),
+        ("b8 full-causal", 8, 16, 16, 64, 16, 64, 1000, None),
+        ("b4 window64", 4, 16, 4, 64, 16, 32, 403, 64),
+        ("b4 window17", 4, 16, 4, 64, 16, 32, 403, 17),
+    ]:
+        n_pool = b * mpp + 1
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        q = jax.random.normal(ks[0], (b, h, d), jnp.bfloat16)
+        pk = jax.random.normal(ks[1], (n_pool, ps, kv, d), jnp.bfloat16)
+        pv = jax.random.normal(ks[2], (n_pool, ps, kv, d), jnp.bfloat16)
+        perm = jax.random.permutation(ks[3], n_pool - 1) + 1
+        table = np.zeros((b, mpp), np.int32)
+        need = -(-fill // ps)
+        table[:, :need] = np.asarray(perm)[: b * need].reshape(b, need)
+        table = jnp.asarray(table)
+        lens = jnp.full((b,), fill, jnp.int32)
+
+        got = jax.device_get(
+            paged_attention(
+                q, pk, pv, table, lens, window=window, interpret=False
+            )
+        ).astype(np.float32)
+        want = jax.device_get(_gather_oracle(q, pk, pv, table, lens, window))
+        err = np.max(np.abs(got - want))
+        # bf16 inputs -> ~1e-2 tolerance band is the expected float noise.
+        log(
+            f"paged parity {label}: max|err|={err:.2e} "
+            f"{'OK' if err < 3e-2 else '** MISMATCH **'}"
+        )
+
+
+def timed_chain(fn, x, iters: int, small: int = 2) -> float:
+    """Per-application seconds; same design as bench.py (fori_loop chains
+    + two-point timing so relay dispatch/sync overhead cancels)."""
+    from k8s_device_plugin_tpu.models.benchmark import measure_two_point
+
+    def chain(n):
+        @jax.jit
+        def run(x):
+            c = jax.lax.fori_loop(0, n, lambda i, c: fn(c), x)
+            return jnp.mean(c, dtype=jnp.float32)
+
+        return run
+
+    run_s, run_b = chain(small), chain(small + iters)
+    jax.device_get(run_s(x))
+    jax.device_get(run_b(x))
+    dt, fell_back = measure_two_point(
+        lambda: jax.device_get(run_s(x)),
+        lambda: jax.device_get(run_b(x)),
+        iters,
+        small + iters,
+    )
+    if fell_back:
+        log("  (chain delta below noise floor; single-point)")
+    return dt / iters
+
+
+@section("bwd_sweep")
+def bwd_sweep():
+    from k8s_device_plugin_tpu.ops.flash_attention import flash_attention
+
+    b, h, s, d = 4, 16, 2048, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(3), (b, h, s, d), jnp.bfloat16)
+    bwd_flops = 7 * b * h * s * s * d / 2 * 2
+    for bq, bkv in [
+        (128, 512),
+        (256, 512),
+        (512, 512),
+        (128, 1024),
+        (256, 1024),
+        (512, 1024),
+    ]:
+        try:
+            t = timed_chain(
+                lambda qq, bq=bq, bkv=bkv: jax.grad(
+                    lambda x: flash_attention(
+                        x, k, v, causal=True,
+                        bwd_impl="pallas",
+                        bwd_block_q=bq,
+                        bwd_block_kv=bkv,
+                    )
+                    .astype(jnp.float32)
+                    .sum()
+                )(qq),
+                q,
+                10,
+            )
+            log(
+                f"bwd sweep q{bq}/kv{bkv}: {t*1e3:.2f} ms "
+                f"({bwd_flops/t/1e12:.1f} TFLOP/s)"
+            )
+        except Exception as e:
+            log(f"bwd sweep q{bq}/kv{bkv}: failed ({e})")
+
+
+@section("engine_ab")
+def engine_ab():
+    from k8s_device_plugin_tpu.models.engine import ServingEngine
+    from k8s_device_plugin_tpu.models.transformer import (
+        GPTConfig,
+        PagedConfig,
+        TransformerLM,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=32000,
+        hidden_size=1024,
+        num_layers=2,
+        num_heads=16,
+        intermediate_size=2816,
+        max_seq=2048,
+        num_kv_heads=4,
+    )
+    rng = jax.random.PRNGKey(0)
+    params = TransformerLM(cfg).init(rng, jnp.zeros((1, 2), jnp.int32))["params"]
+    slots, prompt_len, steps = 8, 512, 40
+
+    results = {}
+    for use_kernel in (False, True):
+        paged = PagedConfig(
+            page_size=16,
+            num_pages=slots * 40 + 8,
+            max_pages_per_seq=40,
+            use_kernel=use_kernel,
+        )
+        eng = ServingEngine(cfg, params, paged, max_slots=slots)
+        prompts = [
+            (list(np.random.default_rng(i).integers(0, 32000, prompt_len)), 120)
+            for i in range(slots)
+        ]
+        for p, n in prompts:
+            eng.submit(p, max_new_tokens=n)
+        eng.step()  # admission + prefill + first decode
+        eng.step()  # settle into pure decode
+        # Warm + timed host-driven decode steps.  Each pays one relay RTT;
+        # the kernel-vs-gather DELTA is RTT-free (identical everything
+        # else).
+        for _ in range(3):
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = (time.perf_counter() - t0) / steps
+        results[use_kernel] = dt
+        log(
+            f"engine step ({'kernel' if use_kernel else 'gather'}): "
+            f"{dt*1e3:.2f} ms/step, raw {slots/dt:.0f} tokens/sec "
+            f"(b{slots} len~{prompt_len}+; includes relay RTT)"
+        )
+    if False in results and True in results:
+        delta = (results[False] - results[True]) * 1e3
+        log(
+            f"engine kernel-vs-gather delta: {delta:+.2f} ms/step "
+            f"({'kernel wins' if delta > 0 else 'gather wins'}; "
+            "RTT-free difference)"
+        )
+
+
+ALL = {
+    "paged_parity": paged_parity,
+    "bwd_sweep": bwd_sweep,
+    "engine_ab": engine_ab,
+}
+
+
+if __name__ == "__main__":
+    picks = sys.argv[1:] or list(ALL)
+    plat = jax.devices()[0].platform
+    log(f"hw_sweep on platform={plat}")
+    if plat == "cpu":
+        log("WARNING: no accelerator — numbers are meaningless; parity only")
+    for name in picks:
+        ALL[name]()
