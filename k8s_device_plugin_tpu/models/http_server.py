@@ -256,6 +256,16 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="expected adapter rank r of the --adapters trees (optional "
         "cross-check; the served rank is always read from the trees)",
     )
+    p.add_argument(
+        "--lora-alpha",
+        type=float,
+        default=None,
+        help="LoRA alpha the --adapters trees were trained with (delta "
+        "scale = alpha/rank).  Rank is recoverable from a tree's shapes; "
+        "alpha is NOT (models/lora.py merge_lora_params), so serving "
+        "adapters trained with a non-default alpha REQUIRES this flag "
+        "(default: GPTConfig.lora_alpha = 16.0)",
+    )
     args = p.parse_args(argv)
     if args.adapters and args.quant:
         raise SystemExit(
@@ -322,6 +332,8 @@ def main(argv: Optional[list[str]] = None) -> None:
             )
         params = stack_lora_adapters(params, trees)
         cfg = dataclasses.replace(cfg, lora_rank=rank, lora_serve=len(trees))
+        if args.lora_alpha is not None:
+            cfg = dataclasses.replace(cfg, lora_alpha=args.lora_alpha)
         print(
             f"serving {len(trees)} LoRA adapter(s) over the base weights",
             file=sys.stderr,

@@ -17,19 +17,28 @@ lazily as each sequence grows).
 
 Design (same language as ops/flash_attention.py):
 
-- grid (batch, kv_heads, pages): batch/head parallel, the page axis
-  sequential, carrying lane-replicated [groups, 128] online-softmax
-  state (running max / denominator) plus an f32 output accumulator;
-- GQA-native: one kv head's page is resident while its whole q-head
-  group scores against it ([groups, head_dim] q tile);
-- pages past a slot's length skip both matmuls via `pl.when` (the grid
+- grid (batch, pages): batch parallel, the page axis sequential.  Each
+  step's K/V block is a FULL page — all kv heads, ``[page_size,
+  kv_heads, head_dim]`` — so every live page is fetched exactly once per
+  row (the round-2 design blocked one kv head per step, which Mosaic
+  rejects — a block's second-to-last dim must be 8-divisible or span the
+  array — and would have re-fetched each page once per kv head);
+- inside the kernel a STATIC unrolled loop over kv heads scores each
+  head's q-group tile ([group_pad, head_dim]) against its slice of the
+  resident page, carrying per-head lane-replicated [group_pad, 128]
+  online-softmax state (running max / denominator) and an f32 output
+  accumulator, all stacked ``[kv_heads, ...]`` in VMEM scratch;
+- GQA-native: one page fetch serves every q head;
+- pages past a slot's length skip all matmuls via `pl.when` (the grid
   is rectangular; dead pages cost one predicate);
-- per-position masking inside the frontier page via iota < len.
+- per-position masking inside the frontier page via iota < len;
+- f32 pools matmul at ``Precision.HIGHEST`` (the MXU's default bf16
+  passes cost ~2e-3 relative error, measured on v5e; bf16 pools use the
+  native path).
 
-Status: validated for parity against the gather path under the Pallas
-interpreter (tests/test_paged_attention.py); opt-in for the serving
-engine via ``PagedConfig`` once a hardware round proves the Mosaic
-lowering (BASELINE.md hardware queue).  Reference analogue: none — the
+Status: Mosaic-compiled and parity-checked against an f32 host oracle on
+real v5e hardware (round 3 session 2; MHA/GQA/MQA, windowed, bf16+f32,
+page sizes 8/16 — see BASELINE.md).  Reference analogue: none — the
 reference delegates all compute to the workload image (SURVEY.md §2.4).
 """
 
@@ -52,20 +61,21 @@ _MIN_GROUP_TILE = 8
 def _paged_kernel(
     table_ref,  # scalar-prefetch: [batch, pages] int32
     lens_ref,  # scalar-prefetch: [batch] int32
-    q_ref,  # [1, 1, group_pad, head_dim]
-    k_ref,  # [1, page_size, 1, head_dim]
+    q_ref,  # [1, kv_heads, group_pad, head_dim]
+    k_ref,  # [1, page_size, kv_heads, head_dim] — one full page
     v_ref,
-    o_ref,  # [1, 1, group_pad, head_dim]
-    m_ref,  # VMEM [group_pad, 128] f32, lane-replicated running max
-    l_ref,  # VMEM [group_pad, 128] f32, running denominator
-    acc_ref,  # VMEM [group_pad, head_dim] f32
+    o_ref,  # [1, kv_heads, group_pad, head_dim]
+    m_ref,  # VMEM [kv_heads, group_pad, 128] f32, lane-replicated running max
+    l_ref,  # VMEM [kv_heads, group_pad, 128] f32, running denominator
+    acc_ref,  # VMEM [kv_heads, group_pad, head_dim] f32
     *,
     page_size: int,
     num_pages: int,
+    kv_heads: int,
     sm_scale: float,
     window: int | None,
 ):
-    b, p = pl.program_id(0), pl.program_id(2)
+    b, p = pl.program_id(0), pl.program_id(1)
     length = lens_ref[b]  # valid cache slots: positions [0, length)
     # Sliding window: the (single) query sits at position length-1 and sees
     # keys in (length-1-window, length-1] — i.e. col >= length - window —
@@ -80,40 +90,56 @@ def _paged_kernel(
         acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
     def _page():
-        q = q_ref[0, 0]  # [group_pad, head_dim]
-        k = k_ref[0, :, 0, :]  # [page_size, head_dim]
-        v = v_ref[0, :, 0, :]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            * sm_scale
-        )  # [group_pad, page_size]
-        # Mask positions at/past the frontier (the partial last page) and,
-        # under a sliding window, positions that scrolled out of it.
-        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = col < length
-        if window is not None:
-            valid = jnp.logical_and(valid, col >= lo)
-        s = jnp.where(valid, s, NEG_INF)
+        # f32 operands need HIGHEST or the MXU's bf16 passes cost ~2e-3.
+        prec = (
+            jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32 else None
+        )
+        col0 = p * page_size
+        for h in range(kv_heads):  # static unroll: one page, every kv head
+            q = q_ref[0, h]  # [group_pad, head_dim]
+            k = k_ref[0, :, h, :]  # [page_size, head_dim]
+            v = v_ref[0, :, h, :]
+            s = (
+                jax.lax.dot_general(
+                    q,
+                    k,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=prec,
+                )
+                * sm_scale
+            )  # [group_pad, page_size]
+            # Mask positions at/past the frontier (the partial last page)
+            # and, under a sliding window, positions that scrolled out.
+            col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            valid = col < length
+            if window is not None:
+                valid = jnp.logical_and(valid, col >= lo)
+            s = jnp.where(valid, s, NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        seen = m_new > NEG_INF
-        prob = jnp.where(seen, jnp.exp(s - jnp.where(seen, m_new, 0.0)), 0.0)
-        alpha = jnp.where(seen, jnp.exp(jnp.where(seen, m_prev - m_new, 0.0)), 0.0)
-        l_ref[...] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(prob, axis=-1, keepdims=True), l_ref.shape
-        )
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            prob.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            seen = m_new > NEG_INF
+            prob = jnp.where(seen, jnp.exp(s - jnp.where(seen, m_new, 0.0)), 0.0)
+            alpha = jnp.where(
+                seen, jnp.exp(jnp.where(seen, m_prev - m_new, 0.0)), 0.0
+            )
+            l_ref[h] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(prob, axis=-1, keepdims=True),
+                l_ref.shape[1:],
+            )
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                prob.astype(v.dtype),
+                v,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=prec,
+            )
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
 
     # Pages wholly past the frontier — or wholly scrolled out of the
-    # window — skip both matmuls.
+    # window — skip all matmuls.
     live = p * page_size < length
     if window is not None:
         live = jnp.logical_and(live, (p + 1) * page_size > lo)
@@ -121,9 +147,10 @@ def _paged_kernel(
 
     @pl.when(p == num_pages - 1)
     def _finish():
-        l = l_ref[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        for h in range(kv_heads):
+            l = l_ref[h, :, :1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = (acc_ref[h] / l_safe).astype(o_ref.dtype)
 
 
 def paged_attention(
@@ -183,34 +210,35 @@ def paged_attention(
         _paged_kernel,
         page_size=page_size,
         num_pages=pages_per_seq,
+        kv_heads=kv_heads,
         sm_scale=sm_scale,
         window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(batch, kv_heads, pages_per_seq),
+        grid=(batch, pages_per_seq),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, group_pad, head_dim),
-                lambda b, h, p, table, lens: (b, h, 0, 0),
+                (1, kv_heads, group_pad, head_dim),
+                lambda b, p, table, lens: (b, 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, page_size, 1, head_dim),
-                lambda b, h, p, table, lens: (table[b, p], 0, h, 0),
+                (1, page_size, kv_heads, head_dim),
+                lambda b, p, table, lens: (table[b, p], 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, page_size, 1, head_dim),
-                lambda b, h, p, table, lens: (table[b, p], 0, h, 0),
+                (1, page_size, kv_heads, head_dim),
+                lambda b, p, table, lens: (table[b, p], 0, 0, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, group_pad, head_dim),
-            lambda b, h, p, table, lens: (b, h, 0, 0),
+            (1, kv_heads, group_pad, head_dim),
+            lambda b, p, table, lens: (b, 0, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((group_pad, 128), jnp.float32),
-            pltpu.VMEM((group_pad, 128), jnp.float32),
-            pltpu.VMEM((group_pad, head_dim), jnp.float32),
+            pltpu.VMEM((kv_heads, group_pad, 128), jnp.float32),
+            pltpu.VMEM((kv_heads, group_pad, 128), jnp.float32),
+            pltpu.VMEM((kv_heads, group_pad, head_dim), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -220,7 +248,7 @@ def paged_attention(
             (batch, kv_heads, group_pad, head_dim), q.dtype
         ),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
+            dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
     )(page_table, lens, q4, pool_k, pool_v)
