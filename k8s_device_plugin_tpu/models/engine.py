@@ -1345,9 +1345,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--max-new", type=_positive_int, default=32)
     p.add_argument(
         "--use-kernel",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
+        default=None,
         help="decode through the Pallas paged-attention kernel instead of "
-        "the gather path (ops/paged_attention.py)",
+        "the gather path (ops/paged_attention.py); default auto — kernel "
+        "on TPU, gather on CPU/quant_kv",
     )
     p.add_argument(
         "--temperature",
@@ -1463,7 +1465,7 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "requests": len(done),
                 "slots": args.slots,
                 "quant": args.quant,
-                "kernel": args.use_kernel,
+                "kernel": paged.kernel_enabled(cfg.quant_kv),
                 "sampler": "greedy"
                 if args.temperature <= 0
                 else f"temperature={args.temperature},top_k={args.top_k},"

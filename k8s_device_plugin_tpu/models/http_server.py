@@ -226,7 +226,13 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--num-pages", type=_positive_int, default=128)
     p.add_argument("--max-pages-per-seq", type=_positive_int, default=16)
     p.add_argument("--slots", type=_positive_int, default=4)
-    p.add_argument("--use-kernel", action="store_true")
+    p.add_argument(
+        "--use-kernel",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="force the Pallas paged-attention kernel on/off (default "
+        "auto: kernel on TPU, gather on CPU/quant_kv)",
+    )
     p.add_argument("--spec-gamma", type=int, default=0)
     p.add_argument(
         "--prefill-chunk",

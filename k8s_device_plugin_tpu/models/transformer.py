@@ -58,9 +58,19 @@ class PagedConfig:
     # traffic) instead of materializing the gathered [max_len] view.
     # Sliding windows mask inside the kernel (attention_window composes);
     # int8 KV pools (quant_kv) do not — the kernel streams bf16 pages.
-    # Opt-in until a hardware round proves the Mosaic lowering (BASELINE.md
-    # queue); interpreter-mode parity is pinned either way.
-    use_kernel: bool = False
+    # None = auto: the kernel on TPU backends (Mosaic-proven and faster on
+    # hardware — round-3 session 2 measured +19 ms/step at b8 over the
+    # gather path, BASELINE.md), the gather path on CPU (where the kernel
+    # would run under the slow Pallas interpreter) and whenever quant_kv
+    # needs int8 pools.  Explicit True forces the kernel (interpreter off
+    # TPU — what the parity tests pin); explicit False forces gather.
+    use_kernel: bool | None = None
+
+    def kernel_enabled(self, quant_kv: bool = False) -> bool:
+        """Resolve the tri-state ``use_kernel`` at trace time."""
+        if self.use_kernel is None:
+            return not quant_kv and jax.default_backend() == "tpu"
+        return self.use_kernel
 
     @property
     def max_len(self) -> int:
@@ -407,7 +417,7 @@ class CausalSelfAttention(nn.Module):
             # (the speculative verify pass) ride the gather path below —
             # its per-query masks handle in-block causality — so
             # use_kernel engines still spec.
-            if pg.use_kernel and q_len == 1:
+            if pg.kernel_enabled(cfg.quant_kv) and q_len == 1:
                 from ..ops.paged_attention import paged_attention
 
                 # Pages stream straight from the pool via the scalar-

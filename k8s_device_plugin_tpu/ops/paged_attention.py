@@ -94,7 +94,16 @@ def _paged_kernel(
         prec = (
             jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32 else None
         )
-        col0 = p * page_size
+        # Mask positions at/past the frontier (the partial last page) and,
+        # under a sliding window, positions that scrolled out — the mask
+        # is head-independent, so it is built once outside the unroll.
+        group_pad = q_ref.shape[2]
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (group_pad, page_size), 1
+        )
+        valid = col < length
+        if window is not None:
+            valid = jnp.logical_and(valid, col >= lo)
         for h in range(kv_heads):  # static unroll: one page, every kv head
             q = q_ref[0, h]  # [group_pad, head_dim]
             k = k_ref[0, :, h, :]  # [page_size, head_dim]
@@ -109,12 +118,6 @@ def _paged_kernel(
                 )
                 * sm_scale
             )  # [group_pad, page_size]
-            # Mask positions at/past the frontier (the partial last page)
-            # and, under a sliding window, positions that scrolled out.
-            col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            valid = col < length
-            if window is not None:
-                valid = jnp.logical_and(valid, col >= lo)
             s = jnp.where(valid, s, NEG_INF)
 
             m_prev = m_ref[h, :, :1]
