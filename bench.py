@@ -219,34 +219,43 @@ def _inner() -> None:
         return ips
 
     def bench_resnet_variants() -> None:
-        """Secondary: the two queued ResNet levers, A/B'd against the
-        headline configuration on the same chip (stderr only) — bf16
-        BatchNorm output (ResNet.norm_dtype) and the space-to-depth stem.
-        Whichever wins with margin becomes the default next round."""
+        """Secondary: ResNet levers A/B'd against the headline
+        configuration on the same chip (stderr only).  The round-3
+        session-2 A/B measured bf16 BatchNorm output at 2630 vs 2071
+        images/sec (+27%), so bf16-BN IS now the headline default; the
+        f32-BN variant keeps the regression visible, and the
+        space-to-depth stem stays on watch (2066 ips standalone — no win
+        at b128, re-check if the input pipeline changes)."""
         if platform == "cpu":
             return
         try:
             rng = jax.random.PRNGKey(0)
             batch = synthetic_image_batch(rng, 128, image_size=224, num_classes=1000)
             tx = optax.sgd(0.1, momentum=0.9)
-            for label, kw in [
-                ("bf16-BN", dict(norm_dtype=jnp.bfloat16)),
-                ("s2d-stem", dict(stem="space_to_depth")),
-                (
-                    "bf16-BN+s2d",
-                    dict(norm_dtype=jnp.bfloat16, stem="space_to_depth"),
-                ),
+            for label, bsz, kw in [
+                ("f32-BN", 128, dict(norm_dtype=jnp.float32)),
+                ("s2d-stem", 128, dict(stem="space_to_depth")),
+                # b128-beats-b256 was measured at f32 BN (r3 session 1);
+                # bf16 BN halves the traffic that penalized b256 — re-check.
+                ("b256", 256, dict()),
             ]:
                 try:
+                    vbatch = (
+                        batch
+                        if bsz == 128
+                        else synthetic_image_batch(
+                            rng, bsz, image_size=224, num_classes=1000
+                        )
+                    )
                     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, **kw)
-                    state = create_train_state(rng, model, batch, tx)
+                    state = create_train_state(rng, model, vbatch, tx)
                     step = jax.jit(make_train_step(model, tx), donate_argnums=0)
                     # Same chain length as the headline: shorter chains
                     # carry proportionally more relay RTT (the 1949-vs-
                     # 2051 finding above) and would bias the A/B against
                     # the variants.
-                    state, loss, dt = timed_steps(step, state, batch, 5, 60)
-                    ips = 128 * 60 / dt
+                    state, loss, dt = timed_steps(step, state, vbatch, 5, 60)
+                    ips = bsz * 60 / dt
                     log(f"resnet50 variant {label}: {ips:.1f} images/sec")
                 except Exception as e:
                     log(f"resnet50 variant {label} failed: {e}")
@@ -742,13 +751,19 @@ def _inner() -> None:
         ),
         flush=True,
     )
-    bench_resnet_variants()
-    bench_lm_train()
-    bench_flash_attention()
-    bench_paged_kernel()
-    bench_allocation_latency()
+    # Secondary order = value density under the attempt timeout: relay
+    # compiles cost ~100-150s EACH, and the round-3 session-2 run lost
+    # everything after fused-xent to the 2200s window — so the still-
+    # unmeasured queue items (int8 decode, speculative, paged kernel) go
+    # FIRST and the already-hardware-measured A/Bs (resnet variants,
+    # fused-xent inside bench_lm_train) run last.
     bench_decode_quant()
     bench_speculative()
+    bench_paged_kernel()
+    bench_allocation_latency()
+    bench_lm_train()
+    bench_resnet_variants()
+    bench_flash_attention()
 
 
 # --------------------------------------------------------------------------

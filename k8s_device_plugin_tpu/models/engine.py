@@ -187,13 +187,6 @@ class ServingEngine:
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
-        if paged.use_kernel and cfg.quant_kv:
-            # Fail at the config boundary, not at the first jitted step.
-            raise ValueError(
-                "use_kernel + quant_kv is not supported (the Pallas paged "
-                "kernel streams bf16 pages); use the gather path for int8 "
-                "paged KV"
-            )
         if spec_gamma < 0:
             raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
         if cfg.lora_serve and spec_gamma > 0:
@@ -1349,7 +1342,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         default=None,
         help="decode through the Pallas paged-attention kernel instead of "
         "the gather path (ops/paged_attention.py); default auto — kernel "
-        "on TPU, gather on CPU/quant_kv",
+        "on TPU, gather on CPU and (until its Mosaic lowering is "
+        "hardware-proven) for --quant-kv pools",
     )
     p.add_argument(
         "--temperature",

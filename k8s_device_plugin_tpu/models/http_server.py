@@ -231,7 +231,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         action=argparse.BooleanOptionalAction,
         default=None,
         help="force the Pallas paged-attention kernel on/off (default "
-        "auto: kernel on TPU, gather on CPU/quant_kv)",
+        "auto: kernel on TPU, gather on CPU and for --quant-kv pools)",
     )
     p.add_argument("--spec-gamma", type=int, default=0)
     p.add_argument(

@@ -56,14 +56,18 @@ class PagedConfig:
     # Read pages through the Pallas paged-attention kernel
     # (ops/paged_attention.py: scalar-prefetched page table, O(len) HBM
     # traffic) instead of materializing the gathered [max_len] view.
-    # Sliding windows mask inside the kernel (attention_window composes);
-    # int8 KV pools (quant_kv) do not — the kernel streams bf16 pages.
+    # Sliding windows mask inside the kernel (attention_window composes),
+    # and int8 KV pools (quant_kv) stream as int8 with their scale pools
+    # riding along — half the decode traffic.
     # None = auto: the kernel on TPU backends (Mosaic-proven and faster on
     # hardware — round-3 session 2 measured +19 ms/step at b8 over the
     # gather path, BASELINE.md), the gather path on CPU (where the kernel
-    # would run under the slow Pallas interpreter) and whenever quant_kv
-    # needs int8 pools.  Explicit True forces the kernel (interpreter off
-    # TPU — what the parity tests pin); explicit False forces gather.
+    # would run under the slow Pallas interpreter).  The int8-pool variant
+    # (quant_kv) is interpreter-parity-proven but its Mosaic lowering has
+    # NOT yet run on hardware (the relay wedged first — BASELINE.md
+    # queue), so auto keeps quant_kv on gather until a session proves it;
+    # explicit True forces the kernel for it too (interpreter off TPU —
+    # what the parity tests pin); explicit False forces gather.
     use_kernel: bool | None = None
 
     def kernel_enabled(self, quant_kv: bool = False) -> bool:
@@ -353,12 +357,6 @@ class CausalSelfAttention(nn.Module):
             # scratch target so inactive rows never collide with live
             # pages.
             pg = cfg.paged
-            if pg.use_kernel and cfg.quant_kv:
-                raise ValueError(
-                    "use_kernel + quant_kv is not supported (the Pallas "
-                    "paged kernel streams bf16 pages); use the gather path "
-                    "for int8 paged KV"
-                )
             batch, q_len = hidden.shape[:2]
             pool_shape = (pg.num_pages, pg.page_size, cfg.kv_heads, cfg.head_dim)
             if cfg.quant_kv:
@@ -424,7 +422,9 @@ class CausalSelfAttention(nn.Module):
                 # prefetched table; valid slots per row = position + 1
                 # (this token's K/V were just written above).  A sliding
                 # window masks inside the kernel (and skips wholly-dead
-                # pages), mirroring the gather path's mask.
+                # pages), mirroring the gather path's mask.  int8 pools
+                # (quant_kv) stream as int8 — half the traffic — with
+                # their scale pools riding along.
                 attn = paged_attention(
                     q[:, 0],
                     pk.value,
@@ -432,6 +432,8 @@ class CausalSelfAttention(nn.Module):
                     table.value,
                     positions[:, 0] + 1,
                     window=cfg.attention_window,
+                    scale_k=psk.value if cfg.quant_kv else None,
+                    scale_v=psv.value if cfg.quant_kv else None,
                 )[:, None]
             else:
                 # Gather each row's pages into its logical [max_len] view.

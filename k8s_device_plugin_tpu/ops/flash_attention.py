@@ -47,9 +47,11 @@ NEG_INF = float("-inf")
 # so the fwd default rides the large end; VMEM stays modest (f32 scores
 # tile 512x1024 = 2 MB + double-buffered kv tiles).  The backward kernels
 # keep more operands live per tile (q, k, v, dO, O, lse + two f32
-# accumulators), so they keep the smaller hardware-proven shape until a
-# dedicated bwd sweep lands (bench.py logs both each round; re-tune as
-# data accumulates).
+# accumulators), so their swept optimum is squarer: the round-3 bwd sweep
+# (two-point, 10-iter chains) measured grad(flash) at q512/kv512 in
+# 1.67 ms vs 2.78 ms at q256/kv512 for b4 h16 s2048 d64, and 1.49 ms vs
+# 7.03 ms (single-point) at the old q128/kv512 for d=128 — q512/kv1024
+# regressed (8.15 ms, VMEM pressure), so bwd stays at 512x512.
 _BLOCK_DEFAULTS = (
     ("TPU v5 lite", (512, 1024)),
     ("TPU v5e", (512, 1024)),
@@ -58,11 +60,11 @@ _BLOCK_DEFAULTS = (
     ("TPU v6", (512, 1024)),  # unswept: inherit v5e until a v6 sweep exists
 )
 _BWD_BLOCK_DEFAULTS = (
-    ("TPU v5 lite", (128, 512)),
-    ("TPU v5e", (128, 512)),
-    ("TPU v5p", (128, 512)),
+    ("TPU v5 lite", (512, 512)),
+    ("TPU v5e", (512, 512)),
+    ("TPU v5p", (512, 512)),
     ("TPU v4", (128, 256)),
-    ("TPU v6", (128, 512)),
+    ("TPU v6", (512, 512)),  # unswept: inherit v5e until a v6 sweep exists
 )
 _FALLBACK_BLOCKS = (128, 256)  # unknown TPU generation
 _INTERPRET_BLOCKS = (128, 128)  # CPU interpreter: smallest legal tiles

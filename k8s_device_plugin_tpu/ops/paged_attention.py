@@ -34,7 +34,11 @@ Design (same language as ops/flash_attention.py):
 - per-position masking inside the frontier page via iota < len;
 - f32 pools matmul at ``Precision.HIGHEST`` (the MXU's default bf16
   passes cost ~2e-3 relative error, measured on v5e; bf16 pools use the
-  native path).
+  native path);
+- int8 pools (``GPTConfig.quant_kv``) stream as int8 — HALF the decode
+  HBM traffic — with per-(slot, head) scale pools riding as extra
+  blocks; the scale factors out of the head_dim dot, so pages matmul on
+  the exact int8→bf16 cast and scales multiply the small score matrix.
 
 Status: Mosaic-compiled and parity-checked against an f32 host oracle on
 real v5e hardware (round 3 session 2; MHA/GQA/MQA, windowed, bf16+f32,
@@ -64,17 +68,22 @@ def _paged_kernel(
     q_ref,  # [1, kv_heads, group_pad, head_dim]
     k_ref,  # [1, page_size, kv_heads, head_dim] — one full page
     v_ref,
-    o_ref,  # [1, kv_heads, group_pad, head_dim]
-    m_ref,  # VMEM [kv_heads, group_pad, 128] f32, lane-replicated running max
-    l_ref,  # VMEM [kv_heads, group_pad, 128] f32, running denominator
-    acc_ref,  # VMEM [kv_heads, group_pad, head_dim] f32
-    *,
+    *rest,  # int8 pools: sk_ref, sv_ref [1, kv_heads, page_size] f32; then
+    # o_ref [1, kv_heads, group_pad, head_dim],
+    # m_ref VMEM [kv_heads, group_pad, 128] f32 lane-replicated running max,
+    # l_ref VMEM [kv_heads, group_pad, 128] f32 running denominator,
+    # acc_ref VMEM [kv_heads, group_pad, head_dim] f32
     page_size: int,
     num_pages: int,
     kv_heads: int,
     sm_scale: float,
     window: int | None,
+    quant: bool,
 ):
+    if quant:
+        sk_ref, sv_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b, p = pl.program_id(0), pl.program_id(1)
     length = lens_ref[b]  # valid cache slots: positions [0, length)
     # Sliding window: the (single) query sits at position length-1 and sees
@@ -108,6 +117,16 @@ def _paged_kernel(
             q = q_ref[0, h]  # [group_pad, head_dim]
             k = k_ref[0, :, h, :]  # [page_size, head_dim]
             v = v_ref[0, :, h, :]
+            if quant:
+                # int8 pages: the per-(position, head) scale factors OUT
+                # of the dot over head_dim, so the page matmuls on the
+                # EXACT int8→compute-dtype cast (|x| ≤ 127 is exact in
+                # bf16) and the scale multiplies the small [group_pad,
+                # page_size] score matrix in f32 — no dequantized page
+                # materializes, and no bf16 rounding of scaled K (the
+                # gather path rounds; this path is strictly closer to the
+                # f32 math).
+                k = k.astype(q.dtype)
             s = (
                 jax.lax.dot_general(
                     q,
@@ -118,6 +137,8 @@ def _paged_kernel(
                 )
                 * sm_scale
             )  # [group_pad, page_size]
+            if quant:
+                s = s * sk_ref[0, h][None, :]
             s = jnp.where(valid, s, NEG_INF)
 
             m_prev = m_ref[h, :, :1]
@@ -132,6 +153,10 @@ def _paged_kernel(
                 alpha * l_prev + jnp.sum(prob, axis=-1, keepdims=True),
                 l_ref.shape[1:],
             )
+            if quant:
+                # V's scale rides the probabilities (same factoring as K).
+                prob = prob * sv_ref[0, h][None, :]
+                v = v.astype(q.dtype)
             acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
                 prob.astype(v.dtype),
                 v,
@@ -163,6 +188,8 @@ def paged_attention(
     page_table: jax.Array,
     lens: jax.Array,
     *,
+    scale_k: jax.Array | None = None,
+    scale_v: jax.Array | None = None,
     sm_scale: float | None = None,
     window: int | None = None,
     interpret: bool | None = None,
@@ -184,6 +211,12 @@ def paged_attention(
     serving engine additionally re-points their table entries at scratch
     so they skip fetch too (windowed page reclamation).
 
+    ``scale_k``/``scale_v``: int8 KV pools — when the pools are int8
+    (``GPTConfig.quant_kv``), pass the per-(page-slot, kv-head) f32 scale
+    pools ``[num_pool_pages, page_size, kv_heads]`` and the kernel
+    streams int8 pages (HALF the decode HBM traffic) and applies scales
+    on the score matrix, where they factor out of the head_dim dot.
+
     Traffic note: table entries past a row's live pages are read by the
     pipeline regardless of the dead-page predicate (see module docstring)
     — point them all at one scratch page to keep per-row traffic O(len).
@@ -196,6 +229,15 @@ def paged_attention(
     pages_per_seq = page_table.shape[1]
     if num_heads % kv_heads:
         raise ValueError(f"num_heads {num_heads} not a multiple of kv_heads {kv_heads}")
+    quant = pool_k.dtype == jnp.int8
+    if pool_v.dtype != pool_k.dtype:
+        raise ValueError(
+            f"pool dtypes must match, got k={pool_k.dtype} v={pool_v.dtype}"
+        )
+    if quant and (scale_k is None or scale_v is None):
+        raise ValueError("int8 pools require scale_k and scale_v scale pools")
+    if not quant and (scale_k is not None or scale_v is not None):
+        raise ValueError(f"scale pools passed with {pool_k.dtype} (non-int8) pools")
     group = num_heads // kv_heads
     if sm_scale is None:
         sm_scale = head_dim ** -0.5
@@ -216,28 +258,37 @@ def paged_attention(
         kv_heads=kv_heads,
         sm_scale=sm_scale,
         window=window,
+        quant=quant,
     )
+    qo_spec = pl.BlockSpec(
+        (1, kv_heads, group_pad, head_dim),
+        lambda b, p, table, lens: (b, 0, 0, 0),
+    )
+    page_spec = pl.BlockSpec(
+        (1, page_size, kv_heads, head_dim),
+        lambda b, p, table, lens: (table[b, p], 0, 0, 0),
+    )
+    in_specs = [qo_spec, page_spec, page_spec]
+    operands = [q4, pool_k, pool_v]
+    if quant:
+        # Scales ride as [pool, kv_heads, page_size] so the in-kernel
+        # slice [0, h] lands on the LANE axis, matching the score
+        # matrix's page_size lanes (the engine stores [pool, page_size,
+        # kv_heads]; this transpose moves KB, the pools move MB).
+        scale_spec = pl.BlockSpec(
+            (1, kv_heads, page_size),
+            lambda b, p, table, lens: (table[b, p], 0, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [
+            jnp.swapaxes(scale_k, 1, 2),
+            jnp.swapaxes(scale_v, 1, 2),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec(
-                (1, kv_heads, group_pad, head_dim),
-                lambda b, p, table, lens: (b, 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, page_size, kv_heads, head_dim),
-                lambda b, p, table, lens: (table[b, p], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, page_size, kv_heads, head_dim),
-                lambda b, p, table, lens: (table[b, p], 0, 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, kv_heads, group_pad, head_dim),
-            lambda b, p, table, lens: (b, 0, 0, 0),
-        ),
+        in_specs=in_specs,
+        out_specs=qo_spec,
         scratch_shapes=[
             pltpu.VMEM((kv_heads, group_pad, 128), jnp.float32),
             pltpu.VMEM((kv_heads, group_pad, 128), jnp.float32),
@@ -254,5 +305,5 @@ def paged_attention(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(page_table, lens, q4, pool_k, pool_v)
+    )(page_table, lens, *operands)
     return out[:, :, :group, :].reshape(batch, num_heads, head_dim)

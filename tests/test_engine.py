@@ -500,14 +500,40 @@ def test_engine_int8_kv_composes_with_window_and_spec(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
-def test_kernel_plus_quant_kv_rejected(rng):
+def test_kernel_with_int8_paged_kv(rng):
+    """use_kernel + quant_kv (the r2 exclusion, now closed): the kernel
+    streams int8 pages with their scale pools riding along — tokens
+    still match the dense quant_kv oracle, pools really are int8."""
     cfg = _cfg(quant_kv=True)
     params = _params(cfg, rng)
     paged = PagedConfig(
-        page_size=4, num_pages=16, max_pages_per_seq=8, use_kernel=True
+        page_size=4, num_pages=32, max_pages_per_seq=8, use_kernel=True
     )
-    with pytest.raises(ValueError, match="quant_kv"):
-        ServingEngine(cfg, params, paged, max_slots=1)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    att = eng.cache["layer_0"]["attn"]
+    assert att["pool_key"].dtype == jnp.int8
+    jobs = [([3, 141, 59], 7), ([9, 10], 5)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_kernel_int8_kv_composes_with_window(rng):
+    """use_kernel + quant_kv + sliding window: int8 pages stream through
+    the windowed kernel mask while reclamation re-points scrolled
+    entries — tokens match the dense windowed quant_kv oracle."""
+    cfg = _cfg(quant_kv=True, attention_window=4)
+    params = _params(cfg, rng)
+    paged = PagedConfig(
+        page_size=2, num_pages=24, max_pages_per_seq=12, use_kernel=True
+    )
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    jobs = [([3, 141, 59], 9), ([9, 10], 6)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
 
 
 def test_spec_engine_matches_dense_oracle(rng):
@@ -834,7 +860,7 @@ def test_engine_feature_matrix_fuzz(rng):
     for trial in range(4):
         window = int(npr.choice([0, 4]))
         use_kernel = bool(npr.randint(2))
-        quant_kv = bool(npr.randint(2)) and not use_kernel
+        quant_kv = bool(npr.randint(2))
         spec = int(npr.choice([0, 2]))
         cfg = _cfg(
             attention_window=window or None, quant_kv=quant_kv

@@ -151,3 +151,62 @@ def test_validation(rng):
         paged_attention(q[:, :5], pk, pv, table, lens, interpret=True)
     with pytest.raises(ValueError, match="window"):
         paged_attention(q, pk, pv, table, lens, window=0, interpret=True)
+
+
+def _int8_setup(rng, **kw):
+    """Quantize a float _setup's pools into int8 pools + scale pools."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_kv
+
+    q, pk, pv, table, lens = _setup(rng, **kw)
+    # quantize_kv wants [batch, tokens, kv_heads, head_dim]; the pool's
+    # [pages, page_size, ...] layout matches positionally.
+    pk8, sk = quantize_kv(pk)
+    pv8, sv = quantize_kv(pv)
+    return q, pk8, pv8, sk, sv, table, lens
+
+
+def _int8_gather_oracle(q, pk8, pv8, sk, sv, table, lens, window=None):
+    """The engine's int8 gather path: dequantize the materialized view
+    (ops/quant.py dequantize_kv), then the float oracle."""
+    from k8s_device_plugin_tpu.ops.quant import dequantize_kv
+
+    pk = dequantize_kv(pk8, sk, jnp.float32)
+    pv = dequantize_kv(pv8, sv, jnp.float32)
+    return gather_oracle(q, pk, pv, table, lens, window=window)
+
+
+def test_int8_pools_match_dequant_oracle(rng):
+    """int8 pages stream through the kernel with scale pools riding
+    along; scales factor onto the score matrix, so the result matches
+    the dequantize-then-attend gather path."""
+    q, pk8, pv8, sk, sv, table, lens = _int8_setup(rng)
+    got = paged_attention(
+        q, pk8, pv8, table, lens, scale_k=sk, scale_v=sv, interpret=True
+    )
+    want = _int8_gather_oracle(q, pk8, pv8, sk, sv, table, lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_int8_pools_gqa_and_window(rng):
+    for heads, kv_heads, window in [(8, 2, None), (8, 4, 7), (16, 1, 12)]:
+        q, pk8, pv8, sk, sv, table, lens = _int8_setup(rng, heads=heads, kv_heads=kv_heads)
+        got = paged_attention(
+            q, pk8, pv8, table, lens, scale_k=sk, scale_v=sv,
+            window=window, interpret=True,
+        )
+        want = _int8_gather_oracle(q, pk8, pv8, sk, sv, table, lens, window=window)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"{heads}q/{kv_heads}kv win={window}",
+        )
+
+
+def test_int8_scale_validation(rng):
+    q, pk8, pv8, sk, sv, table, lens = _int8_setup(rng)
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention(q, pk8, pv8, table, lens, interpret=True)
+    qf, pkf, pvf, tablef, lensf = _setup(rng)
+    with pytest.raises(ValueError, match="non-int8"):
+        paged_attention(
+            qf, pkf, pvf, tablef, lensf, scale_k=sk, scale_v=sv, interpret=True
+        )

@@ -3,13 +3,16 @@
 Run on a live TPU (never by the driver — this is the builder's measurement
 tool; results land in BASELINE.md and drive default flips):
 
-    python tools/hw_sweep.py [paged_parity] [bwd_sweep] [engine_ab]
+    python tools/hw_sweep.py [paged_parity] [int8_parity] [bwd_sweep] [engine_ab]
 
 Sections (default: all), each guarded so one failure doesn't kill the rest:
 
 - ``paged_parity``  — Mosaic-compiled paged-attention kernel vs an f32
   gather oracle at serving shapes, full-causal AND windowed (BASELINE.md
   queue: "parity vs host oracle, then kernel-vs-gather ms").
+- ``int8_parity``   — Mosaic parity of the int8-pool kernel variant
+  (scale pools ride as blocks, scales multiply the score matrix); the
+  gate for auto-routing quant_kv through the kernel.
 - ``bwd_sweep``     — flash-attention backward tile sweep over
   ``bwd_block_q``/``bwd_block_kv`` (queue: "512-class bwd tiles are
   unswept").
@@ -72,41 +75,79 @@ def _gather_oracle(q, pk, pv, table, lens, window=None):
 
 
 @section("paged_parity")
+def _pool_setup(b, h, kv, d, ps, mpp, fill, seed=1):
+    """Pools + a scrambled non-contiguous table; fill deliberately NOT
+    page-aligned so the partial last page's masking is exercised on real
+    Mosaic."""
+    n_pool = b * mpp + 1
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (b, h, d), jnp.bfloat16)
+    pk = jax.random.normal(ks[1], (n_pool, ps, kv, d), jnp.bfloat16)
+    pv = jax.random.normal(ks[2], (n_pool, ps, kv, d), jnp.bfloat16)
+    perm = jax.random.permutation(ks[3], n_pool - 1) + 1
+    table = np.zeros((b, mpp), np.int32)
+    need = -(-fill // ps)
+    table[:, :need] = np.asarray(perm)[: b * need].reshape(b, need)
+    return q, pk, pv, jnp.asarray(table), jnp.full((b,), fill, jnp.int32)
+
+
+def _report_parity(tag, label, got, want):
+    # bf16 inputs -> ~1e-2 tolerance band is the expected float noise.
+    err = np.max(np.abs(got - want))
+    log(
+        f"{tag} {label}: max|err|={err:.2e} "
+        f"{'OK' if err < 3e-2 else '** MISMATCH **'}"
+    )
+
+
 def paged_parity():
     from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
 
-    # Serving shapes; fill deliberately NOT page-aligned so the partial
-    # last page's masking is exercised on real Mosaic.
     for (label, b, h, kv, d, ps, mpp, fill, window) in [
         ("b4 full-causal", 4, 16, 4, 64, 16, 32, 403, None),
         ("b8 full-causal", 8, 16, 16, 64, 16, 64, 1000, None),
         ("b4 window64", 4, 16, 4, 64, 16, 32, 403, 64),
         ("b4 window17", 4, 16, 4, 64, 16, 32, 403, 17),
     ]:
-        n_pool = b * mpp + 1
-        ks = jax.random.split(jax.random.PRNGKey(1), 4)
-        q = jax.random.normal(ks[0], (b, h, d), jnp.bfloat16)
-        pk = jax.random.normal(ks[1], (n_pool, ps, kv, d), jnp.bfloat16)
-        pv = jax.random.normal(ks[2], (n_pool, ps, kv, d), jnp.bfloat16)
-        perm = jax.random.permutation(ks[3], n_pool - 1) + 1
-        table = np.zeros((b, mpp), np.int32)
-        need = -(-fill // ps)
-        table[:, :need] = np.asarray(perm)[: b * need].reshape(b, need)
-        table = jnp.asarray(table)
-        lens = jnp.full((b,), fill, jnp.int32)
-
+        q, pk, pv, table, lens = _pool_setup(b, h, kv, d, ps, mpp, fill)
         got = jax.device_get(
             paged_attention(
                 q, pk, pv, table, lens, window=window, interpret=False
             )
         ).astype(np.float32)
         want = jax.device_get(_gather_oracle(q, pk, pv, table, lens, window))
-        err = np.max(np.abs(got - want))
-        # bf16 inputs -> ~1e-2 tolerance band is the expected float noise.
-        log(
-            f"paged parity {label}: max|err|={err:.2e} "
-            f"{'OK' if err < 3e-2 else '** MISMATCH **'}"
+        _report_parity("paged parity", label, got, want)
+
+
+@section("int8_parity")
+def int8_parity():
+    """Mosaic parity of the paged kernel's int8-pool variant (the gate
+    for letting kernel_enabled() auto-route quant_kv — see the
+    PagedConfig comment).  Oracle = dequantize-then-attend in f32, the
+    gather path's math."""
+    from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
+    from k8s_device_plugin_tpu.ops.quant import dequantize_kv, quantize_kv
+
+    for (label, b, h, kv, d, ps, mpp, fill, window) in [
+        ("b4 full-causal", 4, 16, 4, 64, 16, 32, 403, None),
+        ("b8 gqa16/4 d128", 8, 16, 4, 128, 16, 32, 403, None),
+        ("b4 window48", 4, 16, 4, 64, 16, 32, 403, 48),
+    ]:
+        q, pk, pv, table, lens = _pool_setup(b, h, kv, d, ps, mpp, fill, seed=5)
+        pk8, sk = quantize_kv(pk)
+        pv8, sv = quantize_kv(pv)
+        got = jax.device_get(
+            paged_attention(
+                q, pk8, pv8, table, lens, scale_k=sk, scale_v=sv,
+                window=window, interpret=False,
+            )
+        ).astype(np.float32)
+        pkf = dequantize_kv(pk8, sk, jnp.float32)
+        pvf = dequantize_kv(pv8, sv, jnp.float32)
+        want = jax.device_get(
+            _gather_oracle(q.astype(jnp.float32), pkf, pvf, table, lens, window)
         )
+        _report_parity("int8 paged parity", label, got, want)
 
 
 def timed_chain(fn, x, iters: int, small: int = 2) -> float:
@@ -241,6 +282,7 @@ def engine_ab():
 
 ALL = {
     "paged_parity": paged_parity,
+    "int8_parity": int8_parity,
     "bwd_sweep": bwd_sweep,
     "engine_ab": engine_ab,
 }
