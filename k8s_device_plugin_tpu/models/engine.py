@@ -184,11 +184,23 @@ class ServingEngine:
         draft_params: Any = None,
         draft_cfg: Optional[GPTConfig] = None,
         prefill_chunk: Optional[int] = None,
+        decode_block: int = 1,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
         if spec_gamma < 0:
             raise ValueError(f"spec_gamma must be >= 0, got {spec_gamma}")
+        if decode_block < 1 or (decode_block & (decode_block - 1)):
+            # Power of two: the host down-buckets the block to the largest
+            # power of two that fits every active slot's remaining budget,
+            # so compiled block programs stay O(log decode_block).
+            raise ValueError(
+                f"decode_block must be a power of two >= 1, got {decode_block}"
+            )
+        if decode_block > 1 and spec_gamma > 0:
+            # Both amortize dispatches over multi-token device rounds with
+            # incompatible schedules (scan of exact steps vs draft+verify).
+            raise ValueError("decode_block > 1 is not supported with spec_gamma")
         if cfg.lora_serve and spec_gamma > 0:
             # The self-draft is the same model int8-quantized, and quant is
             # mutually exclusive with LoRA (quantize after merging) — there
@@ -292,6 +304,18 @@ class ServingEngine:
 
         self._step = step
         self._step_plain = step_plain
+        # Decode blocks (decode_block > 1): when the engine is in pure
+        # decode — no admission work, every slot past prefill — the host
+        # dispatches ONE program that scans T exact single-token steps
+        # (same math, T fresh subkeys), then consumes/rewinds on sync.
+        # Each dispatch costs one host round-trip instead of T, which is
+        # the serving bottleneck at small batch (per-step dispatch is
+        # ~100us on a local TPU VM and ~90ms through this relay).  Jitted
+        # per (T, filtered) lazily; T down-buckets by powers of two so at
+        # most O(log decode_block) programs ever compile.
+        self._decode_block = decode_block
+        self._decode_model = model
+        self._block_fns: dict = {}
         # ALL prefill runs through the multi-token CACHED append (the
         # speculative verifier's path): each chunk attends against the
         # K/V of every previous chunk via position masks, so a prompt can
@@ -1057,6 +1081,115 @@ class ServingEngine:
 
     # ----------------------------------------------------------------- steps
 
+    def _block_fn(self, T: int, filtered: bool):
+        """Build (lazily, once per (T, filtered)) the jitted T-step decode
+        block: a lax.scan of T exact single-token decode steps — same
+        model apply, same per-slot sampling, a fresh subkey per step — so
+        one dispatch advances every active slot T tokens.  Greedy slots
+        emit exactly their step-at-a-time decode; sampled slots draw from
+        the identical per-step distributions (different key schedule than
+        T separate step() calls, same law)."""
+        key_ = (T, filtered)
+        if key_ in self._block_fns:
+            return self._block_fns[key_]
+        model = self._decode_model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def block(params, cache, tokens, positions, temps, topks, topps, aids, key):
+            def body(carry, k):
+                cache, toks, pos = carry
+                logits, mut = model.apply(
+                    {"params": params, "cache": cache},
+                    toks,
+                    pos,
+                    adapter_ids=aids,
+                    mutable=["cache"],
+                )
+                row = logits[:, -1, :]
+                greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
+                if filtered:
+                    scaled = filter_top_k_top_p(scaled, topks, topps)
+                sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                return (mut["cache"], nxt[:, None], pos + 1), nxt
+
+            (cache, _, _), toks = jax.lax.scan(
+                body, (cache, tokens, positions), jax.random.split(key, T)
+            )
+            return toks.T, cache  # [slots, T]
+
+        self._block_fns[key_] = block
+        return block
+
+    def _block_step(
+        self, active: list[int], finished: list[Request], T: int
+    ) -> list[Request]:
+        """Advance every active slot up to T tokens in ONE dispatch (the
+        pure-decode fast path of step()).  A slot that hits EOS/max_new
+        mid-block wastes its tail iterations (their K/V writes land past
+        the row's final length and are masked forever after the rewind —
+        the speculative round's exact discipline); everything the host
+        consumes is identical to T single steps."""
+        for s in active:
+            self._extend_frontier(s, lookahead=T - 1)
+        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
+        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
+        temps = jnp.asarray(self._slot_temp, jnp.float32)
+        aids = jnp.asarray(self._slot_aid, jnp.int32)
+        topks = jnp.asarray(self._slot_topk, jnp.int32)
+        topps = jnp.asarray(self._slot_topp, jnp.float32)
+        filtered = any(
+            self.slots[s] is not None
+            and (
+                self._slot_topk[s] < self.cfg.vocab_size
+                or self._slot_topp[s] < 1.0
+            )
+            for s in range(self.max_slots)
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        out, self.cache = self._block_fn(T, filtered)(
+            self.params, self.cache, tokens, positions, temps, topks,
+            topps, aids, sub,
+        )
+        out = np.asarray(out)
+        emitted_total = 0
+        for s in active:
+            req = self.slots[s]
+            consumed = 0
+            for j in range(T):
+                tok = int(out[s, j])
+                req.tokens.append(tok)
+                self._slot_last[s] = tok
+                consumed += 1
+                emitted_total += 1
+                if len(req.tokens) >= req.max_new_tokens or (
+                    self.eos_id is not None and tok == self.eos_id
+                ):
+                    break
+            self._slot_len[s] += consumed
+            self._maybe_finish(s)
+            if req.done:
+                finished.append(req)
+            else:
+                self._extend_frontier(s)
+                if self.cfg.attention_window is not None:
+                    self._reclaim_windowed(s)
+        # The block left every row's device length at L+T; re-align to the
+        # host truth in one vector write per layer (fresh array per layer
+        # — see the identical note in _spec_step re double donation).
+        for name in self._layer_names:
+            att = self.cache[name]["attn"]
+            self.cache[name]["attn"] = {
+                **att,
+                "seq_lens": jnp.array(self._slot_len, jnp.int32),
+            }
+        if self.metrics:
+            self.metrics.steps.inc()
+            self.metrics.tokens.inc(emitted_total)
+        self._update_gauges()
+        return finished
+
     def step(self) -> list[Request]:
         """Admit what fits, advance every active slot one token; returns
         every request that finished this step (including ones done at
@@ -1080,6 +1213,20 @@ class ServingEngine:
             return finished
         if self._spec_gamma:
             return self._spec_step(active, finished)
+        if (
+            self._decode_block > 1
+            and not self._pending  # no prompt mid-stream: keep chunking
+            and not self.queue  # admission possible next step: stay fine-grained
+        ):
+            # Largest power-of-two block that no active slot's remaining
+            # budget truncates (so no slot can overrun max_new mid-block).
+            room = min(
+                self.slots[s].max_new_tokens - len(self.slots[s].tokens)
+                for s in active
+            )
+            T = min(self._decode_block, 1 << max(0, room.bit_length() - 1))
+            if T > 1:
+                return self._block_step(active, finished, T)
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
@@ -1203,14 +1350,18 @@ class ServingEngine:
         self._update_gauges()
         return finished
 
-    def _extend_frontier(self, slot: int) -> None:
+    def _extend_frontier(self, slot: int, lookahead: Optional[int] = None) -> None:
         """Publish every page the next step can write — up to the one
-        covering position len+gamma (gamma=0 without speculation) — into
-        the device table the moment the frontier approaches it: tiny
-        .at[slot, idx].set updates per layer, amortized O(1/page_size)
-        dispatches per token."""
+        covering position len+lookahead — into the device table the
+        moment the frontier approaches it: tiny .at[slot, idx].set
+        updates per layer, amortized O(1/page_size) dispatches per token.
+        ``lookahead`` defaults to the speculative gamma (0 for plain
+        decode: only the next position's page); decode blocks pass T-1,
+        their furthest write."""
+        if lookahead is None:
+            lookahead = self._spec_gamma
         need = (
-            self._slot_len[slot] + self._spec_gamma
+            self._slot_len[slot] + lookahead
         ) // self.paged.page_size + 1
         need = min(
             need, self._slot_page_base[slot] + len(self._slot_pages[slot])
@@ -1376,6 +1527,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         "tokens (power of two), bounding how long active slots stall "
         "per step during a long admission",
     )
+    p.add_argument(
+        "--decode-block",
+        type=_pow2_int,
+        default=1,
+        help="in pure decode (no admission work), advance every slot up "
+        "to this many tokens per dispatch via one scanned program "
+        "(power of two) — amortizes the per-step host round-trip; "
+        "incompatible with --spec-gamma",
+    )
     args = p.parse_args(argv)
     if args.spec_gamma and args.quant:
         raise SystemExit(
@@ -1418,7 +1578,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         )
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
-        prefill_chunk=args.prefill_chunk, **spec_kw,
+        prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
+        **spec_kw,
     )
     sample_kw = dict(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p

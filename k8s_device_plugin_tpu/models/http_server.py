@@ -240,6 +240,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         default=None,
         help="stream prompts into the prefill in chunks (power of two)",
     )
+    p.add_argument(
+        "--decode-block",
+        type=_pow2_int,
+        default=1,
+        help="tokens per dispatch in pure decode (power of two; one "
+        "scanned program amortizes the per-step host round-trip)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--checkpoint-dir",
@@ -365,6 +372,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk,
+        decode_block=args.decode_block,
         **spec_kw,
     )
     server = EngineServer(
