@@ -159,6 +159,10 @@ class Request:
     rid: int = -1
     tokens: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # Set via ServingEngine.cancel() (client went away): a queued request
+    # finishes immediately; an in-flight one is torn down at the next step
+    # boundary, its slot and pages returned to the pool.
+    cancelled: bool = False
 
 
 class ServingEngine:
@@ -658,6 +662,29 @@ class ServingEngine:
             self._update_gauges()
         return req
 
+    def cancel(self, req: Request) -> bool:
+        """Stop generating for ``req`` (the client went away — the HTTP
+        front-end calls this on disconnect/timeout so an abandoned
+        request stops burning chip time).  Thread-safe like submit().
+
+        A still-queued request finishes right here (it holds no pages);
+        an in-flight one is marked and the owner thread tears it down at
+        its next step boundary — slot, pages, and prefix refcounts all
+        return through the ordinary _clear_slot path, so the pool stays
+        exact.  Returns False if the request had already finished."""
+        with self._lock:
+            if req.done:
+                return False
+            req.cancelled = True
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                pass  # admitted (slot or mid-prefill): next step cleans up
+            else:
+                req.done = True
+            self._update_gauges()
+            return True
+
     def _prefill_chunk_fn(self, chunk: int, batch: int):
         """Jitted CHUNK prefill: one multi-token cached append of ``chunk``
         tokens at traced offset pos0 into a carried dense cache.  One
@@ -1073,8 +1100,14 @@ class ServingEngine:
         req = self.slots[slot]
         if req is None:
             return
-        if len(req.tokens) >= req.max_new_tokens or (
-            self.eos_id is not None and req.tokens and req.tokens[-1] == self.eos_id
+        if (
+            req.cancelled
+            or len(req.tokens) >= req.max_new_tokens
+            or (
+                self.eos_id is not None
+                and req.tokens
+                and req.tokens[-1] == self.eos_id
+            )
         ):
             req.done = True
             self._clear_slot(slot)
@@ -1195,6 +1228,16 @@ class ServingEngine:
         every request that finished this step (including ones done at
         admission — EOS/max_new on the prefill token)."""
         finished = self._admit()
+        # Cancelled slots tear down BEFORE the dispatch (no farewell
+        # token).  Only ready slots: a cancelled request mid-prefill
+        # keeps its job's slot/pages intact until activation, whose own
+        # _maybe_finish call then finishes it (this sweep catches
+        # requests cancelled after they were already live).
+        for s in range(self.max_slots):
+            req = self.slots[s]
+            if req is not None and req.cancelled and self._slot_ready[s]:
+                self._maybe_finish(s)
+                finished.append(req)
         # Advance every in-flight prefill job by ONE chunk (an unchunked
         # job completes right here, in the same step() it was admitted):
         # chunking bounds how long active slots stall per step while a

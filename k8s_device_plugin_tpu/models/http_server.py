@@ -17,8 +17,18 @@ API (token-level — the framework is tokenizer-agnostic, matching the
 rest of the models/ stack which benchmarks on synthetic ids):
 
     POST /generate   {"prompt": [int, ...], "max_new_tokens": N,
-                      "temperature": t?, "top_k": k?, "top_p": p?}
+                      "temperature": t?, "top_k": k?, "top_p": p?,
+                      "stream": false?}
       -> 200 {"tokens": [int, ...], "rid": R}
+      -> with "stream": true, 200 text/event-stream: one
+         `data: {"token": t, "index": i, "rid": R}` event per generated
+         token as the engine emits it, then `data: {"done": true,
+         "tokens": [...], "rid": R}` — or, if generation exceeds the
+         request timeout, a final `data: {"error": "generation timed
+         out", "rid": R}` with NO done event.  `: ping` comment
+         heartbeats flow while idle.  Disconnecting mid-stream cancels
+         the request (engine.cancel) — its slot and pages return to the
+         pool instead of decoding for nobody.
     GET /healthz     -> 200 "ok" while the engine loop is alive
     GET /metrics     -> Prometheus exposition (when a registry is wired)
 """
@@ -27,6 +37,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -82,6 +93,7 @@ class EngineServer:
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
+                stream = bool(body.get("stream", False))
                 try:
                     req = server.engine.submit(prompt, max_new, **kwargs)
                 except ValueError as e:  # validation: capacity, sampler args
@@ -90,15 +102,75 @@ class EngineServer:
                 except TypeError as e:  # e.g. non-iterable / nested prompt
                     self._reply(400, {"error": f"bad prompt: {e}"})
                     return
+                if stream:
+                    self._stream_reply(req)
+                    return
                 with server._cond:
                     server._cond.notify_all()  # wake an idle loop
                     finished = server._cond.wait_for(
                         lambda: req.done, timeout=server._timeout
                     )
                 if not finished:
+                    # Stop burning chip time on a response nobody reads.
+                    server.engine.cancel(req)
                     self._reply(504, {"error": "generation timed out", "rid": req.rid})
                     return
                 self._reply(200, {"tokens": req.tokens, "rid": req.rid})
+
+            def _stream_reply(self, req) -> None:
+                """Server-sent events: one ``data:`` event per generated
+                token as the engine emits it, then a final ``done`` event
+                with the full sequence.  A client that disconnects
+                mid-stream cancels the request (engine.cancel) so its
+                slot and pages return to the pool immediately."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                deadline = time.monotonic() + server._timeout
+                sent = 0
+                try:
+                    while True:
+                        with server._cond:
+                            server._cond.notify_all()  # wake an idle loop
+                            server._cond.wait_for(
+                                lambda: req.done or len(req.tokens) > sent,
+                                timeout=min(1.0, server._timeout),
+                            )
+                            toks = list(req.tokens)
+                            done = req.done
+                        if not done and sent == len(toks):
+                            # Idle (queued / mid-prefill / slow step): an
+                            # SSE comment heartbeat so a vanished client
+                            # surfaces as a broken pipe HERE, not after
+                            # the full request timeout with the request
+                            # decoding for nobody.
+                            self.wfile.write(b": ping\n\n")
+                            self.wfile.flush()
+                        while sent < len(toks):
+                            self._event(
+                                {"token": toks[sent], "index": sent,
+                                 "rid": req.rid}
+                            )
+                            sent += 1
+                        if done:
+                            self._event(
+                                {"done": True, "tokens": toks, "rid": req.rid}
+                            )
+                            return
+                        if time.monotonic() > deadline:
+                            server.engine.cancel(req)
+                            self._event(
+                                {"error": "generation timed out",
+                                 "rid": req.rid}
+                            )
+                            return
+                except OSError:  # broken pipe & friends: client vanished
+                    server.engine.cancel(req)
+
+            def _event(self, obj: dict) -> None:
+                self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+                self.wfile.flush()
 
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0]

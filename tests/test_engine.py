@@ -1148,3 +1148,72 @@ def test_decode_block_validation(rng):
             cfg, params, paged, decode_block=4, spec_gamma=2,
             draft_params=params,
         )
+
+
+# ---------------------------------------------------------------------------
+# Cancellation (client went away)
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_queued_request(rng):
+    """A cancelled queued request finishes immediately and never takes a
+    slot or pages."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    # Pool fits one request at a time; the second queues.
+    paged = PagedConfig(page_size=4, num_pages=5, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    a = eng.submit([3, 141, 59], 6)
+    eng.step()  # admits a, b will queue
+    b = eng.submit([9, 10], 6)
+    assert eng.cancel(b) is True
+    assert b.done and b.cancelled and b.tokens == []
+    assert not eng.queue
+    while not a.done:
+        eng.step()
+    assert a.tokens == _oracle(cfg, params, [3, 141, 59], 6)
+    assert len(eng.free_pages) == paged.num_pages - 1
+    assert eng.cancel(b) is False  # already finished
+
+
+def test_cancel_in_flight_releases_slot_and_pages(rng):
+    """Cancelling an active request tears it down at the next step
+    boundary: no farewell token, pages and prefix refcounts exact, the
+    other slot undisturbed."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    keep = eng.submit([3, 141, 59], 8)
+    gone = eng.submit([9, 10], 24)
+    for _ in range(3):
+        eng.step()
+    n_before = len(gone.tokens)
+    assert eng.cancel(gone) is True and not gone.done
+    finished = eng.step()
+    assert gone in finished and gone.done
+    assert len(gone.tokens) == n_before  # no token after the cancel
+    while not keep.done:
+        eng.step()
+    assert keep.tokens == _oracle(cfg, params, [3, 141, 59], 8)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_cancel_composes_with_prefix_sharing_and_blocks(rng):
+    """Cancel under refcounted prefix sharing (shared prompt pages must
+    survive for the sibling) and decode blocks."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=2, num_pages=32, max_pages_per_seq=12)
+    eng = ServingEngine(cfg, params, paged, max_slots=2, decode_block=4)
+    shared = [3, 141, 59, 7]
+    a = eng.submit(shared, 16)
+    b = eng.submit(shared, 16)  # shares a's prompt pages
+    for _ in range(2):
+        eng.step()
+    eng.cancel(b)
+    while not a.done:
+        eng.step()
+    assert a.tokens == _oracle(cfg, params, shared, 16)
+    assert b.done and len(b.tokens) < 16
+    assert len(eng.free_pages) == paged.num_pages - 1

@@ -6,6 +6,7 @@ must equal the dense greedy decode token for token."""
 import dataclasses
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -136,3 +137,82 @@ def test_healthz_and_metrics(served):
     ) as r:
         text = r.read().decode()
     assert "tpu_engine_requests_total" in text
+
+
+def _post_stream(port, payload, timeout=120):
+    """POST with stream=true; return the parsed SSE events in order."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({**payload, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(json.loads(line[len("data: "):]))
+                if events[-1].get("done") or events[-1].get("error"):
+                    break
+    return events
+
+
+def test_stream_events_match_oracle(served):
+    """SSE: one event per token, in order, then the done event carrying
+    the full greedy sequence — identical to the non-streaming oracle."""
+    cfg, params, server = served
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 7)
+    events = _post_stream(server.port, {"prompt": prompt, "max_new_tokens": 7})
+    toks = [e["token"] for e in events if "token" in e]
+    assert toks == want
+    assert [e["index"] for e in events if "token" in e] == list(range(7))
+    done = events[-1]
+    assert done.get("done") is True and done["tokens"] == want
+
+
+def test_stream_disconnect_cancels(served):
+    """Dropping the SSE connection mid-generation cancels the request:
+    the slot and its pages return to the pool (no orphaned decode)."""
+    import http.client
+
+    cfg, params, server = served
+    engine = server.engine
+    free_before = len(engine.free_pages)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+    conn.request(
+        "POST",
+        "/generate",
+        json.dumps(
+            {"prompt": [9, 10], "max_new_tokens": 24, "stream": True}
+        ),
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    # Read a couple of events to ensure the request is mid-flight...
+    got_one = False
+    while not got_one:
+        line = resp.fp.readline().decode().strip()
+        if line.startswith("data: ") and "token" in json.loads(line[6:]):
+            got_one = True
+    # ...then vanish (the response owns the socket after getresponse).
+    resp.close()
+    conn.close()
+    # The handler thread notices on its next write, cancels, and the
+    # owner loop tears the slot down at its next step.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if (
+            all(s is None for s in engine.slots)
+            and len(engine.free_pages) == free_before
+        ):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(
+            f"cancelled request did not release its slot/pages "
+            f"(slots={engine.slots}, free={len(engine.free_pages)}, "
+            f"want {free_before})"
+        )
