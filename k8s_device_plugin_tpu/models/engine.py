@@ -63,6 +63,17 @@ def _pow2_int(text: str) -> int:
     return value
 
 
+def _token_logprob(row, nxt):
+    """The emitted token's logprob under the UNSCALED model distribution
+    (sampler-independent semantics — temperature/top-k reshape what gets
+    PICKED, not what is reported).  One log_softmax over [slots, vocab]
+    per step: noise next to the LM-head matmul that produced the row, so
+    the jitted steps always compute it and the host simply discards it
+    for requests that didn't ask."""
+    lp = jax.nn.log_softmax(row.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, nxt[:, None], axis=1)[:, 0]
+
+
 def filter_top_k_top_p(scaled, top_k, top_p):
     """Mask ``scaled`` logits [batch, vocab] to each row's top-k tokens and
     smallest nucleus with mass >= top_p — with PER-ROW traced ``top_k``
@@ -156,8 +167,13 @@ class Request:
     # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
     # request decodes through; None = base model.
     adapter: Optional[int] = None
+    # Record each emitted token's logprob under the unscaled model
+    # distribution in ``token_logprobs`` (parallel to ``tokens``).
+    # Sampler settings change what gets picked, never what is reported.
+    logprobs: bool = False
     rid: int = -1
     tokens: list[int] = dataclasses.field(default_factory=list)
+    token_logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False
     # Set via ServingEngine.cancel() (client went away): a queued request
     # finishes immediately; an in-flight one is torn down at the next step
@@ -259,6 +275,13 @@ class ServingEngine:
         self.cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
         self._layer_names = [f"layer_{i}" for i in range(cfg.num_layers)]
 
+        # Single-token decode steps are built lazily per (filtered,
+        # want_lp) — like _block_fn — so the common greedy/temperature
+        # path never compiles the top-k/top-p sort and never computes the
+        # [slots, vocab] log-softmax that only logprobs requests read
+        # (jit programs compile on first use: a variant that is never
+        # requested costs nothing).
+        #
         # The cache is donated: the engine reassigns self.cache from the
         # step's output, so the input pool buffers are dead the moment the
         # call is issued — without donation every step transiently holds
@@ -266,48 +289,7 @@ class ServingEngine:
         # HBM capacity would OOM at the first step) and pays a pool-sized
         # copy.  Host-side .at[slot].set bookkeeping always runs on the
         # returned tree, never the donated argument.
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, tokens, positions, temps, topks, topps, aids, key):
-            logits, mut = model.apply(
-                {"params": params, "cache": cache},
-                tokens,
-                positions,
-                adapter_ids=aids,
-                mutable=["cache"],
-            )
-            row = logits[:, -1, :]
-            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
-            # One categorical over the batch samples each row independently;
-            # temp<=0 rows take the argmax (their scaled logits are unused).
-            scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
-            filtered = filter_top_k_top_p(scaled, topks, topps)
-            sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
-            return nxt, mut["cache"]
-
-        # Plain variant: no top-k/top-p filter — the filter costs a
-        # [slots, vocab] descending sort per step, and the host knows from
-        # its slot bookkeeping when no active slot restricts sampling
-        # (greedy/temperature-only serving, the default), so the common
-        # case never pays for the feature.
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step_plain(params, cache, tokens, positions, temps, aids, key):
-            logits, mut = model.apply(
-                {"params": params, "cache": cache},
-                tokens,
-                positions,
-                adapter_ids=aids,
-                mutable=["cache"],
-            )
-            row = logits[:, -1, :]
-            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
-            scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
-            sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
-            return nxt, mut["cache"]
-
-        self._step = step
-        self._step_plain = step_plain
+        self._step_fns: dict = {}
         # Decode blocks (decode_block > 1): when the engine is in pure
         # decode — no admission work, every slot past prefill — the host
         # dispatches ONE program that scans T exact single-token steps
@@ -601,10 +583,19 @@ class ServingEngine:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         adapter: Optional[int] = None,
+        logprobs: bool = False,
     ) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        if logprobs and self._spec_gamma:
+            # The speculative round emits accepted draft tokens without
+            # materializing their target log-softmax; scoring them would
+            # need an extra pass per round.  Pick one per engine.
+            raise ValueError(
+                "logprobs is not supported on a speculative engine "
+                "(spec_gamma > 0)"
+            )
         if adapter is not None:
             if not self.cfg.lora_serve:
                 raise ValueError(
@@ -653,7 +644,7 @@ class ServingEngine:
         with self._lock:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
-                adapter=adapter, rid=self._next_rid,
+                adapter=adapter, logprobs=logprobs, rid=self._next_rid,
             )
             self._next_rid += 1
             self.queue.append(req)
@@ -1078,6 +1069,19 @@ class ServingEngine:
                 first = int(jax.random.categorical(sub, filtered[0]))
             else:
                 first = int(jnp.argmax(last_logits))
+            if req.logprobs:
+                # Same semantics as the jitted steps: the emitted token's
+                # logprob under the unscaled model distribution.  Appended
+                # BEFORE the token so a streaming snapshot never sees a
+                # token without its logprob.
+                req.token_logprobs.append(
+                    float(
+                        _token_logprob(
+                            jnp.asarray(last_logits)[None, :],
+                            jnp.asarray([first], jnp.int32),
+                        )[0]
+                    )
+                )
             req.tokens.append(first)
             self._slot_last[slot] = first
             self._slot_len[slot] = plen
@@ -1114,15 +1118,55 @@ class ServingEngine:
 
     # ----------------------------------------------------------------- steps
 
-    def _block_fn(self, T: int, filtered: bool):
-        """Build (lazily, once per (T, filtered)) the jitted T-step decode
+    def _step_fn(self, filtered: bool, want_lp: bool):
+        """Build (lazily, once per (filtered, want_lp)) the jitted
+        single-token decode step.  ``filtered`` compiles the top-k/top-p
+        sort in; ``want_lp`` compiles the [slots, vocab] log-softmax +
+        gather whose result logprobs requests read (without it the step
+        returns a zeros placeholder so the host consumption code stays
+        uniform)."""
+        key_ = (filtered, want_lp)
+        if key_ in self._step_fns:
+            return self._step_fns[key_]
+        model = self._decode_model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, tokens, positions, temps, topks, topps, aids, key):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                tokens,
+                positions,
+                adapter_ids=aids,
+                mutable=["cache"],
+            )
+            row = logits[:, -1, :]
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            # One categorical over the batch samples each row independently;
+            # temp<=0 rows take the argmax (their scaled logits are unused).
+            scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
+            if filtered:
+                scaled = filter_top_k_top_p(scaled, topks, topps)
+            sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            lps = (
+                _token_logprob(row, nxt)
+                if want_lp
+                else jnp.zeros(nxt.shape, jnp.float32)
+            )
+            return nxt, lps, mut["cache"]
+
+        self._step_fns[key_] = step
+        return step
+
+    def _block_fn(self, T: int, filtered: bool, want_lp: bool):
+        """Build (lazily, once per (T, filtered, want_lp)) the jitted T-step decode
         block: a lax.scan of T exact single-token decode steps — same
         model apply, same per-slot sampling, a fresh subkey per step — so
         one dispatch advances every active slot T tokens.  Greedy slots
         emit exactly their step-at-a-time decode; sampled slots draw from
         the identical per-step distributions (different key schedule than
         T separate step() calls, same law)."""
-        key_ = (T, filtered)
+        key_ = (T, filtered, want_lp)
         if key_ in self._block_fns:
             return self._block_fns[key_]
         model = self._decode_model
@@ -1145,12 +1189,17 @@ class ServingEngine:
                     scaled = filter_top_k_top_p(scaled, topks, topps)
                 sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
                 nxt = jnp.where(temps > 0, sampled, greedy)
-                return (mut["cache"], nxt[:, None], pos + 1), nxt
+                lp = (
+                    _token_logprob(row, nxt)
+                    if want_lp
+                    else jnp.zeros(nxt.shape, jnp.float32)
+                )
+                return (mut["cache"], nxt[:, None], pos + 1), (nxt, lp)
 
-            (cache, _, _), toks = jax.lax.scan(
+            (cache, _, _), (toks, lps) = jax.lax.scan(
                 body, (cache, tokens, positions), jax.random.split(key, T)
             )
-            return toks.T, cache  # [slots, T]
+            return toks.T, lps.T, cache  # [slots, T]
 
         self._block_fns[key_] = block
         return block
@@ -1180,18 +1229,28 @@ class ServingEngine:
             )
             for s in range(self.max_slots)
         )
+        want_lp = any(
+            self.slots[s] is not None and self.slots[s].logprobs
+            for s in range(self.max_slots)
+        )
         self._rng, sub = jax.random.split(self._rng)
-        out, self.cache = self._block_fn(T, filtered)(
+        out, lps, self.cache = self._block_fn(T, filtered, want_lp)(
             self.params, self.cache, tokens, positions, temps, topks,
             topps, aids, sub,
         )
         out = np.asarray(out)
+        lps = np.asarray(lps)
         emitted_total = 0
         for s in active:
             req = self.slots[s]
             consumed = 0
             for j in range(T):
                 tok = int(out[s, j])
+                # Logprob BEFORE token: a streaming handler thread that
+                # snapshots between the two appends must never see a
+                # token whose logprob is missing.
+                if req.logprobs:
+                    req.token_logprobs.append(float(lps[s, j]))
                 req.tokens.append(tok)
                 self._slot_last[s] = tok
                 consumed += 1
@@ -1274,29 +1333,33 @@ class ServingEngine:
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
         aids = jnp.asarray(self._slot_aid, jnp.int32)
-        self._rng, sub = jax.random.split(self._rng)
-        if any(
+        filtered = any(
             self.slots[s] is not None
             and (
                 self._slot_topk[s] < self.cfg.vocab_size
                 or self._slot_topp[s] < 1.0
             )
             for s in range(self.max_slots)
-        ):
-            topks = jnp.asarray(self._slot_topk, jnp.int32)
-            topps = jnp.asarray(self._slot_topp, jnp.float32)
-            nxt, self.cache = self._step(
-                self.params, self.cache, tokens, positions, temps, topks,
-                topps, aids, sub,
-            )
-        else:
-            nxt, self.cache = self._step_plain(
-                self.params, self.cache, tokens, positions, temps, aids, sub
-            )
+        )
+        want_lp = any(
+            self.slots[s] is not None and self.slots[s].logprobs
+            for s in range(self.max_slots)
+        )
+        topks = jnp.asarray(self._slot_topk, jnp.int32)
+        topps = jnp.asarray(self._slot_topp, jnp.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        nxt, lps, self.cache = self._step_fn(filtered, want_lp)(
+            self.params, self.cache, tokens, positions, temps, topks,
+            topps, aids, sub,
+        )
         nxt = np.asarray(nxt)
+        lps = np.asarray(lps)
         for s in active:
             req = self.slots[s]
             tok = int(nxt[s])
+            # Logprob BEFORE token (see _block_step note).
+            if req.logprobs:
+                req.token_logprobs.append(float(lps[s]))
             req.tokens.append(tok)
             self._slot_last[s] = tok
             self._slot_len[s] += 1

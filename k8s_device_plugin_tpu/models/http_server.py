@@ -18,8 +18,13 @@ rest of the models/ stack which benchmarks on synthetic ids):
 
     POST /generate   {"prompt": [int, ...], "max_new_tokens": N,
                       "temperature": t?, "top_k": k?, "top_p": p?,
-                      "stream": false?}
+                      "stream": false?, "logprobs": false?}
       -> 200 {"tokens": [int, ...], "rid": R}
+      -> with "logprobs": true, adds "logprobs": [float, ...] — each
+         emitted token's logprob under the UNSCALED model distribution
+         (sampler settings change what gets picked, not what is
+         reported); streaming events carry a "logprob" field.
+         Unsupported on speculative engines (422).
       -> with "stream": true, 200 text/event-stream: one
          `data: {"token": t, "index": i, "rid": R}` event per generated
          token as the engine emits it, then `data: {"done": true,
@@ -90,6 +95,8 @@ class EngineServer:
                         # Multi-LoRA serving: pick a stacked adapter by
                         # index (engines built with cfg.lora_serve).
                         kwargs["adapter"] = int(body["adapter"])
+                    if body.get("logprobs"):
+                        kwargs["logprobs"] = True
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
@@ -115,7 +122,10 @@ class EngineServer:
                     server.engine.cancel(req)
                     self._reply(504, {"error": "generation timed out", "rid": req.rid})
                     return
-                self._reply(200, {"tokens": req.tokens, "rid": req.rid})
+                out = {"tokens": req.tokens, "rid": req.rid}
+                if req.logprobs:
+                    out["logprobs"] = req.token_logprobs
+                self._reply(200, out)
 
             def _stream_reply(self, req) -> None:
                 """Server-sent events: one ``data:`` event per generated
@@ -148,15 +158,18 @@ class EngineServer:
                             self.wfile.write(b": ping\n\n")
                             self.wfile.flush()
                         while sent < len(toks):
-                            self._event(
-                                {"token": toks[sent], "index": sent,
-                                 "rid": req.rid}
-                            )
+                            ev = {"token": toks[sent], "index": sent,
+                                  "rid": req.rid}
+                            if req.logprobs and sent < len(req.token_logprobs):
+                                ev["logprob"] = req.token_logprobs[sent]
+                            self._event(ev)
                             sent += 1
                         if done:
-                            self._event(
-                                {"done": True, "tokens": toks, "rid": req.rid}
-                            )
+                            fin = {"done": True, "tokens": toks,
+                                   "rid": req.rid}
+                            if req.logprobs:
+                                fin["logprobs"] = req.token_logprobs
+                            self._event(fin)
                             return
                         if time.monotonic() > deadline:
                             server.engine.cancel(req)

@@ -1217,3 +1217,80 @@ def test_cancel_composes_with_prefix_sharing_and_blocks(rng):
     assert a.tokens == _oracle(cfg, params, shared, 16)
     assert b.done and len(b.tokens) < 16
     assert len(eng.free_pages) == paged.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# Per-token logprobs
+# ---------------------------------------------------------------------------
+
+
+def _logprob_oracle(cfg, params, prompt, tokens):
+    """Replay prompt+tokens through the dense model: logprob of each
+    emitted token under the unscaled model distribution."""
+    out = []
+    ctx = list(prompt)
+    for tok in tokens:
+        logits = TransformerLM(cfg).apply(
+            {"params": params}, jnp.asarray([ctx], jnp.int32)
+        )[0, -1]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        out.append(float(lp[tok]))
+        ctx.append(tok)
+    return out
+
+
+def test_logprobs_match_dense_replay(rng):
+    """logprobs=True: token_logprobs runs parallel to tokens (incl. the
+    prefill's first token) and matches a dense replay."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    req = eng.submit([3, 141, 59], 6, logprobs=True)
+    plain = eng.submit([9, 10], 6)  # same batch, not asking
+    while not (req.done and plain.done):
+        eng.step()
+    assert len(req.token_logprobs) == len(req.tokens) == 6
+    want = _logprob_oracle(cfg, params, [3, 141, 59], req.tokens)
+    np.testing.assert_allclose(req.token_logprobs, want, rtol=1e-4, atol=1e-4)
+    assert plain.token_logprobs == []
+
+
+def test_logprobs_through_decode_blocks(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1, decode_block=4)
+    [req] = eng.run([([3, 141, 59], 8)], logprobs=True)
+    assert len(req.token_logprobs) == 8
+    want = _logprob_oracle(cfg, params, [3, 141, 59], req.tokens)
+    np.testing.assert_allclose(req.token_logprobs, want, rtol=1e-4, atol=1e-4)
+
+
+def test_logprobs_sampled_slot_reports_model_distribution(rng):
+    """A temperature/top-k slot still reports UNSCALED model logprobs."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=1, rng=jax.random.PRNGKey(3)
+    )
+    req = eng.submit([9, 10], 6, temperature=0.9, top_k=4, logprobs=True)
+    while not req.done:
+        eng.step()
+    want = _logprob_oracle(cfg, params, [9, 10], req.tokens)
+    np.testing.assert_allclose(req.token_logprobs, want, rtol=1e-4, atol=1e-4)
+
+
+def test_logprobs_rejected_on_spec_engine(rng):
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=1, spec_gamma=2,
+        draft_params=quantize_lm_params(params),
+    )
+    with pytest.raises(ValueError, match="logprobs"):
+        eng.submit([3], 4, logprobs=True)

@@ -216,3 +216,37 @@ def test_stream_disconnect_cancels(served):
             f"(slots={engine.slots}, free={len(engine.free_pages)}, "
             f"want {free_before})"
         )
+
+
+def test_logprobs_in_response_and_stream(served):
+    """logprobs=true: the JSON reply carries per-token logprobs parallel
+    to tokens; stream events carry a logprob field; values are finite
+    negatives and the greedy token's logprob is the row max."""
+    cfg, params, server = served
+    prompt = [3, 141, 59]
+    out = _post(
+        server.port,
+        {"prompt": prompt, "max_new_tokens": 5, "logprobs": True},
+    )
+    assert len(out["logprobs"]) == len(out["tokens"]) == 5
+    assert all(lp <= 0.0 for lp in out["logprobs"])
+    # Greedy: every reported logprob must be the max over the vocab of
+    # the model's log-softmax at that position (replay densely).
+    ctx = list(prompt)
+    for tok, lp in zip(out["tokens"], out["logprobs"]):
+        logits = TransformerLM(cfg).apply(
+            {"params": params}, jnp.asarray([ctx], jnp.int32)
+        )[0, -1]
+        ls = jax.nn.log_softmax(logits.astype(jnp.float32))
+        np.testing.assert_allclose(lp, float(ls[tok]), rtol=1e-4, atol=1e-4)
+        assert tok == int(jnp.argmax(ls))
+        ctx.append(tok)
+    events = _post_stream(
+        server.port,
+        {"prompt": prompt, "max_new_tokens": 5, "logprobs": True},
+    )
+    toks = [e for e in events if "token" in e]
+    assert all("logprob" in e for e in toks)
+    np.testing.assert_allclose(
+        [e["logprob"] for e in toks], out["logprobs"], rtol=1e-6
+    )
