@@ -544,6 +544,81 @@ def _inner() -> None:
         except Exception as e:  # secondary metrics must never kill the bench
             log(f"paged-kernel bench failed: {e}")
 
+    def bench_engine_serving() -> None:
+        """Secondary: ServingEngine steady-state decode throughput at
+        decode_block 1 vs 16 (stderr only).  Host-driven serving pays one
+        dispatch round-trip per step; blocks amortize it — extreme
+        through this relay (~90 ms RTT), still real on a TPU VM.  Uses a
+        small 4-layer GQA model so compile stays inside the attempt
+        window."""
+        if platform == "cpu":
+            return
+        try:
+            import time as _time
+
+            from k8s_device_plugin_tpu.models.engine import ServingEngine
+            from k8s_device_plugin_tpu.models.transformer import (
+                GPTConfig,
+                PagedConfig,
+                TransformerLM,
+            )
+
+            cfg = GPTConfig(
+                vocab_size=32000,
+                hidden_size=1024,
+                num_layers=4,
+                num_heads=16,
+                intermediate_size=2816,
+                max_seq=2048,
+                num_kv_heads=4,
+            )
+            rng = jax.random.PRNGKey(0)
+            params = TransformerLM(cfg).init(
+                rng, jnp.zeros((1, 2), jnp.int32)
+            )["params"]
+            slots, prompt_len = 8, 256
+            for block in (1, 16):
+                # 48 pages x 16 = 768 slots per row >= 256 prompt + 400 new.
+                paged = PagedConfig(
+                    page_size=16, num_pages=slots * 48 + 8, max_pages_per_seq=48
+                )
+                eng = ServingEngine(
+                    cfg, params, paged, max_slots=slots, decode_block=block
+                )
+                import numpy as _np
+
+                for i in range(slots):
+                    eng.submit(
+                        list(
+                            _np.random.default_rng(i).integers(
+                                0, 32000, prompt_len
+                            )
+                        ),
+                        max_new_tokens=400,
+                    )
+                for _ in range(3):  # admit + compile + settle
+                    eng.step()
+                n_disp = max(4, 64 // block)
+                before = sum(
+                    len(r.tokens) for r in eng.slots if r is not None
+                )
+                t0 = _time.perf_counter()
+                for _ in range(n_disp):
+                    eng.step()
+                dt = _time.perf_counter() - t0
+                after = sum(
+                    len(r.tokens) for r in eng.slots if r is not None
+                )
+                toks = after - before
+                log(
+                    f"engine serving decode_block={block}: "
+                    f"{toks/dt:.0f} tokens/sec "
+                    f"({dt/n_disp*1e3:.1f} ms/dispatch, b{slots}, "
+                    f"incl. per-dispatch RTT)"
+                )
+        except Exception as e:  # secondary metrics must never kill the bench
+            log(f"engine serving bench failed: {e}")
+
     def bench_allocation_latency() -> None:
         """Secondary metric from BASELINE.json: chip-allocation latency through
         the actual plugin gRPC path (fixture-backed, no cluster needed)."""
@@ -760,6 +835,7 @@ def _inner() -> None:
     bench_decode_quant()
     bench_speculative()
     bench_paged_kernel()
+    bench_engine_serving()
     bench_allocation_latency()
     bench_lm_train()
     bench_resnet_variants()

@@ -66,10 +66,9 @@ def _pow2_int(text: str) -> int:
 def _token_logprob(row, nxt):
     """The emitted token's logprob under the UNSCALED model distribution
     (sampler-independent semantics — temperature/top-k reshape what gets
-    PICKED, not what is reported).  One log_softmax over [slots, vocab]
-    per step: noise next to the LM-head matmul that produced the row, so
-    the jitted steps always compute it and the host simply discards it
-    for requests that didn't ask."""
+    PICKED, not what is reported).  Compiled into a step variant only
+    when a request asks (the ``want_lp`` key of _step_fn/_block_fn), so
+    engines that never serve logprobs never compute it."""
     lp = jax.nn.log_softmax(row.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(lp, nxt[:, None], axis=1)[:, 0]
 
@@ -1130,8 +1129,13 @@ class ServingEngine:
             return self._step_fns[key_]
         model = self._decode_model
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, tokens, positions, temps, topks, topps, aids, key):
+        # The unfiltered variant's signature omits topks/topps entirely:
+        # an unused jit argument is still transferred every dispatch, and
+        # the greedy/temperature-only path (the common case) shouldn't
+        # pay two host->device array uploads per token for a feature it
+        # compiled out.
+        def _core(params, cache, tokens, positions, temps, aids, key,
+                  topks=None, topps=None):
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
@@ -1154,6 +1158,22 @@ class ServingEngine:
                 else jnp.zeros(nxt.shape, jnp.float32)
             )
             return nxt, lps, mut["cache"]
+
+        if filtered:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def step(params, cache, tokens, positions, temps, topks, topps,
+                     aids, key):
+                return _core(
+                    params, cache, tokens, positions, temps, aids, key,
+                    topks, topps,
+                )
+
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def step(params, cache, tokens, positions, temps, aids, key):
+                return _core(params, cache, tokens, positions, temps, aids, key)
 
         self._step_fns[key_] = step
         return step
@@ -1345,13 +1365,18 @@ class ServingEngine:
             self.slots[s] is not None and self.slots[s].logprobs
             for s in range(self.max_slots)
         )
-        topks = jnp.asarray(self._slot_topk, jnp.int32)
-        topps = jnp.asarray(self._slot_topp, jnp.float32)
         self._rng, sub = jax.random.split(self._rng)
-        nxt, lps, self.cache = self._step_fn(filtered, want_lp)(
-            self.params, self.cache, tokens, positions, temps, topks,
-            topps, aids, sub,
-        )
+        if filtered:
+            nxt, lps, self.cache = self._step_fn(True, want_lp)(
+                self.params, self.cache, tokens, positions, temps,
+                jnp.asarray(self._slot_topk, jnp.int32),
+                jnp.asarray(self._slot_topp, jnp.float32),
+                aids, sub,
+            )
+        else:
+            nxt, lps, self.cache = self._step_fn(False, want_lp)(
+                self.params, self.cache, tokens, positions, temps, aids, sub
+            )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
         for s in active:

@@ -888,15 +888,21 @@ def test_engine_feature_matrix_fuzz(rng):
         # One sampled request rides along (top_k=1 => oracle-exact even
         # through speculation's acceptance-rejection path).
         sampled = eng.submit(jobs[0][0], 4, temperature=5.0, top_k=1)
+        # And one victim cancelled mid-flight: whatever the feature mix,
+        # teardown must leave the survivors' outputs and the pool exact.
+        victim = eng.submit(jobs[1][0], 6)
         guard = 0
-        while not (all(r.done for r in subs) and sampled.done):
+        while not (all(r.done for r in subs) and sampled.done and victim.done):
             eng.step()
+            if guard == int(npr.choice([1, 2, 4])) and not victim.done:
+                eng.cancel(victim)
             guard += 1
             assert guard < 2000, (trial, "engine failed to drain")
         label = (trial, window, use_kernel, quant_kv, spec)
         for (prompt, n), req in zip(jobs, subs):
             assert req.tokens == _oracle(cfg, params, prompt, n), label
         assert sampled.tokens == _oracle(cfg, params, jobs[0][0], 4), label
+        assert victim.done, label
         assert len(eng.free_pages) == paged.num_pages - 1, label
 
 
