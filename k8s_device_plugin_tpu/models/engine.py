@@ -146,6 +146,10 @@ class EngineMetrics:
             "tpu_engine_spec_accepted_total",
             "Draft tokens the target accepted (rate = accepted/proposed)",
         )
+        self.preemptions = registry.counter(
+            "tpu_engine_preemptions_total",
+            "Slots evicted for recompute-resume under optimistic admission",
+        )
 
 
 @dataclasses.dataclass
@@ -204,6 +208,7 @@ class ServingEngine:
         draft_cfg: Optional[GPTConfig] = None,
         prefill_chunk: Optional[int] = None,
         decode_block: int = 1,
+        admission: str = "reserve",
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -220,6 +225,10 @@ class ServingEngine:
             # Both amortize dispatches over multi-token device rounds with
             # incompatible schedules (scan of exact steps vs draft+verify).
             raise ValueError("decode_block > 1 is not supported with spec_gamma")
+        if admission not in ("reserve", "optimistic"):
+            raise ValueError(
+                f"admission must be 'reserve' or 'optimistic', got {admission!r}"
+            )
         if cfg.lora_serve and spec_gamma > 0:
             # The self-draft is the same model int8-quantized, and quant is
             # mutually exclusive with LoRA (quantize after merging) — there
@@ -504,6 +513,12 @@ class ServingEngine:
         # acceptance rate = accepted / proposed, the gamma-tuning signal.
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Optimistic admission: allocate prompt pages only at admission and
+        # grow generation pages on demand; a pool shortage preempts the
+        # NEWEST ready slot (recompute-resume via the effective prompt).
+        self._optimistic = admission == "optimistic"
+        self.preemptions = 0
+        self._seq_counter = 0
 
         # Page 0 is the idle-slot scratch target — never allocated.
         self.free_pages: deque[int] = deque(range(1, paged.num_pages))
@@ -530,6 +545,7 @@ class ServingEngine:
         # frontier reaches them — per-row traffic is O(len), not
         # O(allocated).
         self._slot_visible: list[int] = [0] * max_slots
+        self._slot_seq: list[int] = [0] * max_slots
         # A reserved slot decodes only after its prefill job grafted it
         # (chunked prefill spans several step() calls; until ready the
         # slot behaves exactly like an idle one in the jitted step).
@@ -727,7 +743,9 @@ class ServingEngine:
         so active slots stall at most one chunk's compute per step while
         a long prompt streams in.
         """
-        prompts = [it[1].prompt for it in items]
+        # Effective prompts: resumed (preempted) requests re-prefill
+        # their original prompt PLUS what they had already generated.
+        prompts = [it[1].prompt + it[1].tokens for it in items]
         longest = max(len(p) for p in prompts)
         bucket = min(1 << (longest - 1).bit_length(), self.paged.max_len)
         chunk = min(self._prefill_chunk or bucket, bucket)
@@ -962,18 +980,39 @@ class ServingEngine:
             # Queue peek/pop under the lock (submit() appends from other
             # threads); everything after the pop touches owner-only state.
             with self._lock:
+                # A cancel() racing an eviction can leave a cancelled
+                # request at the queue head (see _evict_slot); finish it
+                # here instead of prefetching for a dead client.
+                while self.queue and self.queue[0].cancelled:
+                    dead = self.queue.popleft()
+                    dead.done = True
                 if self.slots[slot] is not None or not self.queue:
                     continue
                 req = self.queue[0]
-                plen = len(req.prompt)
+                # The EFFECTIVE prompt: original tokens plus anything a
+                # previous occupancy already generated (recompute-resume
+                # after preemption — empty for fresh requests, and always
+                # empty under reserve admission).
+                eff = req.prompt + req.tokens
+                plen = len(eff)
                 bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
-                n_pages = math.ceil(
-                    (plen + req.max_new_tokens + self._spec_gamma)
-                    / self.paged.page_size
-                )
+                if self._optimistic:
+                    # Prompt pages + the first decode write (+ spec
+                    # headroom); generation pages are allocated on demand
+                    # by _ensure_frontier, preempting newer slots when
+                    # the pool runs dry.
+                    n_pages = math.ceil(
+                        (plen + 1 + self._spec_gamma) / self.paged.page_size
+                    )
+                else:
+                    n_pages = math.ceil(
+                        (plen + req.max_new_tokens - len(req.tokens)
+                         + self._spec_gamma)
+                        / self.paged.page_size
+                    )
                 shared = (
                     self._match_prefix(
-                        req.prompt, bucket, burst_pages, req.adapter
+                        eff, bucket, burst_pages, req.adapter
                     )
                     if self.prefix_sharing
                     else []
@@ -1006,7 +1045,7 @@ class ServingEngine:
                     ps = self.paged.page_size
                     parent = self._trie_root(req.adapter)
                     for i in range(plen // ps):
-                        key = (parent, tuple(req.prompt[i * ps : (i + 1) * ps]))
+                        key = (parent, tuple(eff[i * ps : (i + 1) * ps]))
                         if key not in self._prefix_pages:
                             self._prefix_pages[key] = pages[i]
                             self._page_keys.setdefault(pages[i], []).append(key)
@@ -1015,6 +1054,8 @@ class ServingEngine:
                         parent = pages[i]
                 self.slots[slot] = req
                 self._slot_pages[slot] = pages
+                self._slot_seq[slot] = self._seq_counter
+                self._seq_counter += 1
             admitted.append((slot, req, pages, len(shared)))
 
         if not admitted:
@@ -1023,7 +1064,7 @@ class ServingEngine:
         # (advanced chunk-by-chunk from step()).
         groups: dict[int, list[tuple[int, Request, list[int], int]]] = {}
         for item in admitted:
-            plen = len(item[1].prompt)
+            plen = len(item[1].prompt) + len(item[1].tokens)
             bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
             groups.setdefault(bucket, []).append(item)
         for items in groups.values():
@@ -1035,7 +1076,10 @@ class ServingEngine:
         request's first token, and mark the slots ready to decode."""
         finished: list[Request] = []
         for row_idx, (slot, req, pages, n_shared) in enumerate(job["items"]):
-            plen = len(req.prompt)
+            # Effective length: a resumed request's prefill covered its
+            # original prompt plus the tokens generated before eviction
+            # (req.tokens grows below AFTER this is read).
+            plen = len(req.prompt) + len(req.tokens)
             self._graft(
                 slot, job["cache"], pages, plen, n_shared, row_idx=row_idx
             )
@@ -1191,8 +1235,8 @@ class ServingEngine:
             return self._block_fns[key_]
         model = self._decode_model
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def block(params, cache, tokens, positions, temps, topks, topps, aids, key):
+        def _core(params, cache, tokens, positions, temps, aids, key,
+                  topks=None, topps=None):
             def body(carry, k):
                 cache, toks, pos = carry
                 logits, mut = model.apply(
@@ -1221,6 +1265,25 @@ class ServingEngine:
             )
             return toks.T, lps.T, cache  # [slots, T]
 
+        # Same filtered/unfiltered signature split as _step_fn: the
+        # greedy/temperature block path shouldn't upload top-k/top-p
+        # arrays it compiled out.
+        if filtered:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def block(params, cache, tokens, positions, temps, topks, topps,
+                      aids, key):
+                return _core(
+                    params, cache, tokens, positions, temps, aids, key,
+                    topks, topps,
+                )
+
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def block(params, cache, tokens, positions, temps, aids, key):
+                return _core(params, cache, tokens, positions, temps, aids, key)
+
         self._block_fns[key_] = block
         return block
 
@@ -1233,14 +1296,14 @@ class ServingEngine:
         the row's final length and are masked forever after the rewind —
         the speculative round's exact discipline); everything the host
         consumes is identical to T single steps."""
-        for s in active:
-            self._extend_frontier(s, lookahead=T - 1)
+        active = self._ensure_frontier(active, T - 1)
+        if not active:
+            self._update_gauges()
+            return finished
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
         aids = jnp.asarray(self._slot_aid, jnp.int32)
-        topks = jnp.asarray(self._slot_topk, jnp.int32)
-        topps = jnp.asarray(self._slot_topp, jnp.float32)
         filtered = any(
             self.slots[s] is not None
             and (
@@ -1254,10 +1317,17 @@ class ServingEngine:
             for s in range(self.max_slots)
         )
         self._rng, sub = jax.random.split(self._rng)
-        out, lps, self.cache = self._block_fn(T, filtered, want_lp)(
-            self.params, self.cache, tokens, positions, temps, topks,
-            topps, aids, sub,
-        )
+        if filtered:
+            out, lps, self.cache = self._block_fn(T, True, want_lp)(
+                self.params, self.cache, tokens, positions, temps,
+                jnp.asarray(self._slot_topk, jnp.int32),
+                jnp.asarray(self._slot_topp, jnp.float32),
+                aids, sub,
+            )
+        else:
+            out, lps, self.cache = self._block_fn(T, False, want_lp)(
+                self.params, self.cache, tokens, positions, temps, aids, sub
+            )
         out = np.asarray(out)
         lps = np.asarray(lps)
         emitted_total = 0
@@ -1349,6 +1419,14 @@ class ServingEngine:
             T = min(self._decode_block, 1 << max(0, room.bit_length() - 1))
             if T > 1:
                 return self._block_step(active, finished, T)
+        if self._optimistic:
+            # The single-step path's next write (position len) must be
+            # addressable; _block_step/_spec_step run their own ensure
+            # with their larger lookaheads.
+            active = self._ensure_frontier(active, 0)
+            if not active:
+                self._update_gauges()
+                return finished
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         temps = jnp.asarray(self._slot_temp, jnp.float32)
@@ -1407,8 +1485,10 @@ class ServingEngine:
         emit EXACTLY their non-speculative greedy decode; sampled slots
         emit marginally exact filtered target samples (both pinned in
         tests/test_engine.py); speculation changes only the schedule."""
-        for s in active:
-            self._extend_frontier(s)  # round writes up to len+gamma
+        active = self._ensure_frontier(active, self._spec_gamma)
+        if not active:
+            self._update_gauges()
+            return finished
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         if any(
@@ -1480,6 +1560,95 @@ class ServingEngine:
             self.metrics.tokens.inc(emitted_total)
         self._update_gauges()
         return finished
+
+    def _ensure_frontier(self, active: list[int], lookahead: int) -> list[int]:
+        """Make every coming write in [len, len+lookahead] addressable for
+        each active slot, then publish the covering pages.
+
+        Reserve admission: pages were all allocated at admission, so this
+        is pure publication.  Optimistic admission: generation pages are
+        allocated HERE, on demand — processed oldest-admission-first, a
+        pool shortage preempts the newest ready slot (recompute-resume:
+        the victim requeues at the head and re-prefills prompt+generated),
+        and if the shortage persists the starved slot itself is evicted.
+        Oldest-first + newest-evicted means the oldest request can never
+        be robbed, which is the liveness argument (it eventually owns
+        every page its submit-time bound guarantees fit).  Returns the
+        active list minus anything evicted."""
+        if not self._optimistic:
+            for s in active:
+                self._extend_frontier(s, lookahead=lookahead)
+            return active
+        ps = self.paged.page_size
+        for s in sorted(active, key=lambda x: self._slot_seq[x]):
+            req = self.slots[s]
+            if req is None or not self._slot_ready[s]:
+                continue  # evicted as a victim earlier in this pass
+            need = (self._slot_len[s] + lookahead) // ps + 1
+            while need > self._slot_page_base[s] + len(self._slot_pages[s]):
+                with self._lock:
+                    page = (
+                        self.free_pages.popleft() if self.free_pages else None
+                    )
+                    if page is not None:
+                        self._page_refs[page] = 1
+                        self._slot_pages[s].append(page)
+                        continue
+                if not self._preempt_newest(newer_than=self._slot_seq[s]):
+                    break
+            if need > self._slot_page_base[s] + len(self._slot_pages[s]):
+                self._evict_slot(s)  # starved even after preempting: resume later
+                continue
+            self._extend_frontier(s, lookahead=lookahead)
+        return [
+            s
+            for s in active
+            if self.slots[s] is not None and self._slot_ready[s]
+        ]
+
+    def _preempt_newest(self, newer_than: int) -> bool:
+        """Evict the most recently admitted ready slot STRICTLY newer
+        than ``newer_than`` to free its pages; False when none is.  A
+        growing slot may only rob younger slots — never an older one —
+        so the oldest request's page claim is monotone (liveness)."""
+        cands = [
+            s
+            for s in range(self.max_slots)
+            if self.slots[s] is not None
+            and self._slot_ready[s]
+            and self._slot_seq[s] > newer_than
+        ]
+        if not cands:
+            return False
+        self._evict_slot(max(cands, key=lambda s: self._slot_seq[s]))
+        return True
+
+    def _evict_slot(self, slot: int) -> None:
+        """Preempt: tear the slot down exactly like a finish (pages,
+        table row, prefix refcounts all through _clear_slot) but requeue
+        the request at the queue HEAD for recompute-resume — unless the
+        client already cancelled it, in which case eviction doubles as
+        the teardown."""
+        req = self.slots[slot]
+        self._clear_slot(slot)
+        with self._lock:
+            # Atomic with cancel(): a disconnect racing this eviction
+            # either finds the request still in a slot (cancel marks it;
+            # we see cancelled here) or finds it back in the queue
+            # (cancel removes it there) — never a cancelled request
+            # silently re-admitted.
+            if req.cancelled:
+                req.done = True
+                self._update_gauges()
+                return
+            # Only a real recompute-resume counts as a preemption: a
+            # cancelled victim's eviction is ordinary teardown, and
+            # operators size the pool from this counter.
+            self.preemptions += 1
+            if self.metrics:
+                self.metrics.preemptions.inc()
+            self.queue.appendleft(req)
+            self._update_gauges()
 
     def _extend_frontier(self, slot: int, lookahead: Optional[int] = None) -> None:
         """Publish every page the next step can write — up to the one
@@ -1667,6 +1836,16 @@ def main(argv: Optional[list[str]] = None) -> None:
         "(power of two) — amortizes the per-step host round-trip; "
         "incompatible with --spec-gamma",
     )
+    p.add_argument(
+        "--admission",
+        choices=["reserve", "optimistic"],
+        default="reserve",
+        help="reserve: allocate each request's worst-case page chain at "
+        "admission (no preemption ever); optimistic: allocate prompt "
+        "pages only and grow on demand, preempting the newest slot for "
+        "recompute-resume when the pool runs dry — higher concurrency "
+        "when generations finish early",
+    )
     args = p.parse_args(argv)
     if args.spec_gamma and args.quant:
         raise SystemExit(
@@ -1710,7 +1889,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
         prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
-        **spec_kw,
+        admission=args.admission, **spec_kw,
     )
     sample_kw = dict(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p

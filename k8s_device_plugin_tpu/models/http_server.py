@@ -332,6 +332,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="tokens per dispatch in pure decode (power of two; one "
         "scanned program amortizes the per-step host round-trip)",
     )
+    p.add_argument(
+        "--admission",
+        choices=["reserve", "optimistic"],
+        default="reserve",
+        help="optimistic: prompt-pages-only admission with newest-slot "
+        "recompute preemption under pool pressure (higher concurrency "
+        "when generations finish early)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--checkpoint-dir",
@@ -458,6 +466,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk,
         decode_block=args.decode_block,
+        admission=args.admission,
         **spec_kw,
     )
     server = EngineServer(

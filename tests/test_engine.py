@@ -891,10 +891,11 @@ def test_engine_feature_matrix_fuzz(rng):
         # And one victim cancelled mid-flight: whatever the feature mix,
         # teardown must leave the survivors' outputs and the pool exact.
         victim = eng.submit(jobs[1][0], 6)
+        cancel_at = int(npr.choice([1, 2, 4]))
         guard = 0
         while not (all(r.done for r in subs) and sampled.done and victim.done):
             eng.step()
-            if guard == int(npr.choice([1, 2, 4])) and not victim.done:
+            if guard == cancel_at and not victim.done:
                 eng.cancel(victim)
             guard += 1
             assert guard < 2000, (trial, "engine failed to drain")
@@ -1300,3 +1301,137 @@ def test_logprobs_rejected_on_spec_engine(rng):
     )
     with pytest.raises(ValueError, match="logprobs"):
         eng.submit([3], 4, logprobs=True)
+
+
+# ---------------------------------------------------------------------------
+# Optimistic admission + recompute preemption
+# ---------------------------------------------------------------------------
+
+
+def test_optimistic_oversubscribes_then_preempts_exactly(rng):
+    """Pool that reserve-fits ONE worst-case chain runs TWO requests
+    concurrently under optimistic admission; when their growth collides,
+    the newer one is preempted, resumes via recompute, and BOTH outputs
+    still match the dense oracle exactly."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    # 6 allocatable pages of 4; each request's worst case is 4 pages
+    # (4 prompt + 12 new = 16 slots), so reserve admits one at a time.
+    paged = PagedConfig(page_size=4, num_pages=7, max_pages_per_seq=8)
+    pa, pb = [3, 141, 59, 7], [9, 10, 11, 12]
+
+    reserve = ServingEngine(cfg, params, paged, max_slots=2)
+    reserve.submit(pa, 12)
+    reserve.submit(pb, 12)
+    reserve.step()
+    assert sum(s is not None for s in reserve.slots) == 1  # the baseline
+
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, admission="optimistic",
+        prefix_sharing=False,
+    )
+    a = eng.submit(pa, 12)
+    b = eng.submit(pb, 12)
+    eng.step()
+    assert sum(s is not None for s in eng.slots) == 2  # oversubscribed
+    guard = 0
+    while not (a.done and b.done):
+        eng.step()
+        guard += 1
+        assert guard < 500, "optimistic engine failed to drain"
+    assert eng.preemptions > 0, "pool collision never forced a preemption"
+    assert a.tokens == _oracle(cfg, params, pa, 12)
+    assert b.tokens == _oracle(cfg, params, pb, 12)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_optimistic_preemption_preserves_prefix_sharing(rng):
+    """A preempted request sharing prompt pages must not free them from
+    under its sibling, and its resume re-prefills prompt+generated."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=2, num_pages=12, max_pages_per_seq=12)
+    shared = [3, 141, 59, 7]
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, admission="optimistic"
+    )
+    a = eng.submit(shared, 10)
+    b = eng.submit(shared, 10)
+    guard = 0
+    while not (a.done and b.done):
+        eng.step()
+        guard += 1
+        assert guard < 500
+    want = _oracle(cfg, params, shared, 10)
+    assert a.tokens == want and b.tokens == want
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_optimistic_composes_with_blocks_and_window(rng):
+    """Decode blocks grow their T-token frontier through the optimistic
+    allocator, and windowed reclamation returns pages to the shared
+    pool mid-flight."""
+    cfg = _cfg(attention_window=4)
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=2, num_pages=14, max_pages_per_seq=14)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, admission="optimistic",
+        decode_block=4,
+    )
+    jobs = [([3, 141, 59], 12), ([9, 10], 10)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_optimistic_spec_engine_parity(rng):
+    """Speculative rounds grow gamma-lookahead pages on demand; greedy
+    outputs stay exactly the dense decode."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
+
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, admission="optimistic",
+        spec_gamma=2, draft_params=quantize_lm_params(params),
+    )
+    jobs = [([3, 141, 59], 8), ([9, 10], 5)]
+    reqs = eng.run(jobs)
+    for (prompt, n), req in zip(jobs, reqs):
+        assert req.tokens == _oracle(cfg, params, prompt, n), prompt
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_optimistic_cancelled_victim_not_requeued(rng):
+    """Eviction of an already-cancelled request doubles as its teardown:
+    it finishes instead of resuming."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=7, max_pages_per_seq=8)
+    eng = ServingEngine(
+        cfg, params, paged, max_slots=2, admission="optimistic",
+        prefix_sharing=False,
+    )
+    a = eng.submit([3, 141, 59, 7], 12)
+    b = eng.submit([9, 10, 11, 12], 12)
+    for _ in range(2):
+        eng.step()
+    eng.cancel(b)
+    guard = 0
+    while not (a.done and b.done):
+        eng.step()
+        guard += 1
+        assert guard < 500
+    assert b.done and not eng.queue
+    assert a.tokens == _oracle(cfg, params, [3, 141, 59, 7], 12)
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_admission_validation(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    with pytest.raises(ValueError, match="admission"):
+        ServingEngine(cfg, params, paged, admission="magic")
