@@ -170,6 +170,14 @@ class Request:
     # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
     # request decodes through; None = base model.
     adapter: Optional[int] = None
+    # Stop sequences (token-id lists): generation ends when the output's
+    # tail equals any of them; the matched suffix is EXCLUDED from
+    # ``tokens`` (eos_id, by contrast, is included — the id itself is the
+    # terminator, a stop sequence is a content sentinel).
+    stop: Optional[list[list[int]]] = None
+    # Latched by the engine when a stop sequence matched (the matched
+    # suffix is truncated away, so the flag — not the tail — records it).
+    stopped: bool = False
     # Record each emitted token's logprob under the unscaled model
     # distribution in ``token_logprobs`` (parallel to ``tokens``).
     # Sampler settings change what gets picked, never what is reported.
@@ -599,10 +607,18 @@ class ServingEngine:
         top_p: Optional[float] = None,
         adapter: Optional[int] = None,
         logprobs: bool = False,
+        stop: Optional[list] = None,
     ) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        if stop is not None:
+            stop = [[int(t) for t in seq] for seq in stop]
+            if not stop or any(not seq for seq in stop):
+                raise ValueError(
+                    "stop must be a non-empty list of non-empty "
+                    "token-id sequences"
+                )
         if logprobs and self._spec_gamma:
             # The speculative round emits accepted draft tokens without
             # materializing their target log-softmax; scoring them would
@@ -659,7 +675,8 @@ class ServingEngine:
         with self._lock:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
-                adapter=adapter, logprobs=logprobs, rid=self._next_rid,
+                adapter=adapter, logprobs=logprobs, stop=stop,
+                rid=self._next_rid,
             )
             self._next_rid += 1
             self.queue.append(req)
@@ -1005,9 +1022,11 @@ class ServingEngine:
                         (plen + 1 + self._spec_gamma) / self.paged.page_size
                     )
                 else:
+                    # Reserve admission never preempts, so req.tokens is
+                    # always empty here and plen == len(req.prompt): the
+                    # worst-case chain, allocated up front.
                     n_pages = math.ceil(
-                        (plen + req.max_new_tokens - len(req.tokens)
-                         + self._spec_gamma)
+                        (plen + req.max_new_tokens + self._spec_gamma)
                         / self.paged.page_size
                     )
                 shared = (
@@ -1079,6 +1098,7 @@ class ServingEngine:
             # Effective length: a resumed request's prefill covered its
             # original prompt plus the tokens generated before eviction
             # (req.tokens grows below AFTER this is read).
+            resumed = bool(req.tokens)
             plen = len(req.prompt) + len(req.tokens)
             self._graft(
                 slot, job["cache"], pages, plen, n_shared, row_idx=row_idx
@@ -1136,12 +1156,36 @@ class ServingEngine:
             )
             self._slot_ready[slot] = True
             if self.metrics:
-                self.metrics.requests.inc()
+                # A preemption resume re-activates the SAME client
+                # request: counting it again would skew requests_total
+                # exactly in the overload regime it helps diagnose.
+                if not resumed:
+                    self.metrics.requests.inc()
                 self.metrics.tokens.inc()
             self._maybe_finish(slot)
             if req.done:
                 finished.append(req)
         return finished
+
+    @staticmethod
+    def _hit_stop(req: Request) -> bool:
+        """True when the output's tail equals one of the request's stop
+        sequences (or already did): truncates the matched suffix (and its
+        logprobs) and LATCHES ``req.stopped`` — the evidence is deleted,
+        so the flag carries the verdict to _maybe_finish."""
+        if req.stopped:
+            return True
+        if not req.stop:
+            return False
+        for seq in req.stop:
+            n = len(seq)
+            if n and len(req.tokens) >= n and req.tokens[-n:] == seq:
+                del req.tokens[-n:]
+                if req.logprobs:
+                    del req.token_logprobs[len(req.tokens):]
+                req.stopped = True
+                return True
+        return False
 
     def _maybe_finish(self, slot: int):
         req = self.slots[slot]
@@ -1155,6 +1199,7 @@ class ServingEngine:
                 and req.tokens
                 and req.tokens[-1] == self.eos_id
             )
+            or self._hit_stop(req)
         ):
             req.done = True
             self._clear_slot(slot)
@@ -1345,8 +1390,10 @@ class ServingEngine:
                 self._slot_last[s] = tok
                 consumed += 1
                 emitted_total += 1
-                if len(req.tokens) >= req.max_new_tokens or (
-                    self.eos_id is not None and tok == self.eos_id
+                if (
+                    len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_id is not None and tok == self.eos_id)
+                    or self._hit_stop(req)
                 ):
                     break
             self._slot_len[s] += consumed
@@ -1531,8 +1578,10 @@ class ServingEngine:
                 self._slot_last[s] = tok
                 consumed += 1
                 emitted_total += 1
-                if len(req.tokens) >= req.max_new_tokens or (
-                    self.eos_id is not None and tok == self.eos_id
+                if (
+                    len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_id is not None and tok == self.eos_id)
+                    or self._hit_stop(req)
                 ):
                     break
             self._slot_len[s] += consumed

@@ -18,8 +18,11 @@ rest of the models/ stack which benchmarks on synthetic ids):
 
     POST /generate   {"prompt": [int, ...], "max_new_tokens": N,
                       "temperature": t?, "top_k": k?, "top_p": p?,
-                      "stream": false?, "logprobs": false?}
+                      "stream": false?, "logprobs": false?,
+                      "stop": [[int, ...], ...]?}
       -> 200 {"tokens": [int, ...], "rid": R}
+      -> "stop": token-id sequences ending generation; a matched suffix
+         is EXCLUDED from tokens (eos stays included — see engine docs).
       -> with "logprobs": true, adds "logprobs": [float, ...] — each
          emitted token's logprob under the UNSCALED model distribution
          (sampler settings change what gets picked, not what is
@@ -97,6 +100,8 @@ class EngineServer:
                         kwargs["adapter"] = int(body["adapter"])
                     if body.get("logprobs"):
                         kwargs["logprobs"] = True
+                    if body.get("stop") is not None:
+                        kwargs["stop"] = body["stop"]
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
@@ -139,25 +144,38 @@ class EngineServer:
                 self.end_headers()
                 deadline = time.monotonic() + server._timeout
                 sent = 0
+                # Stop sequences truncate the matched suffix at the END:
+                # the last (longest_stop - 1) tokens are provisional — a
+                # later token could complete a match and delete them — so
+                # hold them back until the request finishes (the final
+                # list IS post-truncation truth).  Without stop, lag 0.
+                lag = max((len(s) for s in req.stop), default=1) - 1 if req.stop else 0
                 try:
                     while True:
                         with server._cond:
                             server._cond.notify_all()  # wake an idle loop
                             server._cond.wait_for(
-                                lambda: req.done or len(req.tokens) > sent,
+                                lambda: req.done
+                                or len(req.tokens) - lag > sent,
                                 timeout=min(1.0, server._timeout),
                             )
                             toks = list(req.tokens)
                             done = req.done
-                        if not done and sent == len(toks):
-                            # Idle (queued / mid-prefill / slow step): an
+                        # Emit up to the lag horizon mid-flight; once done,
+                        # everything left (req.tokens is already
+                        # stop-truncated, so the held-back suffix that
+                        # matched simply never streams).
+                        limit = len(toks) if done else max(0, len(toks) - lag)
+                        if not done and sent == limit:
+                            # Idle (queued / mid-prefill / slow step / all
+                            # emittable tokens inside the hold-back): an
                             # SSE comment heartbeat so a vanished client
                             # surfaces as a broken pipe HERE, not after
                             # the full request timeout with the request
                             # decoding for nobody.
                             self.wfile.write(b": ping\n\n")
                             self.wfile.flush()
-                        while sent < len(toks):
+                        while sent < limit:
                             ev = {"token": toks[sent], "index": sent,
                                   "rid": req.rid}
                             if req.logprobs and sent < len(req.token_logprobs):

@@ -1435,3 +1435,70 @@ def test_admission_validation(rng):
     paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
     with pytest.raises(ValueError, match="admission"):
         ServingEngine(cfg, params, paged, admission="magic")
+
+
+# ---------------------------------------------------------------------------
+# Stop sequences
+# ---------------------------------------------------------------------------
+
+
+def test_stop_sequence_truncates_exactly(rng):
+    """Generation ends when the output's tail matches a stop sequence;
+    the matched suffix is excluded from tokens (and its logprobs)."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 8)
+    stop = [want[3], want[4]]  # a 2-token mid-stream sentinel
+    # The engine stops at the FIRST tail match — with repeating greedy
+    # output that can be earlier than index 3 — so compute it.
+    first = next(i for i in range(len(want) - 1) if want[i : i + 2] == stop)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    req = eng.submit(prompt, 8, logprobs=True, stop=[stop])
+    while not req.done:
+        eng.step()
+    assert req.stopped
+    assert req.tokens == want[:first]
+    assert len(req.token_logprobs) == first
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_stop_sequence_mid_decode_block(rng):
+    """A stop matching inside a decode block truncates there — the
+    block's wasted tail iterations never leak."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1, decode_block=4)
+    req = eng.submit(prompt, 8, stop=[[want[2]]])
+    while not req.done:
+        eng.step()
+    assert req.stopped and req.tokens == want[:2]
+    assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_stop_sequence_never_matching_runs_to_budget(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 6)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    req = eng.submit(prompt, 6, stop=[[cfg.vocab_size - 1] * 3])
+    while not req.done:
+        eng.step()
+    assert not req.stopped and req.tokens == want
+
+
+def test_stop_validation(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    with pytest.raises(ValueError, match="stop"):
+        eng.submit([3], 4, stop=[])
+    with pytest.raises(ValueError, match="stop"):
+        eng.submit([3], 4, stop=[[]])

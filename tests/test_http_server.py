@@ -250,3 +250,27 @@ def test_logprobs_in_response_and_stream(served):
     np.testing.assert_allclose(
         [e["logprob"] for e in toks], out["logprobs"], rtol=1e-6
     )
+
+
+def test_stop_sequences_over_http_and_stream(served):
+    """'stop' ends generation with the matched suffix excluded — and the
+    STREAM never emits a token the final truncation removes (held back
+    by the stop-length lag)."""
+    cfg, params, server = served
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 8)
+    stop = [want[2], want[3]]
+    first = next(i for i in range(len(want) - 1) if want[i : i + 2] == stop)
+    out = _post(
+        server.port,
+        {"prompt": prompt, "max_new_tokens": 8, "stop": [stop]},
+    )
+    assert out["tokens"] == want[:first]
+    events = _post_stream(
+        server.port,
+        {"prompt": prompt, "max_new_tokens": 8, "stop": [stop]},
+    )
+    streamed = [e["token"] for e in events if "token" in e]
+    done = events[-1]
+    assert done.get("done") is True
+    assert streamed == done["tokens"] == want[:first]
