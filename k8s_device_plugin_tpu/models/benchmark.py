@@ -496,6 +496,14 @@ def main(argv: list[str] | None = None) -> None:
         help="resnet50 stem: standard 7x7/s2 conv or the space-to-depth "
         "packing (geometry-equivalent, MXU-friendlier — models/resnet.py)",
     )
+    p.add_argument(
+        "--grad-accum",
+        type=_positive_int,
+        default=1,
+        help="microbatches per optimizer step (one scanned program; "
+        "activation memory of one microbatch, full-batch update math) — "
+        "the GLOBAL batch must divide evenly",
+    )
     p.add_argument("--tiny", action="store_true", help="tiny model config (CPU smoke; gpt and vit)")
     p.add_argument(
         "--trace-dir",
@@ -547,6 +555,11 @@ def main(argv: list[str] | None = None) -> None:
     # silently ignore a requested behavior.
     if args.fused_xent and args.model != "gpt":
         raise SystemExit("--fused-xent requires --model gpt")
+    if args.grad_accum > 1 and (args.fused_xent or args.pp > 1):
+        raise SystemExit(
+            "--grad-accum applies to the standard train step only (the "
+            "fused-xent and pipelined steps manage their own microbatching)"
+        )
     if args.fused_xent and args.pp > 1:
         raise SystemExit(
             "--fused-xent is not supported with --pp (the pipelined LM head "
@@ -576,7 +589,11 @@ def main(argv: list[str] | None = None) -> None:
         step_fn = make_fused_lm_train_step(model, tx)
         log("loss tail: fused LM-head + cross-entropy (no logits tensor)")
     else:
-        step_fn = make_train_step(model, tx, input_key=input_key)
+        step_fn = make_train_step(
+            model, tx, input_key=input_key, grad_accum=args.grad_accum
+        )
+        if args.grad_accum > 1:
+            log(f"grad accumulation: {args.grad_accum} microbatches/step")
     step, state, batch_sh = shard_train_step(step_fn, mesh, state, batch)
     if jax.process_count() > 1:
         # Each process owns a slice of the global batch; assemble global

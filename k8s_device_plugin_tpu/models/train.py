@@ -98,35 +98,89 @@ def make_train_step(
     input_key: str = "images",
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_xent,
     aux_loss_coeff: float = 0.0,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, dict], tuple[TrainState, jax.Array]]:
     """Build `(state, batch) -> (state, loss)`; jit/pjit it at the call site.
 
     aux_loss_coeff > 0 makes the 'intermediates' collection mutable and adds
     `coeff * sum(sown *aux_loss*)` to the loss — REQUIRED for MoE models
     (parallel/moe.py sows `moe_aux_loss` per layer; without this the router
-    trains with no load balancing).  GShard/Switch use coeff ≈ 0.01."""
+    trains with no load balancing).  GShard/Switch use coeff ≈ 0.01.
 
-    def train_step(state: TrainState, batch: dict):
-        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+    grad_accum > 1 splits the batch into that many microbatches and runs
+    them through ONE `lax.scan` inside the step, averaging the f32 grads
+    before a single optimizer update — the standard large-effective-batch
+    /small-memory trade, TPU-shaped: activation memory is one
+    microbatch's, the scan is a single compiled program (no per-micro
+    dispatch), and the update math equals the full-batch step up to
+    summation order.  The batch's leading dim must divide evenly.
+    BatchNorm models keep per-micro running-stat updates (stats carry
+    through the scan — the same sequential semantics as feeding the
+    microbatches as separate steps); dropout folds a distinct rng per
+    microbatch."""
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
-        def compute_loss(params):
+    def compute_loss(params, state, micro, dropout_rng):
+        def inner(p):
             logits, new_stats, inters = _apply(
                 model,
                 state,
-                params,
-                batch[input_key],
+                p,
+                micro[input_key],
                 train=True,
                 rngs={"dropout": dropout_rng},
                 capture_intermediates=aux_loss_coeff > 0.0,
             )
-            loss = loss_fn(logits, batch["labels"])
+            loss = loss_fn(logits, micro["labels"])
             if aux_loss_coeff > 0.0:
                 loss = loss + aux_loss_coeff * sown_aux_loss(inters)
             return loss, new_stats
 
-        (loss, new_stats), grads = jax.value_and_grad(compute_loss, has_aux=True)(
-            state.params
-        )
+        return jax.value_and_grad(inner, has_aux=True)(params)
+
+    def train_step(state: TrainState, batch: dict):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        if grad_accum == 1:
+            (loss, new_stats), grads = compute_loss(
+                state.params, state, batch, dropout_rng
+            )
+        else:
+            micros = jax.tree.map(
+                lambda x: x.reshape(
+                    (grad_accum, x.shape[0] // grad_accum) + x.shape[1:]
+                ),
+                batch,
+            )
+
+            def body(carry, micro):
+                stats, grad_sum, loss_sum, i = carry
+                rng_i = jax.random.fold_in(dropout_rng, i)
+                (loss_i, stats), grads_i = compute_loss(
+                    state.params,
+                    state.with_updates(batch_stats=stats),
+                    micro,
+                    rng_i,
+                )
+                grad_sum = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grad_sum, grads_i
+                )
+                return (stats, grad_sum, loss_sum + loss_i, i + 1), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (new_stats, grad_sum, loss_sum, _), _ = jax.lax.scan(
+                body,
+                (state.batch_stats, zero_grads, jnp.float32(0.0), jnp.int32(0)),
+                micros,
+            )
+            grads = jax.tree.map(
+                lambda p, g: (g / grad_accum).astype(p.dtype),
+                state.params,
+                grad_sum,
+            )
+            loss = loss_sum / grad_accum
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (
