@@ -555,10 +555,18 @@ def main(argv: list[str] | None = None) -> None:
     # silently ignore a requested behavior.
     if args.fused_xent and args.model != "gpt":
         raise SystemExit("--fused-xent requires --model gpt")
-    if args.grad_accum > 1 and (args.fused_xent or args.pp > 1):
+    if args.grad_accum > 1 and (
+        args.fused_xent or args.pp > 1 or args.model == "gpt-decode"
+    ):
         raise SystemExit(
             "--grad-accum applies to the standard train step only (the "
-            "fused-xent and pipelined steps manage their own microbatching)"
+            "fused-xent and pipelined steps manage their own "
+            "microbatching, and gpt-decode does not train)"
+        )
+    if args.grad_accum > 1 and args.batch_size % args.grad_accum:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} is not divisible by "
+            f"--grad-accum {args.grad_accum}"
         )
     if args.fused_xent and args.pp > 1:
         raise SystemExit(

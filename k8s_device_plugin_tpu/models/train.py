@@ -146,6 +146,12 @@ def make_train_step(
                 state.params, state, batch, dropout_rng
             )
         else:
+            n = batch[input_key].shape[0]
+            if n % grad_accum:
+                raise ValueError(
+                    f"batch size {n} is not divisible by grad_accum "
+                    f"{grad_accum}"
+                )
             micros = jax.tree.map(
                 lambda x: x.reshape(
                     (grad_accum, x.shape[0] // grad_accum) + x.shape[1:]
