@@ -39,6 +39,9 @@ rest of the models/ stack which benchmarks on synthetic ids):
          pool instead of decoding for nobody.
     GET /healthz     -> 200 "ok" while the engine loop is alive
     GET /metrics     -> Prometheus exposition (when a registry is wired)
+    POST /debug/trace {"seconds": s?}
+      -> 200 {"trace_dir": ...} after capturing a jax.profiler trace of
+         the live serving loop (XProf/Perfetto); 409 while one runs.
 """
 
 from __future__ import annotations
@@ -75,11 +78,16 @@ class EngineServer:
         self._stop = threading.Event()
         self._loop_alive = False
         self._timeout = request_timeout_s
+        self._trace_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] != "/generate":
+                path = self.path.split("?")[0]
+                if path == "/debug/trace":
+                    self._trace_capture()
+                    return
+                if path != "/generate":
                     self.send_error(404)
                     return
                 try:
@@ -131,6 +139,58 @@ class EngineServer:
                 if req.logprobs:
                     out["logprobs"] = req.token_logprobs
                 self._reply(200, out)
+
+            def _trace_capture(self) -> None:
+                """POST /debug/trace {"seconds": s?}: capture
+                a jax.profiler trace of the LIVE serving loop (XLA op
+                timelines, HBM, collectives — loads in XProf/Perfetto)
+                for s seconds and reply with the server-chosen trace
+                dir.  The capture rides this handler thread while the
+                owner loop keeps stepping, which is the point; one
+                capture at a time (409 while busy), seconds clamped to
+                (0, 30]."""
+                import math
+                import tempfile
+
+                import jax
+
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise TypeError(f"body must be an object, got {body!r}")
+                    seconds = float(body.get("seconds", 2.0))
+                    if not math.isfinite(seconds):
+                        raise ValueError(f"seconds must be finite, got {seconds}")
+                    seconds = min(max(seconds, 0.05), 30.0)
+                except (TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                # The dir is SERVER-chosen: an unauthenticated client must
+                # not direct profiler writes at arbitrary paths (the
+                # server binds 0.0.0.0 by default).
+                tdir = tempfile.mkdtemp(prefix="tpu-serving-trace-")
+                if not server._trace_lock.acquire(blocking=False):
+                    self._reply(409, {"error": "a trace capture is already running"})
+                    return
+                started = False
+                try:
+                    jax.profiler.start_trace(tdir)
+                    started = True
+                    time.sleep(seconds)
+                except Exception as e:  # profiler state is global: report, not crash
+                    self._reply(500, {"error": f"trace failed: {e}"})
+                    return
+                finally:
+                    if started:
+                        try:
+                            # Always unwound, or the global profiler stays
+                            # started and bricks every later capture.
+                            jax.profiler.stop_trace()
+                        except Exception:
+                            pass
+                    server._trace_lock.release()
+                self._reply(200, {"trace_dir": tdir, "seconds": seconds})
 
             def _stream_reply(self, req) -> None:
                 """Server-sent events: one ``data:`` event per generated

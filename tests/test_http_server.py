@@ -44,15 +44,19 @@ def served():
     server.stop()
 
 
-def _post(port, payload, timeout=120):
+def _post_path(port, path, payload, timeout=120):
     req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/generate",
+        f"http://127.0.0.1:{port}{path}",
         data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def _post(port, payload, timeout=120):
+    return _post_path(port, "/generate", payload, timeout)
 
 
 def _oracle(cfg, params, prompt, n):
@@ -274,3 +278,30 @@ def test_stop_sequences_over_http_and_stream(served):
     done = events[-1]
     assert done.get("done") is True
     assert streamed == done["tokens"] == want[:first]
+
+
+def test_debug_trace_endpoint(served):
+    """POST /debug/trace captures a jax.profiler trace of the live loop
+    and replies with the dir (which must contain profile output)."""
+    import os
+
+    cfg, params, server = served
+    # Keep the engine busy so the trace has device work in it.
+    bg = threading.Thread(
+        target=lambda: _post(
+            server.port, {"prompt": [3, 141, 59], "max_new_tokens": 12}
+        ),
+        daemon=True,
+    )
+    bg.start()
+    out = _post_path(server.port, "/debug/trace", {"seconds": 0.3})
+    tdir = out["trace_dir"]  # server-chosen: clients cannot aim writes
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(tdir) for f in fs
+    ]
+    assert found, "profiler wrote nothing into the trace dir"
+    # Malformed bodies answer 400, not a dropped connection.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_path(server.port, "/debug/trace", [1])
+    assert e.value.code == 400
+    bg.join(timeout=60)
