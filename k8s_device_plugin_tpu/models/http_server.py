@@ -39,9 +39,10 @@ rest of the models/ stack which benchmarks on synthetic ids):
          pool instead of decoding for nobody.
     GET /healthz     -> 200 "ok" while the engine loop is alive
     GET /metrics     -> Prometheus exposition (when a registry is wired)
-    POST /debug/trace {"seconds": s?}
+    POST /debug/trace {"seconds": s?}   [opt-in: --debug-trace]
       -> 200 {"trace_dir": ...} after capturing a jax.profiler trace of
-         the live serving loop (XProf/Perfetto); 409 while one runs.
+         the live serving loop (XProf/Perfetto); 409 while one runs;
+         404 unless the operator enabled the endpoint.
 """
 
 from __future__ import annotations
@@ -72,6 +73,7 @@ class EngineServer:
         port: int = 8000,
         registry: Optional[MetricsRegistry] = None,
         request_timeout_s: float = 600.0,
+        enable_trace: bool = False,
     ):
         self.engine = engine
         self._cond = threading.Condition()
@@ -79,12 +81,19 @@ class EngineServer:
         self._loop_alive = False
         self._timeout = request_timeout_s
         self._trace_lock = threading.Lock()
+        self._enable_trace = enable_trace
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
                 path = self.path.split("?")[0]
                 if path == "/debug/trace":
+                    if not server._enable_trace:
+                        # Off unless the operator opted in (--debug-trace):
+                        # the server binds 0.0.0.0 by default, and an open
+                        # profiler endpoint is a latency/disk DoS lever.
+                        self.send_error(404)
+                        return
                     self._trace_capture()
                     return
                 if path != "/generate":
@@ -166,13 +175,14 @@ class EngineServer:
                 except (TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
-                # The dir is SERVER-chosen: an unauthenticated client must
-                # not direct profiler writes at arbitrary paths (the
-                # server binds 0.0.0.0 by default).
-                tdir = tempfile.mkdtemp(prefix="tpu-serving-trace-")
                 if not server._trace_lock.acquire(blocking=False):
                     self._reply(409, {"error": "a trace capture is already running"})
                     return
+                # Lock first, THEN mkdtemp: a 409 poll loop must not mint
+                # an orphan dir per attempt.  The dir is SERVER-chosen —
+                # clients must not direct profiler writes at arbitrary
+                # paths.
+                tdir = tempfile.mkdtemp(prefix="tpu-serving-trace-")
                 started = False
                 try:
                     jax.profiler.start_trace(tdir)
@@ -180,6 +190,10 @@ class EngineServer:
                     time.sleep(seconds)
                 except Exception as e:  # profiler state is global: report, not crash
                     self._reply(500, {"error": f"trace failed: {e}"})
+                    if not started:
+                        import shutil
+
+                        shutil.rmtree(tdir, ignore_errors=True)
                     return
                 finally:
                     if started:
@@ -420,6 +434,13 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
+        "--debug-trace",
+        action="store_true",
+        help="enable POST /debug/trace (on-demand jax.profiler capture of "
+        "the live serving loop) — off by default: the endpoint is "
+        "unauthenticated and the server binds 0.0.0.0",
+    )
+    p.add_argument(
         "--checkpoint-dir",
         default="",
         help="restore params from an orbax checkpoint (models/checkpoint.py) "
@@ -548,7 +569,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         **spec_kw,
     )
     server = EngineServer(
-        engine, port=args.http_port, registry=registry
+        engine, port=args.http_port, registry=registry,
+        enable_trace=args.debug_trace,
     ).start()
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics)",

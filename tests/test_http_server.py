@@ -38,7 +38,7 @@ def served():
     )
     server = EngineServer(
         engine, host="127.0.0.1", port=0, registry=registry,
-        request_timeout_s=120,
+        request_timeout_s=120, enable_trace=True,
     ).start()
     yield cfg, params, server
     server.stop()
