@@ -305,3 +305,22 @@ def test_debug_trace_endpoint(served):
         _post_path(server.port, "/debug/trace", [1])
     assert e.value.code == 400
     bg.join(timeout=60)
+
+
+def test_debug_trace_gated_off_by_default():
+    """A default-constructed server must 404 /debug/trace: the endpoint
+    is an unauthenticated profiler trigger and is strictly opt-in."""
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ServingEngine(
+        cfg, params, PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    )
+    server = EngineServer(engine, host="127.0.0.1", port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_path(server.port, "/debug/trace", {"seconds": 0.1})
+        assert e.value.code == 404
+    finally:
+        server.stop()
