@@ -33,6 +33,7 @@ import dataclasses
 import functools
 import math
 import threading
+import time
 from collections import deque
 from typing import Any, Optional
 
@@ -150,6 +151,16 @@ class EngineMetrics:
             "tpu_engine_preemptions_total",
             "Slots evicted for recompute-resume under optimistic admission",
         )
+        self.step_seconds = registry.histogram(
+            "tpu_engine_step_seconds",
+            "Wall time of one engine step() call (admission + dispatch + "
+            "consume); histogram_quantile() gives serving-step p50/p99",
+        )
+        self.wait_seconds = registry.histogram(
+            "tpu_engine_request_wait_seconds",
+            "Queue-to-first-token wait per request (admission latency "
+            "under load)",
+        )
 
 
 @dataclasses.dataclass
@@ -183,6 +194,8 @@ class Request:
     # Sampler settings change what gets picked, never what is reported.
     logprobs: bool = False
     rid: int = -1
+    # monotonic submit time (engine-internal: queue-wait observation).
+    submitted_at: float = 0.0
     tokens: list[int] = dataclasses.field(default_factory=list)
     token_logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -676,7 +689,7 @@ class ServingEngine:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
                 adapter=adapter, logprobs=logprobs, stop=stop,
-                rid=self._next_rid,
+                rid=self._next_rid, submitted_at=time.monotonic(),
             )
             self._next_rid += 1
             self.queue.append(req)
@@ -1161,6 +1174,9 @@ class ServingEngine:
                 # exactly in the overload regime it helps diagnose.
                 if not resumed:
                     self.metrics.requests.inc()
+                    self.metrics.wait_seconds.observe(
+                        time.monotonic() - req.submitted_at
+                    )
                 self.metrics.tokens.inc()
             self._maybe_finish(slot)
             if req.done:
@@ -1423,6 +1439,12 @@ class ServingEngine:
         """Admit what fits, advance every active slot one token; returns
         every request that finished this step (including ones done at
         admission — EOS/max_new on the prefill token)."""
+        if self.metrics:
+            with self.metrics.step_seconds.time():
+                return self._step_inner()
+        return self._step_inner()
+
+    def _step_inner(self) -> list[Request]:
         finished = self._admit()
         # Cancelled slots tear down BEFORE the dispatch (no farewell
         # token).  Only ready slots: a cancelled request mid-prefill

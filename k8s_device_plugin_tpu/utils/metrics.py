@@ -13,6 +13,7 @@ line per labeled series.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -104,6 +105,22 @@ class Gauge(_Metric):
             return self._series.get(self._key(labels), 0.0)
 
 
+class _Timer:
+    """Context manager observing elapsed wall seconds into any metric
+    with an ``observe(seconds)`` method (Summary, Histogram)."""
+
+    def __init__(self, observe):
+        self._observe = observe
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._observe(time.monotonic() - self._t0)
+        return False
+
+
 class Summary:
     """count + sum pair (enough for rate()/avg in PromQL; no quantiles)."""
 
@@ -121,20 +138,8 @@ class Summary:
             self._count += 1
             self._sum += float(value)
 
-    def time(self):
-        """Context manager observing elapsed wall seconds."""
-        summary = self
-
-        class _Timer:
-            def __enter__(self):
-                self._t0 = time.monotonic()
-                return self
-
-            def __exit__(self, *exc):
-                summary.observe(time.monotonic() - self._t0)
-                return False
-
-        return _Timer()
+    def time(self) -> "_Timer":
+        return _Timer(self.observe)
 
     @property
     def count(self) -> int:
@@ -154,6 +159,63 @@ class Summary:
                 f"{self.name}_count {self._count}",
                 f"{self.name}_sum {_format_value(self._sum)}",
             ]
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative ``le`` exposition): what
+    PromQL's histogram_quantile() needs for p50/p99 dashboards — the
+    piece Summary (count+sum only) can't provide."""
+
+    TYPE = "histogram"
+    # Log-spaced seconds, 1ms..10s: covers local-chip decode steps
+    # (~ms), relay-RTT steps (~100ms), and compile stalls (~s).
+    DEFAULT_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, name: str, help_text: str, buckets=None):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        self._bucket_counts = [0] * len(self.buckets)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(self._bucket_counts):
+                self._bucket_counts[i] += 1
+            self._count += 1
+            self._sum += v
+
+    def time(self) -> "_Timer":
+        return _Timer(self.observe)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.TYPE}",
+            ]
+            cum = 0
+            for le, n in zip(self.buckets, self._bucket_counts):
+                cum += n
+                lines.append(
+                    f'{self.name}_bucket{{le="{_format_value(le)}"}} {cum}'
+                )
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+            lines.append(f"{self.name}_sum {_format_value(self._sum)}")
+            lines.append(f"{self.name}_count {self._count}")
+            return lines
 
 
 class MetricsRegistry:
@@ -178,6 +240,9 @@ class MetricsRegistry:
 
     def summary(self, name: str, help_text: str) -> Summary:
         return self._register(Summary(name, help_text))
+
+    def histogram(self, name: str, help_text: str, buckets=None) -> Histogram:
+        return self._register(Histogram(name, help_text, buckets))
 
     def render(self) -> str:
         with self._lock:

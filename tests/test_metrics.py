@@ -161,3 +161,65 @@ class _FakeContext:
 
     def is_active(self):
         return True
+
+
+def test_histogram_buckets_cumulative_and_exposition():
+    r = MetricsRegistry()
+    h = r.histogram("t_h", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 2.0, 100.0):
+        h.observe(v)
+    text = r.render()
+    assert 't_h_bucket{le="0.1"} 2' in text
+    assert 't_h_bucket{le="1"} 3' in text
+    assert 't_h_bucket{le="10"} 4' in text
+    assert 't_h_bucket{le="+Inf"} 5' in text
+    assert "t_h_count 5" in text
+    assert "t_h_sum 102.6" in text
+    assert "# TYPE t_h histogram" in text
+
+
+def test_histogram_timer_and_boundary():
+    r = MetricsRegistry()
+    h = r.histogram("t_h2", "help", buckets=(0.5,))
+    h.observe(0.5)  # boundary value belongs to le="0.5" (le = <=)
+    assert 't_h2_bucket{le="0.5"} 1' in r.render()
+    with h.time():
+        pass
+    assert h.count == 2
+
+
+def test_engine_latency_histograms_populate():
+    """EngineMetrics wires step/wait histograms: after serving one
+    request, both carry observations in the exposition."""
+    import dataclasses as _dc
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from k8s_device_plugin_tpu.models.engine import EngineMetrics, ServingEngine
+    from k8s_device_plugin_tpu.models.transformer import (
+        GPTConfig,
+        PagedConfig,
+        TransformerLM,
+    )
+
+    cfg = _dc.replace(GPTConfig.tiny(), max_seq=32)
+    params = TransformerLM(cfg).init(
+        _jax.random.PRNGKey(0), _jnp.zeros((1, 8), _jnp.int32)
+    )["params"]
+    r = MetricsRegistry()
+    eng = ServingEngine(
+        cfg, params,
+        PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8),
+        max_slots=1, metrics=EngineMetrics(r),
+    )
+    eng.run([([3, 141, 59], 4)])
+    text = r.render()
+    assert "tpu_engine_step_seconds_count" in text
+    assert "tpu_engine_request_wait_seconds_count 1" in text
+    import re
+
+    steps = int(re.search(r"tpu_engine_step_seconds_count (\d+)", text).group(1))
+    # 4 tokens need >= 3 steps (the admission step emits the prefill
+    # token AND the first decode token).
+    assert steps >= 3
