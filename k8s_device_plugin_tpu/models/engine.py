@@ -160,6 +160,13 @@ class EngineMetrics:
             "tpu_engine_request_wait_seconds",
             "Queue-to-first-token wait per request (admission latency "
             "under load)",
+            # Wider than the step buckets: overload pushes waits far past
+            # 10s, and a saturated top bucket would clamp the p99 exactly
+            # when the metric matters.
+            buckets=(
+                0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0, 120.0, 300.0,
+            ),
         )
 
 
@@ -181,6 +188,11 @@ class Request:
     # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
     # request decodes through; None = base model.
     adapter: Optional[int] = None
+    # Sparse logit bias: {token_id: added_logit} applied BEFORE greedy
+    # argmax and sampling (OpenAI semantics: -100 bans, +100 forces);
+    # capped at ServingEngine.MAX_BIAS entries.  Reported logprobs stay
+    # UNBIASED (bias changes what gets picked, not what is scored).
+    logit_bias: Optional[dict] = None
     # Stop sequences (token-id lists): generation ends when the output's
     # tail equals any of them; the matched suffix is EXCLUDED from
     # ``tokens`` (eos_id, by contrast, is included — the id itself is the
@@ -208,10 +220,16 @@ class Request:
 class ServingEngine:
     """Batch-continuous greedy decoding server (single host, one model).
 
+    ``MAX_BIAS``: per-request logit_bias entries are padded to this fixed
+    width so they trace into the jitted step as [slots, MAX_BIAS] arrays
+    (no recompiles as biased requests come and go).
+
     ``cfg`` is the model config WITHOUT paging; the engine derives the
     paged decode config.  ``params`` may be any serving tree the config
     accepts (bf16, or int8 via ``cfg.quant``).
     """
+
+    MAX_BIAS = 16
 
     def __init__(
         self,
@@ -555,6 +573,14 @@ class ServingEngine:
         # slots are no-ops in the shared filter.
         self._slot_topk: list[int] = [cfg.vocab_size] * max_slots
         self._slot_topp: list[float] = [1.0] * max_slots
+        # Per-slot sparse logit bias: up to MAX_BIAS (id, value) pairs,
+        # padded with (0, 0.0) — a zero bias is a no-op whatever the id.
+        self._slot_bias_ids: list[list[int]] = [
+            [0] * self.MAX_BIAS for _ in range(max_slots)
+        ]
+        self._slot_bias_vals: list[list[float]] = [
+            [0.0] * self.MAX_BIAS for _ in range(max_slots)
+        ]
         # Logical index of _slot_pages[s][0] in the device table row (> 0
         # once leading pages were reclaimed by a sliding window).
         self._slot_page_base: list[int] = [0] * max_slots
@@ -621,6 +647,7 @@ class ServingEngine:
         adapter: Optional[int] = None,
         logprobs: bool = False,
         stop: Optional[list] = None,
+        logit_bias: Optional[dict] = None,
     ) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -631,6 +658,23 @@ class ServingEngine:
                 raise ValueError(
                     "stop must be a non-empty list of non-empty "
                     "token-id sequences"
+                )
+        if logit_bias is not None:
+            logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
+            if not logit_bias or len(logit_bias) > self.MAX_BIAS:
+                raise ValueError(
+                    f"logit_bias must have 1..{self.MAX_BIAS} entries, "
+                    f"got {len(logit_bias)}"
+                )
+            bad = [t for t in logit_bias if not 0 <= t < self.cfg.vocab_size]
+            if bad:
+                raise ValueError(f"logit_bias ids out of vocab range: {bad}")
+            if self._spec_gamma:
+                # The round's draft/verify acceptance math scores the
+                # UNBIASED distributions; biasing only the emitted pick
+                # would break the exactness guarantee.
+                raise ValueError(
+                    "logit_bias is not supported on a speculative engine"
                 )
         if logprobs and self._spec_gamma:
             # The speculative round emits accepted draft tokens without
@@ -689,6 +733,7 @@ class ServingEngine:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
                 adapter=adapter, logprobs=logprobs, stop=stop,
+                logit_bias=logit_bias,
                 rid=self._next_rid, submitted_at=time.monotonic(),
             )
             self._next_rid += 1
@@ -918,6 +963,8 @@ class ServingEngine:
         self._slot_temp[slot] = 0.0
         self._slot_topk[slot] = self.cfg.vocab_size
         self._slot_topp[slot] = 1.0
+        self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
+        self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
         self._slot_aid[slot] = -1
         self._slot_page_base[slot] = 0
         self._slot_visible[slot] = 0
@@ -1120,6 +1167,18 @@ class ServingEngine:
             # prefix-shared by any later request.
             self._pending_pages.difference_update(pages[n_shared:])
             last_logits = job["logits"][row_idx]
+            if req.logit_bias:
+                # Same semantics as the jitted step: bias what gets
+                # PICKED; reported logprobs (below) stay unbiased.
+                ids = jnp.asarray(list(req.logit_bias), jnp.int32)
+                vals = jnp.asarray(
+                    list(req.logit_bias.values()), jnp.float32
+                )
+                picked_logits = last_logits.at[ids].add(
+                    vals.astype(last_logits.dtype)
+                )
+            else:
+                picked_logits = last_logits
             # A greedy slot's token is the argmax regardless of
             # top_k/top_p, so normalize them to "off" — otherwise one
             # greedy+top_k request would drag the whole batch onto the
@@ -1138,13 +1197,13 @@ class ServingEngine:
                 # token must come from the same restricted distribution.
                 self._rng, sub = jax.random.split(self._rng)
                 filtered = filter_top_k_top_p(
-                    (last_logits / req.temperature)[None, :],
+                    (picked_logits / req.temperature)[None, :],
                     jnp.asarray([topk], jnp.int32),
                     jnp.asarray([topp], jnp.float32),
                 )
                 first = int(jax.random.categorical(sub, filtered[0]))
             else:
-                first = int(jnp.argmax(last_logits))
+                first = int(jnp.argmax(picked_logits))
             if req.logprobs:
                 # Same semantics as the jitted steps: the emitted token's
                 # logprob under the unscaled model distribution.  Appended
@@ -1164,6 +1223,15 @@ class ServingEngine:
             self._slot_temp[slot] = req.temperature
             self._slot_topk[slot] = topk
             self._slot_topp[slot] = topp
+            if req.logit_bias:
+                ids_l = list(req.logit_bias)
+                vals_l = list(req.logit_bias.values())
+                pad = self.MAX_BIAS - len(ids_l)
+                self._slot_bias_ids[slot] = ids_l + [0] * pad
+                self._slot_bias_vals[slot] = vals_l + [0.0] * pad
+            else:
+                self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
+                self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
             self._slot_aid[slot] = (
                 req.adapter if req.adapter is not None else -1
             )
@@ -1222,25 +1290,54 @@ class ServingEngine:
 
     # ----------------------------------------------------------------- steps
 
-    def _step_fn(self, filtered: bool, want_lp: bool):
-        """Build (lazily, once per (filtered, want_lp)) the jitted
+    @staticmethod
+    def _variant_names(filtered: bool, biased: bool) -> list[str]:
+        """Keyword names of the optional per-slot arrays a (filtered,
+        biased) step/block variant takes, in signature order — the ONE
+        place the ordering lives (builders zip *rest against it, call
+        sites assemble arrays with _variant_arrays)."""
+        names = []
+        if filtered:
+            names += ["topks", "topps"]
+        if biased:
+            names += ["bias_ids", "bias_vals"]
+        return names
+
+    def _variant_arrays(self, filtered: bool, biased: bool) -> list:
+        """Device arrays matching _variant_names, built from slot state."""
+        arrays = []
+        if filtered:
+            arrays += [
+                jnp.asarray(self._slot_topk, jnp.int32),
+                jnp.asarray(self._slot_topp, jnp.float32),
+            ]
+        if biased:
+            arrays += [
+                jnp.asarray(self._slot_bias_ids, jnp.int32),
+                jnp.asarray(self._slot_bias_vals, jnp.float32),
+            ]
+        return arrays
+
+    def _step_fn(self, filtered: bool, want_lp: bool, biased: bool = False):
+        """Build (lazily, once per (filtered, want_lp, biased)) the jitted
         single-token decode step.  ``filtered`` compiles the top-k/top-p
         sort in; ``want_lp`` compiles the [slots, vocab] log-softmax +
         gather whose result logprobs requests read (without it the step
         returns a zeros placeholder so the host consumption code stays
-        uniform)."""
-        key_ = (filtered, want_lp)
+        uniform); ``biased`` compiles the [slots, MAX_BIAS] scatter-add
+        of per-slot logit biases onto the picking row (reported logprobs
+        stay unbiased)."""
+        key_ = (filtered, want_lp, biased)
         if key_ in self._step_fns:
             return self._step_fns[key_]
         model = self._decode_model
 
-        # The unfiltered variant's signature omits topks/topps entirely:
+        # Variant signatures omit the arrays their feature compiled out:
         # an unused jit argument is still transferred every dispatch, and
         # the greedy/temperature-only path (the common case) shouldn't
-        # pay two host->device array uploads per token for a feature it
-        # compiled out.
+        # pay host->device uploads for filters/biases it never applies.
         def _core(params, cache, tokens, positions, temps, aids, key,
-                  topks=None, topps=None):
+                  topks=None, topps=None, bias_ids=None, bias_vals=None):
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
@@ -1249,10 +1346,16 @@ class ServingEngine:
                 mutable=["cache"],
             )
             row = logits[:, -1, :]
-            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            pick = row
+            if biased:
+                rows = jnp.arange(row.shape[0])[:, None]
+                pick = row.at[rows, bias_ids].add(
+                    bias_vals.astype(row.dtype)
+                )
+            greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
             # One categorical over the batch samples each row independently;
             # temp<=0 rows take the argmax (their scaled logits are unused).
-            scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
+            scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
             if filtered:
                 scaled = filter_top_k_top_p(scaled, topks, topps)
             sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
@@ -1264,40 +1367,33 @@ class ServingEngine:
             )
             return nxt, lps, mut["cache"]
 
-        if filtered:
+        extra = self._variant_names(filtered, biased)
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def step(params, cache, tokens, positions, temps, topks, topps,
-                     aids, key):
-                return _core(
-                    params, cache, tokens, positions, temps, aids, key,
-                    topks, topps,
-                )
-
-        else:
-
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def step(params, cache, tokens, positions, temps, aids, key):
-                return _core(params, cache, tokens, positions, temps, aids, key)
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, tokens, positions, temps, aids, key, *rest):
+            return _core(
+                params, cache, tokens, positions, temps, aids, key,
+                **dict(zip(extra, rest)),
+            )
 
         self._step_fns[key_] = step
         return step
 
-    def _block_fn(self, T: int, filtered: bool, want_lp: bool):
-        """Build (lazily, once per (T, filtered, want_lp)) the jitted T-step decode
+    def _block_fn(self, T: int, filtered: bool, want_lp: bool, biased: bool = False):
+        """Build (lazily, once per (T, filtered, want_lp, biased)) the jitted T-step decode
         block: a lax.scan of T exact single-token decode steps — same
         model apply, same per-slot sampling, a fresh subkey per step — so
         one dispatch advances every active slot T tokens.  Greedy slots
         emit exactly their step-at-a-time decode; sampled slots draw from
         the identical per-step distributions (different key schedule than
         T separate step() calls, same law)."""
-        key_ = (T, filtered, want_lp)
+        key_ = (T, filtered, want_lp, biased)
         if key_ in self._block_fns:
             return self._block_fns[key_]
         model = self._decode_model
 
         def _core(params, cache, tokens, positions, temps, aids, key,
-                  topks=None, topps=None):
+                  topks=None, topps=None, bias_ids=None, bias_vals=None):
             def body(carry, k):
                 cache, toks, pos = carry
                 logits, mut = model.apply(
@@ -1308,8 +1404,14 @@ class ServingEngine:
                     mutable=["cache"],
                 )
                 row = logits[:, -1, :]
-                greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
-                scaled = row / jnp.where(temps > 0, temps, 1.0)[:, None]
+                pick = row
+                if biased:
+                    rows = jnp.arange(row.shape[0])[:, None]
+                    pick = row.at[rows, bias_ids].add(
+                        bias_vals.astype(row.dtype)
+                    )
+                greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
+                scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
                 if filtered:
                     scaled = filter_top_k_top_p(scaled, topks, topps)
                 sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
@@ -1326,24 +1428,16 @@ class ServingEngine:
             )
             return toks.T, lps.T, cache  # [slots, T]
 
-        # Same filtered/unfiltered signature split as _step_fn: the
-        # greedy/temperature block path shouldn't upload top-k/top-p
-        # arrays it compiled out.
-        if filtered:
+        # Same variant-signature split as _step_fn: the common path
+        # shouldn't upload filter/bias arrays it compiled out.
+        extra = self._variant_names(filtered, biased)
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def block(params, cache, tokens, positions, temps, topks, topps,
-                      aids, key):
-                return _core(
-                    params, cache, tokens, positions, temps, aids, key,
-                    topks, topps,
-                )
-
-        else:
-
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def block(params, cache, tokens, positions, temps, aids, key):
-                return _core(params, cache, tokens, positions, temps, aids, key)
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def block(params, cache, tokens, positions, temps, aids, key, *rest):
+            return _core(
+                params, cache, tokens, positions, temps, aids, key,
+                **dict(zip(extra, rest)),
+            )
 
         self._block_fns[key_] = block
         return block
@@ -1377,18 +1471,15 @@ class ServingEngine:
             self.slots[s] is not None and self.slots[s].logprobs
             for s in range(self.max_slots)
         )
+        biased = any(
+            self.slots[s] is not None and self.slots[s].logit_bias
+            for s in range(self.max_slots)
+        )
         self._rng, sub = jax.random.split(self._rng)
-        if filtered:
-            out, lps, self.cache = self._block_fn(T, True, want_lp)(
-                self.params, self.cache, tokens, positions, temps,
-                jnp.asarray(self._slot_topk, jnp.int32),
-                jnp.asarray(self._slot_topp, jnp.float32),
-                aids, sub,
-            )
-        else:
-            out, lps, self.cache = self._block_fn(T, False, want_lp)(
-                self.params, self.cache, tokens, positions, temps, aids, sub
-            )
+        out, lps, self.cache = self._block_fn(T, filtered, want_lp, biased)(
+            self.params, self.cache, tokens, positions, temps, aids, sub,
+            *self._variant_arrays(filtered, biased),
+        )
         out = np.asarray(out)
         lps = np.asarray(lps)
         emitted_total = 0
@@ -1512,18 +1603,15 @@ class ServingEngine:
             self.slots[s] is not None and self.slots[s].logprobs
             for s in range(self.max_slots)
         )
+        biased = any(
+            self.slots[s] is not None and self.slots[s].logit_bias
+            for s in range(self.max_slots)
+        )
         self._rng, sub = jax.random.split(self._rng)
-        if filtered:
-            nxt, lps, self.cache = self._step_fn(True, want_lp)(
-                self.params, self.cache, tokens, positions, temps,
-                jnp.asarray(self._slot_topk, jnp.int32),
-                jnp.asarray(self._slot_topp, jnp.float32),
-                aids, sub,
-            )
-        else:
-            nxt, lps, self.cache = self._step_fn(False, want_lp)(
-                self.params, self.cache, tokens, positions, temps, aids, sub
-            )
+        nxt, lps, self.cache = self._step_fn(filtered, want_lp, biased)(
+            self.params, self.cache, tokens, positions, temps, aids, sub,
+            *self._variant_arrays(filtered, biased),
+        )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
         for s in active:

@@ -19,7 +19,8 @@ rest of the models/ stack which benchmarks on synthetic ids):
     POST /generate   {"prompt": [int, ...], "max_new_tokens": N,
                       "temperature": t?, "top_k": k?, "top_p": p?,
                       "stream": false?, "logprobs": false?,
-                      "stop": [[int, ...], ...]?}
+                      "stop": [[int, ...], ...]?,
+                      "logit_bias": {"token_id": added_logit, ...}?}
       -> 200 {"tokens": [int, ...], "rid": R}
       -> "stop": token-id sequences ending generation; a matched suffix
          is EXCLUDED from tokens (eos stays included — see engine docs).
@@ -119,6 +120,13 @@ class EngineServer:
                         kwargs["logprobs"] = True
                     if body.get("stop") is not None:
                         kwargs["stop"] = body["stop"]
+                    if body.get("logit_bias"):  # {} is a no-op, not a 422
+                        # JSON object keys are strings; the engine wants
+                        # int token ids.
+                        kwargs["logit_bias"] = {
+                            int(t): float(v)
+                            for t, v in body["logit_bias"].items()
+                        }
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return

@@ -1567,3 +1567,61 @@ def test_stop_validation(rng):
         eng.submit([3], 4, stop=[])
     with pytest.raises(ValueError, match="stop"):
         eng.submit([3], 4, stop=[[]])
+
+
+# ---------------------------------------------------------------------------
+# logit_bias
+# ---------------------------------------------------------------------------
+
+
+def test_logit_bias_bans_and_forces(rng):
+    """-1e9 on the greedy token bans it (the runner-up wins); +1e9 on an
+    arbitrary token forces it — in single steps AND decode blocks, with
+    unbiased logprobs reported."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    prompt = [3, 141, 59]
+    want = _oracle(cfg, params, prompt, 4)
+    for block in (1, 4):
+        eng = ServingEngine(cfg, params, paged, max_slots=2, decode_block=block)
+        # Ban the natural first token: every step must avoid it.
+        banned = eng.submit(prompt, 4, logit_bias={want[0]: -1e9})
+        forced = eng.submit(prompt, 3, logit_bias={7: 1e9}, logprobs=True)
+        while not (banned.done and forced.done):
+            eng.step()
+        assert want[0] not in banned.tokens, (block, banned.tokens)
+        assert forced.tokens == [7, 7, 7], (block, forced.tokens)
+        # Reported logprobs are UNBIASED: forcing a cold token yields
+        # very negative model logprobs, not ~0.
+        assert all(lp < -1.0 for lp in forced.token_logprobs), (
+            forced.token_logprobs
+        )
+        assert len(eng.free_pages) == paged.num_pages - 1
+
+
+def test_logit_bias_unbiased_slots_unaffected(rng):
+    """A biased slot must not perturb its unbiased neighbors (the
+    scatter is per-row)."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2)
+    plain = eng.submit([3, 141, 59], 6)
+    eng.submit([9, 10], 6, logit_bias={5: 100.0})
+    while not plain.done:
+        eng.step()
+    assert plain.tokens == _oracle(cfg, params, [3, 141, 59], 6)
+
+
+def test_logit_bias_validation(rng):
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    with pytest.raises(ValueError, match="logit_bias"):
+        eng.submit([3], 4, logit_bias={})
+    with pytest.raises(ValueError, match="vocab"):
+        eng.submit([3], 4, logit_bias={cfg.vocab_size + 5: 1.0})
+    with pytest.raises(ValueError, match="logit_bias"):
+        eng.submit([3], 4, logit_bias={i: 1.0 for i in range(20)})
