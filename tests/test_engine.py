@@ -960,15 +960,23 @@ def test_engine_feature_matrix_fuzz(rng):
         assert len(eng.free_pages) == paged.num_pages - 1, label
         # A stop-sequence rider: the ENGINE's own first token (already
         # verified above vs the oracle) as a 1-token stop => empty
-        # output, stopped latched, pool still exact.
+        # output, stopped latched, pool still exact.  A force-bias rider
+        # rides the same drain: +1e9 on one token must pin every pick
+        # whatever the feature mix.
         first_tok = [subs[0].tokens[0]]
         stopper = eng.submit(jobs[0][0], 3, stop=[first_tok])
+        # Spec engines reject logit_bias by design; ride it elsewhere.
+        forced = (
+            None if spec else eng.submit(jobs[0][0], 3, logit_bias={5: 1e9})
+        )
         guard = 0
-        while not stopper.done:
+        while not (stopper.done and (forced is None or forced.done)):
             eng.step()
             guard += 1
-            assert guard < 500, (label, "stop rider failed to drain")
+            assert guard < 500, (label, "riders failed to drain")
         assert stopper.stopped and stopper.tokens == [], label
+        if forced is not None:
+            assert forced.tokens == [5, 5, 5], label
         assert len(eng.free_pages) == paged.num_pages - 1, label
 
 
