@@ -20,8 +20,13 @@ rest of the models/ stack which benchmarks on synthetic ids):
                       "temperature": t?, "top_k": k?, "top_p": p?,
                       "stream": false?, "logprobs": false?,
                       "stop": [[int, ...], ...]?,
-                      "logit_bias": {"token_id": added_logit, ...}?}
+                      "logit_bias": {"token_id": added_logit, ...}?,
+                      "n": 1?}
       -> 200 {"tokens": [int, ...], "rid": R}
+      -> "n" > 1 (max 8; sampling configs — greedy copies are identical;
+         not composable with "stream"): adds "choices": [{"tokens",
+         "rid", "logprobs"?}, ...] — n independent samples over ONE
+         shared prompt (prefix sharing dedupes the prompt pages).
       -> "stop": token-id sequences ending generation; a matched suffix
          is EXCLUDED from tokens (eos stays included — see engine docs).
       -> with "logprobs": true, adds "logprobs": [float, ...] — each
@@ -120,6 +125,7 @@ class EngineServer:
                         kwargs["logprobs"] = True
                     if body.get("stop") is not None:
                         kwargs["stop"] = body["stop"]
+                    n = int(body.get("n", 1) or 0)  # null -> 0 -> 422 below
                     if body.get("logit_bias"):  # {} is a no-op, not a 422
                         # JSON object keys are strings; the engine wants
                         # int token ids.
@@ -131,30 +137,61 @@ class EngineServer:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
                 stream = bool(body.get("stream", False))
+                if not 1 <= n <= 8:
+                    self._reply(422, {"error": f"n must be in [1, 8], got {n}"})
+                    return
+                if n > 1 and stream:
+                    self._reply(
+                        422, {"error": "n > 1 does not compose with stream"}
+                    )
+                    return
                 try:
-                    req = server.engine.submit(prompt, max_new, **kwargs)
+                    # n samples = n engine requests over ONE shared prompt:
+                    # the prefix trie dedupes the prompt pages, so extra
+                    # choices cost generation pages only (and each slot
+                    # draws its own sampling rows — independent samples).
+                    reqs = [
+                        server.engine.submit(prompt, max_new, **kwargs)
+                        for _ in range(n)
+                    ]
                 except ValueError as e:  # validation: capacity, sampler args
                     self._reply(422, {"error": str(e)})
                     return
                 except TypeError as e:  # e.g. non-iterable / nested prompt
                     self._reply(400, {"error": f"bad prompt: {e}"})
                     return
+                req = reqs[0]
                 if stream:
                     self._stream_reply(req)
                     return
                 with server._cond:
                     server._cond.notify_all()  # wake an idle loop
                     finished = server._cond.wait_for(
-                        lambda: req.done, timeout=server._timeout
+                        lambda: all(r.done for r in reqs),
+                        timeout=server._timeout,
                     )
                 if not finished:
                     # Stop burning chip time on a response nobody reads.
-                    server.engine.cancel(req)
+                    for r in reqs:
+                        server.engine.cancel(r)
                     self._reply(504, {"error": "generation timed out", "rid": req.rid})
                     return
                 out = {"tokens": req.tokens, "rid": req.rid}
                 if req.logprobs:
                     out["logprobs"] = req.token_logprobs
+                if n > 1:
+                    out["choices"] = [
+                        {
+                            "tokens": r.tokens,
+                            **(
+                                {"logprobs": r.token_logprobs}
+                                if r.logprobs
+                                else {}
+                            ),
+                            "rid": r.rid,
+                        }
+                        for r in reqs
+                    ]
                 self._reply(200, out)
 
             def _trace_capture(self) -> None:

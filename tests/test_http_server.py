@@ -324,3 +324,33 @@ def test_debug_trace_gated_off_by_default():
         assert e.value.code == 404
     finally:
         server.stop()
+
+
+def test_n_choices_sampling(served):
+    """n=3 returns three independent sampled choices over one shared
+    prompt; greedy n-copies are identical; n+stream rejects."""
+    cfg, params, server = served
+    out = _post(
+        server.port,
+        {"prompt": [3, 141, 59], "max_new_tokens": 6, "n": 3,
+         "temperature": 1.2},
+    )
+    assert len(out["choices"]) == 3
+    assert out["tokens"] == out["choices"][0]["tokens"]
+    for c in out["choices"]:
+        assert len(c["tokens"]) == 6
+    rids = {c["rid"] for c in out["choices"]}
+    assert len(rids) == 3
+    greedy = _post(
+        server.port,
+        {"prompt": [3, 141, 59], "max_new_tokens": 5, "n": 2},
+    )
+    assert greedy["choices"][0]["tokens"] == greedy["choices"][1]["tokens"]
+    assert greedy["tokens"] == _oracle(cfg, params, [3, 141, 59], 5)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"prompt": [3], "max_new_tokens": 2, "n": 2,
+                            "stream": True})
+    assert e.value.code == 422
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"prompt": [3], "max_new_tokens": 2, "n": 99})
+    assert e.value.code == 422
