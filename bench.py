@@ -602,14 +602,18 @@ def _inner() -> None:
                 before = sum(
                     len(r.tokens) for r in eng.slots if r is not None
                 )
+                # A request that finishes inside the window vacates its slot,
+                # so live-slot sums would drop its tokens from `after`; count
+                # finished requests from step()'s return instead.
+                fin_toks = 0
                 t0 = _time.perf_counter()
                 for _ in range(n_disp):
-                    eng.step()
+                    fin_toks += sum(len(r.tokens) for r in eng.step())
                 dt = _time.perf_counter() - t0
                 after = sum(
                     len(r.tokens) for r in eng.slots if r is not None
                 )
-                toks = after - before
+                toks = after + fin_toks - before
                 log(
                     f"engine serving decode_block={block}: "
                     f"{toks/dt:.0f} tokens/sec "

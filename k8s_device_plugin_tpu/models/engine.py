@@ -230,6 +230,11 @@ class ServingEngine:
     """
 
     MAX_BIAS = 16
+    # Stop-sequence caps (OpenAI allows 4 stops; 8 is generous).  Checked in
+    # submit() so the unauthenticated HTTP path can't make _hit_stop's
+    # per-token Python scan unbounded.
+    MAX_STOPS = 8
+    MAX_STOP_LEN = 32
 
     def __init__(
         self,
@@ -658,6 +663,20 @@ class ServingEngine:
                 raise ValueError(
                     "stop must be a non-empty list of non-empty "
                     "token-id sequences"
+                )
+            # _hit_stop is O(num_stops x stop_len) Python compares on the
+            # owner thread per emitted token; an uncapped list from the
+            # unauthenticated HTTP endpoint could stall the serving loop
+            # for every tenant, so cap like logit_bias caps MAX_BIAS.
+            if len(stop) > self.MAX_STOPS:
+                raise ValueError(
+                    f"at most {self.MAX_STOPS} stop sequences, got {len(stop)}"
+                )
+            too_long = [seq for seq in stop if len(seq) > self.MAX_STOP_LEN]
+            if too_long:
+                raise ValueError(
+                    f"stop sequences are capped at {self.MAX_STOP_LEN} "
+                    f"tokens, got one of length {max(len(s) for s in too_long)}"
                 )
         if logit_bias is not None:
             logit_bias = {int(t): float(v) for t, v in logit_bias.items()}

@@ -264,11 +264,15 @@ class EngineServer:
                 deadline = time.monotonic() + server._timeout
                 sent = 0
                 # Stop sequences truncate the matched suffix at the END:
-                # the last (longest_stop - 1) tokens are provisional — a
-                # later token could complete a match and delete them — so
-                # hold them back until the request finishes (the final
-                # list IS post-truncation truth).  Without stop, lag 0.
-                lag = max((len(s) for s in req.stop), default=1) - 1 if req.stop else 0
+                # the last longest_stop tokens are provisional.  A lag of
+                # longest_stop-1 would cover only post-truncation states;
+                # the engine appends the match-completing token and runs
+                # _hit_stop a few statements later, so a stream thread
+                # waking in that window can see the FULL match still
+                # present — hold back one extra token so even that
+                # pre-truncation snapshot never leaks a matched-suffix
+                # token the final list will exclude.  Without stop, lag 0.
+                lag = max(len(s) for s in req.stop) if req.stop else 0
                 try:
                     while True:
                         with server._cond:

@@ -38,6 +38,16 @@ RESOURCE_NAMESPACE = "google.com"
 RESOURCE_NAME = "tpu"
 RESOURCE = f"{RESOURCE_NAMESPACE}/{RESOURCE_NAME}"
 
+
+def _chip_index_key(device_id: str) -> tuple[int, str]:
+    """Numeric-aware sort key: ``tpu-2`` orders before ``tpu-10``.
+
+    Lexicographic sort would scatter the fallback pick across the mesh on
+    hosts with >9 chips (the 16-chip bounds entry exists in topology.py).
+    """
+    _, _, tail = device_id.rpartition("-")
+    return (int(tail), device_id) if tail.isdigit() else (1 << 30, device_id)
+
 # Process-wide registry: the daemon has exactly one plugin+manager, and a
 # single registry keeps the /metrics endpoint wiring trivial.  Tests that need
 # isolation construct their own MetricsRegistry and pass it in.
@@ -274,7 +284,7 @@ class TpuDevicePlugin:
         except KeyError as e:
             log.warning("GetPreferredAllocation names unknown device %s", e)
             self.metrics.preferred_allocations.inc(result="unknown_device")
-            return sorted(available)[:size]
+            return sorted(available, key=_chip_index_key)[:size]
         by_index = {c.index: c for c in inventory.chips}
         sub = select_contiguous(
             size,

@@ -31,3 +31,36 @@ except ImportError:
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Test tiers.  The hermetic plugin/protocol tier (no JAX imports, no XLA
+# compiles — pure gRPC/filesystem/threading) is auto-marked `plugin` so the
+# ~2-minute kubelet-facing signal is runnable without the multi-minute
+# model/engine compile grind:
+#
+#     python -m pytest tests/ -q -m "plugin and not slow"   # fast tier
+#     python -m pytest tests/ -q -m "not plugin"            # JAX tier
+#
+PLUGIN_TIER_FILES = {
+    "test_cli.py",
+    "test_discovery.py",
+    "test_envs.py",
+    "test_health.py",
+    "test_manager.py",
+    "test_native.py",
+    "test_protocol.py",
+    "test_resources.py",
+    "test_server.py",
+    "test_stress.py",
+    "test_topology.py",
+    "test_watcher.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    for item in items:
+        if os.path.basename(str(item.fspath)) in PLUGIN_TIER_FILES:
+            item.add_marker(_pytest.mark.plugin)

@@ -1575,6 +1575,14 @@ def test_stop_validation(rng):
         eng.submit([3], 4, stop=[])
     with pytest.raises(ValueError, match="stop"):
         eng.submit([3], 4, stop=[[]])
+    # DoS caps: the unauthenticated HTTP path feeds submit() directly, so
+    # count and per-sequence length are bounded like MAX_BIAS.
+    with pytest.raises(ValueError, match="stop sequences"):
+        eng.submit([3], 4, stop=[[1]] * (ServingEngine.MAX_STOPS + 1))
+    with pytest.raises(ValueError, match="capped"):
+        eng.submit([3], 4, stop=[[1] * (ServingEngine.MAX_STOP_LEN + 1)])
+    # At-the-cap shapes are accepted.
+    eng.submit([3], 1, stop=[[1] * ServingEngine.MAX_STOP_LEN] * ServingEngine.MAX_STOPS)
 
 
 # ---------------------------------------------------------------------------

@@ -228,3 +228,28 @@ def test_preferred_allocation_respects_must_include(stub):
 
 def test_prestart_container(stub):
     stub.PreStartContainer(pb.PreStartContainerRequest(devicesIDs=["tpu-0"]))
+
+
+def test_preferred_allocation_unknown_device_fallback_is_index_dense(tmp_path):
+    # On a >9-chip host the unknown-device fallback must sort by chip index:
+    # lexicographic order would put tpu-10..tpu-15 before tpu-2 and hand the
+    # kubelet a mesh-scattered set.
+    root = make_fake_tpu_host(tmp_path / "host16", n_chips=16)
+    plugin = TpuDevicePlugin(
+        discover=lambda: discovery.discover(root=root, environ={}),
+        health_checker=ChipHealthChecker(root=root),
+    )
+    available = [f"tpu-{i}" for i in range(16)] + ["tpu-ghost"]
+    resp = plugin.GetPreferredAllocation(
+        pb.PreferredAllocationRequest(
+            container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=available,
+                    allocation_size=4,
+                )
+            ]
+        ),
+        None,
+    )
+    ids = list(resp.container_responses[0].deviceIDs)
+    assert ids == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]

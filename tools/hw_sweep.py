@@ -300,16 +300,17 @@ def engine_ab():
         for _ in range(2):
             eng.step()  # compile + warm the block program
         n_disp = max(2, 24 // block)
+        # Count finished requests from step()'s return: a request finishing
+        # inside the window vacates its slot, and the old live-slot delta
+        # silently dropped its tokens (clamped negative deltas to 0).
+        before = sum(len(r.tokens) for r in eng.slots if r is not None)
+        fin_toks = 0
         t0 = time.perf_counter()
-        toks = 0
         for _ in range(n_disp):
-            before = sum(len(r.tokens) for r in eng.slots if r is not None)
-            eng.step()
-            after = sum(
-                len(r.tokens) for r in eng.slots if r is not None
-            )
-            toks += max(0, after - before)
+            fin_toks += sum(len(r.tokens) for r in eng.step())
         dt = time.perf_counter() - t0
+        after = sum(len(r.tokens) for r in eng.slots if r is not None)
+        toks = after + fin_toks - before
         log(
             f"engine decode_block={block}: {dt/n_disp*1e3:.2f} ms/dispatch, "
             f"{toks/dt:.0f} tokens/sec (b{slots}, incl. relay RTT)"
