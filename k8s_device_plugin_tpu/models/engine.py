@@ -9,8 +9,8 @@ those chips.  Design split, TPU-shaped:
   slot advances every step, idle slots compute masked garbage into the
   reserved scratch page.  Static shapes, no recompiles as requests come
   and go.
-- **Host side** (this module, plain Python between steps): admission,
-  page allocation/free, per-slot bookkeeping.  State edits are row-wise
+- **Host side** (plain Python between steps): admission, page
+  allocation/free, per-slot bookkeeping.  State edits are row-wise
   ``.at[slot].set`` updates on the cache tree — O(layers) small
   dispatches per request event, never per token.
 
@@ -25,15 +25,21 @@ Capacity model: a request needs ``ceil((prompt + max_new) / page_size)``
 pages, allocated at admission (no mid-flight allocation → no deadlock);
 requests queue when the pool is dry and admit as finished requests free
 their pages — continuous batching.
+
+Module layout (round-4 split; this module remains the import surface):
+
+- engine_types.py      — ``Request``, ``EngineMetrics``
+- engine_sampling.py   — top-k/top-p filter, jitted step/block builders
+- engine_admission.py  — submit/cancel, batched chunked prefill, admission
+- engine_paging.py     — page pool, prefix trie, frontier, reclamation
+- engine_spec.py       — speculative round builders + host consumption
+- here                 — ``ServingEngine`` wiring, step loop, CLI ``main``
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
-import math
 import threading
-import time
 from collections import deque
 from typing import Any, Optional
 
@@ -41,9 +47,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.metrics import MetricsRegistry
+from .engine_admission import AdmissionMixin
+from .engine_paging import PagingMixin
+from .engine_sampling import (  # noqa: F401  (re-export: public surface)
+    _token_logprob,
+    build_block_fn,
+    build_step_fn,
+    filter_top_k_top_p,
+    variant_names,
+)
+from .engine_spec import SpeculativeMixin, build_spec_rounds
+from .engine_types import (  # noqa: F401  (re-export: public surface)
+    EngineMetrics,
+    Request,
+    _pow2_int,
+)
 from .transformer import (
-    NEG_LOGIT,
     GPTConfig,
     PagedConfig,
     TransformerLM,
@@ -51,173 +70,7 @@ from .transformer import (
 )
 
 
-def _pow2_int(text: str) -> int:
-    """argparse type: positive power of two (chunk sizes must tile the
-    power-of-two length buckets)."""
-    import argparse
-
-    value = int(text)
-    if value < 1 or value & (value - 1):
-        raise argparse.ArgumentTypeError(
-            f"must be a positive power of two, got {value}"
-        )
-    return value
-
-
-def _token_logprob(row, nxt):
-    """The emitted token's logprob under the UNSCALED model distribution
-    (sampler-independent semantics — temperature/top-k reshape what gets
-    PICKED, not what is reported).  Compiled into a step variant only
-    when a request asks (the ``want_lp`` key of _step_fn/_block_fn), so
-    engines that never serve logprobs never compute it."""
-    lp = jax.nn.log_softmax(row.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(lp, nxt[:, None], axis=1)[:, 0]
-
-
-def filter_top_k_top_p(scaled, top_k, top_p):
-    """Mask ``scaled`` logits [batch, vocab] to each row's top-k tokens and
-    smallest nucleus with mass >= top_p — with PER-ROW traced ``top_k``
-    (int32, vocab = disabled) and ``top_p`` (float32, 1.0 = disabled), so
-    slots with different sampler settings mix in one jitted step.
-
-    `lax.top_k` needs a static k, so this uses one descending sort per row
-    and reads thresholds out of it: the k-th value for top-k, and the
-    smallest value still inside the nucleus for top-p (computed on the
-    top-k-filtered distribution, the HF/vLLM filter order).  Keeping
-    ``scaled >= threshold`` admits ties, matching sample_generate's
-    static-k semantics (transformer.py).  O(vocab log vocab) on a
-    [slots, vocab] array — noise next to the model forward.
-    """
-    vocab = scaled.shape[-1]
-    s_sorted = jnp.sort(scaled, axis=-1)[:, ::-1]
-    ranks = jnp.arange(vocab)[None, :]
-    kth = jnp.take_along_axis(
-        s_sorted, jnp.clip(top_k, 1, vocab)[:, None] - 1, axis=-1
-    )
-    in_k = ranks < jnp.clip(top_k, 1, vocab)[:, None]
-    probs = jax.nn.softmax(jnp.where(in_k, s_sorted, NEG_LOGIT), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # A rank is in the nucleus while the mass BEFORE it is < p (so the
-    # first token is always kept); p = 1.0 keeps every unmasked rank.
-    in_p = jnp.logical_and(in_k, (cum - probs) < top_p[:, None])
-    p_min = jnp.min(
-        jnp.where(in_p, s_sorted, jnp.inf), axis=-1, keepdims=True
-    )
-    return jnp.where(
-        scaled >= jnp.maximum(kth, p_min), scaled, NEG_LOGIT
-    )
-
-
-class EngineMetrics:
-    """Prometheus series for the serving engine (same registry machinery
-    the plugin daemon exposes on its --metrics-port).  Pass a shared
-    registry to co-expose with other subsystems, or let each engine own
-    one and mount it on a utils.metrics.MetricsServer."""
-
-    def __init__(self, registry: MetricsRegistry):
-        self.registry = registry
-        self.requests = registry.counter(
-            "tpu_engine_requests_total",
-            "Requests admitted into a decode slot",
-        )
-        self.tokens = registry.counter(
-            "tpu_engine_tokens_total", "Tokens emitted across all requests"
-        )
-        self.steps = registry.counter(
-            "tpu_engine_steps_total", "Jitted decode steps executed"
-        )
-        self.active_slots = registry.gauge(
-            "tpu_engine_active_slots", "Slots currently serving a request"
-        )
-        self.queued = registry.gauge(
-            "tpu_engine_queued_requests", "Requests waiting for slots/pages"
-        )
-        self.free_pages = registry.gauge(
-            "tpu_engine_free_pages", "Unallocated KV-cache pages"
-        )
-        self.shared_pages = registry.gauge(
-            "tpu_engine_shared_pages",
-            "Pages currently referenced by more than one request (prefix sharing)",
-        )
-        self.spec_proposed = registry.counter(
-            "tpu_engine_spec_proposed_total",
-            "Draft tokens proposed by speculative rounds",
-        )
-        self.spec_accepted = registry.counter(
-            "tpu_engine_spec_accepted_total",
-            "Draft tokens the target accepted (rate = accepted/proposed)",
-        )
-        self.preemptions = registry.counter(
-            "tpu_engine_preemptions_total",
-            "Slots evicted for recompute-resume under optimistic admission",
-        )
-        self.step_seconds = registry.histogram(
-            "tpu_engine_step_seconds",
-            "Wall time of one engine step() call (admission + dispatch + "
-            "consume); histogram_quantile() gives serving-step p50/p99",
-        )
-        self.wait_seconds = registry.histogram(
-            "tpu_engine_request_wait_seconds",
-            "Queue-to-first-token wait per request (admission latency "
-            "under load)",
-            # Wider than the step buckets: overload pushes waits far past
-            # 10s, and a saturated top bucket would clamp the p99 exactly
-            # when the metric matters.
-            buckets=(
-                0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-                30.0, 60.0, 120.0, 300.0,
-            ),
-        )
-
-
-@dataclasses.dataclass
-class Request:
-    """One generation request and, when finished, its output tokens.
-
-    ``temperature`` 0 means greedy; > 0 samples that request's tokens at
-    that temperature.  ``top_k``/``top_p`` restrict sampling to the k
-    highest logits / the smallest nucleus with mass >= p (None = off;
-    only meaningful with temperature > 0).  Slots with different sampler
-    settings mix freely in one jitted step."""
-
-    prompt: list[int]
-    max_new_tokens: int
-    temperature: float = 0.0
-    top_k: Optional[int] = None
-    top_p: Optional[float] = None
-    # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
-    # request decodes through; None = base model.
-    adapter: Optional[int] = None
-    # Sparse logit bias: {token_id: added_logit} applied BEFORE greedy
-    # argmax and sampling (OpenAI semantics: -100 bans, +100 forces);
-    # capped at ServingEngine.MAX_BIAS entries.  Reported logprobs stay
-    # UNBIASED (bias changes what gets picked, not what is scored).
-    logit_bias: Optional[dict] = None
-    # Stop sequences (token-id lists): generation ends when the output's
-    # tail equals any of them; the matched suffix is EXCLUDED from
-    # ``tokens`` (eos_id, by contrast, is included — the id itself is the
-    # terminator, a stop sequence is a content sentinel).
-    stop: Optional[list[list[int]]] = None
-    # Latched by the engine when a stop sequence matched (the matched
-    # suffix is truncated away, so the flag — not the tail — records it).
-    stopped: bool = False
-    # Record each emitted token's logprob under the unscaled model
-    # distribution in ``token_logprobs`` (parallel to ``tokens``).
-    # Sampler settings change what gets picked, never what is reported.
-    logprobs: bool = False
-    rid: int = -1
-    # monotonic submit time (engine-internal: queue-wait observation).
-    submitted_at: float = 0.0
-    tokens: list[int] = dataclasses.field(default_factory=list)
-    token_logprobs: list[float] = dataclasses.field(default_factory=list)
-    done: bool = False
-    # Set via ServingEngine.cancel() (client went away): a queued request
-    # finishes immediately; an in-flight one is torn down at the next step
-    # boundary, its slot and pages returned to the pool.
-    cancelled: bool = False
-
-
-class ServingEngine:
+class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
     """Batch-continuous greedy decoding server (single host, one model).
 
     ``MAX_BIAS``: per-request logit_bias entries are padded to this fixed
@@ -253,6 +106,7 @@ class ServingEngine:
         prefill_chunk: Optional[int] = None,
         decode_block: int = 1,
         admission: str = "reserve",
+        racecheck: bool = False,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -366,193 +220,9 @@ class ServingEngine:
             draft_model = TransformerLM(
                 dataclasses.replace(draft_cfg, paged=paged), decode=True
             )
-            # Local alias: the jitted closure must not capture self.
-            layer_names = self._layer_names
-            gamma = spec_gamma
-
-            @functools.partial(jax.jit, donate_argnums=(2,))
-            def spec_round(
-                params, dparams, cache, tokens, positions, temps, topks,
-                topps, key,
-            ):
-                """One speculative round for every slot at once.
-
-                tokens/positions: [slots, 1] (positions = each row's
-                current length L).  gamma draft steps propose
-                d_1..d_gamma per slot (writing draft K/V at L..L+gamma-1),
-                then ONE (gamma+1)-token target pass scores
-                [last, d_1..d_gamma] at L..L+gamma — overwriting every
-                draft-written slot with exact target K/V, which is what
-                makes the shared pool sound.
-
-                Greedy slots (temp <= 0) use longest-agreeing-prefix
-                verification (output exactly the greedy decode); sampled
-                slots use Leviathan/Chen acceptance-rejection over the
-                SAME per-slot temperature/top-k/top-p filter the ordinary
-                step applies (accept d w.p. min(1, P(d)/Q(d)); first
-                rejection resamples the residual max(0, P-Q), full accept
-                samples the bonus from P) — marginally exact filtered
-                target sampling, mixed freely in one batch.
-
-                Returns (emitted [slots, gamma+1], a [slots], cache):
-                row s's round tokens are emitted[s, :a[s]+1]; length
-                rewind is host bookkeeping.
-                """
-                kd, ka, kt = jax.random.split(key, 3)
-                sampling = temps > 0  # [slots]
-                safe_t = jnp.where(sampling, temps, 1.0)[:, None]
-
-                def d_step(carry, i):
-                    c, tok = carry
-                    logits, mut = draft_model.apply(
-                        {"params": dparams, "cache": c},
-                        tok,
-                        positions + i,
-                        mutable=["cache"],
-                    )
-                    row = logits[:, -1, :]
-                    greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
-                    filt = filter_top_k_top_p(row / safe_t, topks, topps)
-                    samp = jax.random.categorical(
-                        jax.random.fold_in(kd, i), filt
-                    ).astype(jnp.int32)
-                    nxt = jnp.where(sampling, samp, greedy)[:, None]
-                    q = jax.nn.softmax(filt, axis=-1)  # draft dist Q_i
-                    return (mut["cache"], nxt), (nxt[:, 0], q)
-
-                (cache, _), (props_t, q_t) = jax.lax.scan(
-                    d_step, (cache, tokens), jnp.arange(gamma)
-                )
-                props = props_t.T  # [slots, gamma]
-                qs = jnp.moveaxis(q_t, 0, 1)  # [slots, gamma, vocab]
-                # The draft advanced every row's seq_lens to L+gamma;
-                # rewind to L so the verify append writes L..L+gamma.
-                L = positions[:, 0]
-                cache = {
-                    name: {
-                        **cache[name],
-                        "attn": {**cache[name]["attn"], "seq_lens": L},
-                    }
-                    for name in layer_names
-                }
-                block = jnp.concatenate([tokens, props], axis=1)
-                block_pos = positions + jnp.arange(gamma + 1)[None, :]
-                v_logits, mut = model.apply(
-                    {"params": params, "cache": cache},
-                    block,
-                    block_pos,
-                    mutable=["cache"],
-                )  # [slots, gamma+1, vocab]
-                slots, vocab = v_logits.shape[0], v_logits.shape[2]
-                v_filt = filter_top_k_top_p(
-                    (v_logits / safe_t[..., None]).reshape(-1, vocab),
-                    jnp.repeat(topks, gamma + 1),
-                    jnp.repeat(topps, gamma + 1),
-                ).reshape(slots, gamma + 1, vocab)
-                p = jax.nn.softmax(v_filt, axis=-1)  # target dist P_j
-
-                # Greedy acceptance: longest prefix agreeing with argmax.
-                t_greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
-                match_g = (props == t_greedy[:, :gamma]).astype(jnp.int32)
-                a_g = jnp.sum(jnp.cumprod(match_g, axis=1), axis=1)
-                # Sampling acceptance-rejection.
-                p_d = jnp.take_along_axis(
-                    p[:, :gamma], props[..., None], axis=-1
-                )[..., 0]
-                q_d = jnp.take_along_axis(qs, props[..., None], axis=-1)[
-                    ..., 0
-                ]
-                u = jax.random.uniform(ka, (slots, gamma))
-                accept = (u * q_d < p_d).astype(jnp.int32)
-                a_s = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
-                a = jnp.where(sampling, a_s, a_g)  # [slots]
-
-                # Tail token at position a: correction/bonus.  Sampled
-                # slots draw from the residual max(0, P_a - Q_a) (full
-                # accept: Q_gamma := 0 so the residual is P_gamma itself).
-                p_a = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
-                qs_pad = jnp.concatenate(
-                    [qs, jnp.zeros((slots, 1, vocab), qs.dtype)], axis=1
-                )
-                q_a = jnp.take_along_axis(qs_pad, a[:, None, None], axis=1)[
-                    :, 0
-                ]
-                resid = jnp.where(
-                    (a < gamma)[:, None], jnp.clip(p_a - q_a, min=0.0), p_a
-                )
-                norm = jnp.sum(resid, axis=-1, keepdims=True)
-                tail_p = jnp.where(norm > 0, resid / norm, p_a)
-                tail_samp = jax.random.categorical(
-                    kt, jnp.log(tail_p)
-                ).astype(jnp.int32)
-                tail_greedy = jnp.take_along_axis(t_greedy, a[:, None], 1)[
-                    :, 0
-                ]
-                tail = jnp.where(sampling, tail_samp, tail_greedy)
-                idxs = jnp.arange(gamma + 1)[None, :]
-                props_pad = jnp.concatenate(
-                    [props, jnp.zeros((slots, 1), jnp.int32)], axis=1
-                )
-                emitted = jnp.where(idxs < a[:, None], props_pad, tail[:, None])
-                return emitted, a, mut["cache"]
-
-            # Plain greedy round — no filter sorts, no softmaxes, no
-            # stacked Q distributions.  Same step_plain rationale: a spec
-            # engine serving only greedy requests (the CLI default) must
-            # not pay the sampler machinery every round; _spec_step
-            # dispatches host-side on whether any active slot samples.
-            @functools.partial(jax.jit, donate_argnums=(2,))
-            def spec_round_plain(params, dparams, cache, tokens, positions):
-                def d_step(carry, i):
-                    c, tok = carry
-                    logits, mut = draft_model.apply(
-                        {"params": dparams, "cache": c},
-                        tok,
-                        positions + i,
-                        mutable=["cache"],
-                    )
-                    nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(
-                        jnp.int32
-                    )[:, None]
-                    return (mut["cache"], nxt), nxt[:, 0]
-
-                (cache, _), props_t = jax.lax.scan(
-                    d_step, (cache, tokens), jnp.arange(gamma)
-                )
-                props = props_t.T
-                L = positions[:, 0]
-                cache = {
-                    name: {
-                        **cache[name],
-                        "attn": {**cache[name]["attn"], "seq_lens": L},
-                    }
-                    for name in layer_names
-                }
-                block = jnp.concatenate([tokens, props], axis=1)
-                block_pos = positions + jnp.arange(gamma + 1)[None, :]
-                v_logits, mut = model.apply(
-                    {"params": params, "cache": cache},
-                    block,
-                    block_pos,
-                    mutable=["cache"],
-                )
-                slots = v_logits.shape[0]
-                t_greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
-                match = (props == t_greedy[:, :gamma]).astype(jnp.int32)
-                a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-                tail = jnp.take_along_axis(t_greedy, a[:, None], 1)[:, 0]
-                props_pad = jnp.concatenate(
-                    [props, jnp.zeros((slots, 1), jnp.int32)], axis=1
-                )
-                emitted = jnp.where(
-                    jnp.arange(gamma + 1)[None, :] < a[:, None],
-                    props_pad,
-                    tail[:, None],
-                )
-                return emitted, a, mut["cache"]
-
-            self._spec_round = spec_round
-            self._spec_round_plain = spec_round_plain
+            self._spec_round, self._spec_round_plain = build_spec_rounds(
+                model, draft_model, self._layer_names, spec_gamma
+            )
         # Host-visible speculation counters (also exported via metrics):
         # acceptance rate = accepted / proposed, the gamma-tuning signal.
         self.spec_proposed = 0
@@ -639,691 +309,30 @@ class ServingEngine:
         # and re-registered with different content — surviving child links
         # would then form a stale chain, so they die with the parent.
         self._child_keys: dict[int, list[tuple[int, tuple]]] = {}
+        if racecheck:
+            # Lock-discipline detection (utils/racecheck.py): every
+            # mutation of the cross-thread state must hold the engine
+            # lock, and with this flag a violation RAISES at the faulty
+            # call site instead of corrupting state probabilistically.
+            # The stress suites run with it on; production engines skip
+            # the per-op check.
+            from ..utils.racecheck import GuardedDeque, GuardedDict
 
-    # ------------------------------------------------------------- admission
-
-    def submit(
-        self,
-        prompt,
-        max_new_tokens: int,
-        temperature: float = 0.0,
-        top_k: Optional[int] = None,
-        top_p: Optional[float] = None,
-        adapter: Optional[int] = None,
-        logprobs: bool = False,
-        stop: Optional[list] = None,
-        logit_bias: Optional[dict] = None,
-    ) -> Request:
-        prompt = [int(t) for t in prompt]
-        if not prompt:
-            raise ValueError("empty prompt")
-        if stop is not None:
-            stop = [[int(t) for t in seq] for seq in stop]
-            if not stop or any(not seq for seq in stop):
-                raise ValueError(
-                    "stop must be a non-empty list of non-empty "
-                    "token-id sequences"
-                )
-            # _hit_stop is O(num_stops x stop_len) Python compares on the
-            # owner thread per emitted token; an uncapped list from the
-            # unauthenticated HTTP endpoint could stall the serving loop
-            # for every tenant, so cap like logit_bias caps MAX_BIAS.
-            if len(stop) > self.MAX_STOPS:
-                raise ValueError(
-                    f"at most {self.MAX_STOPS} stop sequences, got {len(stop)}"
-                )
-            too_long = [seq for seq in stop if len(seq) > self.MAX_STOP_LEN]
-            if too_long:
-                raise ValueError(
-                    f"stop sequences are capped at {self.MAX_STOP_LEN} "
-                    f"tokens, got one of length {max(len(s) for s in too_long)}"
-                )
-        if logit_bias is not None:
-            logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
-            if not logit_bias or len(logit_bias) > self.MAX_BIAS:
-                raise ValueError(
-                    f"logit_bias must have 1..{self.MAX_BIAS} entries, "
-                    f"got {len(logit_bias)}"
-                )
-            bad = [t for t in logit_bias if not 0 <= t < self.cfg.vocab_size]
-            if bad:
-                raise ValueError(f"logit_bias ids out of vocab range: {bad}")
-            if self._spec_gamma:
-                # The round's draft/verify acceptance math scores the
-                # UNBIASED distributions; biasing only the emitted pick
-                # would break the exactness guarantee.
-                raise ValueError(
-                    "logit_bias is not supported on a speculative engine"
-                )
-        if logprobs and self._spec_gamma:
-            # The speculative round emits accepted draft tokens without
-            # materializing their target log-softmax; scoring them would
-            # need an extra pass per round.  Pick one per engine.
-            raise ValueError(
-                "logprobs is not supported on a speculative engine "
-                "(spec_gamma > 0)"
+            self.free_pages = GuardedDeque(
+                self.free_pages, lock=self._lock, name="free_pages"
             )
-        if adapter is not None:
-            if not self.cfg.lora_serve:
-                raise ValueError(
-                    "adapter requires an engine built with cfg.lora_serve"
-                )
-            if not 0 <= adapter < self.cfg.lora_serve:
-                raise ValueError(
-                    f"adapter must be in [0, {self.cfg.lora_serve}), "
-                    f"got {adapter}"
-                )
-        if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if temperature < 0:
-            raise ValueError(f"temperature must be >= 0, got {temperature}")
-        if top_k is not None and not 1 <= top_k <= self.cfg.vocab_size:
-            raise ValueError(
-                f"top_k must be in [1, vocab_size={self.cfg.vocab_size}], "
-                f"got {top_k}"
+            self.queue = GuardedDeque(
+                self.queue, lock=self._lock, name="queue"
             )
-        if top_p is not None and not 0 < top_p <= 1:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        # Speculative rounds write up to gamma positions past the accepted
-        # point before the host rewinds, so every capacity bound carries
-        # that headroom (= models/speculative.py's max_seq check).
-        need = len(prompt) + max_new_tokens + self._spec_gamma
-        if need > self.paged.max_len:
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new_tokens}"
-                + (
-                    f" + spec headroom {self._spec_gamma}"
-                    if self._spec_gamma
-                    else ""
-                )
-                + f" exceeds paged max_len {self.paged.max_len}"
+            self._page_refs = GuardedDict(
+                self._page_refs, lock=self._lock, name="_page_refs"
             )
-        # Admissibility, not just addressability: the request must fit the
-        # ALLOCATABLE pool (page 0 is reserved), else it would block the
-        # FIFO head forever.
-        allocatable = (self.paged.num_pages - 1) * self.paged.page_size
-        if need > allocatable:
-            raise ValueError(
-                f"request needs {need} cache slots but the pool only ever "
-                f"has {allocatable} ({self.paged.num_pages - 1} allocatable "
-                f"pages x {self.paged.page_size})"
-            )
-        with self._lock:
-            req = Request(
-                prompt, max_new_tokens, temperature, top_k, top_p,
-                adapter=adapter, logprobs=logprobs, stop=stop,
-                logit_bias=logit_bias,
-                rid=self._next_rid, submitted_at=time.monotonic(),
-            )
-            self._next_rid += 1
-            self.queue.append(req)
-            # Scrapes happen on the MetricsServer thread: reflect queue
-            # pressure immediately, not at the owner's next step().
-            self._update_gauges()
-        return req
-
-    def cancel(self, req: Request) -> bool:
-        """Stop generating for ``req`` (the client went away — the HTTP
-        front-end calls this on disconnect/timeout so an abandoned
-        request stops burning chip time).  Thread-safe like submit().
-
-        A still-queued request finishes right here (it holds no pages);
-        an in-flight one is marked and the owner thread tears it down at
-        its next step boundary — slot, pages, and prefix refcounts all
-        return through the ordinary _clear_slot path, so the pool stays
-        exact.  Returns False if the request had already finished."""
-        with self._lock:
-            if req.done:
-                return False
-            req.cancelled = True
-            try:
-                self.queue.remove(req)
-            except ValueError:
-                pass  # admitted (slot or mid-prefill): next step cleans up
-            else:
-                req.done = True
-            self._update_gauges()
-            return True
-
-    def _prefill_chunk_fn(self, chunk: int, batch: int):
-        """Jitted CHUNK prefill: one multi-token cached append of ``chunk``
-        tokens at traced offset pos0 into a carried dense cache.  One
-        compiled program per (chunk, batch) pair serves every chunk index
-        of every bucket (the unchunked path is simply chunk == bucket).
-        Cached on THIS instance (a process-global lru_cache would pin the
-        engine — params tree and page pools included — beyond its
-        lifetime).  The carried cache is donated: the host rebinds
-        job["cache"] from the output, so without donation every chunk
-        would copy the whole [batch, max_len] dense cache."""
-        key = (chunk, batch)
-        fn = self._prefill_cache.get(key)
-        if fn is not None:
-            return fn
-
-        def run(params, cache, tokens, pos0, last_idx, aids):
-            pos = jnp.broadcast_to(
-                pos0 + jnp.arange(chunk)[None, :], (batch, chunk)
-            )
-            logits, mut = self._dense_chunk.apply(
-                {"params": params, "cache": cache}, tokens, pos,
-                adapter_ids=aids,
-                mutable=["cache"],
-            )
-            # Each row's true-last-position logits, valid only when
-            # last_idx falls inside this chunk (the host keeps the row
-            # from the covering chunk).
-            sel = jnp.clip(last_idx - pos0, 0, chunk - 1)
-            return logits[jnp.arange(batch), sel], mut["cache"]
-
-        fn = jax.jit(run, donate_argnums=(1,))
-        self._prefill_cache[key] = fn
-        return fn
-
-    def _start_prefill(self, items: list[tuple[int, "Request", list[int], int]]):
-        """Create one prefill JOB for a same-length-bucket admission group.
-
-        Length padding is sound because attention is causal — positions
-        >= plen cannot influence logits[plen-1] — and _graft copies only
-        rows [:plen] into pages, so the padded tail's garbage K/V never
-        leaves the throwaway dense cache.  The batch dim is padded to a
-        power of two (repeating the first prompt; its extra rows are
-        discarded), so an admission burst of N prompts costs ONE dispatch
-        per chunk instead of N serial prefills, and the number of
-        compiled prefill programs stays O(log max_len * log max_slots).
-
-        Without ``prefill_chunk`` the job is a single full-bucket chunk
-        and completes on its first advance (same step() call it was
-        admitted in); with chunking, step() advances ONE chunk per call,
-        so active slots stall at most one chunk's compute per step while
-        a long prompt streams in.
-        """
-        # Effective prompts: resumed (preempted) requests re-prefill
-        # their original prompt PLUS what they had already generated.
-        prompts = [it[1].prompt + it[1].tokens for it in items]
-        longest = max(len(p) for p in prompts)
-        bucket = min(1 << (longest - 1).bit_length(), self.paged.max_len)
-        chunk = min(self._prefill_chunk or bucket, bucket)
-        n = len(prompts)
-        batch = 1 << (n - 1).bit_length()
-        rows = [p + [0] * (bucket - len(p)) for p in prompts]
-        rows += [rows[0]] * (batch - n)
-        last_idx = [len(p) - 1 for p in prompts] + [0] * (batch - n)
-        aids = [
-            it[1].adapter if it[1].adapter is not None else -1 for it in items
-        ]
-        aids += [aids[0]] * (batch - n)  # pad rows are discarded anyway
-        spec = decode_cache_spec(self._dense_chunk, batch)
-        self._pending.append(
-            {
-                "items": items,
-                "bucket": bucket,
-                "chunk": chunk,
-                "batch": batch,
-                "rows": jnp.asarray(rows, jnp.int32),
-                "last_idx_host": last_idx,
-                "last_idx": jnp.asarray(last_idx, jnp.int32),
-                "aids": jnp.asarray(aids, jnp.int32),
-                "cache": jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), spec
-                ),
-                "pos": 0,
-                "logits": [None] * n,
-            }
-        )
-
-    def _advance_prefill(self, job: dict) -> bool:
-        """Run ONE chunk of a pending prefill job; True when complete."""
-        chunk, pos = job["chunk"], job["pos"]
-        fn = self._prefill_chunk_fn(chunk, job["batch"])
-        tokens = jax.lax.slice_in_dim(job["rows"], pos, pos + chunk, axis=1)
-        logits_rows, job["cache"] = fn(
-            self.params,
-            job["cache"],
-            tokens,
-            jnp.asarray(pos, jnp.int32),
-            job["last_idx"],
-            job["aids"],
-        )
-        for i in range(len(job["items"])):
-            if pos <= job["last_idx_host"][i] < pos + chunk:
-                job["logits"][i] = logits_rows[i]
-        job["pos"] = pos + chunk
-        return job["pos"] >= job["bucket"]
-
-    def _graft(
-        self,
-        slot: int,
-        dense_cache: Any,
-        pages: list[int],
-        plen: int,
-        n_shared: int,
-        row_idx: int = 0,
-    ):
-        """Scatter a prefilled dense cache's rows into the PRIVATE prompt
-        pages and point the slot's table/length at the full chain — ONE
-        page-indexed scatter per pool per layer (not per page: eager `.at`
-        updates are copy-on-write, so per-page updates would round-trip
-        the whole pool once per page).
-
-        Shared prefix pages (the first ``n_shared``) are never rewritten:
-        a concurrent request is reading them, and K/V from a prefill
-        compiled at a different prompt length are not guaranteed bitwise
-        identical — rewriting could perturb an in-flight generation.
-        Private pages are written whole; tail slots past plen carry zeros,
-        which later appends overwrite before any masked read can see
-        them."""
-        ps = self.paged.page_size
-        n_cover = math.ceil(plen / ps)
-        # Publish only the pages the NEXT decode step can touch: those
-        # covering positions [0, plen] (the first decode write lands at
-        # position plen; a speculative round writes up to plen+gamma).
-        # The rest of the chain stays at scratch page 0 until the
-        # frontier reaches it (_extend_frontier) so the kernel's pipeline
-        # never streams unwritten generation pages.
-        n_publish = min((plen + self._spec_gamma) // ps + 1, len(pages))
-        row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
-        row[:n_publish] = pages[:n_publish]
-        self._slot_visible[slot] = n_publish
-        lo_tok = n_shared * ps  # first private-covered token position
-        n_priv_cover = n_cover - n_shared
-        cover = jnp.asarray(pages[n_shared:n_cover], jnp.int32)
-        pad = n_cover * ps - plen
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            src = dense_cache[name]["attn"]
-
-            def paged_rows(slab):
-                rows = slab[row_idx, lo_tok:plen]
-                if pad:
-                    rows = jnp.pad(
-                        rows, ((0, pad),) + ((0, 0),) * (rows.ndim - 1)
-                    )
-                return rows.reshape(n_priv_cover, ps, *rows.shape[1:])
-
-            new_att = {
-                **att,
-                "page_table": att["page_table"].at[slot].set(jnp.asarray(row)),
-                "seq_lens": att["seq_lens"].at[slot].set(plen),
-            }
-            if n_priv_cover > 0:
-                new_att["pool_key"] = (
-                    att["pool_key"].at[cover].set(paged_rows(src["cached_key"]))
-                )
-                new_att["pool_value"] = (
-                    att["pool_value"].at[cover].set(paged_rows(src["cached_value"]))
-                )
-                if "pool_key_scale" in att:  # int8 KV: scales ride along
-                    new_att["pool_key_scale"] = (
-                        att["pool_key_scale"]
-                        .at[cover]
-                        .set(paged_rows(src["cached_key_scale"]))
-                    )
-                    new_att["pool_value_scale"] = (
-                        att["pool_value_scale"]
-                        .at[cover]
-                        .set(paged_rows(src["cached_value_scale"]))
-                    )
-            self.cache[name]["attn"] = new_att
-
-    def _clear_slot(self, slot: int):
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
-                **att,
-                "page_table": att["page_table"].at[slot].set(0),
-                "seq_lens": att["seq_lens"].at[slot].set(0),
-            }
-        for page in self._slot_pages[slot]:
-            self._release_page(page)
-        self._slot_pages[slot] = []
-        self.slots[slot] = None
-        self._slot_last[slot] = 0
-        self._slot_len[slot] = 0
-        self._slot_temp[slot] = 0.0
-        self._slot_topk[slot] = self.cfg.vocab_size
-        self._slot_topp[slot] = 1.0
-        self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
-        self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
-        self._slot_aid[slot] = -1
-        self._slot_page_base[slot] = 0
-        self._slot_visible[slot] = 0
-        self._slot_ready[slot] = False
-
-    def _release_page(self, page: int) -> None:
-        """Drop one reference; at zero, tear down every trie link touching
-        the page (keys registered FOR it and keys in which it is the
-        PARENT — a freed id can be reallocated and re-registered with
-        different content, so a surviving child link would let a later
-        prompt walk into another request's K/V) and return it to the
-        pool.  The ONE page-free path: _clear_slot and windowed
-        reclamation both come through here.  Runs under the engine lock:
-        _update_gauges iterates _page_refs from the scraping/submitting
-        threads, and a resize here mid-iteration would crash them."""
-        with self._lock:
-            self._page_refs[page] -= 1
-            if self._page_refs[page] > 0:
-                return
-            del self._page_refs[page]
-            for key in self._page_keys.pop(page, []):
-                self._prefix_pages.pop(key, None)
-            for key in self._child_keys.pop(page, []):
-                child = self._prefix_pages.pop(key, None)
-                if child is not None:
-                    keys = self._page_keys.get(child)
-                    if keys and key in keys:
-                        keys.remove(key)
-            self.free_pages.append(page)
-
-    @staticmethod
-    def _trie_root(adapter: Optional[int]) -> int:
-        """Root pseudo-parent for the prefix trie: K/V are a function of
-        (params, adapter, tokens), so each adapter gets its own root (-1 =
-        base model, -(2+i) = adapter i) and chains never cross adapters.
-        Pseudo-roots are never real pages, so they are never freed and
-        take no _child_keys bookkeeping (their links die with the child
-        page, exactly like the old -1 root's)."""
-        return -1 if adapter is None else -(2 + adapter)
-
-    def _match_prefix(
-        self,
-        prompt: list[int],
-        bucket: int,
-        burst_pages: dict[int, int],
-        adapter: Optional[int] = None,
-    ) -> list[int]:
-        """Longest chain of live registered pages whose token chunks equal
-        this prompt's leading FULL pages (trie walk: O(prompt)).
-
-        A page may only be shared once its content is guaranteed written
-        before this request's first decode step: pages of ACTIVATED
-        requests always qualify; pages of a still-pending prefill job do
-        NOT (the owner's graft is deferred — sharing them would decode
-        against zeros), EXCEPT pages admitted in this same burst with the
-        same length bucket — those land in the same job, whose _activate
-        grafts every item before any of them decodes.
-        """
-        ps = self.paged.page_size
-        pages: list[int] = []
-        parent = self._trie_root(adapter)
-        for i in range(len(prompt) // ps):
-            chunk = tuple(prompt[i * ps : (i + 1) * ps])
-            page = self._prefix_pages.get((parent, chunk))
-            if page is None:
-                break
-            if page in burst_pages:
-                if burst_pages[page] != bucket:
-                    break  # different bucket -> different job -> unsafe
-            elif page in self._pending_pages:
-                break  # owner's job from an earlier step not grafted yet
-            pages.append(page)
-            parent = page
-        return pages
-
-    def _admit(self) -> list[Request]:
-        """Admit queued requests into free slots; returns any that finished
-        at admission already (EOS or max_new_tokens == 1 on the prefill
-        token) so step() can report them.
-
-        Two phases so an admission BURST costs one prefill dispatch per
-        length bucket, not one per request (serial per-request prefill was
-        the churn-throughput hole, VERDICT r2 weak #5): phase 1 assigns
-        slots/pages/trie links for everything that fits, phase 2 batches
-        the dense prefills by length bucket and grafts each row.
-        """
-        admitted: list[tuple[int, Request, list[int], int]] = []
-        burst_pages: dict[int, int] = {}  # page -> length bucket, this burst
-        for slot in range(self.max_slots):
-            # Queue peek/pop under the lock (submit() appends from other
-            # threads); everything after the pop touches owner-only state.
-            with self._lock:
-                # A cancel() racing an eviction can leave a cancelled
-                # request at the queue head (see _evict_slot); finish it
-                # here instead of prefetching for a dead client.
-                while self.queue and self.queue[0].cancelled:
-                    dead = self.queue.popleft()
-                    dead.done = True
-                if self.slots[slot] is not None or not self.queue:
-                    continue
-                req = self.queue[0]
-                # The EFFECTIVE prompt: original tokens plus anything a
-                # previous occupancy already generated (recompute-resume
-                # after preemption — empty for fresh requests, and always
-                # empty under reserve admission).
-                eff = req.prompt + req.tokens
-                plen = len(eff)
-                bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
-                if self._optimistic:
-                    # Prompt pages + the first decode write (+ spec
-                    # headroom); generation pages are allocated on demand
-                    # by _ensure_frontier, preempting newer slots when
-                    # the pool runs dry.
-                    n_pages = math.ceil(
-                        (plen + 1 + self._spec_gamma) / self.paged.page_size
-                    )
-                else:
-                    # Reserve admission never preempts, so req.tokens is
-                    # always empty here and plen == len(req.prompt): the
-                    # worst-case chain, allocated up front.
-                    n_pages = math.ceil(
-                        (plen + req.max_new_tokens + self._spec_gamma)
-                        / self.paged.page_size
-                    )
-                shared = (
-                    self._match_prefix(
-                        eff, bucket, burst_pages, req.adapter
-                    )
-                    if self.prefix_sharing
-                    else []
-                )
-                n_private = n_pages - len(shared)
-                if n_private > len(self.free_pages):
-                    break  # FIFO: wait for pages rather than starving the head
-                self.queue.popleft()
-                # Refcounts and free-page moves stay under the lock too:
-                # _update_gauges (called from submit() on another thread)
-                # iterates _page_refs, and an unlocked resize here would
-                # crash that iteration mid-scrape.
-                private = [self.free_pages.popleft() for _ in range(n_private)]
-                pages = shared + private
-                for page in shared:
-                    self._page_refs[page] += 1
-                for page in private:
-                    self._page_refs[page] = 1
-                    # Ungrafted until _activate: shareable within this
-                    # burst's same-bucket group only.
-                    burst_pages[page] = bucket
-                    self._pending_pages.add(page)
-                if self.prefix_sharing:
-                    # Register this prompt's full pages (shared or fresh) as
-                    # trie links so later same-prefix requests can ride them
-                    # — including requests admitted in this SAME burst: a
-                    # same-burst match is sound because every shared page's
-                    # content is written by its first owner's graft before
-                    # any decode step reads it.
-                    ps = self.paged.page_size
-                    parent = self._trie_root(req.adapter)
-                    for i in range(plen // ps):
-                        key = (parent, tuple(eff[i * ps : (i + 1) * ps]))
-                        if key not in self._prefix_pages:
-                            self._prefix_pages[key] = pages[i]
-                            self._page_keys.setdefault(pages[i], []).append(key)
-                            if parent >= 0:
-                                self._child_keys.setdefault(parent, []).append(key)
-                        parent = pages[i]
-                self.slots[slot] = req
-                self._slot_pages[slot] = pages
-                self._slot_seq[slot] = self._seq_counter
-                self._seq_counter += 1
-            admitted.append((slot, req, pages, len(shared)))
-
-        if not admitted:
-            return []
-        # Group by length bucket; each group becomes ONE prefill job
-        # (advanced chunk-by-chunk from step()).
-        groups: dict[int, list[tuple[int, Request, list[int], int]]] = {}
-        for item in admitted:
-            plen = len(item[1].prompt) + len(item[1].tokens)
-            bucket = min(1 << (plen - 1).bit_length(), self.paged.max_len)
-            groups.setdefault(bucket, []).append(item)
-        for items in groups.values():
-            self._start_prefill(items)
-        return []
-
-    def _activate(self, job: dict) -> list[Request]:
-        """Graft a completed prefill job's K/V into pages, sample each
-        request's first token, and mark the slots ready to decode."""
-        finished: list[Request] = []
-        for row_idx, (slot, req, pages, n_shared) in enumerate(job["items"]):
-            # Effective length: a resumed request's prefill covered its
-            # original prompt plus the tokens generated before eviction
-            # (req.tokens grows below AFTER this is read).
-            resumed = bool(req.tokens)
-            plen = len(req.prompt) + len(req.tokens)
-            self._graft(
-                slot, job["cache"], pages, plen, n_shared, row_idx=row_idx
-            )
-            # Grafted: the private pages are now real K/V and may be
-            # prefix-shared by any later request.
-            self._pending_pages.difference_update(pages[n_shared:])
-            last_logits = job["logits"][row_idx]
-            if req.logit_bias:
-                # Same semantics as the jitted step: bias what gets
-                # PICKED; reported logprobs (below) stay unbiased.
-                ids = jnp.asarray(list(req.logit_bias), jnp.int32)
-                vals = jnp.asarray(
-                    list(req.logit_bias.values()), jnp.float32
-                )
-                picked_logits = last_logits.at[ids].add(
-                    vals.astype(last_logits.dtype)
-                )
-            else:
-                picked_logits = last_logits
-            # A greedy slot's token is the argmax regardless of
-            # top_k/top_p, so normalize them to "off" — otherwise one
-            # greedy+top_k request would drag the whole batch onto the
-            # filtered (sorting) step path for zero output change.
-            if req.temperature > 0:
-                topk = (
-                    req.top_k
-                    if req.top_k is not None
-                    else self.cfg.vocab_size
-                )
-                topp = req.top_p if req.top_p is not None else 1.0
-            else:
-                topk, topp = self.cfg.vocab_size, 1.0
-            if req.temperature > 0:
-                # Same filter math as the jitted step — the admission
-                # token must come from the same restricted distribution.
-                self._rng, sub = jax.random.split(self._rng)
-                filtered = filter_top_k_top_p(
-                    (picked_logits / req.temperature)[None, :],
-                    jnp.asarray([topk], jnp.int32),
-                    jnp.asarray([topp], jnp.float32),
-                )
-                first = int(jax.random.categorical(sub, filtered[0]))
-            else:
-                first = int(jnp.argmax(picked_logits))
-            if req.logprobs:
-                # Same semantics as the jitted steps: the emitted token's
-                # logprob under the unscaled model distribution.  Appended
-                # BEFORE the token so a streaming snapshot never sees a
-                # token without its logprob.
-                req.token_logprobs.append(
-                    float(
-                        _token_logprob(
-                            jnp.asarray(last_logits)[None, :],
-                            jnp.asarray([first], jnp.int32),
-                        )[0]
-                    )
-                )
-            req.tokens.append(first)
-            self._slot_last[slot] = first
-            self._slot_len[slot] = plen
-            self._slot_temp[slot] = req.temperature
-            self._slot_topk[slot] = topk
-            self._slot_topp[slot] = topp
-            if req.logit_bias:
-                ids_l = list(req.logit_bias)
-                vals_l = list(req.logit_bias.values())
-                pad = self.MAX_BIAS - len(ids_l)
-                self._slot_bias_ids[slot] = ids_l + [0] * pad
-                self._slot_bias_vals[slot] = vals_l + [0.0] * pad
-            else:
-                self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
-                self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
-            self._slot_aid[slot] = (
-                req.adapter if req.adapter is not None else -1
-            )
-            self._slot_ready[slot] = True
-            if self.metrics:
-                # A preemption resume re-activates the SAME client
-                # request: counting it again would skew requests_total
-                # exactly in the overload regime it helps diagnose.
-                if not resumed:
-                    self.metrics.requests.inc()
-                    self.metrics.wait_seconds.observe(
-                        time.monotonic() - req.submitted_at
-                    )
-                self.metrics.tokens.inc()
-            self._maybe_finish(slot)
-            if req.done:
-                finished.append(req)
-        return finished
-
-    @staticmethod
-    def _hit_stop(req: Request) -> bool:
-        """True when the output's tail equals one of the request's stop
-        sequences (or already did): truncates the matched suffix (and its
-        logprobs) and LATCHES ``req.stopped`` — the evidence is deleted,
-        so the flag carries the verdict to _maybe_finish."""
-        if req.stopped:
-            return True
-        if not req.stop:
-            return False
-        for seq in req.stop:
-            n = len(seq)
-            if n and len(req.tokens) >= n and req.tokens[-n:] == seq:
-                del req.tokens[-n:]
-                if req.logprobs:
-                    del req.token_logprobs[len(req.tokens):]
-                req.stopped = True
-                return True
-        return False
-
-    def _maybe_finish(self, slot: int):
-        req = self.slots[slot]
-        if req is None:
-            return
-        if (
-            req.cancelled
-            or len(req.tokens) >= req.max_new_tokens
-            or (
-                self.eos_id is not None
-                and req.tokens
-                and req.tokens[-1] == self.eos_id
-            )
-            or self._hit_stop(req)
-        ):
-            req.done = True
-            self._clear_slot(slot)
 
     # ----------------------------------------------------------------- steps
 
-    @staticmethod
-    def _variant_names(filtered: bool, biased: bool) -> list[str]:
-        """Keyword names of the optional per-slot arrays a (filtered,
-        biased) step/block variant takes, in signature order — the ONE
-        place the ordering lives (builders zip *rest against it, call
-        sites assemble arrays with _variant_arrays)."""
-        names = []
-        if filtered:
-            names += ["topks", "topps"]
-        if biased:
-            names += ["bias_ids", "bias_vals"]
-        return names
-
     def _variant_arrays(self, filtered: bool, biased: bool) -> list:
-        """Device arrays matching _variant_names, built from slot state."""
+        """Device arrays matching engine_sampling.variant_names, built
+        from slot state."""
         arrays = []
         if filtered:
             arrays += [
@@ -1338,128 +347,26 @@ class ServingEngine:
         return arrays
 
     def _step_fn(self, filtered: bool, want_lp: bool, biased: bool = False):
-        """Build (lazily, once per (filtered, want_lp, biased)) the jitted
-        single-token decode step.  ``filtered`` compiles the top-k/top-p
-        sort in; ``want_lp`` compiles the [slots, vocab] log-softmax +
-        gather whose result logprobs requests read (without it the step
-        returns a zeros placeholder so the host consumption code stays
-        uniform); ``biased`` compiles the [slots, MAX_BIAS] scatter-add
-        of per-slot logit biases onto the picking row (reported logprobs
-        stay unbiased)."""
+        """The jitted single-token decode step, built lazily once per
+        (filtered, want_lp, biased) — engine_sampling.build_step_fn —
+        and cached on THIS instance (a process-global cache would pin
+        params/pools beyond the engine's lifetime)."""
         key_ = (filtered, want_lp, biased)
-        if key_ in self._step_fns:
-            return self._step_fns[key_]
-        model = self._decode_model
-
-        # Variant signatures omit the arrays their feature compiled out:
-        # an unused jit argument is still transferred every dispatch, and
-        # the greedy/temperature-only path (the common case) shouldn't
-        # pay host->device uploads for filters/biases it never applies.
-        def _core(params, cache, tokens, positions, temps, aids, key,
-                  topks=None, topps=None, bias_ids=None, bias_vals=None):
-            logits, mut = model.apply(
-                {"params": params, "cache": cache},
-                tokens,
-                positions,
-                adapter_ids=aids,
-                mutable=["cache"],
+        if key_ not in self._step_fns:
+            self._step_fns[key_] = build_step_fn(
+                self._decode_model, filtered, want_lp, biased
             )
-            row = logits[:, -1, :]
-            pick = row
-            if biased:
-                rows = jnp.arange(row.shape[0])[:, None]
-                pick = row.at[rows, bias_ids].add(
-                    bias_vals.astype(row.dtype)
-                )
-            greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
-            # One categorical over the batch samples each row independently;
-            # temp<=0 rows take the argmax (their scaled logits are unused).
-            scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
-            if filtered:
-                scaled = filter_top_k_top_p(scaled, topks, topps)
-            sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
-            lps = (
-                _token_logprob(row, nxt)
-                if want_lp
-                else jnp.zeros(nxt.shape, jnp.float32)
-            )
-            return nxt, lps, mut["cache"]
-
-        extra = self._variant_names(filtered, biased)
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, tokens, positions, temps, aids, key, *rest):
-            return _core(
-                params, cache, tokens, positions, temps, aids, key,
-                **dict(zip(extra, rest)),
-            )
-
-        self._step_fns[key_] = step
-        return step
+        return self._step_fns[key_]
 
     def _block_fn(self, T: int, filtered: bool, want_lp: bool, biased: bool = False):
-        """Build (lazily, once per (T, filtered, want_lp, biased)) the jitted T-step decode
-        block: a lax.scan of T exact single-token decode steps — same
-        model apply, same per-slot sampling, a fresh subkey per step — so
-        one dispatch advances every active slot T tokens.  Greedy slots
-        emit exactly their step-at-a-time decode; sampled slots draw from
-        the identical per-step distributions (different key schedule than
-        T separate step() calls, same law)."""
+        """The jitted T-step decode block, built lazily once per
+        (T, filtered, want_lp, biased) — engine_sampling.build_block_fn."""
         key_ = (T, filtered, want_lp, biased)
-        if key_ in self._block_fns:
-            return self._block_fns[key_]
-        model = self._decode_model
-
-        def _core(params, cache, tokens, positions, temps, aids, key,
-                  topks=None, topps=None, bias_ids=None, bias_vals=None):
-            def body(carry, k):
-                cache, toks, pos = carry
-                logits, mut = model.apply(
-                    {"params": params, "cache": cache},
-                    toks,
-                    pos,
-                    adapter_ids=aids,
-                    mutable=["cache"],
-                )
-                row = logits[:, -1, :]
-                pick = row
-                if biased:
-                    rows = jnp.arange(row.shape[0])[:, None]
-                    pick = row.at[rows, bias_ids].add(
-                        bias_vals.astype(row.dtype)
-                    )
-                greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
-                scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
-                if filtered:
-                    scaled = filter_top_k_top_p(scaled, topks, topps)
-                sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
-                nxt = jnp.where(temps > 0, sampled, greedy)
-                lp = (
-                    _token_logprob(row, nxt)
-                    if want_lp
-                    else jnp.zeros(nxt.shape, jnp.float32)
-                )
-                return (mut["cache"], nxt[:, None], pos + 1), (nxt, lp)
-
-            (cache, _, _), (toks, lps) = jax.lax.scan(
-                body, (cache, tokens, positions), jax.random.split(key, T)
+        if key_ not in self._block_fns:
+            self._block_fns[key_] = build_block_fn(
+                self._decode_model, T, filtered, want_lp, biased
             )
-            return toks.T, lps.T, cache  # [slots, T]
-
-        # Same variant-signature split as _step_fn: the common path
-        # shouldn't upload filter/bias arrays it compiled out.
-        extra = self._variant_names(filtered, biased)
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def block(params, cache, tokens, positions, temps, aids, key, *rest):
-            return _core(
-                params, cache, tokens, positions, temps, aids, key,
-                **dict(zip(extra, rest)),
-            )
-
-        self._block_fns[key_] = block
-        return block
+        return self._block_fns[key_]
 
     def _block_step(
         self, active: list[int], finished: list[Request], T: int
@@ -1654,250 +561,6 @@ class ServingEngine:
             self.metrics.tokens.inc(len(active))
         self._update_gauges()
         return finished
-
-    def _spec_step(self, active: list[int], finished: list[Request]) -> list[Request]:
-        """One speculative round: gamma draft steps + one verify pass
-        advance every active slot by 1..gamma+1 tokens.  Greedy slots
-        emit EXACTLY their non-speculative greedy decode; sampled slots
-        emit marginally exact filtered target samples (both pinned in
-        tests/test_engine.py); speculation changes only the schedule."""
-        active = self._ensure_frontier(active, self._spec_gamma)
-        if not active:
-            self._update_gauges()
-            return finished
-        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
-        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
-        if any(
-            self.slots[s] is not None and self._slot_temp[s] > 0
-            for s in range(self.max_slots)
-        ):
-            temps = jnp.asarray(self._slot_temp, jnp.float32)
-            topks = jnp.asarray(self._slot_topk, jnp.int32)
-            topps = jnp.asarray(self._slot_topp, jnp.float32)
-            self._rng, sub = jax.random.split(self._rng)
-            emitted, a_vec, self.cache = self._spec_round(
-                self.params, self.draft_params, self.cache, tokens,
-                positions, temps, topks, topps, sub,
-            )
-        else:
-            emitted, a_vec, self.cache = self._spec_round_plain(
-                self.params, self.draft_params, self.cache, tokens, positions
-            )
-        emitted = np.asarray(emitted)
-        a_vec = np.asarray(a_vec)
-        gamma = self._spec_gamma
-        emitted_total = 0
-        for s in active:
-            req = self.slots[s]
-            a = int(a_vec[s])
-            # Emit d_1..d_a then the target's own token at position a
-            # (correction on rejection, bonus on full accept).  All a+1
-            # tokens are consumed unless a finish condition truncates —
-            # and truncation only ever coincides with req.done, so live
-            # slots always consume exactly a+1.
-            self.spec_proposed += gamma
-            self.spec_accepted += a
-            if self.metrics:
-                self.metrics.spec_proposed.inc(gamma)
-                self.metrics.spec_accepted.inc(a)
-            round_toks = [int(emitted[s, j]) for j in range(a + 1)]
-            consumed = 0
-            for tok in round_toks:
-                req.tokens.append(tok)
-                self._slot_last[s] = tok
-                consumed += 1
-                emitted_total += 1
-                if (
-                    len(req.tokens) >= req.max_new_tokens
-                    or (self.eos_id is not None and tok == self.eos_id)
-                    or self._hit_stop(req)
-                ):
-                    break
-            self._slot_len[s] += consumed
-            self._maybe_finish(s)
-            if req.done:
-                finished.append(req)
-            else:
-                self._extend_frontier(s)
-                if self.cfg.attention_window is not None:
-                    self._reclaim_windowed(s)
-        # The round left every row's device length at L+gamma+1; re-align
-        # all rows to the host truth in one vector write per layer (idle
-        # and just-cleared rows are 0 in _slot_len, matching _clear_slot).
-        # A FRESH array per layer: sharing one across layers would hand
-        # the next round's donation the same buffer twice, which XLA
-        # rejects (donate(a), donate(a)).
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
-                **att,
-                "seq_lens": jnp.array(self._slot_len, jnp.int32),
-            }
-        if self.metrics:
-            self.metrics.steps.inc()
-            self.metrics.tokens.inc(emitted_total)
-        self._update_gauges()
-        return finished
-
-    def _ensure_frontier(self, active: list[int], lookahead: int) -> list[int]:
-        """Make every coming write in [len, len+lookahead] addressable for
-        each active slot, then publish the covering pages.
-
-        Reserve admission: pages were all allocated at admission, so this
-        is pure publication.  Optimistic admission: generation pages are
-        allocated HERE, on demand — processed oldest-admission-first, a
-        pool shortage preempts the newest ready slot (recompute-resume:
-        the victim requeues at the head and re-prefills prompt+generated),
-        and if the shortage persists the starved slot itself is evicted.
-        Oldest-first + newest-evicted means the oldest request can never
-        be robbed, which is the liveness argument (it eventually owns
-        every page its submit-time bound guarantees fit).  Returns the
-        active list minus anything evicted."""
-        if not self._optimistic:
-            for s in active:
-                self._extend_frontier(s, lookahead=lookahead)
-            return active
-        ps = self.paged.page_size
-        for s in sorted(active, key=lambda x: self._slot_seq[x]):
-            req = self.slots[s]
-            if req is None or not self._slot_ready[s]:
-                continue  # evicted as a victim earlier in this pass
-            need = (self._slot_len[s] + lookahead) // ps + 1
-            while need > self._slot_page_base[s] + len(self._slot_pages[s]):
-                with self._lock:
-                    page = (
-                        self.free_pages.popleft() if self.free_pages else None
-                    )
-                    if page is not None:
-                        self._page_refs[page] = 1
-                        self._slot_pages[s].append(page)
-                        continue
-                if not self._preempt_newest(newer_than=self._slot_seq[s]):
-                    break
-            if need > self._slot_page_base[s] + len(self._slot_pages[s]):
-                self._evict_slot(s)  # starved even after preempting: resume later
-                continue
-            self._extend_frontier(s, lookahead=lookahead)
-        return [
-            s
-            for s in active
-            if self.slots[s] is not None and self._slot_ready[s]
-        ]
-
-    def _preempt_newest(self, newer_than: int) -> bool:
-        """Evict the most recently admitted ready slot STRICTLY newer
-        than ``newer_than`` to free its pages; False when none is.  A
-        growing slot may only rob younger slots — never an older one —
-        so the oldest request's page claim is monotone (liveness)."""
-        cands = [
-            s
-            for s in range(self.max_slots)
-            if self.slots[s] is not None
-            and self._slot_ready[s]
-            and self._slot_seq[s] > newer_than
-        ]
-        if not cands:
-            return False
-        self._evict_slot(max(cands, key=lambda s: self._slot_seq[s]))
-        return True
-
-    def _evict_slot(self, slot: int) -> None:
-        """Preempt: tear the slot down exactly like a finish (pages,
-        table row, prefix refcounts all through _clear_slot) but requeue
-        the request at the queue HEAD for recompute-resume — unless the
-        client already cancelled it, in which case eviction doubles as
-        the teardown."""
-        req = self.slots[slot]
-        self._clear_slot(slot)
-        with self._lock:
-            # Atomic with cancel(): a disconnect racing this eviction
-            # either finds the request still in a slot (cancel marks it;
-            # we see cancelled here) or finds it back in the queue
-            # (cancel removes it there) — never a cancelled request
-            # silently re-admitted.
-            if req.cancelled:
-                req.done = True
-                self._update_gauges()
-                return
-            # Only a real recompute-resume counts as a preemption: a
-            # cancelled victim's eviction is ordinary teardown, and
-            # operators size the pool from this counter.
-            self.preemptions += 1
-            if self.metrics:
-                self.metrics.preemptions.inc()
-            self.queue.appendleft(req)
-            self._update_gauges()
-
-    def _extend_frontier(self, slot: int, lookahead: Optional[int] = None) -> None:
-        """Publish every page the next step can write — up to the one
-        covering position len+lookahead — into the device table the
-        moment the frontier approaches it: tiny .at[slot, idx].set
-        updates per layer, amortized O(1/page_size) dispatches per token.
-        ``lookahead`` defaults to the speculative gamma (0 for plain
-        decode: only the next position's page); decode blocks pass T-1,
-        their furthest write."""
-        if lookahead is None:
-            lookahead = self._spec_gamma
-        need = (
-            self._slot_len[slot] + lookahead
-        ) // self.paged.page_size + 1
-        need = min(
-            need, self._slot_page_base[slot] + len(self._slot_pages[slot])
-        )
-        while self._slot_visible[slot] < need:
-            idx = self._slot_visible[slot]  # logical page index to publish
-            page = self._slot_pages[slot][idx - self._slot_page_base[slot]]
-            for name in self._layer_names:
-                att = self.cache[name]["attn"]
-                self.cache[name]["attn"] = {
-                    **att,
-                    "page_table": att["page_table"].at[slot, idx].set(page),
-                }
-            self._slot_visible[slot] = idx + 1
-
-    def _reclaim_windowed(self, slot: int) -> None:
-        """Free pages that scrolled fully out of a sliding attention
-        window.  A query at position p sees keys in (p - window, p]; once
-        every position in a page is below ``len - window`` no future query
-        can see it — visibility only moves forward — so the page returns
-        to the pool mid-flight (bounded cache memory for long windowed
-        decodes).  Its table entry points at the scratch page: gathers of
-        masked positions read garbage that the window mask discards, and
-        the append frontier is always ahead of the reclaimed region."""
-        window = self.cfg.attention_window
-        ps = self.paged.page_size
-        horizon = self._slot_len[slot] - window
-        # horizon // ps = TOTAL pages ever dead for this slot; subtract the
-        # already-reclaimed count (the page list is trimmed in place, so
-        # reusing the total as an increment would double-free live pages —
-        # caught by the windowed-oracle test).
-        n_dead = max(
-            0,
-            min(
-                horizon // ps - self._slot_page_base[slot],
-                len(self._slot_pages[slot]),
-            ),
-        )
-        if n_dead <= 0:
-            return
-        dead, self._slot_pages[slot] = (
-            self._slot_pages[slot][:n_dead],
-            self._slot_pages[slot][n_dead:],
-        )
-        # The logical page indices shift only in OUR bookkeeping; the
-        # device table keeps absolute logical positions, so dead entries
-        # are re-pointed at scratch (a sliced device update — no host
-        # round-trip) rather than compacted.
-        lo = self._slot_page_base[slot]
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
-                **att,
-                "page_table": att["page_table"].at[slot, lo : lo + n_dead].set(0),
-            }
-        self._slot_page_base[slot] += n_dead
-        for page in dead:
-            self._release_page(page)
 
     def _update_gauges(self) -> None:
         if not self.metrics:

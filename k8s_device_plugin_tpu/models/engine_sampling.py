@@ -1,0 +1,190 @@
+"""Serving-engine sampling: per-slot filters and the jitted decode steps.
+
+Split out of engine.py (round 4).  Everything here is a pure function of
+its arguments — the builders take the decode-mode ``TransformerLM`` and
+return jitted programs; nothing closes over engine state.  The engine
+caches built programs per (variant key) on the instance (a process-global
+cache would pin params/pools beyond the engine's lifetime).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import NEG_LOGIT
+
+
+def _token_logprob(row, nxt):
+    """The emitted token's logprob under the UNSCALED model distribution
+    (sampler-independent semantics — temperature/top-k reshape what gets
+    PICKED, not what is reported).  Compiled into a step variant only
+    when a request asks (the ``want_lp`` key of build_step_fn /
+    build_block_fn), so engines that never serve logprobs never compute
+    it."""
+    lp = jax.nn.log_softmax(row.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, nxt[:, None], axis=1)[:, 0]
+
+
+def filter_top_k_top_p(scaled, top_k, top_p):
+    """Mask ``scaled`` logits [batch, vocab] to each row's top-k tokens and
+    smallest nucleus with mass >= top_p — with PER-ROW traced ``top_k``
+    (int32, vocab = disabled) and ``top_p`` (float32, 1.0 = disabled), so
+    slots with different sampler settings mix in one jitted step.
+
+    `lax.top_k` needs a static k, so this uses one descending sort per row
+    and reads thresholds out of it: the k-th value for top-k, and the
+    smallest value still inside the nucleus for top-p (computed on the
+    top-k-filtered distribution, the HF/vLLM filter order).  Keeping
+    ``scaled >= threshold`` admits ties, matching sample_generate's
+    static-k semantics (transformer.py).  O(vocab log vocab) on a
+    [slots, vocab] array — noise next to the model forward.
+    """
+    vocab = scaled.shape[-1]
+    s_sorted = jnp.sort(scaled, axis=-1)[:, ::-1]
+    ranks = jnp.arange(vocab)[None, :]
+    kth = jnp.take_along_axis(
+        s_sorted, jnp.clip(top_k, 1, vocab)[:, None] - 1, axis=-1
+    )
+    in_k = ranks < jnp.clip(top_k, 1, vocab)[:, None]
+    probs = jax.nn.softmax(jnp.where(in_k, s_sorted, NEG_LOGIT), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A rank is in the nucleus while the mass BEFORE it is < p (so the
+    # first token is always kept); p = 1.0 keeps every unmasked rank.
+    in_p = jnp.logical_and(in_k, (cum - probs) < top_p[:, None])
+    p_min = jnp.min(
+        jnp.where(in_p, s_sorted, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(
+        scaled >= jnp.maximum(kth, p_min), scaled, NEG_LOGIT
+    )
+
+
+def variant_names(filtered: bool, biased: bool) -> list[str]:
+    """Keyword names of the optional per-slot arrays a (filtered,
+    biased) step/block variant takes, in signature order — the ONE
+    place the ordering lives (builders zip *rest against it, call
+    sites assemble arrays with ServingEngine._variant_arrays)."""
+    names = []
+    if filtered:
+        names += ["topks", "topps"]
+    if biased:
+        names += ["bias_ids", "bias_vals"]
+    return names
+
+
+def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
+    """Build the jitted single-token decode step.  ``filtered`` compiles
+    the top-k/top-p sort in; ``want_lp`` compiles the [slots, vocab]
+    log-softmax + gather whose result logprobs requests read (without it
+    the step returns a zeros placeholder so the host consumption code
+    stays uniform); ``biased`` compiles the [slots, MAX_BIAS] scatter-add
+    of per-slot logit biases onto the picking row (reported logprobs
+    stay unbiased)."""
+
+    # Variant signatures omit the arrays their feature compiled out:
+    # an unused jit argument is still transferred every dispatch, and
+    # the greedy/temperature-only path (the common case) shouldn't
+    # pay host->device uploads for filters/biases it never applies.
+    def _core(params, cache, tokens, positions, temps, aids, key,
+              topks=None, topps=None, bias_ids=None, bias_vals=None):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            positions,
+            adapter_ids=aids,
+            mutable=["cache"],
+        )
+        row = logits[:, -1, :]
+        pick = row
+        if biased:
+            rows = jnp.arange(row.shape[0])[:, None]
+            pick = row.at[rows, bias_ids].add(
+                bias_vals.astype(row.dtype)
+            )
+        greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
+        # One categorical over the batch samples each row independently;
+        # temp<=0 rows take the argmax (their scaled logits are unused).
+        scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
+        if filtered:
+            scaled = filter_top_k_top_p(scaled, topks, topps)
+        sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
+        lps = (
+            _token_logprob(row, nxt)
+            if want_lp
+            else jnp.zeros(nxt.shape, jnp.float32)
+        )
+        return nxt, lps, mut["cache"]
+
+    extra = variant_names(filtered, biased)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tokens, positions, temps, aids, key, *rest):
+        return _core(
+            params, cache, tokens, positions, temps, aids, key,
+            **dict(zip(extra, rest)),
+        )
+
+    return step
+
+
+def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
+                   biased: bool = False):
+    """Build the jitted T-step decode block: a lax.scan of T exact
+    single-token decode steps — same model apply, same per-slot sampling,
+    a fresh subkey per step — so one dispatch advances every active slot
+    T tokens.  Greedy slots emit exactly their step-at-a-time decode;
+    sampled slots draw from the identical per-step distributions
+    (different key schedule than T separate step() calls, same law)."""
+
+    def _core(params, cache, tokens, positions, temps, aids, key,
+              topks=None, topps=None, bias_ids=None, bias_vals=None):
+        def body(carry, k):
+            cache, toks, pos = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                toks,
+                pos,
+                adapter_ids=aids,
+                mutable=["cache"],
+            )
+            row = logits[:, -1, :]
+            pick = row
+            if biased:
+                rows = jnp.arange(row.shape[0])[:, None]
+                pick = row.at[rows, bias_ids].add(
+                    bias_vals.astype(row.dtype)
+                )
+            greedy = jnp.argmax(pick, axis=-1).astype(jnp.int32)
+            scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
+            if filtered:
+                scaled = filter_top_k_top_p(scaled, topks, topps)
+            sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            lp = (
+                _token_logprob(row, nxt)
+                if want_lp
+                else jnp.zeros(nxt.shape, jnp.float32)
+            )
+            return (mut["cache"], nxt[:, None], pos + 1), (nxt, lp)
+
+        (cache, _, _), (toks, lps) = jax.lax.scan(
+            body, (cache, tokens, positions), jax.random.split(key, T)
+        )
+        return toks.T, lps.T, cache  # [slots, T]
+
+    # Same variant-signature split as build_step_fn: the common path
+    # shouldn't upload filter/bias arrays it compiled out.
+    extra = variant_names(filtered, biased)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def block(params, cache, tokens, positions, temps, aids, key, *rest):
+        return _core(
+            params, cache, tokens, positions, temps, aids, key,
+            **dict(zip(extra, rest)),
+        )
+
+    return block

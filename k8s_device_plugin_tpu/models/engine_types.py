@@ -1,0 +1,136 @@
+"""Shared serving-engine types: the request record and Prometheus series.
+
+Split out of engine.py (round 4) so the engine orchestrator, admission
+policy (engine_admission.py), and paging (engine_paging.py) submodules can
+all name them without import cycles.  Public import surface stays
+``models.engine`` (which re-exports these).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..utils.metrics import MetricsRegistry
+
+
+def _pow2_int(text: str) -> int:
+    """argparse type: positive power of two (chunk sizes must tile the
+    power-of-two length buckets)."""
+    import argparse
+
+    value = int(text)
+    if value < 1 or value & (value - 1):
+        raise argparse.ArgumentTypeError(
+            f"must be a positive power of two, got {value}"
+        )
+    return value
+
+
+class EngineMetrics:
+    """Prometheus series for the serving engine (same registry machinery
+    the plugin daemon exposes on its --metrics-port).  Pass a shared
+    registry to co-expose with other subsystems, or let each engine own
+    one and mount it on a utils.metrics.MetricsServer."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.requests = registry.counter(
+            "tpu_engine_requests_total",
+            "Requests admitted into a decode slot",
+        )
+        self.tokens = registry.counter(
+            "tpu_engine_tokens_total", "Tokens emitted across all requests"
+        )
+        self.steps = registry.counter(
+            "tpu_engine_steps_total", "Jitted decode steps executed"
+        )
+        self.active_slots = registry.gauge(
+            "tpu_engine_active_slots", "Slots currently serving a request"
+        )
+        self.queued = registry.gauge(
+            "tpu_engine_queued_requests", "Requests waiting for slots/pages"
+        )
+        self.free_pages = registry.gauge(
+            "tpu_engine_free_pages", "Unallocated KV-cache pages"
+        )
+        self.shared_pages = registry.gauge(
+            "tpu_engine_shared_pages",
+            "Pages currently referenced by more than one request (prefix sharing)",
+        )
+        self.spec_proposed = registry.counter(
+            "tpu_engine_spec_proposed_total",
+            "Draft tokens proposed by speculative rounds",
+        )
+        self.spec_accepted = registry.counter(
+            "tpu_engine_spec_accepted_total",
+            "Draft tokens the target accepted (rate = accepted/proposed)",
+        )
+        self.preemptions = registry.counter(
+            "tpu_engine_preemptions_total",
+            "Slots evicted for recompute-resume under optimistic admission",
+        )
+        self.step_seconds = registry.histogram(
+            "tpu_engine_step_seconds",
+            "Wall time of one engine step() call (admission + dispatch + "
+            "consume); histogram_quantile() gives serving-step p50/p99",
+        )
+        self.wait_seconds = registry.histogram(
+            "tpu_engine_request_wait_seconds",
+            "Queue-to-first-token wait per request (admission latency "
+            "under load)",
+            # Wider than the step buckets: overload pushes waits far past
+            # 10s, and a saturated top bucket would clamp the p99 exactly
+            # when the metric matters.
+            buckets=(
+                0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0, 120.0, 300.0,
+            ),
+        )
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and, when finished, its output tokens.
+
+    ``temperature`` 0 means greedy; > 0 samples that request's tokens at
+    that temperature.  ``top_k``/``top_p`` restrict sampling to the k
+    highest logits / the smallest nucleus with mass >= p (None = off;
+    only meaningful with temperature > 0).  Slots with different sampler
+    settings mix freely in one jitted step."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    # Multi-LoRA serving (cfg.lora_serve > 0): which stacked adapter this
+    # request decodes through; None = base model.
+    adapter: Optional[int] = None
+    # Sparse logit bias: {token_id: added_logit} applied BEFORE greedy
+    # argmax and sampling (OpenAI semantics: -100 bans, +100 forces);
+    # capped at ServingEngine.MAX_BIAS entries.  Reported logprobs stay
+    # UNBIASED (bias changes what gets picked, not what is scored).
+    logit_bias: Optional[dict] = None
+    # Stop sequences (token-id lists): generation ends when the output's
+    # tail equals any of them; the matched suffix is EXCLUDED from
+    # ``tokens`` (eos_id, by contrast, is included — the id itself is the
+    # terminator, a stop sequence is a content sentinel).
+    stop: Optional[list[list[int]]] = None
+    # Latched by the engine when a stop sequence matched (the matched
+    # suffix is truncated away, so the flag — not the tail — records it).
+    stopped: bool = False
+    # Record each emitted token's logprob under the unscaled model
+    # distribution in ``token_logprobs`` (parallel to ``tokens``).
+    # Sampler settings change what gets picked, never what is reported.
+    logprobs: bool = False
+    rid: int = -1
+    # monotonic submit time (engine-internal: queue-wait observation).
+    submitted_at: float = 0.0
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    token_logprobs: list[float] = dataclasses.field(default_factory=list)
+    done: bool = False
+    # Set via ServingEngine.cancel() (client went away): a queued request
+    # finishes immediately; an in-flight one is torn down at the next step
+    # boundary, its slot and pages returned to the pool.
+    cancelled: bool = False

@@ -1,0 +1,119 @@
+"""Lock-discipline race detector for host-side shared state.
+
+SURVEY.md §5.2 records that the reference ships real data races with no
+sanitizer anywhere (no ``-race`` in its build — reference Dockerfile:17 —
+while its ListAndWatch/heartbeat code races, reference main.go:126-132).
+The JAX device side here is functional and race-free by construction, but
+the serving engine's HOST side has a documented threading contract:
+``submit()``/``cancel()`` run on RPC-handler threads and the metrics
+scraper reads gauges concurrently, so the queue, the free-page pool, and
+the page refcounts must only ever be touched under the engine lock.
+
+The stress suites (tests/test_stress.py, tests/test_engine_stress.py)
+*exercise* those races; this module *detects* violations of the contract
+itself — the TSan-style systematic check, scaled to what Python needs:
+
+- ``GuardedDeque`` / ``GuardedDict`` wrap the shared containers and assert
+  on EVERY mutating (and optionally reading) operation that the declared
+  lock is held by the calling thread.  A violation raises
+  ``LockDisciplineError`` at the exact faulty call site instead of
+  corrupting state with a probability the stress test may or may not hit.
+- ``ServingEngine(..., racecheck=True)`` (the engine wires this up) swaps
+  its queue/free_pages/_page_refs for guarded versions; the fuzz/stress
+  suites run with it ON, so every schedule they explore is checked, not
+  just observed.
+
+Single-threaded fast path: ``_is_owned`` is one C-level call; the guard
+adds ~100ns per container op and is OFF by default in production engines.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Iterable
+
+
+class LockDisciplineError(AssertionError):
+    """A lock-protected container was touched without its lock held."""
+
+
+def _owned(lock) -> bool:
+    # RLock exposes _is_owned (CPython, PyPy); a plain Lock would need
+    # owner tracking we don't use (the engine lock is reentrant).
+    return lock._is_owned()
+
+
+class GuardedDeque(deque):
+    """A deque that asserts ``lock`` is held on every mutation.
+
+    Reads (len, iteration, indexing) are deliberately unguarded: the
+    engine's contract allows lock-free reads of approximate state (gauge
+    snapshots), and guarding them would flag the benign ones.  Mutations
+    are never benign off-lock — a deque resize mid-iteration crashes the
+    scraper thread.
+    """
+
+    _MUTATORS = (
+        "append", "appendleft", "pop", "popleft", "extend", "extendleft",
+        "remove", "insert", "clear", "rotate", "__setitem__", "__delitem__",
+        "__iadd__",
+    )
+
+    def __init__(self, iterable: Iterable = (), *, lock, name: str = "deque"):
+        super().__init__(iterable)
+        self._lock = lock
+        self._name = name
+
+    def _check(self, op: str) -> None:
+        if not _owned(self._lock):
+            raise LockDisciplineError(
+                f"{self._name}.{op}() without the engine lock held "
+                f"(thread {threading.current_thread().name})"
+            )
+
+
+class GuardedDict(dict):
+    """A dict that asserts ``lock`` is held on every mutation (same read
+    policy as GuardedDeque)."""
+
+    _MUTATORS = (
+        "__setitem__", "__delitem__", "pop", "popitem", "clear", "update",
+        "setdefault",
+    )
+
+    def __init__(self, *args, lock, name: str = "dict", **kw):
+        # Build content first so the initial fill needs no lock.
+        super().__init__(*args, **kw)
+        self._lock = lock
+        self._name = name
+
+    def _check(self, op: str) -> None:
+        if not _owned(self._lock):
+            raise LockDisciplineError(
+                f"{self._name}.{op}() without the engine lock held "
+                f"(thread {threading.current_thread().name})"
+            )
+
+
+def _install_guards(cls, mutators):
+    """Generate checking overrides for every mutator name: each calls
+    _check(op) then the parent implementation.  Done at import time (not
+    per instance) so instances carry no per-object closures and each op
+    pays one extra attribute check, nothing more."""
+    for op in mutators:
+        parent = getattr(cls.__mro__[1], op)
+
+        def make(op=op, parent=parent):
+            def guarded(self, *a, **kw):
+                self._check(op)
+                return parent(self, *a, **kw)
+
+            guarded.__name__ = op
+            return guarded
+
+        setattr(cls, op, make())
+
+
+_install_guards(GuardedDeque, GuardedDeque._MUTATORS)
+_install_guards(GuardedDict, GuardedDict._MUTATORS)

@@ -34,7 +34,7 @@ def test_engine_survives_submit_cancel_storm():
     paged = PagedConfig(page_size=4, num_pages=24, max_pages_per_seq=16)
     eng = ServingEngine(
         cfg, params, paged, max_slots=3, admission="optimistic",
-        decode_block=4,
+        decode_block=4, racecheck=True,
     )
     server = EngineServer(eng, host="127.0.0.1", port=0).start()
     errors: list = []
