@@ -533,15 +533,26 @@ def main(argv: list[str] | None = None) -> None:
         "training; --steps is then the ABSOLUTE target step, so a resumed "
         "run finishes the remaining steps",
     )
+    p.add_argument(
+        "--compilation-cache-dir",
+        default=os.environ.get("TPU_COMPILATION_CACHE_DIR", ""),
+        help="persist XLA compilations here so a restarted benchmark pod "
+        "(node drain, preemption — the --resume scenario) skips its "
+        "recompiles; empty = no persistent cache",
+    )
     args = p.parse_args(argv)
 
     # Honor an explicit JAX_PLATFORMS from the pod spec even if the image's
     # site hooks programmatically pinned a platform (the CPU-control pod
     # k8s-pod-example-cpu.yaml depends on this: ≙ the reference pinning its
     # control run off-GPU with HIP_VISIBLE_DEVICES=-1).
-    from ..utils.platform import honor_jax_platforms_env
+    from ..utils.platform import (
+        enable_compilation_cache,
+        honor_jax_platforms_env,
+    )
 
     honor_jax_platforms_env(empty_is_auto=False, log=log)
+    enable_compilation_cache(args.compilation_cache_dir, log=log)
 
     # Multi-host (k8s-job-resnet50-2host.yaml): stitch processes over DCN,
     # derived from the plugin-injected TPU_WORKER_* env (or explicit JAX_*

@@ -54,6 +54,7 @@ rest of the models/ stack which benchmarks on synthetic ids):
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -483,6 +484,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
+        "--compilation-cache-dir",
+        default=os.environ.get("TPU_COMPILATION_CACHE_DIR", ""),
+        help="persist XLA compilations here so a restarted pod skips its "
+        "20-40s-per-program recompiles (deploy/k8s-deploy-serve-http.yaml "
+        "mounts an emptyDir, which survives liveness-probe container "
+        "restarts); empty = no persistent cache",
+    )
+    p.add_argument(
         "--debug-trace",
         action="store_true",
         help="enable POST /debug/trace (on-demand jax.profiler capture of "
@@ -539,6 +548,11 @@ def main(argv: Optional[list[str]] = None) -> None:
             "target; an already-quantized target (--quant) leaves nothing "
             "to verify against — drop one of the flags"
         )
+    from ..utils.platform import enable_compilation_cache
+
+    enable_compilation_cache(
+        args.compilation_cache_dir, log=lambda m: print(m, file=sys.stderr)
+    )
 
     cfg = GPTConfig(
         vocab_size=args.vocab,

@@ -68,3 +68,39 @@ def honor_jax_platforms_env(
     except Exception as e:
         if log is not None:
             log(f"could not apply JAX_PLATFORMS={value!r}: {e}")
+
+
+def enable_compilation_cache(
+    cache_dir: str,
+    *,
+    min_compile_seconds: float = 1.0,
+    log: Optional[Callable[[str], None]] = None,
+) -> None:
+    """Persist XLA compilations under ``cache_dir`` so a restarted pod
+    reuses them instead of recompiling (TPU compiles run 20-40s per
+    program; a liveness-probe restart of the serving pod would otherwise
+    pay them all again — the manifests mount an emptyDir here, which
+    survives container restarts within the pod).
+
+    ``min_compile_seconds`` filters entries: only compilations at least
+    this slow are written (sub-second CPU test compiles would churn the
+    dir).  An empty ``cache_dir`` is a no-op, so every entry point can
+    pass its flag/env value straight through (same self-contained
+    semantics as honor_jax_platforms_env).  Best-effort: serving must
+    come up cacheless rather than die over cache plumbing.
+    """
+    if not cache_dir:
+        return
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_seconds
+        )
+        if log is not None:
+            log(f"persistent compilation cache at {cache_dir}")
+    except Exception as e:
+        if log is not None:
+            log(f"compilation cache unavailable ({cache_dir}): {e}")

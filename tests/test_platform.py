@@ -58,3 +58,53 @@ def test_failure_logs_and_never_raises(monkeypatch):
     calls, logs = _run(monkeypatch, "cpu", empty_is_auto=False, fail=True)
     assert calls == []
     assert len(logs) == 1 and "cpu" in logs[0]
+
+
+# ---------------------------------------------------------------- comp cache
+
+
+def _cache_run(cache_dir):
+    """Run a tiny jitted program in a fresh process with the persistent
+    compilation cache pointed at ``cache_dir``; returns entry count after."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from k8s_device_plugin_tpu.utils.platform import "
+        "enable_compilation_cache\n"
+        f"enable_compilation_cache({str(cache_dir)!r}, min_compile_seconds=0.0)\n"
+        "x = jnp.ones((64, 64), jnp.float32)\n"
+        "print(float(jax.jit(lambda a: (a @ a) * 1.61803).lower(x)"
+        ".compile()(x).sum()))\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    entries = [
+        f for f in os.listdir(cache_dir)
+        if not f.startswith(".")
+    ]
+    return len(entries)
+
+
+def test_compilation_cache_persists_and_reuses(tmp_path):
+    """The serving cold-start lever (--compilation-cache-dir): a first
+    process writes cache entries; an identical second process reuses them
+    (same computation key -> no new entry), which is what lets a
+    liveness-restarted pod skip its recompiles."""
+    cache = tmp_path / "xla-cache"
+    first = _cache_run(cache)
+    assert first > 0, "no cache entries written"
+    second = _cache_run(cache)
+    assert second == first, (
+        f"second run changed the entry count ({first} -> {second}): "
+        "the computation was recompiled, not reused"
+    )
