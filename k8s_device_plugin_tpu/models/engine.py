@@ -287,6 +287,16 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        # Device-resident step state: the per-slot arrays the jitted step
+        # consumes (tokens/positions/temps/aids/filters/biases/key) live
+        # on device between steps, with tokens/positions/key fed forward
+        # from the previous step's OUTPUTS.  Rebuilt from the host lists
+        # only when slot structure changes (_mark_state_dirty: admission,
+        # teardown, speculative rounds) — in steady-state decode a step
+        # costs ZERO host->device uploads and no separate key-split
+        # dispatch, which is what matters on a real TPU VM where device
+        # step time (~100us) is comparable to one transfer.
+        self._dev: Optional[dict] = None
         self.metrics = metrics
         # Prefix sharing: K/V are a deterministic function of (params,
         # prompt tokens), so FULL pages covering a common prompt prefix are
@@ -330,20 +340,62 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
 
     # ----------------------------------------------------------------- steps
 
-    def _variant_arrays(self, filtered: bool, biased: bool) -> list:
-        """Device arrays matching engine_sampling.variant_names, built
-        from slot state."""
+    def _mark_state_dirty(self) -> None:
+        """Invalidate the device-resident step state: the next dispatch
+        rebuilds every per-slot array from the host lists.  Called on any
+        event that changes a slot's scalars (activation, teardown) or
+        moves lengths by a data-dependent amount (speculative rounds)."""
+        self._dev = None
+
+    def _device_state(self) -> dict:
+        """The per-slot arrays the next dispatch consumes, on device.
+        Fresh-built from host truth when dirty; otherwise whatever the
+        previous step fed forward (tokens/positions/key) plus the cached
+        uploads (temps/aids/filters/biases, which only change via dirty
+        events)."""
+        dev = self._dev
+        if dev is None:
+            self._rng, sub = jax.random.split(self._rng)
+            dev = self._dev = {
+                "tokens": jnp.asarray(self._slot_last, jnp.int32)[:, None],
+                "positions": jnp.asarray(self._slot_len, jnp.int32)[:, None],
+                "temps": jnp.asarray(self._slot_temp, jnp.float32),
+                "aids": jnp.asarray(self._slot_aid, jnp.int32),
+                "key": sub,
+            }
+        return dev
+
+    def _feed_forward(self, dev: dict, tokens, positions, key) -> None:
+        """Install the step's returned next-inputs as the new device
+        state.  Runs BEFORE host consumption: a finish in consumption
+        tears the slot down through _clear_slot, which marks the state
+        dirty again — ordering keeps both paths correct."""
+        self._dev = {
+            **dev, "tokens": tokens, "positions": positions, "key": key,
+        }
+
+    def _variant_arrays(self, dev: dict, filtered: bool, biased: bool) -> list:
+        """The optional per-slot arrays matching
+        engine_sampling.variant_names.  Built lazily into the device
+        state on first need: a greedy-only server rebuilds its state on
+        every admission/finish, and uploading filter/bias arrays no
+        compiled variant consumes would defeat the variant-signature
+        split (engine_sampling.py).  Safe to cache: any change to a
+        slot's sampler settings rides an activation/teardown, which
+        marks the whole state dirty."""
         arrays = []
         if filtered:
-            arrays += [
-                jnp.asarray(self._slot_topk, jnp.int32),
-                jnp.asarray(self._slot_topp, jnp.float32),
-            ]
+            if "topks" not in dev:
+                dev["topks"] = jnp.asarray(self._slot_topk, jnp.int32)
+                dev["topps"] = jnp.asarray(self._slot_topp, jnp.float32)
+            arrays += [dev["topks"], dev["topps"]]
         if biased:
-            arrays += [
-                jnp.asarray(self._slot_bias_ids, jnp.int32),
-                jnp.asarray(self._slot_bias_vals, jnp.float32),
-            ]
+            if "bias_ids" not in dev:
+                dev["bias_ids"] = jnp.asarray(self._slot_bias_ids, jnp.int32)
+                dev["bias_vals"] = jnp.asarray(
+                    self._slot_bias_vals, jnp.float32
+                )
+            arrays += [dev["bias_ids"], dev["bias_vals"]]
         return arrays
 
     def _step_fn(self, filtered: bool, want_lp: bool, biased: bool = False):
@@ -381,10 +433,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         if not active:
             self._update_gauges()
             return finished
-        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
-        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
-        temps = jnp.asarray(self._slot_temp, jnp.float32)
-        aids = jnp.asarray(self._slot_aid, jnp.int32)
+        dev = self._device_state()
         filtered = any(
             self.slots[s] is not None
             and (
@@ -401,11 +450,14 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             self.slots[s] is not None and self.slots[s].logit_bias
             for s in range(self.max_slots)
         )
-        self._rng, sub = jax.random.split(self._rng)
-        out, lps, self.cache = self._block_fn(T, filtered, want_lp, biased)(
-            self.params, self.cache, tokens, positions, temps, aids, sub,
-            *self._variant_arrays(filtered, biased),
+        out, lps, ff_tok, ff_pos, ff_key, self.cache = self._block_fn(
+            T, filtered, want_lp, biased
+        )(
+            self.params, self.cache, dev["tokens"], dev["positions"],
+            dev["temps"], dev["aids"], dev["key"],
+            *self._variant_arrays(dev, filtered, biased),
         )
+        self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         out = np.asarray(out)
         lps = np.asarray(lps)
         emitted_total = 0
@@ -437,15 +489,20 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 self._extend_frontier(s)
                 if self.cfg.attention_window is not None:
                     self._reclaim_windowed(s)
-        # The block left every row's device length at L+T; re-align to the
-        # host truth in one vector write per layer (fresh array per layer
-        # — see the identical note in _spec_step re double donation).
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
-                **att,
-                "seq_lens": jnp.array(self._slot_len, jnp.int32),
-            }
+        # The block left every row's device length at L+T.  When every
+        # active slot consumed all T tokens that IS the host truth and no
+        # realignment is needed; a mid-block finish tore its slot down
+        # (_clear_slot -> state dirty), and only then do device lengths
+        # disagree — re-align in one vector write per layer (fresh array
+        # per layer — see the identical note in _spec_step re double
+        # donation).
+        if self._dev is None:
+            for name in self._layer_names:
+                att = self.cache[name]["attn"]
+                self.cache[name]["attn"] = {
+                    **att,
+                    "seq_lens": jnp.array(self._slot_len, jnp.int32),
+                }
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(emitted_total)
@@ -513,10 +570,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             if not active:
                 self._update_gauges()
                 return finished
-        tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
-        positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
-        temps = jnp.asarray(self._slot_temp, jnp.float32)
-        aids = jnp.asarray(self._slot_aid, jnp.int32)
+        dev = self._device_state()
         filtered = any(
             self.slots[s] is not None
             and (
@@ -533,11 +587,14 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             self.slots[s] is not None and self.slots[s].logit_bias
             for s in range(self.max_slots)
         )
-        self._rng, sub = jax.random.split(self._rng)
-        nxt, lps, self.cache = self._step_fn(filtered, want_lp, biased)(
-            self.params, self.cache, tokens, positions, temps, aids, sub,
-            *self._variant_arrays(filtered, biased),
+        nxt, lps, ff_tok, ff_pos, ff_key, self.cache = self._step_fn(
+            filtered, want_lp, biased
+        )(
+            self.params, self.cache, dev["tokens"], dev["positions"],
+            dev["temps"], dev["aids"], dev["key"],
+            *self._variant_arrays(dev, filtered, biased),
         )
+        self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
         for s in active:

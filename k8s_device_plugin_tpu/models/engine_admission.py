@@ -482,6 +482,9 @@ class AdmissionMixin:
             self._maybe_finish(slot)
             if req.done:
                 finished.append(req)
+        # Activated slots carry fresh scalars (last token, length, sampler
+        # settings, adapter): rebuild the device step state (engine.py).
+        self._mark_state_dirty()
         return finished
 
     @staticmethod

@@ -119,6 +119,9 @@ class PagingMixin:
         self._slot_page_base[slot] = 0
         self._slot_visible[slot] = 0
         self._slot_ready[slot] = False
+        # Slot scalars changed: the device-resident step state must be
+        # rebuilt from host truth before the next dispatch (engine.py).
+        self._mark_state_dirty()
 
     def _release_page(self, page: int) -> None:
         """Drop one reference; at zero, tear down every trie link touching

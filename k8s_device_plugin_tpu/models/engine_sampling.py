@@ -82,7 +82,14 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
     the step returns a zeros placeholder so the host consumption code
     stays uniform); ``biased`` compiles the [slots, MAX_BIAS] scatter-add
     of per-slot logit biases onto the picking row (reported logprobs
-    stay unbiased)."""
+    stay unbiased).
+
+    Returns ``(nxt, lps, next_tokens, next_positions, next_key, cache)``:
+    the last three are the NEXT step's inputs, computed in-program so a
+    steady-state decode loop feeds device outputs straight back in — no
+    per-step host->device uploads, no separate key-split dispatch (the
+    engine's device-resident step state; it rebuilds from host lists only
+    when slot structure changes)."""
 
     # Variant signatures omit the arrays their feature compiled out:
     # an unused jit argument is still transferred every dispatch, and
@@ -90,6 +97,7 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
     # pay host->device uploads for filters/biases it never applies.
     def _core(params, cache, tokens, positions, temps, aids, key,
               topks=None, topps=None, bias_ids=None, bias_vals=None):
+        key, sub = jax.random.split(key)
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tokens,
@@ -110,14 +118,14 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
         scaled = pick / jnp.where(temps > 0, temps, 1.0)[:, None]
         if filtered:
             scaled = filter_top_k_top_p(scaled, topks, topps)
-        sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        sampled = jax.random.categorical(sub, scaled).astype(jnp.int32)
         nxt = jnp.where(temps > 0, sampled, greedy)
         lps = (
             _token_logprob(row, nxt)
             if want_lp
             else jnp.zeros(nxt.shape, jnp.float32)
         )
-        return nxt, lps, mut["cache"]
+        return nxt, lps, nxt[:, None], positions + 1, key, mut["cache"]
 
     extra = variant_names(filtered, biased)
 
@@ -138,10 +146,16 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
     a fresh subkey per step — so one dispatch advances every active slot
     T tokens.  Greedy slots emit exactly their step-at-a-time decode;
     sampled slots draw from the identical per-step distributions
-    (different key schedule than T separate step() calls, same law)."""
+    (different key schedule than T separate step() calls, same law).
+
+    Returns ``(toks, lps, next_tokens, next_positions, next_key, cache)``
+    — same feed-forward contract as build_step_fn, with toks/lps shaped
+    [slots, T]."""
 
     def _core(params, cache, tokens, positions, temps, aids, key,
               topks=None, topps=None, bias_ids=None, bias_vals=None):
+        key, sub = jax.random.split(key)
+
         def body(carry, k):
             cache, toks, pos = carry
             logits, mut = model.apply(
@@ -171,10 +185,10 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
             )
             return (mut["cache"], nxt[:, None], pos + 1), (nxt, lp)
 
-        (cache, _, _), (toks, lps) = jax.lax.scan(
-            body, (cache, tokens, positions), jax.random.split(key, T)
+        (cache, last_tok, last_pos), (toks, lps) = jax.lax.scan(
+            body, (cache, tokens, positions), jax.random.split(sub, T)
         )
-        return toks.T, lps.T, cache  # [slots, T]
+        return toks.T, lps.T, last_tok, last_pos, key, cache  # [slots, T]
 
     # Same variant-signature split as build_step_fn: the common path
     # shouldn't upload filter/bias arrays it compiled out.

@@ -293,6 +293,9 @@ class SpeculativeMixin:
                 **att,
                 "seq_lens": jnp.array(self._slot_len, jnp.int32),
             }
+        # Rounds advance each slot by a data-dependent 1..gamma+1: the
+        # device-resident step state cannot be fed forward (engine.py).
+        self._mark_state_dirty()
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(emitted_total)
