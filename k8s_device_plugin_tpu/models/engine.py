@@ -234,6 +234,18 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self.preemptions = 0
         self._seq_counter = 0
 
+        # In-program table derivation (non-speculative engines): the full
+        # allocated page chain lives in ONE [slots, max_pages_per_seq]
+        # device array, and the jitted step computes the visible prefix
+        # from it (engine_sampling._derived_tables) — no per-layer host
+        # publication scatters, and graft/teardown/reclaim edit one array
+        # instead of num_layers cache tables.  Speculative engines keep
+        # host-published cache tables (their round programs read the
+        # table as carried cache state).
+        self._derive_tables = spec_gamma == 0
+        self._chain = jnp.zeros(
+            (max_slots, paged.max_pages_per_seq), jnp.int32
+        )
         # Page 0 is the idle-slot scratch target — never allocated.
         self.free_pages: deque[int] = deque(range(1, paged.num_pages))
         self.slots: list[Optional[Request]] = [None] * max_slots
@@ -406,7 +418,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         key_ = (filtered, want_lp, biased)
         if key_ not in self._step_fns:
             self._step_fns[key_] = build_step_fn(
-                self._decode_model, filtered, want_lp, biased
+                self._decode_model, filtered, want_lp, biased,
+                derive_tables=self._derive_tables,
             )
         return self._step_fns[key_]
 
@@ -416,9 +429,15 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         key_ = (T, filtered, want_lp, biased)
         if key_ not in self._block_fns:
             self._block_fns[key_] = build_block_fn(
-                self._decode_model, T, filtered, want_lp, biased
+                self._decode_model, T, filtered, want_lp, biased,
+                derive_tables=self._derive_tables,
             )
         return self._block_fns[key_]
+
+    def _chain_args(self) -> list:
+        """The chain operand for derive-tables step variants (leading
+        entry of the *rest signature; empty for speculative engines)."""
+        return [self._chain] if self._derive_tables else []
 
     def _block_step(
         self, active: list[int], finished: list[Request], T: int
@@ -455,6 +474,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         )(
             self.params, self.cache, dev["tokens"], dev["positions"],
             dev["temps"], dev["aids"], dev["key"],
+            *self._chain_args(),
             *self._variant_arrays(dev, filtered, biased),
         )
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)
@@ -592,6 +612,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         )(
             self.params, self.cache, dev["tokens"], dev["positions"],
             dev["temps"], dev["aids"], dev["key"],
+            *self._chain_args(),
             *self._variant_arrays(dev, filtered, biased),
         )
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)

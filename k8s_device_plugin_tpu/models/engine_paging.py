@@ -49,12 +49,21 @@ class PagingMixin:
         # covering positions [0, plen] (the first decode write lands at
         # position plen; a speculative round writes up to plen+gamma).
         # The rest of the chain stays at scratch page 0 until the
-        # frontier reaches it (_extend_frontier) so the kernel's pipeline
-        # never streams unwritten generation pages.
+        # frontier reaches it so the kernel's pipeline never streams
+        # unwritten generation pages.  Derive-tables engines record the
+        # FULL chain in the [slots, max_pages] chain array (one device
+        # write) and the jitted step computes the visible prefix
+        # in-program; speculative engines publish into every layer's
+        # cache table here and extend via _extend_frontier.
         n_publish = min((plen + self._spec_gamma) // ps + 1, len(pages))
-        row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
-        row[:n_publish] = pages[:n_publish]
         self._slot_visible[slot] = n_publish
+        if self._derive_tables:
+            full = np.zeros((self.paged.max_pages_per_seq,), np.int32)
+            full[: len(pages)] = pages
+            self._chain = self._chain.at[slot].set(jnp.asarray(full))
+        else:
+            row = np.zeros((self.paged.max_pages_per_seq,), np.int32)
+            row[:n_publish] = pages[:n_publish]
         lo_tok = n_shared * ps  # first private-covered token position
         n_priv_cover = n_cover - n_shared
         cover = jnp.asarray(pages[n_shared:n_cover], jnp.int32)
@@ -73,9 +82,12 @@ class PagingMixin:
 
             new_att = {
                 **att,
-                "page_table": att["page_table"].at[slot].set(jnp.asarray(row)),
                 "seq_lens": att["seq_lens"].at[slot].set(plen),
             }
+            if not self._derive_tables:
+                new_att["page_table"] = (
+                    att["page_table"].at[slot].set(jnp.asarray(row))
+                )
             if n_priv_cover > 0:
                 new_att["pool_key"] = (
                     att["pool_key"].at[cover].set(paged_rows(src["cached_key"]))
@@ -97,13 +109,19 @@ class PagingMixin:
             self.cache[name]["attn"] = new_att
 
     def _clear_slot(self, slot: int):
+        if self._derive_tables:
+            # One chain-row zero; per-layer cache tables are derived
+            # in-program and overwritten before any read.
+            self._chain = self._chain.at[slot].set(0)
         for name in self._layer_names:
             att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
+            new_att = {
                 **att,
-                "page_table": att["page_table"].at[slot].set(0),
                 "seq_lens": att["seq_lens"].at[slot].set(0),
             }
+            if not self._derive_tables:
+                new_att["page_table"] = att["page_table"].at[slot].set(0)
+            self.cache[name]["attn"] = new_att
         for page in self._slot_pages[slot]:
             self._release_page(page)
         self._slot_pages[slot] = []
@@ -225,6 +243,15 @@ class PagingMixin:
                     if page is not None:
                         self._page_refs[page] = 1
                         self._slot_pages[s].append(page)
+                        if self._derive_tables:
+                            # Record the grown chain; the step publishes
+                            # it in-program once the frontier arrives.
+                            idx = (
+                                self._slot_page_base[s]
+                                + len(self._slot_pages[s])
+                                - 1
+                            )
+                            self._chain = self._chain.at[s, idx].set(page)
                         continue
                 if not self._preempt_newest(newer_than=self._slot_seq[s]):
                     break
@@ -298,6 +325,12 @@ class PagingMixin:
         need = min(
             need, self._slot_page_base[slot] + len(self._slot_pages[slot])
         )
+        if self._derive_tables:
+            # Publication happens in-program (the step derives the
+            # visible prefix from the chain array); only the host-side
+            # watermark advances here, for invariants and tests.
+            self._slot_visible[slot] = max(self._slot_visible[slot], need)
+            return
         while self._slot_visible[slot] < need:
             idx = self._slot_visible[slot]  # logical page index to publish
             page = self._slot_pages[slot][idx - self._slot_page_base[slot]]
@@ -341,14 +374,20 @@ class PagingMixin:
         # The logical page indices shift only in OUR bookkeeping; the
         # device table keeps absolute logical positions, so dead entries
         # are re-pointed at scratch (a sliced device update — no host
-        # round-trip) rather than compacted.
+        # round-trip) rather than compacted.  A freed id may be
+        # reallocated to another request immediately, so the entry MUST
+        # be zeroed before the next dispatch — derive-tables engines
+        # zero the chain (one array), spec engines every layer's table.
         lo = self._slot_page_base[slot]
-        for name in self._layer_names:
-            att = self.cache[name]["attn"]
-            self.cache[name]["attn"] = {
-                **att,
-                "page_table": att["page_table"].at[slot, lo : lo + n_dead].set(0),
-            }
+        if self._derive_tables:
+            self._chain = self._chain.at[slot, lo : lo + n_dead].set(0)
+        else:
+            for name in self._layer_names:
+                att = self.cache[name]["attn"]
+                self.cache[name]["attn"] = {
+                    **att,
+                    "page_table": att["page_table"].at[slot, lo : lo + n_dead].set(0),
+                }
         self._slot_page_base[slot] += n_dead
         for page in dead:
             self._release_page(page)

@@ -75,7 +75,29 @@ def variant_names(filtered: bool, biased: bool) -> list[str]:
     return names
 
 
-def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
+def _derived_tables(cache, chain, pos, page_size):
+    """The visible page-table view, computed IN-PROGRAM from the full
+    allocated chain: entries covering positions [0, pos] show their real
+    page, later entries show scratch page 0 — the same lazy-frontier
+    publication the engine used to perform with per-layer host scatters
+    (engine_paging._extend_frontier), now one cheap elementwise op whose
+    result every layer's cache entry shares.  The kernel's pipeline
+    therefore never streams unwritten generation pages, and the host
+    never dispatches a publication scatter."""
+    mpp = chain.shape[1]
+    table = jnp.where(
+        jnp.arange(mpp, dtype=jnp.int32)[None, :] <= pos[:, 0:1] // page_size,
+        chain,
+        0,
+    )
+    return {
+        name: {**layer, "attn": {**layer["attn"], "page_table": table}}
+        for name, layer in cache.items()
+    }
+
+
+def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False,
+                  derive_tables: bool = False):
     """Build the jitted single-token decode step.  ``filtered`` compiles
     the top-k/top-p sort in; ``want_lp`` compiles the [slots, vocab]
     log-softmax + gather whose result logprobs requests read (without it
@@ -89,15 +111,24 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
     steady-state decode loop feeds device outputs straight back in — no
     per-step host->device uploads, no separate key-split dispatch (the
     engine's device-resident step state; it rebuilds from host lists only
-    when slot structure changes)."""
+    when slot structure changes).
+
+    ``derive_tables``: take a ``chain`` argument (the full allocated page
+    chain, [slots, max_pages_per_seq]) and compute the visible page-table
+    view in-program (_derived_tables) instead of reading host-published
+    cache tables — the engine enables this for non-speculative engines."""
+    page_size = model.config.paged.page_size if derive_tables else None
 
     # Variant signatures omit the arrays their feature compiled out:
     # an unused jit argument is still transferred every dispatch, and
     # the greedy/temperature-only path (the common case) shouldn't
     # pay host->device uploads for filters/biases it never applies.
     def _core(params, cache, tokens, positions, temps, aids, key,
+              chain=None,
               topks=None, topps=None, bias_ids=None, bias_vals=None):
         key, sub = jax.random.split(key)
+        if derive_tables:
+            cache = _derived_tables(cache, chain, positions, page_size)
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tokens,
@@ -127,7 +158,9 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
         )
         return nxt, lps, nxt[:, None], positions + 1, key, mut["cache"]
 
-    extra = variant_names(filtered, biased)
+    extra = (["chain"] if derive_tables else []) + variant_names(
+        filtered, biased
+    )
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, tokens, positions, temps, aids, key, *rest):
@@ -140,7 +173,7 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False):
 
 
 def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
-                   biased: bool = False):
+                   biased: bool = False, derive_tables: bool = False):
     """Build the jitted T-step decode block: a lax.scan of T exact
     single-token decode steps — same model apply, same per-slot sampling,
     a fresh subkey per step — so one dispatch advances every active slot
@@ -150,14 +183,21 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
 
     Returns ``(toks, lps, next_tokens, next_positions, next_key, cache)``
     — same feed-forward contract as build_step_fn, with toks/lps shaped
-    [slots, T]."""
+    [slots, T].  ``derive_tables``: per-iteration in-program publication
+    from the chain (the scan's running position naturally publishes each
+    page exactly as the write frontier reaches it — the host used to
+    pre-publish the whole block's lookahead)."""
+    page_size = model.config.paged.page_size if derive_tables else None
 
     def _core(params, cache, tokens, positions, temps, aids, key,
+              chain=None,
               topks=None, topps=None, bias_ids=None, bias_vals=None):
         key, sub = jax.random.split(key)
 
         def body(carry, k):
             cache, toks, pos = carry
+            if derive_tables:
+                cache = _derived_tables(cache, chain, pos, page_size)
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 toks,
@@ -192,7 +232,9 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
 
     # Same variant-signature split as build_step_fn: the common path
     # shouldn't upload filter/bias arrays it compiled out.
-    extra = variant_names(filtered, biased)
+    extra = (["chain"] if derive_tables else []) + variant_names(
+        filtered, biased
+    )
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def block(params, cache, tokens, positions, temps, aids, key, *rest):

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Hardware session driver: runs the round's measurement queue in priority
+# order the moment a chip answers.  Each item is independently time-boxed
+# so a relay wedge mid-queue keeps every earlier result on disk.
+#
+#   PYTHONPATH must carry the repo AND the accelerator plugin site dir
+#   (APPEND, never replace — see BASELINE.md measurement methodology).
+#   Usage:  tools/hw_session.sh [logfile]
+LOG=$(realpath -m "${1:-/tmp/hw_session.log}")
+cd "$(dirname "$0")/.."
+# The accelerator PJRT plugin rides its own site dir; APPEND the repo and
+# (when present) that dir so a bare-env invocation can't burn the queue
+# on backend-init failures.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+[ -d /root/.axon_site ] && case ":$PYTHONPATH:" in
+  *:/root/.axon_site:*) ;;
+  *) export PYTHONPATH="$PYTHONPATH:/root/.axon_site" ;;
+esac
+# Preflight: a 100s-bounded probe must answer before the 45-min bench
+# window is spent on a dead backend.
+if ! timeout 100 python tools/probe_tpu.py >> "$LOG" 2>&1; then
+  echo "PREFLIGHT FAILED: accelerator probe dead — aborting session" | tee -a "$LOG"
+  exit 1
+fi
+run() {
+  name="$1"; tmo="$2"; shift 2
+  echo "=== [$name] start $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+  timeout "$tmo" "$@" >> "$LOG" 2>&1
+  echo "=== [$name] done rc=$? $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+}
+echo "HW SESSION START $(date -u)" | tee -a "$LOG"
+run bench        2700 python bench.py
+run int8_parity   900 python tools/hw_sweep.py int8_parity
+run engine_ab    1500 python tools/hw_sweep.py engine_ab admission_ab
+run spec_sweep   1800 python tools/hw_sweep.py spec_sweep
+run resnet_flags 3600 python tools/hw_sweep.py resnet_flags
+echo "HW SESSION END $(date -u)" | tee -a "$LOG"

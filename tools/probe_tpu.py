@@ -1,0 +1,9 @@
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+d = jax.devices()
+print("devices:", d, "in", round(time.time()-t0,1), "s")
+x = jnp.ones((1024,1024), jnp.bfloat16)
+f = jax.jit(lambda a: (a @ a).sum())
+t1 = time.time()
+v = jax.device_get(f(x))
+print("matmul ok:", float(v), "in", round(time.time()-t1,1), "s")
