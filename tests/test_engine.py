@@ -1641,3 +1641,72 @@ def test_logit_bias_validation(rng):
         eng.submit([3], 4, logit_bias={cfg.vocab_size + 5: 1.0})
     with pytest.raises(ValueError, match="logit_bias"):
         eng.submit([3], 4, logit_bias={i: 1.0 for i in range(20)})
+
+
+# ---------------------------------------------------------------------------
+# device-resident step state + in-program table derivation (round 4)
+# ---------------------------------------------------------------------------
+
+
+def test_derived_tables_mask_boundaries():
+    """The in-program visibility mask must publish exactly the pages
+    covering positions [0, pos] — the page being written this step is
+    visible, the next one is not until the frontier crosses into it."""
+    from k8s_device_plugin_tpu.models.engine_sampling import _derived_tables
+
+    chain = jnp.asarray([[5, 9, 7, 3]], jnp.int32)  # one slot, mpp=4
+    cache = {"layer_0": {"attn": {"page_table": jnp.zeros((1, 4), jnp.int32)}}}
+    ps = 4
+    for pos, want in [
+        (0, [5, 0, 0, 0]),   # writing position 0: first page only
+        (3, [5, 0, 0, 0]),   # last slot of page 0
+        (4, [5, 9, 0, 0]),   # first slot of page 1: page 1 appears
+        (11, [5, 9, 7, 0]),
+        (12, [5, 9, 7, 3]),
+        (15, [5, 9, 7, 3]),
+    ]:
+        out = _derived_tables(
+            cache, chain, jnp.asarray([[pos]], jnp.int32), ps
+        )
+        got = np.asarray(out["layer_0"]["attn"]["page_table"])[0].tolist()
+        assert got == want, (pos, got, want)
+
+
+def test_steady_state_feeds_device_outputs_forward(rng):
+    """In pure decode with no admissions/finishes the engine must keep
+    its device step state alive (no host rebuild) and the emitted tokens
+    must still match the dense oracle exactly."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=16, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=1)
+    prompt = [3, 141, 59]
+    req = eng.submit(prompt, 12)
+    eng.step()  # admit + activate: state dirty, rebuilt at dispatch
+    assert eng._dev is not None
+    dev_after_first = eng._dev
+    # Spy on invalidation: pure decode must never mark the state dirty —
+    # a rebuilt-every-step regression would pass the identity asserts
+    # below (rebuilds also produce fresh non-None dicts), so the spy is
+    # what actually pins the feed-forward invariant.
+    dirty_calls = 0
+    real_mark = eng._mark_state_dirty
+
+    def counting_mark():
+        nonlocal dirty_calls
+        dirty_calls += 1
+        real_mark()
+
+    eng._mark_state_dirty = counting_mark
+    for _ in range(5):
+        eng.step()
+    assert dirty_calls == 0, "pure decode invalidated the device state"
+    # Feed-forward persisted: the state was never invalidated, and its
+    # tokens/positions entries are device outputs, not host re-uploads.
+    assert eng._dev is not None
+    assert eng._dev is not dev_after_first  # advanced, not stale
+    while not req.done:
+        eng.step()
+    assert dirty_calls > 0  # the finish teardown invalidated it
+    assert eng._dev is None  # finish tears down -> dirty
+    assert req.tokens == _oracle(cfg, params, prompt, 12)
