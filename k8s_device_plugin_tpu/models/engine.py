@@ -367,6 +367,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         events)."""
         dev = self._dev
         if dev is None:
+            if self.metrics:
+                self.metrics.state_rebuilds.inc()
             self._rng, sub = jax.random.split(self._rng)
             dev = self._dev = {
                 "tokens": jnp.asarray(self._slot_last, jnp.int32)[:, None],

@@ -70,6 +70,12 @@ class EngineMetrics:
             "tpu_engine_preemptions_total",
             "Slots evicted for recompute-resume under optimistic admission",
         )
+        self.state_rebuilds = registry.counter(
+            "tpu_engine_state_rebuilds_total",
+            "Device step-state rebuilds from host lists (admissions, "
+            "teardowns, speculative rounds); steady decode should add "
+            "~2 per request lifecycle, not per token",
+        )
         self.step_seconds = registry.histogram(
             "tpu_engine_step_seconds",
             "Wall time of one engine step() call (admission + dispatch + "

@@ -223,3 +223,10 @@ def test_engine_latency_histograms_populate():
     # 4 tokens need >= 3 steps (the admission step emits the prefill
     # token AND the first decode token).
     assert steps >= 3
+    # Device-state rebuilds: O(request lifecycle) — the activation and
+    # the finish teardown — never O(token); more rebuilds than steps
+    # would mean the feed-forward path regressed to per-step uploads.
+    rebuilds = int(
+        re.search(r"tpu_engine_state_rebuilds_total (\d+)", text).group(1)
+    )
+    assert 1 <= rebuilds <= 2, rebuilds
