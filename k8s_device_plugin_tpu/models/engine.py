@@ -211,10 +211,14 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         # ALL prefill runs through the multi-token CACHED append (the
         # speculative verifier's path): each chunk attends against the
         # K/V of every previous chunk via position masks, so a prompt can
-        # be consumed across several bounded dispatches — or one.
-        self._dense_chunk = TransformerLM(
-            self.dense_cfg, decode=True, append_mode="cached"
-        )
+        # be consumed across several bounded dispatches — or one.  One
+        # model per LENGTH BUCKET: the throwaway dense cache is sized to
+        # the bucket, not paged.max_len, so a short prompt's chunks score
+        # [chunk, bucket] instead of [chunk, max_len] — up to
+        # max_len/bucket x less prefill attention work in long-context
+        # engines (positions past the bucket were masked anyway, so
+        # outputs are identical).
+        self._dense_chunk_models: dict[int, TransformerLM] = {}
 
         if spec_gamma > 0:
             draft_model = TransformerLM(
@@ -349,6 +353,20 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             self._page_refs = GuardedDict(
                 self._page_refs, lock=self._lock, name="_page_refs"
             )
+
+    def _dense_chunk_model(self, bucket: int) -> TransformerLM:
+        """The cached-append prefill model for one length bucket (cache
+        sized to the bucket; see __init__ note).  Cached per bucket —
+        O(log max_len) instances ever exist."""
+        model = self._dense_chunk_models.get(bucket)
+        if model is None:
+            model = TransformerLM(
+                dataclasses.replace(self.dense_cfg, max_seq=bucket),
+                decode=True,
+                append_mode="cached",
+            )
+            self._dense_chunk_models[bucket] = model
+        return model
 
     # ----------------------------------------------------------------- steps
 

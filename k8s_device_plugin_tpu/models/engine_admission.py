@@ -168,26 +168,29 @@ class AdmissionMixin:
             self._update_gauges()
             return True
 
-    def _prefill_chunk_fn(self, chunk: int, batch: int):
+    def _prefill_chunk_fn(self, chunk: int, batch: int, bucket: int):
         """Jitted CHUNK prefill: one multi-token cached append of ``chunk``
         tokens at traced offset pos0 into a carried dense cache.  One
-        compiled program per (chunk, batch) pair serves every chunk index
-        of every bucket (the unchunked path is simply chunk == bucket).
-        Cached on THIS instance (a process-global lru_cache would pin the
-        engine — params tree and page pools included — beyond its
-        lifetime).  The carried cache is donated: the host rebinds
-        job["cache"] from the output, so without donation every chunk
-        would copy the whole [batch, max_len] dense cache."""
-        key = (chunk, batch)
+        compiled program per (chunk, batch, bucket) triple serves every
+        chunk index of its bucket (the unchunked path is simply
+        chunk == bucket; the bucket keys the cache SIZE the chunk scores
+        against — see ServingEngine._dense_chunk_model).  Cached on THIS
+        instance (a process-global lru_cache would pin the engine —
+        params tree and page pools included — beyond its lifetime).  The
+        carried cache is donated: the host rebinds job["cache"] from the
+        output, so without donation every chunk would copy the whole
+        [batch, bucket] dense cache."""
+        key = (chunk, batch, bucket)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
+        model = self._dense_chunk_model(bucket)
 
         def run(params, cache, tokens, pos0, last_idx, aids):
             pos = jnp.broadcast_to(
                 pos0 + jnp.arange(chunk)[None, :], (batch, chunk)
             )
-            logits, mut = self._dense_chunk.apply(
+            logits, mut = model.apply(
                 {"params": params, "cache": cache}, tokens, pos,
                 adapter_ids=aids,
                 mutable=["cache"],
@@ -235,7 +238,7 @@ class AdmissionMixin:
             it[1].adapter if it[1].adapter is not None else -1 for it in items
         ]
         aids += [aids[0]] * (batch - n)  # pad rows are discarded anyway
-        spec = decode_cache_spec(self._dense_chunk, batch)
+        spec = decode_cache_spec(self._dense_chunk_model(bucket), batch)
         self._pending.append(
             {
                 "items": items,
@@ -257,7 +260,7 @@ class AdmissionMixin:
     def _advance_prefill(self, job: dict) -> bool:
         """Run ONE chunk of a pending prefill job; True when complete."""
         chunk, pos = job["chunk"], job["pos"]
-        fn = self._prefill_chunk_fn(chunk, job["batch"])
+        fn = self._prefill_chunk_fn(chunk, job["batch"], job["bucket"])
         tokens = jax.lax.slice_in_dim(job["rows"], pos, pos + chunk, axis=1)
         logits_rows, job["cache"] = fn(
             self.params,
