@@ -237,6 +237,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._optimistic = admission == "optimistic"
         self.preemptions = 0
         self._seq_counter = 0
+        # Set by each _admit pass; read by the decode-block gate.
+        self._admit_page_blocked = False
 
         # In-program table derivation (non-speculative engines): the full
         # allocated page chain lives in ONE [slots, max_pages_per_seq]
@@ -591,7 +593,20 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         if (
             self._decode_block > 1
             and not self._pending  # no prompt mid-stream: keep chunking
-            and not self.queue  # admission possible next step: stay fine-grained
+            # Queued work argues for fine-grained steps ONLY while the
+            # head could actually admit: a SATURATED engine (every slot
+            # occupied — the steady operating point of a loaded server)
+            # or a PAGE-BLOCKED head (this step's _admit broke on the
+            # pool; only a finish or reclamation frees pages) cannot
+            # admit until something releases, so it keeps blocking — a
+            # mid-block finish truncates that slot's tail and the next
+            # step() admits from the queue.  Otherwise stay fine-grained
+            # so the queue head lands immediately.
+            and (
+                not self.queue
+                or all(s is not None for s in self.slots)
+                or self._admit_page_blocked
+            )
         ):
             # Largest power-of-two block that no active slot's remaining
             # budget truncates (so no slot can overrun max_new mid-block).

@@ -289,6 +289,11 @@ class AdmissionMixin:
         """
         admitted: list[tuple[int, Request, list[int], int]] = []
         burst_pages: dict[int, int] = {}  # page -> length bucket, this burst
+        # Whether this pass left the FIFO head stuck on a page shortage:
+        # the decode-block gate reads it — with the head page-blocked,
+        # nothing can admit until something frees, so fine-grained
+        # stepping buys no admission latency (engine.py _step_inner).
+        self._admit_page_blocked = False
         for slot in range(self.max_slots):
             # Queue peek/pop under the lock (submit() appends from other
             # threads); everything after the pop touches owner-only state.
@@ -334,7 +339,9 @@ class AdmissionMixin:
                 )
                 n_private = n_pages - len(shared)
                 if n_private > len(self.free_pages):
-                    break  # FIFO: wait for pages rather than starving the head
+                    # FIFO: wait for pages rather than starving the head.
+                    self._admit_page_blocked = True
+                    break
                 self.queue.popleft()
                 # Refcounts and free-page moves stay under the lock too:
                 # _update_gauges (called from submit() on another thread)

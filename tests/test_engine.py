@@ -1710,3 +1710,54 @@ def test_steady_state_feeds_device_outputs_forward(rng):
     assert dirty_calls > 0  # the finish teardown invalidated it
     assert eng._dev is None  # finish tears down -> dirty
     assert req.tokens == _oracle(cfg, params, prompt, 12)
+
+
+def test_decode_blocks_engage_while_saturated_with_queue(rng):
+    """A loaded server (every slot busy, more requests queued) must still
+    use decode blocks — no admission is possible until a finish anyway.
+    Regression: the old gate disabled blocks whenever the queue was
+    non-empty, i.e. exactly at the steady operating point."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2, decode_block=4)
+    prompts = [[3, 141, 59], [9, 10], [7, 5, 2]]
+    n_new = 12
+    reqs = [eng.submit(p, n_new) for p in prompts]  # 3rd queues behind 2 slots
+    steps = 0
+    while not all(r.done for r in reqs):
+        eng.step()
+        steps += 1
+        assert steps < 500
+    for p, r in zip(prompts, reqs):
+        assert r.tokens == _oracle(cfg, params, p, n_new), p
+    # Blocks engaged WHILE saturated: the old queue-disables-blocks gate
+    # single-stepped p1/p2's 12 tokens each (~16 steps total once p3's
+    # empty-queue tail blocked); the saturation clause runs p1/p2 in
+    # blocks too, landing ~9-10.  12 separates the behaviors.
+    assert steps <= 12, steps
+
+
+def test_decode_blocks_engage_while_page_blocked(rng):
+    """With a FREE slot but a page-blocked queue head (reserve admission
+    broke on the pool), fine-grained stepping cannot admit anything —
+    blocks must stay engaged for the running request."""
+    cfg = _cfg()
+    params = _params(cfg, rng)
+    # Pool: 9 allocatable pages; p1 takes 8 (4+28 -> ceil(32/4)); the
+    # head then needs 8 > 1 free with a slot open -> page-blocked.
+    paged = PagedConfig(page_size=4, num_pages=10, max_pages_per_seq=8)
+    eng = ServingEngine(cfg, params, paged, max_slots=2, decode_block=4)
+    p1 = eng.submit([3, 141, 59, 265], 28)
+    p2 = eng.submit([9, 10, 2, 4], 28)
+    steps = 0
+    while not (p1.done and p2.done):
+        eng.step()
+        steps += 1
+        assert steps < 500
+    assert p1.tokens == _oracle(cfg, params, [3, 141, 59, 265], 28)
+    assert p2.tokens == _oracle(cfg, params, [9, 10, 2, 4], 28)
+    # p1 decodes solo while p2 waits page-blocked: blocks of 4 put the
+    # whole drain well under one-step-per-token (56 tokens single-step
+    # would need ~56 dispatches; blocked runs land ~20).
+    assert steps <= 24, steps
