@@ -108,3 +108,16 @@ def test_compilation_cache_persists_and_reuses(tmp_path):
         f"second run changed the entry count ({first} -> {second}): "
         "the computation was recompiled, not reused"
     )
+
+
+def test_compilation_cache_unwritable_dir_never_raises():
+    """Best-effort contract: serving must come up cacheless rather than
+    die over cache plumbing (an unwritable mount, a bad flag value)."""
+    from k8s_device_plugin_tpu.utils.platform import enable_compilation_cache
+
+    logs = []
+    enable_compilation_cache("/proc/definitely/not/writable", log=logs.append)
+    assert len(logs) == 1 and "unavailable" in logs[0]
+    # And the empty-string no-op leaves no log noise.
+    enable_compilation_cache("", log=logs.append)
+    assert len(logs) == 1
