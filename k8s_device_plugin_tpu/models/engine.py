@@ -787,8 +787,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         default=1,
         help="in pure decode (no admission work), advance every slot up "
         "to this many tokens per dispatch via one scanned program "
-        "(power of two) — amortizes the per-step host round-trip; "
-        "incompatible with --spec-gamma",
+        "(power of two) — amortizes the per-step host round-trip; under "
+        "saturation a finishing request's slot is refilled at the next "
+        "step boundary, so blocks add up to block-size steps of "
+        "first-token wait; incompatible with --spec-gamma",
     )
     p.add_argument(
         "--admission",

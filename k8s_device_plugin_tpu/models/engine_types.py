@@ -73,8 +73,10 @@ class EngineMetrics:
         self.state_rebuilds = registry.counter(
             "tpu_engine_state_rebuilds_total",
             "Device step-state rebuilds from host lists (admissions, "
-            "teardowns, speculative rounds); steady decode should add "
-            "~2 per request lifecycle, not per token",
+            "teardowns); steady decode should add ~2 per request "
+            "lifecycle, not per token.  Speculative engines drive every "
+            "step through their own host-published state and never "
+            "rebuild, so this stays 0 when spec_gamma > 0",
         )
         self.step_seconds = registry.histogram(
             "tpu_engine_step_seconds",

@@ -472,7 +472,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         type=_pow2_int,
         default=1,
         help="tokens per dispatch in pure decode (power of two; one "
-        "scanned program amortizes the per-step host round-trip)",
+        "scanned program amortizes the per-step host round-trip; under "
+        "saturation a finishing request's slot is refilled at the next "
+        "step boundary, adding up to block-size steps of first-token "
+        "wait)",
     )
     p.add_argument(
         "--admission",

@@ -30,8 +30,13 @@ adds ~100ns per container op and is OFF by default in production engines.
 from __future__ import annotations
 
 import threading
+import warnings
 from collections import deque
 from typing import Iterable
+
+# Lock types already warned about on the fail-open path (one warning per
+# type, not per call — _owned runs at every mutation site).
+_FAIL_OPEN_WARNED: set = set()
 
 
 class LockDisciplineError(AssertionError):
@@ -40,8 +45,28 @@ class LockDisciplineError(AssertionError):
 
 def _owned(lock) -> bool:
     # RLock exposes _is_owned (CPython, PyPy); a plain Lock would need
-    # owner tracking we don't use (the engine lock is reentrant).
-    return lock._is_owned()
+    # owner tracking we don't use (the engine lock is reentrant).  This
+    # is a test-only instrument, so when the introspection hook is
+    # absent (exotic lock type, future rename) we FAIL OPEN — no
+    # discipline checking — rather than turn every guarded op into an
+    # AttributeError on code that may be perfectly correct.
+    probe = getattr(lock, "_is_owned", None)
+    if probe is None:
+        # Warn once per lock type so a silent fail-open can't masquerade
+        # as a passing race check (e.g. an RLock->Lock refactor would
+        # otherwise turn every stress suite into a no-op detector).
+        key = type(lock)
+        if key not in _FAIL_OPEN_WARNED:
+            _FAIL_OPEN_WARNED.add(key)
+            warnings.warn(
+                f"racecheck: lock type {key.__name__} has no _is_owned "
+                "introspection hook; lock-discipline checking is DISABLED "
+                "for containers guarded by it",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        return True
+    return probe()
 
 
 class GuardedDeque(deque):

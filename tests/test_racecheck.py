@@ -27,6 +27,34 @@ from k8s_device_plugin_tpu.utils.racecheck import (
 )
 
 
+def test_guard_fails_open_without_is_owned_hook():
+    # _owned leans on RLock._is_owned (a private CPython/PyPy attribute).
+    # A lock type without it must degrade to no-checking — this is a
+    # test-only instrument, and an AttributeError at every mutation site
+    # would fail code that is perfectly correct.
+    class PlainLock:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    import warnings as _w
+
+    from k8s_device_plugin_tpu.utils import racecheck as rc
+
+    rc._FAIL_OPEN_WARNED.discard(PlainLock)
+    d = GuardedDeque([1], lock=PlainLock(), name="q")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        d.append(2)  # fails open: no LockDisciplineError, no AttributeError
+        d.append(3)
+    assert list(d) == [1, 2, 3]
+    # ... but loudly: one RuntimeWarning per lock TYPE, not per call.
+    hits = [w for w in caught if "lock-discipline checking is DISABLED" in str(w.message)]
+    assert len(hits) == 1 and issubclass(hits[0].category, RuntimeWarning)
+
+
 def test_guarded_deque_rejects_offlock_mutation():
     lock = threading.RLock()
     d = GuardedDeque([1, 2], lock=lock, name="q")
