@@ -63,7 +63,8 @@ def _owned(lock) -> bool:
                 "introspection hook; lock-discipline checking is DISABLED "
                 "for containers guarded by it",
                 RuntimeWarning,
-                stacklevel=3,
+                # user mutation site -> guarded wrapper -> _check -> _owned
+                stacklevel=4,
             )
         return True
     return probe()

@@ -1,0 +1,12 @@
+# Shared environment discipline for every hardware-facing tools/ script.
+# Source AFTER cd'ing to the repo root.
+#
+# PYTHONPATH must carry the repo AND the accelerator PJRT plugin site dir,
+# and must be APPENDED to, never replaced — replacing it breaks backend
+# init with "Backend 'axon' is not in the list of known backends" (see
+# BASELINE.md "Measurement methodology").
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+[ -d /root/.axon_site ] && case ":$PYTHONPATH:" in
+  *:/root/.axon_site:*) ;;
+  *) export PYTHONPATH="$PYTHONPATH:/root/.axon_site" ;;
+esac

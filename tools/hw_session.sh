@@ -11,11 +11,7 @@ cd "$(dirname "$0")/.."
 # The accelerator PJRT plugin rides its own site dir; APPEND the repo and
 # (when present) that dir so a bare-env invocation can't burn the queue
 # on backend-init failures.
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-[ -d /root/.axon_site ] && case ":$PYTHONPATH:" in
-  *:/root/.axon_site:*) ;;
-  *) export PYTHONPATH="$PYTHONPATH:/root/.axon_site" ;;
-esac
+. tools/_env.sh
 # Preflight: a 100s-bounded probe must answer before the 45-min bench
 # window is spent on a dead backend.
 if ! timeout 100 python tools/probe_tpu.py >> "$LOG" 2>&1; then

@@ -5,11 +5,7 @@
 # time-boxed inside hw_session.sh).  Usage: tools/probe_loop.sh [logfile]
 LOG=$(realpath -m "${1:-/tmp/probe_loop_r5.log}")
 cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-[ -d /root/.axon_site ] && case ":$PYTHONPATH:" in
-  *:/root/.axon_site:*) ;;
-  *) export PYTHONPATH="$PYTHONPATH:/root/.axon_site" ;;
-esac
+. tools/_env.sh
 n=0
 while true; do
   n=$((n+1))
@@ -19,12 +15,14 @@ while true; do
     tools/hw_session.sh /tmp/hw_session_r5.log
     rc=$?
     echo "=== hw_session rc=$rc $(date -u) ===" | tee -a "$LOG"
-    # rc=1 is hw_session's own preflight failing — the relay wedged in
-    # the window between our probe and its probe, and NO queue item ran.
-    # Keep watching; any other rc means the queue at least started, so
-    # results (possibly partial) are on disk and the loop's job is done.
-    [ "$rc" -eq 1 ] && { sleep 600; continue; }
-    exit 0
+    # Only a clean rc=0 means the queue ran to its end.  Anything else —
+    # its own preflight failing (rc=1: the relay wedged between our probe
+    # and its probe), exec failure (126/127), signal death (>128) — keeps
+    # the watch alive; re-running a partially-complete session is safe
+    # (each item overwrites its own results).
+    [ "$rc" -eq 0 ] && exit 0
+    sleep 600
+    continue
   fi
   echo "probe #$n dead $(date -u +%T)" >> "$LOG"
   sleep 600
