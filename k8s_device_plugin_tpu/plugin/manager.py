@@ -7,8 +7,14 @@ dpm/manager.go + dpm/plugin.go), with its sharp edges filed off:
   retried 3×/3s (≙ dpm/manager.go:17-20,204-218),
 - registration failure rolls the server back per the protocol's
   "terminate upon registration failure" contract (≙ dpm/plugin.go:83-87),
-- kubelet.sock create ⇒ full restart + re-register, remove ⇒ stop
-  (≙ dpm/manager.go:73-84), via watcher.KubeletSocketWatcher,
+- kubelet.sock events are LEVEL-triggered, not edge-replayed: watcher
+  events (≙ dpm/manager.go:73-84, via watcher.KubeletSocketWatcher) only
+  kick a reconciler thread that compares the CURRENT socket identity
+  (inode+ctime) against the identity we last registered with — socket
+  present with a new identity ⇒ full restart + re-register, absent ⇒
+  stop.  A kubelet flapping N times while we were busy costs ONE
+  reconcile against its final state, not N replayed restart dances
+  (the reference replays each fsnotify event),
 - a heartbeat thread drives per-chip health/discovery polls (≙ the reference's
   ticker goroutine at main.go:201-209, minus its duplicate-append bug),
 - no 10-second startup stall: the reference's readiness loop waited for a
@@ -71,6 +77,14 @@ class PluginManager:
         self._watcher = None
         self._heartbeat: threading.Thread | None = None
         self.registrations = 0  # observability: how many times we registered
+        # Level-triggered recovery: watcher/fan-in events only set this
+        # kick; the reconciler thread compares current socket identity to
+        # _registered_key and acts on the DELTA (coalescing any number of
+        # flaps into one reconcile).
+        self._reconcile_kick = threading.Event()
+        self._reconciler: threading.Thread | None = None
+        self._registered_key: tuple | None = None
+        self._counted_key: tuple | None = None  # last incarnation metered
 
     # ----------------------------------------------------------------- paths
 
@@ -94,7 +108,16 @@ class PluginManager:
             self.stop_all()
 
     def start(self) -> None:
+        # Capture the kubelet's identity BEFORE registering: if it restarts
+        # mid-registration, the stale key makes the next reconcile register
+        # again (conservative — at least one registration per incarnation).
+        key = self._kubelet_key()
         self._start_and_register()
+        self._registered_key = key
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop, name="tpu-reconcile", daemon=True
+        )
+        self._reconciler.start()
         if self._watch_kubelet:
             self._watcher = self._make_watcher()
             self._watcher.start()
@@ -119,6 +142,9 @@ class PluginManager:
         dead watcher means restarts would go unnoticed, which IS death."""
         if self._stop.is_set():
             return False
+        if self._reconciler is not None and not self._reconciler.is_alive():
+            # A dead reconciler means kubelet restarts would go unhandled.
+            return False
         if not self._watch_kubelet:
             # An owning MultiResourceManager holds the watch; we're alive as
             # long as we haven't been stopped.
@@ -130,11 +156,15 @@ class PluginManager:
         # (kubelet restarting at the same moment as our SIGTERM) cannot
         # resurrect the server after we tear it down.
         self._stop.set()
+        self._reconcile_kick.set()  # unblock the reconciler so it can exit
         self.plugin.interrupt_streams()
         if self._watcher is not None:
             self._watcher.stop()
             self._watcher.join(timeout=5)
             self._watcher = None
+        if self._reconciler is not None:
+            self._reconciler.join(timeout=5)
+            self._reconciler = None
         if self._heartbeat is not None:
             self._heartbeat.join(timeout=5)
             self._heartbeat = None
@@ -187,7 +217,18 @@ class PluginManager:
         the kubelet may be mid-upgrade and come back compatible.
         """
         try:
-            with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as channel:
+            # Cap connect backoff: C-core pools subchannels process-wide, so
+            # failed dials against a flapping kubelet.sock otherwise push the
+            # cached subchannel into exponential backoff (up to minutes) that
+            # a FRESH channel to the same target inherits — turning the first
+            # re-registration after an outage into a multi-second stall.
+            with grpc.insecure_channel(
+                f"unix://{self.kubelet_socket}",
+                options=[
+                    ("grpc.initial_reconnect_backoff_ms", 100),
+                    ("grpc.max_reconnect_backoff_ms", 2000),
+                ],
+            ) as channel:
                 RegistrationStub(channel).Register(
                     pb.RegisterRequest(
                         version=constants.VERSION,
@@ -263,27 +304,71 @@ class PluginManager:
             poll_interval=self._watch_poll_interval,
         )
 
+    def _kubelet_key(self) -> tuple | None:
+        """Identity of the CURRENT kubelet.sock (None when absent).  A fresh
+        kubelet incarnation binds a fresh socket → new inode; ctime guards
+        against inode reuse on busy tmpfs."""
+        try:
+            st = os.stat(self.kubelet_socket)
+            return (st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
     def _on_kubelet_create(self) -> None:
-        """kubelet.sock (re)appeared: the kubelet restarted and forgot us.
-        Restart our server (fresh socket) and re-register."""
-        if self._stop.is_set():
-            return
-        self.plugin.metrics.kubelet_restarts.inc()
-        log.info("kubelet restart detected; re-registering")
+        if not self._stop.is_set():
+            self._reconcile_kick.set()
+
+    _on_kubelet_remove = _on_kubelet_create
+
+    def _reconcile_loop(self) -> None:
+        """Drain kicks into reconciles.  Every watcher event (or fan-in call)
+        just sets the kick; this loop then compares observed socket identity
+        to the registered one — so a storm of N flaps while a reconcile is in
+        flight coalesces into ONE pass against the final state, instead of N
+        replayed restart/register dances against states that no longer exist."""
+        retry: float | None = None
+        while not self._stop.is_set():
+            kicked = self._reconcile_kick.wait(timeout=retry)
+            if self._stop.is_set():
+                return
+            if kicked:
+                self._reconcile_kick.clear()
+            retry = None if self._reconcile_once() else self._register_retry_delay
+
+    def _reconcile_once(self) -> bool:
+        """One level-triggered pass; returns False when it should be retried
+        (a registration attempt failed against a live socket)."""
+        key = self._kubelet_key()
+        if key is None:
+            # kubelet is down: stop serving until it returns (the create
+            # event will kick us again).
+            if self._registered_key is not None or self._server is not None:
+                log.info("kubelet socket absent; stopping plugin server")
+                self._stop_server()
+                self._registered_key = None
+            return True
+        if key == self._registered_key:
+            return True  # already registered with this incarnation
+        if key != self._counted_key:
+            # Count kubelet INCARNATIONS, not reconcile attempts: a kubelet
+            # that rejects registration re-enters here every retry tick and
+            # must not inflate the restart metric.
+            self._counted_key = key
+            self.plugin.metrics.kubelet_restarts.inc()
+        log.info("kubelet (re)start detected; re-registering")
         try:
             self._stop_server()
             self._start_and_register()
+            self._registered_key = key
+            return True
         except Exception:
             if self._stop.is_set():
                 log.info("shutdown interrupted re-registration")
-            else:
-                log.exception("re-registration after kubelet restart failed")
-
-    def _on_kubelet_remove(self) -> None:
-        """kubelet.sock vanished: kubelet is down; stop serving until it
-        returns (the create event will bring us back)."""
-        log.info("kubelet socket removed; stopping plugin server")
-        self._stop_server()
+                return True
+            log.exception(
+                "re-registration after kubelet restart failed (will retry)"
+            )
+            return False
 
     # Public fan-in points for an owning MultiResourceManager (which holds
     # the single shared kubelet-socket watch; see resources.py).

@@ -23,6 +23,15 @@ from k8s_device_plugin_tpu.kubelet.api import (
     pb,
 )
 
+# Sockets in these tests flap constantly; C-core's process-global
+# subchannel pool would otherwise carry multi-second (growing to minutes)
+# connect backoff from one dead incarnation into fresh channels aimed at
+# the live one.
+_CHAN_OPTS = [
+    ("grpc.initial_reconnect_backoff_ms", 50),
+    ("grpc.max_reconnect_backoff_ms", 500),
+]
+
 
 def make_fake_tpu_host(
     root,
@@ -80,22 +89,104 @@ class FakeKubelet:
     """In-process kubelet double.
 
     Serves the `Registration` service on `<plugin_dir>/kubelet.sock`, records
-    every RegisterRequest, and — like the real kubelet — can then dial back
-    into the registered plugin's DevicePlugin socket.
+    every RegisterRequest, and — like the real kubelet — dials back into the
+    registered plugin's DevicePlugin socket.
+
+    Fidelity notes (docs/kubelet-e2e.md carries the full fake-vs-real
+    analysis; these behaviors are modeled because a fake without them
+    cannot catch the bugs a production kubelet would):
+
+    - ``Register`` VALIDATES like the kubelet device manager: the API
+      version must be the (hardcoded) supported ``v1beta1``, the resource
+      must be a fully-qualified extended-resource name, and the kubelet
+      dials the plugin's endpoint SYNCHRONOUSLY inside the handler —
+      ``GetDevicePluginOptions`` first, then a persistent ``ListAndWatch``
+      stream on a background thread.  A plugin whose server is not
+      serving before it registers fails registration, exactly as in
+      production.
+    - ``restart()`` models kubelet's STARTUP CLEANUP: the real kubelet
+      removes every file in its device-plugins dir (all plugin sockets)
+      before binding a fresh ``kubelet.sock``, deleting plugin sockets out
+      from under live gRPC servers.  Plugins must re-bind + re-register on
+      the create event, not merely re-register.
     """
 
-    def __init__(self, plugin_dir: str):
+    def __init__(self, plugin_dir: str, dial_back: bool = True):
         self.plugin_dir = str(plugin_dir)
         self.socket_path = os.path.join(self.plugin_dir, constants.KUBELET_SOCKET_NAME)
         self.requests: list = []
+        self.options: list = []  # GetDevicePluginOptions response per register
+        self.initial_lists: list = []  # first ListAndWatch response per register
         self.registered = threading.Event()
+        self._dial_back = dial_back
         self._server = None
+        self._dialers: list = []  # (channel, thread) per dial-back
 
     # --- Registration service ------------------------------------------------
     def Register(self, request, context):
+        # The real kubelet hardcodes its supported versions (v1beta1) —
+        # validate against the literal, NOT constants.VERSION, so tests can
+        # skew the plugin's constant and watch rejection happen.
+        if request.version != "v1beta1":
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unsupported device plugin API version: {request.version}",
+            )
+        if "/" not in request.resource_name:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"invalid extended resource name: {request.resource_name}",
+            )
+        if self._dial_back:
+            # kubelet connects to the endpoint inside Register and fails the
+            # registration if the plugin is not actually serving yet.
+            sock = os.path.join(self.plugin_dir, request.endpoint)
+            channel = grpc.insecure_channel(f"unix://{sock}", options=_CHAN_OPTS)
+            try:
+                opts = DevicePluginStub(channel).GetDevicePluginOptions(
+                    pb.Empty(), timeout=5
+                )
+            except grpc.RpcError as e:
+                channel.close()
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"failed to dial device plugin endpoint {request.endpoint}: "
+                    f"{e.code()}",
+                )
+            self.options.append(opts)
+            # First ListAndWatch response is consumed SYNCHRONOUSLY so
+            # initial_lists[i] corresponds to requests[i] and is populated
+            # by the time `registered` is observable; the stream is then
+            # held open on a thread like kubelet's per-endpoint run loop.
+            try:
+                stream = DevicePluginStub(channel).ListAndWatch(pb.Empty())
+                self.initial_lists.append(next(stream))
+            except grpc.RpcError as e:
+                channel.close()
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"ListAndWatch on {request.endpoint} failed: {e.code()}",
+                )
+            watcher = threading.Thread(
+                target=self._hold_stream,
+                args=(stream,),
+                name="fake-kubelet-laW",
+                daemon=True,
+            )
+            watcher.start()
+            self._dialers.append((channel, watcher))
         self.requests.append(request)
         self.registered.set()
         return pb.Empty()
+
+    def _hold_stream(self, stream) -> None:
+        """Hold ListAndWatch open like kubelet's per-endpoint run loop; the
+        stream ends when the plugin server stops or the channel closes."""
+        try:
+            for _ in stream:
+                pass
+        except (grpc.RpcError, StopIteration):
+            pass
 
     # --- lifecycle ------------------------------------------------------------
     def start(self) -> None:
@@ -112,12 +203,24 @@ class FakeKubelet:
         if self._server is not None:
             self._server.stop(grace=None).wait()
             self._server = None
+        for channel, watcher in self._dialers:
+            channel.close()
+        for _channel, watcher in self._dialers:
+            watcher.join(timeout=2)
+        self._dialers.clear()
         if remove_socket and os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
 
     def restart(self) -> None:
-        """Simulate a kubelet restart: new server, socket recreated."""
+        """Simulate a kubelet restart: startup cleanup of the device-plugins
+        dir (plugin sockets deleted out from under their live servers — what
+        the real kubelet does on boot), then a fresh socket + server."""
         self.stop(remove_socket=True)
+        for name in os.listdir(self.plugin_dir):
+            try:
+                os.unlink(os.path.join(self.plugin_dir, name))
+            except OSError:
+                pass
         self.registered.clear()
         self.start()
 
@@ -126,7 +229,9 @@ class FakeKubelet:
         if endpoint is None:
             assert self.requests, "no plugin registered yet"
             endpoint = self.requests[-1].endpoint
-        return grpc.insecure_channel(f"unix://{os.path.join(self.plugin_dir, endpoint)}")
+        return grpc.insecure_channel(
+            f"unix://{os.path.join(self.plugin_dir, endpoint)}", options=_CHAN_OPTS
+        )
 
     def plugin_stub(self, endpoint: str | None = None) -> DevicePluginStub:
         return DevicePluginStub(self.plugin_channel(endpoint))

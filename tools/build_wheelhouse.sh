@@ -1,0 +1,26 @@
+#!/bin/bash
+# Populate deploy/wheelhouse/ so `docker build` needs no network (≙ the
+# reference vendoring its entire dependency graph in vendor/ + Gopkg.lock
+# so its image builds air-gapped).  Run ONCE on a machine with PyPI
+# access, commit or ship the wheelhouse alongside the context, then build
+# anywhere: the Dockerfile auto-detects a populated wheelhouse and flips
+# pip to --no-index.
+#
+#   tools/build_wheelhouse.sh  [dest]          (default deploy/wheelhouse)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+DEST="${1:-deploy/wheelhouse}"
+mkdir -p "$DEST"
+# Everything either image stage installs: the wheel-building frontend
+# (stage 1) and the runtime deps (stage 2), all at requirements.lock pins.
+# Wheels must match the IMAGE (linux/cp312 per python:3.12-slim), not the
+# machine running this script — pin platform + python and refuse sdists,
+# or a macOS/cp311 host would fill the house with wheels the image can't
+# install.
+PIP_TARGET=(--only-binary=:all: --platform manylinux2014_x86_64
+            --python-version 312 --implementation cp)
+pip download "${PIP_TARGET[@]}" --dest "$DEST" \
+    -c requirements.lock build grpcio protobuf
+# `build` needs its own backend chain when offline.
+pip download "${PIP_TARGET[@]}" --dest "$DEST" setuptools wheel
+echo "wheelhouse ready: $(ls "$DEST" | wc -l) files in $DEST"

@@ -1,0 +1,105 @@
+#!/bin/bash
+# Real-kubelet e2e (docs/kubelet-e2e.md steps 2-7) against a kind cluster.
+# Run on a Docker-capable machine:  tools/kubelet_e2e.sh [cluster-name]
+# Requires: kind, kubectl, docker.  Exits nonzero on the first failed check.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CLUSTER="${1:-tpu-dp-e2e}"
+NS=kube-system
+IMG=tpu-device-plugin:e2e
+
+for bin in kind kubectl docker; do
+  command -v "$bin" >/dev/null || { echo "MISSING: $bin — see docs/kubelet-e2e.md"; exit 2; }
+done
+
+say() { echo ">>> $*"; }
+
+say "1/7 cluster + image"
+kind get clusters | grep -qx "$CLUSTER" || kind create cluster --name "$CLUSTER" --wait 120s
+docker build -t "$IMG" -f deploy/Dockerfile .
+kind load docker-image --name "$CLUSTER" "$IMG"
+NODE="${CLUSTER}-control-plane"
+
+say "2/7 fixture host tree on the node"
+docker exec "$NODE" mkdir -p /opt/tpu-fixture
+python - "$NODE" <<'EOF'
+import subprocess, sys, tempfile, tarfile, io, os
+sys.path.insert(0, os.getcwd())
+from tests.fakes import make_fake_tpu_host
+d = tempfile.mkdtemp()
+make_fake_tpu_host(d, n_chips=4)
+buf = io.BytesIO()
+with tarfile.open(fileobj=buf, mode="w") as t:
+    t.add(d, arcname=".")
+subprocess.run(["docker", "exec", "-i", sys.argv[1],
+                "tar", "-C", "/opt/tpu-fixture", "-xf", "-"],
+               input=buf.getvalue(), check=True)
+EOF
+
+say "3/7 DaemonSet with --root seam"
+python - "$IMG" <<'EOF' | kubectl apply -f -
+import sys, yaml
+with open("deploy/k8s-ds-tpu-dp.yaml") as f:
+    ds = yaml.safe_load(f)
+c = ds["spec"]["template"]["spec"]["containers"][0]
+c["image"] = sys.argv[1]
+c["imagePullPolicy"] = "Never"
+c.setdefault("args", []).extend(["--root=/opt/tpu-fixture", "--pulse=2"])
+c.setdefault("volumeMounts", []).append(
+    {"name": "fixture", "mountPath": "/opt/tpu-fixture"})
+spec = ds["spec"]["template"]["spec"]
+spec.setdefault("volumes", []).append(
+    {"name": "fixture", "hostPath": {"path": "/opt/tpu-fixture"}})
+# The kind node is not a TPU node; the fixture IS the hardware here.
+spec.pop("nodeSelector", None)
+print(yaml.safe_dump(ds))
+EOF
+kubectl -n "$NS" rollout status ds/tpu-device-plugin-daemonset --timeout=120s
+
+say "4/7 capacity appears"
+for i in $(seq 30); do
+  CAP=$(kubectl get node "$NODE" -o jsonpath='{.status.allocatable.google\.com/tpu}' || true)
+  [ "$CAP" = "4" ] && break; sleep 2
+done
+[ "$CAP" = "4" ] || { echo "FAIL: allocatable google.com/tpu=$CAP (want 4)"; exit 1; }
+echo "OK capacity 4"
+
+say "5/7 allocation wires env into a pod"
+kubectl apply -f - <<'EOF'
+apiVersion: v1
+kind: Pod
+metadata: {name: tpu-e2e-consumer}
+spec:
+  restartPolicy: Never
+  containers:
+  - name: c
+    image: busybox
+    command: ["sh", "-c", "env | grep TPU_ && sleep 300"]
+    resources: {limits: {google.com/tpu: 2}}
+EOF
+kubectl wait --for=condition=Ready pod/tpu-e2e-consumer --timeout=120s
+CHIPS=$(kubectl exec tpu-e2e-consumer -- sh -c 'echo $TPU_VISIBLE_CHIPS')
+echo "TPU_VISIBLE_CHIPS=$CHIPS"
+[ "$(echo "$CHIPS" | tr ',' '\n' | wc -l)" = "2" ] || { echo "FAIL: want 2 chips"; exit 1; }
+echo "OK allocation"
+
+say "6/7 health fault drops allocatable"
+POD=$(kubectl -n "$NS" get pod -l name=tpu-dp-ds -o name | head -1)
+docker exec "$NODE" sh -c 'mkdir -p /opt/tpu-fixture/run/tpu/health && echo Unhealthy > /opt/tpu-fixture/run/tpu/health/accel3'
+for i in $(seq 30); do
+  CAP=$(kubectl get node "$NODE" -o jsonpath='{.status.allocatable.google\.com/tpu}')
+  [ "$CAP" = "3" ] && break; sleep 2
+done
+[ "$CAP" = "3" ] || { echo "FAIL: allocatable=$CAP after fault (want 3)"; exit 1; }
+echo "OK health stream"
+
+say "7/7 kubelet restart storm -> reconciler recovers"
+for i in 1 2 3; do docker exec "$NODE" systemctl restart kubelet; sleep 2; done
+for i in $(seq 60); do
+  CAP=$(kubectl get node "$NODE" -o jsonpath='{.status.allocatable.google\.com/tpu}' 2>/dev/null || true)
+  [ "$CAP" = "3" ] && break; sleep 2
+done
+[ "$CAP" = "3" ] || { echo "FAIL: capacity did not return after kubelet restarts"; exit 1; }
+kubectl -n "$NS" logs "$POD" | grep -q "re-registering" || { echo "FAIL: no re-registration logged"; exit 1; }
+echo "OK kubelet-restart recovery"
+echo "E2E PASS — archive: kubectl -n $NS logs $POD"
