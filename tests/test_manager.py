@@ -149,6 +149,29 @@ def test_heartbeat_streams_health_transitions(plugin, kubelet, host_root):
         manager.stop_all()
 
 
+def test_reconciler_retries_failed_reregistration(plugin, kubelet, monkeypatch):
+    """A kubelet that comes back REJECTING registration (version skew during
+    an upgrade) must not park the plugin forever: no further filesystem event
+    arrives, so recovery rides the reconciler's retry timer alone."""
+    manager = make_manager(plugin, kubelet)
+    manager.start()
+    try:
+        assert kubelet.registered.wait(5)
+        # Kubelet restarts; the plugin now (artificially) speaks a version
+        # the kubelet's hardcoded set rejects.
+        monkeypatch.setattr(constants, "VERSION", "v0alpha1")
+        kubelet.restart()
+        time.sleep(1.0)  # several reconcile attempts, all rejected
+        assert not kubelet.registered.is_set()
+        # "Upgrade" the plugin.  NO new socket event fires — only the retry
+        # timer can notice and re-register.
+        monkeypatch.setattr(constants, "VERSION", "v1beta1")
+        assert wait_until(lambda: kubelet.registered.is_set(), timeout=10)
+        assert manager.alive()
+    finally:
+        manager.stop_all()
+
+
 def test_kubelet_socket_flap_storm(plugin, kubelet, monkeypatch):
     """Rapid kubelet create/remove/rebind flapping (the hardest part of the
     recovery story, SURVEY §7) against a LIVE manager: 100 storm cycles of

@@ -22,6 +22,12 @@ NODE="${CLUSTER}-control-plane"
 
 say "2/7 fixture host tree on the node"
 docker exec "$NODE" mkdir -p /opt/tpu-fixture
+# Allocate responses name REAL container paths (/dev/accelN); containerd
+# refuses a DeviceSpec whose host path is not a device node, so give the
+# kind node /dev/null-backed stand-ins.
+for i in 0 1 2 3; do
+  docker exec "$NODE" sh -c "[ -e /dev/accel$i ] || mknod /dev/accel$i c 1 3"
+done
 python - "$NODE" <<'EOF'
 import subprocess, sys, tempfile, tarfile, io, os
 sys.path.insert(0, os.getcwd())
