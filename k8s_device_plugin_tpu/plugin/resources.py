@@ -26,6 +26,7 @@ Differences from the reference, on purpose:
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Callable, Iterable, Protocol
 
@@ -103,6 +104,7 @@ class MultiResourceManager:
         self._stop = threading.Event()
         self._watcher = None
         self._discover_thread: threading.Thread | None = None
+        self._retry_thread: threading.Thread | None = None
         self._discover_failed = False
 
     # ----------------------------------------------------------------- naming
@@ -140,6 +142,10 @@ class MultiResourceManager:
             target=self._discover_loop, name="resource-discover", daemon=True
         )
         self._discover_thread.start()
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, name="resource-retry", daemon=True
+        )
+        self._retry_thread.start()
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -162,6 +168,9 @@ class MultiResourceManager:
         if self._discover_thread is not None:
             self._discover_thread.join(timeout=5)
             self._discover_thread = None
+        if self._retry_thread is not None:
+            self._retry_thread.join(timeout=5)
+            self._retry_thread = None
         with self._lock:
             managers, self._managers = dict(self._managers), {}
         for name, mgr in managers.items():
@@ -176,6 +185,21 @@ class MultiResourceManager:
         except Exception:
             self._discover_failed = True
             log.exception("resource discover loop died")
+
+    def _retry_loop(self) -> None:
+        """Timer-driven recovery for wanted-but-not-running resources (their
+        start failed — e.g. the kubelet rejected registration during a skewed
+        upgrade).  The kubelet-create event retries too, but a kubelet that
+        stays up emits no further events; like PluginManager's reconciler,
+        recovery must not depend on one arriving."""
+        period = max(self._register_retry_delay, 0.2) * 3
+        while not self._stop.wait(period):
+            # The kubelet-down case belongs to _on_kubelet_create (starting
+            # servers against an absent socket just burns full registration
+            # backoff cycles); the timer covers kubelet-up-but-rejecting.
+            if not os.path.exists(os.path.join(self.plugin_dir, constants.KUBELET_SOCKET_NAME)):
+                continue
+            self._retry_missing("retry timer")
 
     def publish(self, names: Iterable[str]) -> None:
         """Reconcile the running plugin set against `names` (the full list,
@@ -205,6 +229,29 @@ class MultiResourceManager:
         for name, mgr in to_stop.items():
             log.info("resource %s vanished; stopping its plugin", self.resource_name(name))
             mgr.stop_all()
+        self._start_names(to_start)
+
+    def _retry_missing(self, why: str) -> None:
+        """Start-only reconcile: begin every wanted-but-not-running resource.
+        Retry paths must NEVER derive a stop-set from a snapshot of _wanted —
+        a concurrent discover publish may have grown it, and stopping from
+        the stale view would silently unregister the new resource until the
+        next unrelated list change (listers only publish on change)."""
+        with self._lock:
+            if self._stop.is_set():
+                return
+            missing = sorted(self._wanted - set(self._managers) - self._starting)
+            for name in missing:
+                self._starting.add(name)
+        if missing:
+            log.info(
+                "%s; retrying %s",
+                why,
+                [self.resource_name(n) for n in missing],
+            )
+            self._start_names(missing)
+
+    def _start_names(self, to_start: list[str]) -> None:
         for name in to_start:
             try:
                 mgr = PluginManager(
@@ -221,11 +268,11 @@ class MultiResourceManager:
             except Exception:
                 with self._lock:
                     self._starting.discard(name)
-                # Not dropped forever: the name stays in _wanted, and the
-                # kubelet-create event retries it (see _on_kubelet_create).
+                # Not dropped forever: the name stays in _wanted; the
+                # kubelet-create event and the retry timer both re-attempt
+                # it (see _retry_missing).
                 log.exception(
-                    "failed to start plugin for %s (will retry when the "
-                    "kubelet socket next appears)",
+                    "failed to start plugin for %s (will retry)",
                     self.resource_name(name),
                 )
                 continue
@@ -264,16 +311,8 @@ class MultiResourceManager:
             mgr.handle_kubelet_create()
         # Wanted resources with no running manager (their start failed while
         # the kubelet was down) get another chance now that it's back —
-        # without this they'd be dropped until the next discover publish.
-        with self._lock:
-            wanted = set(self._wanted)
-            missing = wanted - set(self._managers) - self._starting
-        if missing:
-            log.info(
-                "kubelet is back; retrying %s",
-                sorted(self.resource_name(n) for n in missing),
-            )
-            self.publish(wanted)
+        # without this they'd wait for the retry timer's next tick.
+        self._retry_missing("kubelet is back")
 
     def _on_kubelet_remove(self) -> None:
         for mgr in self._snapshot():

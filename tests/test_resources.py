@@ -247,6 +247,28 @@ def test_failed_start_retried_when_kubelet_appears(host_root, tmp_path):
             kubelet.stop()
 
 
+def test_failed_start_retried_on_timer_without_events(host_root, kubelet, monkeypatch):
+    """Kubelet UP but REJECTING registration (version skew mid-upgrade): the
+    socket never flaps, so no create event will ever retry the failed start —
+    recovery must ride the retry timer, exactly like PluginManager's
+    reconciler does for the single-resource path."""
+    lister = PushLister(host_root)
+    multi = make_multi(lister, kubelet, register_retries=1)
+    multi.start()
+    try:
+        assert lister.published.wait(5)
+        monkeypatch.setattr(constants, "VERSION", "v0alpha1")
+        lister.publish(["tpu"])
+        assert wait_until(lambda: multi.resources() == [], timeout=5)
+        # "Upgrade" the plugin; NO filesystem event fires from here on.
+        monkeypatch.setattr(constants, "VERSION", "v1beta1")
+        assert wait_until(lambda: multi.resources() == ["tpu"], timeout=10)
+        assert kubelet.registered.wait(5)
+        assert multi.alive()
+    finally:
+        multi.stop_all()
+
+
 def test_discover_crash_flips_liveness(host_root, kubelet):
     class CrashingLister(PushLister):
         def discover(self, publish, stop):
