@@ -157,6 +157,8 @@ class MultiResourceManager:
         reconciliation is gone for good, so /healthz must go red."""
         if self._stop.is_set() or self._discover_failed:
             return False
+        if self._retry_thread is not None and not self._retry_thread.is_alive():
+            return False  # failed starts would never be retried again
         return self._watcher is not None and self._watcher.is_alive()
 
     def stop_all(self) -> None:
