@@ -112,7 +112,11 @@ def paged_parity():
         q, pk, pv, table, lens = _pool_setup(b, h, kv, d, ps, mpp, fill)
         got = jax.device_get(
             paged_attention(
-                q, pk, pv, table, lens, window=window, interpret=False
+                q, pk, pv, table, lens, window=window,
+                # Interpret ONLY on the CPU smoke: anything accelerator-shaped
+                # (tpu, the axon relay) must prove the Mosaic lowering, which
+                # is this section's whole point.
+                interpret=jax.default_backend() == "cpu",
             )
         ).astype(np.float32)
         want = jax.device_get(_gather_oracle(q, pk, pv, table, lens, window))
@@ -139,7 +143,9 @@ def int8_parity():
         got = jax.device_get(
             paged_attention(
                 q, pk8, pv8, table, lens, scale_k=sk, scale_v=sv,
-                window=window, interpret=False,
+                window=window,
+                # See paged_parity: interpret only for the CPU smoke.
+                interpret=jax.default_backend() == "cpu",
             )
         ).astype(np.float32)
         pkf = dequantize_kv(pk8, sk, jnp.float32)
