@@ -1,9 +1,47 @@
-import time, jax, jax.numpy as jnp
+"""Liveness probe for the tunneled TPU backend.
+
+Two stages:
+1. TCP preflight on the relay's loopback ports (the axon PJRT client
+   dials 127.0.0.1:8082 for the session and :8083 for jax.devices()).
+   Connection refused means the tunnel listener is absent — the r5 wedge
+   diagnosis (ss shows no listener; the jax dial then retry-loops for
+   minutes) — so exit fast instead of paying the 100 s jax probe.
+2. The real thing: jax.devices() + a jitted matmul fetched via
+   device_get (block_until_ready is not a sync point on this backend —
+   BASELINE.md measurement methodology).
+"""
+import socket
+import sys
+import time
+
+def _connect(port: int) -> bool:
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.close()
+        print(f"tcp preflight: listener on 127.0.0.1:{port}")
+        return True
+    except OSError as e:
+        print(f"tcp preflight: 127.0.0.1:{port} -> {e}")
+        return False
+
+
+# :8083 is mandatory — jax.devices() dials it, so a refused connect there
+# guarantees the jax probe below cannot succeed; exit fast.  :8082 refusal
+# is only logged (the claim leg is deferred; half-up states fall through
+# to the real probe, whose outer timeout still bounds them).
+if not _connect(8083):
+    print("relay :8083 listener ABSENT — backend down")
+    sys.exit(2)
+_connect(8082)
+
+import jax
+import jax.numpy as jnp
+
 t0 = time.time()
 d = jax.devices()
-print("devices:", d, "in", round(time.time()-t0,1), "s")
-x = jnp.ones((1024,1024), jnp.bfloat16)
+print("devices:", d, "in", round(time.time() - t0, 1), "s")
+x = jnp.ones((1024, 1024), jnp.bfloat16)
 f = jax.jit(lambda a: (a @ a).sum())
 t1 = time.time()
 v = jax.device_get(f(x))
-print("matmul ok:", float(v), "in", round(time.time()-t1,1), "s")
+print("matmul ok:", float(v), "in", round(time.time() - t1, 1), "s")
