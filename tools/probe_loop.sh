@@ -1,8 +1,9 @@
 #!/bin/bash
-# Round-5 probe loop: probe the relay every ~10 min; on the first live
-# probe, fire the full hardware session queue (tools/hw_session.sh) and
-# exit.  A wedge mid-session keeps earlier results (each item is
-# time-boxed inside hw_session.sh).  Usage: tools/probe_loop.sh [logfile]
+# Round-5 probe loop: probe the relay every ~60s (cheap: probe_tpu's TCP
+# preflight makes a dead probe cost ~1s); on the first live probe, fire
+# the full hardware session queue (tools/hw_session.sh) and exit.  A
+# wedge mid-session keeps earlier results (each item is time-boxed
+# inside hw_session.sh).  Usage: tools/probe_loop.sh [logfile]
 LOG=$(realpath -m "${1:-/tmp/probe_loop_r5.log}")
 cd "$(dirname "$0")/.."
 . tools/_env.sh
@@ -21,9 +22,9 @@ while true; do
     # the watch alive; re-running a partially-complete session is safe
     # (each item overwrites its own results).
     [ "$rc" -eq 0 ] && exit 0
-    sleep 600
+    sleep 60
     continue
   fi
   echo "probe #$n dead $(date -u +%T)" >> "$LOG"
-  sleep 600
+  sleep 60
 done
