@@ -59,21 +59,26 @@ class PagedConfig:
     # Sliding windows mask inside the kernel (attention_window composes),
     # and int8 KV pools (quant_kv) stream as int8 with their scale pools
     # riding along — half the decode traffic.
-    # None = auto: the kernel on TPU backends (Mosaic-proven and faster on
-    # hardware — round-3 session 2 measured +19 ms/step at b8 over the
-    # gather path, BASELINE.md), the gather path on CPU (where the kernel
-    # would run under the slow Pallas interpreter).  The int8-pool variant
-    # (quant_kv) is interpreter-parity-proven but its Mosaic lowering has
-    # NOT yet run on hardware (the relay wedged first — BASELINE.md
-    # queue), so auto keeps quant_kv on gather until a session proves it;
-    # explicit True forces the kernel for it too (interpreter off TPU —
-    # what the parity tests pin); explicit False forces gather.
+    # None = auto: the GATHER path everywhere.  Round-5 hardware (the
+    # first session with the r4 in-program-table engine, BASELINE.md)
+    # measured the kernel LOSING to XLA's gather+einsum both standalone
+    # (0.82-0.91x at len 512-2048, ps 16/32) and at the engine step
+    # (-56 ms/step at b8) — round 3's +19 ms/step kernel win predates the
+    # r4 rework that made the gather path cheap, and at these shapes the
+    # gather's over-read is small (max_pages*ps vs len: ~1.25x at the
+    # measured configs).  The kernel's O(len) traffic wins when
+    # max_len >> typical len (long-context pools); force it there with
+    # use_kernel=True (Mosaic-proven for bf16 AND int8 pools — round-5
+    # parity maxerr <= 5.9e-3 across GQA/window/d128).  The engine-level
+    # int8 kernel-vs-dequant-gather A/B (hw_sweep int8_ab) was cut off by
+    # the 09:37 UTC relay wedge; until it lands, auto stays gather for
+    # quant_kv too.  Explicit False forces gather.
     use_kernel: bool | None = None
 
     def kernel_enabled(self, quant_kv: bool = False) -> bool:
         """Resolve the tri-state ``use_kernel`` at trace time."""
         if self.use_kernel is None:
-            return not quant_kv and jax.default_backend() == "tpu"
+            return False
         return self.use_kernel
 
     @property
@@ -138,7 +143,8 @@ class GPTConfig:
     # decode reads/writes page-table-indirected pool slabs instead of one
     # dense [batch, max_seq] cache.  Single-token decode steps only — the
     # engine prefills through the dense path and grafts the rows into
-    # pages.  Mutually exclusive with quant_kv this round.
+    # pages.  Composes with quant_kv (int8 pools + scale pools; the r2
+    # exclusion closed in r3 — tests/test_engine.py pins both paths).
     paged: Optional[PagedConfig] = None
 
     @property

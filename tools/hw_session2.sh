@@ -1,0 +1,31 @@
+#!/bin/bash
+# Follow-up hardware queue (round 5, window 2): the items the 09:37 UTC
+# wedge killed or that want a second sample.  Same shape as
+# tools/hw_session.sh — preflight probe, per-item time boxes, results
+# append to the shared session log so BASELINE.md edits read one file.
+#
+#   1. int8_ab    — the int8-gate decision A/B (bf16/kv8-gather/kv8-kernel
+#                   engine steps); Mosaic parity already passed 09:10 UTC.
+#   2. engine_ab  — second sample of the kernel-vs-gather reversal
+#                   (window 1 measured gather +56 ms/step ahead, the
+#                   OPPOSITE of r3's +19 kernel win; one repeat decides
+#                   the bf16 auto-route).
+#
+# Usage: tools/hw_session2.sh [logfile]
+LOG=$(realpath -m "${1:-/tmp/hw_session_r5.log}")
+cd "$(dirname "$0")/.."
+. tools/_env.sh
+if ! timeout 100 python tools/probe_tpu.py >> "$LOG" 2>&1; then
+  echo "PREFLIGHT FAILED: accelerator probe dead — aborting session" | tee -a "$LOG"
+  exit 1
+fi
+run() {
+  name="$1"; tmo="$2"; shift 2
+  echo "=== [$name] start $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+  timeout "$tmo" "$@" >> "$LOG" 2>&1
+  echo "=== [$name] done rc=$? $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+}
+echo "HW SESSION-2 START $(date -u)" | tee -a "$LOG"
+run int8_ab   1800 python tools/hw_sweep.py int8_ab
+run engine_ab 1200 python tools/hw_sweep.py engine_ab
+echo "HW SESSION-2 END $(date -u)" | tee -a "$LOG"

@@ -223,16 +223,10 @@ def bwd_sweep():
             log(f"bwd sweep q{bq}/kv{bkv}: failed ({e})")
 
 
-@section("engine_ab")
-def engine_ab():
-    from k8s_device_plugin_tpu.models.engine import ServingEngine
-    from k8s_device_plugin_tpu.models.transformer import (
-        GPTConfig,
-        PagedConfig,
-        TransformerLM,
-    )
+def _engine_cfg(**overrides):
+    from k8s_device_plugin_tpu.models.transformer import GPTConfig
 
-    cfg = GPTConfig(
+    return GPTConfig(
         vocab_size=32000,
         hidden_size=1024,
         num_layers=2,
@@ -240,7 +234,42 @@ def engine_ab():
         intermediate_size=2816,
         max_seq=2048,
         num_kv_heads=4,
+        **overrides,
     )
+
+
+def _engine_decode_dt(cfg, params, paged, slots, prompt_len, steps):
+    """Steady-state decode seconds/step for one ServingEngine config
+    (shared by engine_ab and int8_ab).  Each host-driven step pays one
+    relay RTT; compare DELTAS between arms (identical everything else),
+    not raw values."""
+    from k8s_device_plugin_tpu.models.engine import ServingEngine
+
+    eng = ServingEngine(cfg, params, paged, max_slots=slots)
+    for i in range(slots):
+        eng.submit(
+            list(np.random.default_rng(i).integers(0, 32000, prompt_len)),
+            max_new_tokens=120,
+        )
+    eng.step()  # admission + prefill + first decode
+    eng.step()  # settle into pure decode
+    for _ in range(3):  # warm
+        eng.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        eng.step()
+    return (time.perf_counter() - t0) / steps
+
+
+@section("engine_ab")
+def engine_ab():
+    from k8s_device_plugin_tpu.models.engine import ServingEngine
+    from k8s_device_plugin_tpu.models.transformer import (
+        PagedConfig,
+        TransformerLM,
+    )
+
+    cfg = _engine_cfg()
     rng = jax.random.PRNGKey(0)
     params = TransformerLM(cfg).init(rng, jnp.zeros((1, 2), jnp.int32))["params"]
     slots, prompt_len, steps = 8, 512, 40
@@ -253,24 +282,7 @@ def engine_ab():
             max_pages_per_seq=40,
             use_kernel=use_kernel,
         )
-        eng = ServingEngine(cfg, params, paged, max_slots=slots)
-        prompts = [
-            (list(np.random.default_rng(i).integers(0, 32000, prompt_len)), 120)
-            for i in range(slots)
-        ]
-        for p, n in prompts:
-            eng.submit(p, max_new_tokens=n)
-        eng.step()  # admission + prefill + first decode
-        eng.step()  # settle into pure decode
-        # Warm + timed host-driven decode steps.  Each pays one relay RTT;
-        # the kernel-vs-gather DELTA is RTT-free (identical everything
-        # else).
-        for _ in range(3):
-            eng.step()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            eng.step()
-        dt = (time.perf_counter() - t0) / steps
+        dt = _engine_decode_dt(cfg, params, paged, slots, prompt_len, steps)
         results[use_kernel] = dt
         log(
             f"engine step ({'kernel' if use_kernel else 'gather'}): "
@@ -320,6 +332,56 @@ def engine_ab():
         log(
             f"engine decode_block={block}: {dt/n_disp*1e3:.2f} ms/dispatch, "
             f"{toks/dt:.0f} tokens/sec (b{slots}, incl. relay RTT)"
+        )
+
+
+@section("int8_ab")
+def int8_ab():
+    """quant_kv engine A/B (the int8 gate decision, VERDICT r4 #3):
+    steady-state decode step with int8 KV pools read through (a) the
+    dequantize-then-gather path vs (b) the int8-pool Pallas kernel
+    (Mosaic parity proven by int8_parity).  A bf16-gather arm runs in
+    the same window so the "w8+kv8 vs bf16" ratio shares one RTT
+    regime.  Same harness as engine_ab; the kernel-vs-gather DELTA is
+    RTT-free."""
+    import dataclasses
+
+    from k8s_device_plugin_tpu.models.transformer import (
+        PagedConfig,
+        TransformerLM,
+    )
+
+    slots, prompt_len, steps = 8, 512, 40
+    base_cfg = _engine_cfg()
+    # quant_kv is cache-side only — one init serves all three arms.
+    params = TransformerLM(base_cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32)
+    )["params"]
+    results = {}
+    for label, quant_kv, use_kernel in [
+        ("bf16 gather", False, False),
+        ("kv8 gather", True, False),
+        ("kv8 kernel", True, True),
+    ]:
+        cfg = dataclasses.replace(base_cfg, quant_kv=quant_kv)
+        paged = PagedConfig(
+            page_size=16,
+            num_pages=slots * 40 + 8,
+            max_pages_per_seq=40,
+            use_kernel=use_kernel,
+        )
+        dt = _engine_decode_dt(cfg, params, paged, slots, prompt_len, steps)
+        results[label] = dt
+        log(
+            f"int8_ab {label}: {dt*1e3:.2f} ms/step, raw "
+            f"{slots/dt:.0f} tokens/sec (b{slots} len~{prompt_len}+; "
+            "includes relay RTT)"
+        )
+    if "kv8 gather" in results and "kv8 kernel" in results:
+        delta = (results["kv8 gather"] - results["kv8 kernel"]) * 1e3
+        log(
+            f"int8_ab kv8 kernel-vs-gather delta: {delta:+.2f} ms/step "
+            f"({'kernel wins' if delta > 0 else 'gather wins'}; RTT-free)"
         )
 
 
@@ -574,6 +636,7 @@ ALL = {
     "int8_parity": int8_parity,
     "bwd_sweep": bwd_sweep,
     "engine_ab": engine_ab,
+    "int8_ab": int8_ab,
     "spec_sweep": spec_sweep,
     "admission_ab": admission_ab,
     "resnet_flags": resnet_flags,
