@@ -418,6 +418,17 @@ class EngineServer:
             self.stop()
 
 
+def _resolve_decode_block(explicit: Optional[int], spec_gamma: int) -> int:
+    """Data-chosen default (round-5 hardware: 52/425/826 tokens/sec at
+    block 1/8/16, b8): 16 — unless speculation is on, which steps
+    per-token (the engine rejects the combination).  An explicit
+    --decode-block always wins (and the engine will reject an explicit
+    block > 1 combined with --spec-gamma)."""
+    if explicit is not None:
+        return explicit
+    return 1 if spec_gamma else 16
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     """In-pod HTTP serving entry (≙ deploy/k8s-pod-serve-gpt.yaml's batch
     CLI, but long-running): synthetic weights unless a checkpoint is
@@ -635,14 +646,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk,
-        # Data-chosen default (round-5 hardware: 52/425/826 tokens/sec at
-        # block 1/8/16, b8): 16 — unless speculation is on, which steps
-        # per-token (the engine rejects the combination).
-        decode_block=(
-            args.decode_block
-            if args.decode_block is not None
-            else (1 if args.spec_gamma else 16)
-        ),
+        decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
         admission=args.admission,
         **spec_kw,
     )

@@ -1761,3 +1761,22 @@ def test_decode_blocks_engage_while_page_blocked(rng):
     # whole drain well under one-step-per-token (56 tokens single-step
     # would need ~56 dispatches; blocked runs land ~20).
     assert steps <= 24, steps
+
+
+def test_use_kernel_auto_resolves_to_gather():
+    """Round-5 default flip: use_kernel=None means the gather path on
+    every backend (hardware measured XLA's gather faster at moderate
+    contexts — BASELINE.md round-5 window 1); the kernel is opt-in and,
+    when forced, covers int8 pools too (Mosaic parity proven r5)."""
+    auto = PagedConfig(page_size=4, num_pages=8, max_pages_per_seq=2)
+    assert auto.kernel_enabled() is False
+    assert auto.kernel_enabled(quant_kv=True) is False
+    forced = PagedConfig(
+        page_size=4, num_pages=8, max_pages_per_seq=2, use_kernel=True
+    )
+    assert forced.kernel_enabled() is True
+    assert forced.kernel_enabled(quant_kv=True) is True
+    off = PagedConfig(
+        page_size=4, num_pages=8, max_pages_per_seq=2, use_kernel=False
+    )
+    assert off.kernel_enabled() is False

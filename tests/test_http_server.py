@@ -354,3 +354,18 @@ def test_n_choices_sampling(served):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(server.port, {"prompt": [3], "max_new_tokens": 2, "n": 99})
     assert e.value.code == 422
+
+
+def test_decode_block_cli_resolution():
+    """Round-5 data-chosen serving default: an unset --decode-block
+    resolves to 16, drops to 1 when --spec-gamma is set (the engine
+    rejects blocks+speculation), and an explicit value always wins."""
+    from k8s_device_plugin_tpu.models.http_server import _resolve_decode_block
+
+    assert _resolve_decode_block(None, 0) == 16
+    assert _resolve_decode_block(None, 2) == 1
+    assert _resolve_decode_block(8, 0) == 8
+    # Explicit block + speculation is passed through for the ENGINE to
+    # reject — resolution must not silently override an operator choice.
+    assert _resolve_decode_block(8, 2) == 8
+    assert _resolve_decode_block(1, 0) == 1
