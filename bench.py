@@ -22,6 +22,10 @@ Whatever happens, stage 1 prints exactly ONE JSON line on stdout and exits 0:
      "vs_baseline": N, "platform": "tpu"|"cpu"|"none", "error": null|str,
      "attempts": [...]}
 
+A non-tpu record additionally carries "builder_tpu_reference": the last
+builder-session hardware measurement (LAST_TPU_BENCH.json), clearly
+labeled as context — value/platform above stay the fresh measurement.
+
 `vs_baseline` is honest (VERDICT r1 weak #3): the measured value divided by
 the best prior accelerator number found in BENCH_r*.json at the repo root,
 or — when no prior round produced one — the stated round target
@@ -912,6 +916,21 @@ def _try_attempt(label: str, jax_platforms: str | None, timeout: float):
     return None, f"{label}: exit={proc.returncode}, no JSON line after {dt:.0f}s"
 
 
+def _attach_builder_reference(d: dict) -> dict:
+    """When this run could not reach the accelerator, attach the last
+    builder-session TPU measurement (LAST_TPU_BENCH.json, written after a
+    live `tools/hw_session.sh` window) as clearly-labeled CONTEXT — the
+    driver's own `value`/`platform` stay the honest fresh measurement."""
+    if d.get("platform") == "tpu":
+        return d
+    try:
+        with open(os.path.join(_REPO_ROOT, "LAST_TPU_BENCH.json")) as f:
+            d["builder_tpu_reference"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    return d
+
+
 def main() -> None:
     if "--inner" in sys.argv:
         _inner()
@@ -954,24 +973,26 @@ def main() -> None:
             own = result.get("error")
             result["error"] = "; ".join(errors + ([own] if own else [])) or None
             result["attempts"] = tried
-            print(json.dumps(result), flush=True)
+            print(json.dumps(_attach_builder_reference(result)), flush=True)
             return
         errors.append(err)
         print(f"bench attempt failed — {err}", file=sys.stderr, flush=True)
     baseline, baseline_src = _baseline_value()
     print(
         json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "images/sec/chip",
-                "vs_baseline": 0.0,
-                "baseline": baseline,
-                "baseline_src": baseline_src,
-                "platform": "none",
-                "error": "; ".join(errors),
-                "attempts": tried,
-            }
+            _attach_builder_reference(
+                {
+                    "metric": "resnet50_train_images_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": 0.0,
+                    "baseline": baseline,
+                    "baseline_src": baseline_src,
+                    "platform": "none",
+                    "error": "; ".join(errors),
+                    "attempts": tried,
+                }
+            )
         ),
         flush=True,
     )
