@@ -69,3 +69,16 @@ def test_bench_emits_json_and_exit0_even_when_all_backends_hang():
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip"
     assert rec["platform"] in ("none", "cpu", "tpu")
     assert "vs_baseline" in rec and "error" in rec
+
+
+def test_attach_builder_reference_on_fallback_only():
+    """A CPU/none fallback record carries the last builder-session TPU
+    measurement as labeled context (round-5: a round-end relay wedge must
+    not erase the round's hardware evidence); a tpu record stays clean."""
+    d = bench._attach_builder_reference({"platform": "cpu", "value": 1.6})
+    ref = d.get("builder_tpu_reference")
+    assert ref is not None and ref["parsed"]["platform"] == "tpu"
+    assert ref["parsed"]["value"] > 0
+    assert "note" in ref  # provenance label, not a bare number
+    clean = bench._attach_builder_reference({"platform": "tpu", "value": 2596.6})
+    assert "builder_tpu_reference" not in clean
