@@ -161,9 +161,24 @@ def _inner() -> None:
     # fallback attempt still dials the (possibly hung) tunnel.
     # empty_is_auto: JAX_PLATFORMS="" (the "auto" attempt) must also
     # override the pin, meaning auto-select.
-    from k8s_device_plugin_tpu.utils.platform import honor_jax_platforms_env
+    from k8s_device_plugin_tpu.utils.platform import (
+        enable_compilation_cache,
+        honor_jax_platforms_env,
+    )
 
     honor_jax_platforms_env(empty_is_auto=True)
+    # Persistent XLA compilation cache (best-effort, no-op if the backend
+    # can't serialize executables): accelerator programs here compile in
+    # 100-155 s through the relay, and the 2200 s attempt window has
+    # twice been eaten by recompiles of programs an earlier same-machine
+    # run already built.  Caching affects compile time only — all timed
+    # regions start after warmup executions.  Opt out with
+    # BENCH_COMPILATION_CACHE_DIR="".
+    enable_compilation_cache(
+        os.environ.get(
+            "BENCH_COMPILATION_CACHE_DIR", "/tmp/k8s_dp_tpu_xla_cache"
+        )
+    )
 
     import jax.numpy as jnp
     import optax
