@@ -28,4 +28,9 @@ run() {
 echo "HW SESSION-2 START $(date -u)" | tee -a "$LOG"
 run int8_ab   1800 python tools/hw_sweep.py int8_ab
 run engine_ab 1200 python tools/hw_sweep.py engine_ab
+#   3. bench re-run — a fresh headline under the flipped defaults AND a
+#      warm persistent compilation cache (bench.py enables it), so the
+#      driver's round-end bench.py skips the 100-155 s relay compiles
+#      that have twice eaten its 2200 s window.
+run bench     2700 python bench.py
 echo "HW SESSION-2 END $(date -u)" | tee -a "$LOG"
