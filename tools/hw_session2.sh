@@ -33,4 +33,8 @@ run engine_ab 1200 python tools/hw_sweep.py engine_ab
 #      driver's round-end bench.py skips the 100-155 s relay compiles
 #      that have twice eaten its 2200 s window.
 run bench     2700 python bench.py
+#   4. paged_regime — map the kernel-vs-gather crossover over pool
+#      over-read ratios 1-16 (the >=3 regime is the use_kernel=True
+#      recommendation's unmeasured half).
+run paged_regime 1500 python tools/hw_sweep.py paged_regime
 echo "HW SESSION-2 END $(date -u)" | tee -a "$LOG"

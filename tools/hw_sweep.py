@@ -385,6 +385,59 @@ def int8_ab():
         )
 
 
+@section("paged_regime")
+def paged_regime():
+    """Map the kernel-vs-gather crossover over the pool over-read ratio
+    (docs/serving.md rule of thumb, unmeasured ≥3 regime): fixed
+    len=512, ps=16, ratio = max_pages*ps/len ∈ {1, 2, 4, 8, 16}.  The
+    gather path reads max_pages*ps tokens per row regardless of length;
+    the kernel reads ceil(len/ps) pages — its O(len) advantage should
+    overtake its ~2× per-token cost near ratio 3."""
+    from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
+
+    b, h, kv, d, ps, fill = 4, 16, 4, 64, 16, 512
+    iters = 2 if jax.default_backend() == "cpu" else 30
+    for ratio in (1, 2, 4, 8, 16):
+        mpp = ratio * fill // ps
+        q, pk, pv, table, lens = _pool_setup(b, h, kv, d, ps, mpp, fill)
+
+        def gather_ref(qq):
+            kr = pk[table].reshape(b, mpp * ps, kv, d)
+            vr = pv[table].reshape(b, mpp * ps, kv, d)
+            qg = qq.reshape(b, kv, h // kv, 1, d)
+            s = jnp.einsum(
+                "bhgqd,bkhd->bhgqk", qg, kr,
+                preferred_element_type=jnp.float32,
+            ) * (d**-0.5)
+            mask = (
+                jnp.arange(mpp * ps)[None, None, None, None, :]
+                < lens[:, None, None, None, None]
+            )
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(vr.dtype)
+            return jnp.einsum("bhgqk,bkhd->bhgqd", p, vr).reshape(b, h, d)
+
+        try:
+            t_k = timed_chain(
+                lambda qq: paged_attention(
+                    qq, pk, pv, table, lens,
+                    interpret=jax.default_backend() == "cpu",
+                ).astype(qq.dtype),
+                q,
+                iters,
+            )
+            t_g = timed_chain(
+                lambda qq: gather_ref(qq).astype(qq.dtype), q, iters
+            )
+            log(
+                f"paged regime ratio {ratio:2d} (pool {mpp*ps}, len {fill}): "
+                f"kernel {t_k*1e6:.0f} us vs gather {t_g*1e6:.0f} us "
+                f"({t_g/t_k:.2f}x)"
+            )
+        except Exception as e:
+            log(f"paged regime ratio {ratio}: failed ({e})")
+
+
 @section("spec_sweep")
 def spec_sweep():
     """Speculative-decoding win-or-gate grid (BASELINE queue #5): the w8
@@ -637,6 +690,7 @@ ALL = {
     "bwd_sweep": bwd_sweep,
     "engine_ab": engine_ab,
     "int8_ab": int8_ab,
+    "paged_regime": paged_regime,
     "spec_sweep": spec_sweep,
     "admission_ab": admission_ab,
     "resnet_flags": resnet_flags,
