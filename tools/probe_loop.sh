@@ -1,10 +1,12 @@
 #!/bin/bash
-# Round-5 probe loop: probe the relay every ~60s (cheap: probe_tpu's TCP
+# Probe loop: probe the relay every ~60s (cheap: probe_tpu's TCP
 # preflight makes a dead probe cost ~1s); on the first live probe, fire
-# the full hardware session queue (tools/hw_session.sh) and exit.  A
-# wedge mid-session keeps earlier results (each item is time-boxed
-# inside hw_session.sh).  Usage: tools/probe_loop.sh [logfile]
+# a hardware session queue and exit.  A wedge mid-session keeps earlier
+# results (each item is time-boxed inside the session script).
+# Usage: tools/probe_loop.sh [logfile] [session-script]
+#   e.g.  tools/probe_loop.sh /tmp/probe.log tools/hw_session2.sh
 LOG=$(realpath -m "${1:-/tmp/probe_loop_r5.log}")
+SESSION="${2:-tools/hw_session.sh}"
 cd "$(dirname "$0")/.."
 . tools/_env.sh
 n=0
@@ -12,8 +14,8 @@ while true; do
   n=$((n+1))
   echo "--- probe #$n $(date -u +%F' '%T) ---" >> "$LOG"
   if timeout 100 python tools/probe_tpu.py >> "$LOG" 2>&1; then
-    echo "=== PROBE LIVE at $(date -u) — firing hw_session ===" | tee -a "$LOG"
-    tools/hw_session.sh /tmp/hw_session_r5.log
+    echo "=== PROBE LIVE at $(date -u) — firing $SESSION ===" | tee -a "$LOG"
+    "$SESSION" /tmp/hw_session_r5.log
     rc=$?
     echo "=== hw_session rc=$rc $(date -u) ===" | tee -a "$LOG"
     # Only a clean rc=0 means the queue ran to its end.  Anything else —
