@@ -931,18 +931,31 @@ def _try_attempt(label: str, jax_platforms: str | None, timeout: float):
     return None, f"{label}: exit={proc.returncode}, no JSON line after {dt:.0f}s"
 
 
-def _attach_builder_reference(d: dict) -> dict:
+def _attach_builder_reference(d: dict, root: str = _REPO_ROOT) -> dict:
     """When this run could not reach the accelerator, attach the last
     builder-session TPU measurement (LAST_TPU_BENCH.json, written after a
     live `tools/hw_session.sh` window) as clearly-labeled CONTEXT — the
-    driver's own `value`/`platform` stay the honest fresh measurement."""
+    driver's own `value`/`platform` stay the honest fresh measurement.
+
+    Only a record that actually carries a hardware number qualifies
+    (parsed.platform == "tpu" with value > 0, ADVICE.md round 5): a
+    stale or mangled file attaching a CPU smoke or a zeroed fallback as
+    "the TPU reference" would be worse than attaching nothing."""
     if d.get("platform") == "tpu":
         return d
     try:
-        with open(os.path.join(_REPO_ROOT, "LAST_TPU_BENCH.json")) as f:
-            d["builder_tpu_reference"] = json.load(f)
+        with open(os.path.join(root, "LAST_TPU_BENCH.json")) as f:
+            ref = json.load(f)
     except (OSError, ValueError):
-        pass
+        return d
+    parsed = ref.get("parsed") if isinstance(ref, dict) else None
+    if (
+        isinstance(parsed, dict)
+        and parsed.get("platform") == "tpu"
+        and isinstance(parsed.get("value"), (int, float))
+        and parsed["value"] > 0
+    ):
+        d["builder_tpu_reference"] = ref
     return d
 
 

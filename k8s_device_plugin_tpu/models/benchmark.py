@@ -383,6 +383,87 @@ def run_decode(args) -> None:
     )
 
 
+def run_serving(args) -> None:
+    """Continuous-batching serving benchmark through the SAME telemetry
+    operators scrape: the TTFT/ITL percentiles in the JSON line are read
+    back from the EngineMetrics histograms on the registry (PromQL-style
+    bucket interpolation, utils/metrics.py Histogram.quantile), not from
+    a parallel stopwatch path — so BENCH rounds and Grafana dashboards
+    report the same numbers, and a drift between them is itself a bug."""
+    import math
+
+    from ..utils.metrics import MetricsRegistry
+    from ..utils.spans import SpanRecorder
+    from .engine import EngineMetrics, ServingEngine
+    from .transformer import PagedConfig, TransformerLM
+
+    import dataclasses
+
+    page_size = 16
+    mpp = math.ceil((args.prompt_len + args.decode_tokens) / page_size)
+    paged = PagedConfig(
+        page_size,
+        num_pages=args.slots * mpp + 1,
+        max_pages_per_seq=mpp,
+    )
+    cfg = dataclasses.replace(_gpt_config(args), max_seq=paged.max_len)
+    rng = jax.random.PRNGKey(0)
+    params = TransformerLM(cfg).init(
+        rng, jnp.zeros((1, 2), jnp.int32)
+    )["params"]
+    registry = MetricsRegistry()
+    spans = SpanRecorder()
+    eng = ServingEngine(
+        cfg,
+        params,
+        paged,
+        max_slots=args.slots,
+        metrics=EngineMetrics(registry),
+        spans=spans,
+    )
+    jobs = [
+        (
+            [(11 * i + j) % cfg.vocab_size for j in range(args.prompt_len)],
+            args.decode_tokens,
+        )
+        for i in range(args.requests)
+    ]
+    # Warmup compiles prefill + step outside the timed region (the repo's
+    # measurement-honesty rule); the histogram snapshots below subtract
+    # its compile-dominated observations from the reported quantiles.
+    eng.run([(jobs[0][0], 2)])
+    ttft_h, itl_h = eng.metrics.ttft_seconds, eng.metrics.itl_seconds
+    ttft_snap, itl_snap = ttft_h.snapshot(), itl_h.snapshot()
+
+    def _ms(value):
+        return None if value is None else round(value * 1e3, 3)
+
+    t0 = time.perf_counter()
+    done = eng.run(jobs)
+    dt = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) for r in done)
+    print(
+        json.dumps(
+            {
+                "model": "serving",
+                "chips": len(jax.devices()),
+                "slots": args.slots,
+                "requests": len(done),
+                "prompt_len": args.prompt_len,
+                "new_tokens": args.decode_tokens,
+                "throughput": round(tokens / dt, 2),
+                "unit": "tokens/sec (continuous batching, warm)",
+                "ttft_p50_ms": _ms(ttft_h.quantile(0.5, since=ttft_snap)),
+                "ttft_p99_ms": _ms(ttft_h.quantile(0.99, since=ttft_snap)),
+                "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),
+                "itl_p99_ms": _ms(itl_h.quantile(0.99, since=itl_snap)),
+                "spans_recorded": len(spans.snapshot()) + spans.dropped,
+            }
+        ),
+        flush=True,
+    )
+
+
 def run_pipelined(args) -> None:
     """Decoder-LM training through the pipelined path (--pp stages) —
     the in-pod way to exercise pp on a multi-chip allocation, with either
@@ -440,7 +521,10 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="tpu-benchmark")
     p.add_argument(
         "--model",
-        choices=["alexnet", "resnet50", "vit", "bert", "gpt", "gpt-decode"],
+        choices=[
+            "alexnet", "resnet50", "vit", "bert", "gpt", "gpt-decode",
+            "serving",
+        ],
         default="resnet50",
     )
     p.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
@@ -477,8 +561,20 @@ def main(argv: list[str] | None = None) -> None:
         "(ops/fused_xent.py) — the [batch, seq, vocab] logits tensor "
         "never materializes",
     )
-    p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode prompt")
-    p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode new tokens")
+    p.add_argument("--prompt-len", type=_positive_int, default=64, help="gpt-decode/serving prompt")
+    p.add_argument("--decode-tokens", type=_positive_int, default=128, help="gpt-decode/serving new tokens")
+    p.add_argument(
+        "--slots",
+        type=_positive_int,
+        default=4,
+        help="serving: engine decode slots (continuous-batching width)",
+    )
+    p.add_argument(
+        "--requests",
+        type=_positive_int,
+        default=16,
+        help="serving: synthetic requests pushed through the engine",
+    )
     p.add_argument(
         "--temperature",
         type=float,
@@ -587,6 +683,10 @@ def main(argv: list[str] | None = None) -> None:
 
     if args.model == "gpt-decode":
         run_decode(args)
+        return
+
+    if args.model == "serving":
+        run_serving(args)
         return
 
     if args.pp > 1:

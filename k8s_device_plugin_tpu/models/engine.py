@@ -38,8 +38,10 @@ Module layout (round-4 split; this module remains the import surface):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
+import time
 from collections import deque
 from typing import Any, Optional
 
@@ -62,6 +64,7 @@ from .engine_types import (  # noqa: F401  (re-export: public surface)
     Request,
     _pow2_int,
 )
+from ..utils.spans import ENGINE_TRACE, SpanRecorder
 from .transformer import (
     GPTConfig,
     PagedConfig,
@@ -107,6 +110,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         decode_block: int = 1,
         admission: str = "reserve",
         racecheck: bool = False,
+        spans: Optional[SpanRecorder] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -316,6 +320,11 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         # step time (~100us) is comparable to one transfer.
         self._dev: Optional[dict] = None
         self.metrics = metrics
+        # Request-scoped tracing (utils/spans.py): None = off, zero cost.
+        # Per-slot monotonic stamp of the slot's last emitted token — the
+        # inter-token-latency anchor (reset at activation and teardown).
+        self.spans = spans
+        self._slot_emit_t: list[float] = [0.0] * max_slots
         # Prefix sharing: K/V are a deterministic function of (params,
         # prompt tokens), so FULL pages covering a common prompt prefix are
         # byte-identical across requests and can be shared read-only —
@@ -502,6 +511,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         out = np.asarray(out)
         lps = np.asarray(lps)
+        now = time.monotonic()
         emitted_total = 0
         for s in active:
             req = self.slots[s]
@@ -524,6 +534,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 ):
                     break
             self._slot_len[s] += consumed
+            self._observe_itl(s, consumed, now)
             self._maybe_finish(s)
             if req.done:
                 finished.append(req)
@@ -555,10 +566,16 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         """Admit what fits, advance every active slot one token; returns
         every request that finished this step (including ones done at
         admission — EOS/max_new on the prefill token)."""
-        if self.metrics:
-            with self.metrics.step_seconds.time():
-                return self._step_inner()
-        return self._step_inner()
+        span = (
+            self.spans.span("engine.step", trace_id=ENGINE_TRACE)
+            if self.spans
+            else contextlib.nullcontext()
+        )
+        with span:
+            if self.metrics:
+                with self.metrics.step_seconds.time():
+                    return self._step_inner()
+            return self._step_inner()
 
     def _step_inner(self) -> list[Request]:
         finished = self._admit()
@@ -653,6 +670,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
+        now = time.monotonic()
         for s in active:
             req = self.slots[s]
             tok = int(nxt[s])
@@ -662,6 +680,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             req.tokens.append(tok)
             self._slot_last[s] = tok
             self._slot_len[s] += 1
+            self._observe_itl(s, 1, now)
             self._maybe_finish(s)
             if req.done:
                 finished.append(req)
@@ -675,6 +694,20 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._update_gauges()
         return finished
 
+    def _observe_itl(self, slot: int, consumed: int, now: float) -> None:
+        """Observe inter-token latency for ``consumed`` tokens that landed
+        at ``now`` on this slot.  Multi-token dispatches (decode blocks,
+        speculative rounds) emit several tokens in one host round-trip:
+        each observes the amortized gap dt/consumed, so the histogram sum
+        stays wall-accurate and per-token quantiles stay meaningful."""
+        last = self._slot_emit_t[slot]
+        self._slot_emit_t[slot] = now
+        if not self.metrics or consumed <= 0 or last <= 0.0:
+            return
+        per = (now - last) / consumed
+        for _ in range(consumed):
+            self.metrics.itl_seconds.observe(per)
+
     def _update_gauges(self) -> None:
         if not self.metrics:
             return
@@ -687,6 +720,70 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             self.metrics.shared_pages.set(
                 sum(1 for c in self._page_refs.values() if c > 1)
             )
+            allocatable = self.paged.num_pages - 1  # page 0 is scratch
+            self.metrics.page_utilization.set(
+                1.0 - len(self.free_pages) / allocatable if allocatable else 0.0
+            )
+
+    def debug_state(self) -> dict:
+        """JSON-safe engine snapshot for the /debug/state endpoint: what
+        an operator needs to see DURING an incident — slot occupancy,
+        queue depth, pool pressure, speculation counters — without
+        attaching a debugger to the serving loop.  Token CONTENT is
+        deliberately excluded (prompts are tenant data; lengths are not).
+        Thread-safe: reads the cross-thread state under the engine lock
+        (host lists owned by the step thread are read racily but are
+        plain scalars/lists — a torn read shows one step's drift)."""
+        with self._lock:
+            slots = []
+            for s in range(self.max_slots):
+                req = self.slots[s]
+                if req is None:
+                    slots.append(None)
+                    continue
+                slots.append(
+                    {
+                        "rid": req.rid,
+                        "trace_id": req.trace_id,
+                        "prompt_tokens": len(req.prompt),
+                        "generated": len(req.tokens),
+                        "max_new_tokens": req.max_new_tokens,
+                        "ready": self._slot_ready[s],
+                        "pages": len(self._slot_pages[s]),
+                        "cancelled": req.cancelled,
+                    }
+                )
+            allocatable = self.paged.num_pages - 1
+            return {
+                "slots": slots,
+                "queue_depth": len(self.queue),
+                "pending_prefills": len(self._pending),
+                "free_pages": len(self.free_pages),
+                "allocatable_pages": allocatable,
+                "page_utilization": round(
+                    1.0 - len(self.free_pages) / allocatable, 4
+                )
+                if allocatable
+                else 0.0,
+                "shared_pages": sum(
+                    1 for c in self._page_refs.values() if c > 1
+                ),
+                "preemptions": self.preemptions,
+                "spec": {
+                    "gamma": self._spec_gamma,
+                    "proposed": self.spec_proposed,
+                    "accepted": self.spec_accepted,
+                },
+                "config": {
+                    "max_slots": self.max_slots,
+                    "page_size": self.paged.page_size,
+                    "num_pages": self.paged.num_pages,
+                    "max_pages_per_seq": self.paged.max_pages_per_seq,
+                    "decode_block": self._decode_block,
+                    "admission": "optimistic" if self._optimistic else "reserve",
+                    "prefix_sharing": self.prefix_sharing,
+                },
+            }
 
     def run(self, requests: list[tuple[list[int], int]], **submit_kw) -> list[Request]:
         """Submit all (``submit_kw`` — temperature/top_k/top_p — applies to
@@ -842,8 +939,12 @@ def main(argv: Optional[list[str]] = None) -> None:
             spec_gamma=args.spec_gamma,
             draft_params=quantize_lm_params(params),
         )
+    from ..utils.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
+        metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
         admission=args.admission, **spec_kw,
     )
@@ -872,6 +973,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     # must cover the timed region only (same warmup-exclusion rule as the
     # throughput number).
     eng.spec_proposed = eng.spec_accepted = 0
+    # Latency percentiles come back from the SAME registry histograms
+    # operators scrape — snapshotted here so warmup (compile-dominated
+    # TTFTs of seconds) is subtracted from the reported quantiles.
+    ttft_h, itl_h = eng.metrics.ttft_seconds, eng.metrics.itl_seconds
+    ttft_snap, itl_snap = ttft_h.snapshot(), itl_h.snapshot()
+
+    def _ms(value):
+        return None if value is None else round(value * 1e3, 3)
 
     t0 = time.time()
     done = eng.run(jobs, **sample_kw)
@@ -899,6 +1008,10 @@ def main(argv: Optional[list[str]] = None) -> None:
                 else None,
                 "tokens": tokens,
                 "wall_s": round(dt, 2),
+                "ttft_p50_ms": _ms(ttft_h.quantile(0.5, since=ttft_snap)),
+                "ttft_p99_ms": _ms(ttft_h.quantile(0.99, since=ttft_snap)),
+                "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),
+                "itl_p99_ms": _ms(itl_h.quantile(0.99, since=itl_snap)),
             }
         ),
         file=sys.stdout,

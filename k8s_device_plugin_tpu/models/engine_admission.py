@@ -16,6 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils.spans import new_trace_id
 from .engine_sampling import _token_logprob, filter_top_k_top_p
 from .engine_types import Request
 from .transformer import decode_cache_spec
@@ -36,6 +37,7 @@ class AdmissionMixin:
         logprobs: bool = False,
         stop: Optional[list] = None,
         logit_bias: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -136,8 +138,17 @@ class AdmissionMixin:
                 prompt, max_new_tokens, temperature, top_k, top_p,
                 adapter=adapter, logprobs=logprobs, stop=stop,
                 logit_bias=logit_bias,
+                # Every request is traceable even when the caller didn't
+                # send an id — generated ids tie SSE events, spans, and
+                # log lines of one request together.
+                trace_id=trace_id or new_trace_id(),
                 rid=self._next_rid, submitted_at=time.monotonic(),
             )
+            if self.spans:
+                # Root span id reserved NOW so the queue/prefill/decode
+                # children (recorded from the owner thread) can parent on
+                # it before the root itself is recorded at finish.
+                req.root_span = self.spans.reserve_id()
             self._next_rid += 1
             self.queue.append(req)
             # Scrapes happen on the MetricsServer thread: reflect queue
@@ -343,6 +354,7 @@ class AdmissionMixin:
                     self._admit_page_blocked = True
                     break
                 self.queue.popleft()
+                req.admitted_at = time.monotonic()
                 # Refcounts and free-page moves stay under the lock too:
                 # _update_gauges (called from submit() on another thread)
                 # iterates _page_refs, and an unlocked resize here would
@@ -378,6 +390,18 @@ class AdmissionMixin:
                 self._slot_pages[slot] = pages
                 self._slot_seq[slot] = self._seq_counter
                 self._seq_counter += 1
+            if self.spans:
+                self.spans.record_span(
+                    "pages.alloc",
+                    req.trace_id,
+                    start_monotonic=req.admitted_at,
+                    parent_id=req.root_span,
+                    attrs={
+                        "rid": req.rid,
+                        "pages": len(pages),
+                        "shared": len(shared),
+                    },
+                )
             admitted.append((slot, req, pages, len(shared)))
 
         if not admitted:
@@ -479,16 +503,46 @@ class AdmissionMixin:
                 req.adapter if req.adapter is not None else -1
             )
             self._slot_ready[slot] = True
+            now = time.monotonic()
+            # First emitted token: the TTFT/ITL anchor for this slot.
+            req.first_token_at = now
+            self._slot_emit_t[slot] = now
             if self.metrics:
                 # A preemption resume re-activates the SAME client
                 # request: counting it again would skew requests_total
                 # exactly in the overload regime it helps diagnose.
                 if not resumed:
                     self.metrics.requests.inc()
-                    self.metrics.wait_seconds.observe(
-                        time.monotonic() - req.submitted_at
-                    )
+                    self.metrics.wait_seconds.observe(now - req.submitted_at)
+                    self.metrics.ttft_seconds.observe(now - req.submitted_at)
                 self.metrics.tokens.inc()
+            if self.spans and not resumed:
+                # Queue wait and prefill recorded post-hoc from the
+                # lifecycle stamps, nested under the request root (a
+                # resume re-runs prefill for the SAME client request:
+                # its spans would duplicate the trio, so resumes only
+                # annotate the root via the preemptions counter).
+                self.spans.record_span(
+                    "queue",
+                    req.trace_id,
+                    start_monotonic=req.submitted_at,
+                    end_monotonic=req.admitted_at,
+                    parent_id=req.root_span,
+                    attrs={"rid": req.rid},
+                )
+                self.spans.record_span(
+                    "prefill",
+                    req.trace_id,
+                    start_monotonic=req.admitted_at,
+                    end_monotonic=now,
+                    parent_id=req.root_span,
+                    attrs={
+                        "rid": req.rid,
+                        "prompt_tokens": plen,
+                        "bucket": job["bucket"],
+                        "batched_with": len(job["items"]) - 1,
+                    },
+                )
             self._maybe_finish(slot)
             if req.done:
                 finished.append(req)
@@ -532,4 +586,32 @@ class AdmissionMixin:
             or self._hit_stop(req)
         ):
             req.done = True
+            req.finished_at = time.monotonic()
+            if self.spans:
+                # The decode child covers first token -> finish; the root
+                # closes the trace with the whole-request wall time and
+                # the outcome, under the span id reserved at submit.
+                self.spans.record_span(
+                    "decode",
+                    req.trace_id,
+                    start_monotonic=req.first_token_at or req.finished_at,
+                    end_monotonic=req.finished_at,
+                    parent_id=req.root_span,
+                    attrs={"rid": req.rid, "tokens": len(req.tokens)},
+                )
+                self.spans.record_span(
+                    "request",
+                    req.trace_id,
+                    start_monotonic=req.submitted_at,
+                    end_monotonic=req.finished_at,
+                    span_id=req.root_span,
+                    attrs={
+                        "rid": req.rid,
+                        "prompt_tokens": len(req.prompt),
+                        "new_tokens": len(req.tokens),
+                        "outcome": "cancelled"
+                        if req.cancelled
+                        else ("stopped" if req.stopped else "completed"),
+                    },
+                )
             self._clear_slot(slot)

@@ -137,6 +137,7 @@ class PagingMixin:
         self._slot_page_base[slot] = 0
         self._slot_visible[slot] = 0
         self._slot_ready[slot] = False
+        self._slot_emit_t[slot] = 0.0
         # Slot scalars changed: the device-resident step state must be
         # rebuilt from host truth before the next dispatch (engine.py).
         self._mark_state_dirty()

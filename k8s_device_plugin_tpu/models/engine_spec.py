@@ -11,11 +11,13 @@ standalone dense-cache variant.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.spans import ENGINE_TRACE
 from .engine_sampling import filter_top_k_top_p
 from .engine_types import Request
 
@@ -225,6 +227,7 @@ class SpeculativeMixin:
         if not active:
             self._update_gauges()
             return finished
+        round_t0 = time.monotonic()
         tokens = jnp.asarray(self._slot_last, jnp.int32)[:, None]
         positions = jnp.asarray(self._slot_len, jnp.int32)[:, None]
         if any(
@@ -245,6 +248,22 @@ class SpeculativeMixin:
             )
         emitted = np.asarray(emitted)
         a_vec = np.asarray(a_vec)
+        now = time.monotonic()
+        if self.spans:
+            # One engine-scoped span per draft+verify round: acceptance
+            # attrs make a low-acceptance regime visible right next to
+            # the round's wall time in /debug/state.
+            self.spans.record_span(
+                "spec.verify",
+                ENGINE_TRACE,
+                start_monotonic=round_t0,
+                end_monotonic=now,
+                attrs={
+                    "slots": len(active),
+                    "proposed": int(self._spec_gamma) * len(active),
+                    "accepted": int(sum(a_vec[s] for s in active)),
+                },
+            )
         gamma = self._spec_gamma
         emitted_total = 0
         for s in active:
@@ -260,6 +279,8 @@ class SpeculativeMixin:
             if self.metrics:
                 self.metrics.spec_proposed.inc(gamma)
                 self.metrics.spec_accepted.inc(a)
+                if gamma > a:
+                    self.metrics.spec_rejected.inc(gamma - a)
             round_toks = [int(emitted[s, j]) for j in range(a + 1)]
             consumed = 0
             for tok in round_toks:
@@ -274,6 +295,7 @@ class SpeculativeMixin:
                 ):
                     break
             self._slot_len[s] += consumed
+            self._observe_itl(s, consumed, now)
             self._maybe_finish(s)
             if req.done:
                 finished.append(req)

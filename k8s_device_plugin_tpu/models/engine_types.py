@@ -66,6 +66,11 @@ class EngineMetrics:
             "tpu_engine_spec_accepted_total",
             "Draft tokens the target accepted (rate = accepted/proposed)",
         )
+        self.spec_rejected = registry.counter(
+            "tpu_engine_spec_rejected_total",
+            "Draft tokens the target rejected (proposed - accepted; a "
+            "rising rate says gamma is too high for this traffic)",
+        )
         self.preemptions = registry.counter(
             "tpu_engine_preemptions_total",
             "Slots evicted for recompute-resume under optimistic admission",
@@ -94,6 +99,32 @@ class EngineMetrics:
                 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                 30.0, 60.0, 120.0, 300.0,
             ),
+        )
+        # The two serving-latency numbers operators actually page on.
+        # TTFT = submit -> first emitted token (queue wait + batched
+        # prefill + admission overhead); ITL = gap between consecutive
+        # emitted tokens of one request (decode-block dispatches emit T
+        # tokens at once, so each of those T observes dt/T — the sum
+        # stays wall-accurate and histogram_quantile() stays meaningful).
+        self.ttft_seconds = registry.histogram(
+            "tpu_engine_ttft_seconds",
+            "Submit-to-first-token latency per request; "
+            "histogram_quantile(0.99, ...) is the serving SLO number",
+            buckets=(
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0, 120.0,
+            ),
+        )
+        self.itl_seconds = registry.histogram(
+            "tpu_engine_itl_seconds",
+            "Inter-token latency per emitted decode token "
+            "(block dispatches amortize: each of T tokens observes dt/T)",
+        )
+        self.page_utilization = registry.gauge(
+            "tpu_engine_kv_page_utilization",
+            "Allocated fraction of the allocatable KV page pool (0..1; "
+            "sustained ~1.0 with queued requests means the pool, not "
+            "compute, caps concurrency)",
         )
 
 
@@ -133,8 +164,20 @@ class Request:
     # Sampler settings change what gets picked, never what is reported.
     logprobs: bool = False
     rid: int = -1
+    # End-to-end trace id: supplied by the client (X-Request-Id) or minted
+    # at submit; echoed in responses/SSE events and stamped on every span
+    # this request produces (utils/spans.py).
+    trace_id: str = ""
+    # Reserved root-span id (spans recorder): the queue/prefill/decode
+    # child spans parent on it across threads; 0 when tracing is off.
+    root_span: int = 0
     # monotonic submit time (engine-internal: queue-wait observation).
     submitted_at: float = 0.0
+    # monotonic lifecycle stamps (0.0 until reached): slot assignment,
+    # first emitted token (TTFT anchor), and finish.
+    admitted_at: float = 0.0
+    first_token_at: float = 0.0
+    finished_at: float = 0.0
     tokens: list[int] = dataclasses.field(default_factory=list)
     token_logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False

@@ -45,6 +45,17 @@ rest of the models/ stack which benchmarks on synthetic ids):
          pool instead of decoding for nobody.
     GET /healthz     -> 200 "ok" while the engine loop is alive
     GET /metrics     -> Prometheus exposition (when a registry is wired)
+    GET /debug/state -> 200 JSON engine snapshot (slots, queue, page
+         pool, speculation counters) plus the recent span ring
+         (utils/spans.py) when the engine was built with a recorder —
+         ids and lengths only, never token content.
+
+    Trace-ID contract: a request may send ``X-Request-Id``; a valid id
+    (printable, <= 128 chars, no quotes/backslashes/newlines) is adopted,
+    anything else gets a generated one.  The id comes back on the
+    response's ``X-Request-Id`` header and ``trace_id`` JSON field, on
+    every SSE event, and on every span the request records — one grep
+    key from client log to engine telemetry.
     POST /debug/trace {"seconds": s?}   [opt-in: --debug-trace]
       -> 200 {"trace_dir": ...} after capturing a jax.profiler trace of
          the live serving loop (XProf/Perfetto); 409 while one runs;
@@ -61,6 +72,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..utils.metrics import MetricsRegistry
+from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
 
 
@@ -106,6 +118,12 @@ class EngineServer:
                 if path != "/generate":
                     self.send_error(404)
                     return
+                # Trace-ID contract: a valid client X-Request-Id is
+                # adopted verbatim; anything else (including no header)
+                # gets a generated id.  Either way the SAME id is echoed
+                # on the response header, the JSON body, every SSE
+                # event, and every span the request produces.
+                trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -135,15 +153,19 @@ class EngineServer:
                             for t, v in body["logit_bias"].items()
                         }
                 except (KeyError, TypeError, ValueError) as e:
-                    self._reply(400, {"error": f"bad request: {e}"})
+                    self._reply(400, {"error": f"bad request: {e}"}, trace_id)
                     return
                 stream = bool(body.get("stream", False))
                 if not 1 <= n <= 8:
-                    self._reply(422, {"error": f"n must be in [1, 8], got {n}"})
+                    self._reply(
+                        422, {"error": f"n must be in [1, 8], got {n}"}, trace_id
+                    )
                     return
                 if n > 1 and stream:
                     self._reply(
-                        422, {"error": "n > 1 does not compose with stream"}
+                        422,
+                        {"error": "n > 1 does not compose with stream"},
+                        trace_id,
                     )
                     return
                 try:
@@ -151,15 +173,18 @@ class EngineServer:
                     # the prefix trie dedupes the prompt pages, so extra
                     # choices cost generation pages only (and each slot
                     # draws its own sampling rows — independent samples).
+                    # All n choices share the request's trace id.
                     reqs = [
-                        server.engine.submit(prompt, max_new, **kwargs)
+                        server.engine.submit(
+                            prompt, max_new, trace_id=trace_id, **kwargs
+                        )
                         for _ in range(n)
                     ]
                 except ValueError as e:  # validation: capacity, sampler args
-                    self._reply(422, {"error": str(e)})
+                    self._reply(422, {"error": str(e)}, trace_id)
                     return
                 except TypeError as e:  # e.g. non-iterable / nested prompt
-                    self._reply(400, {"error": f"bad prompt: {e}"})
+                    self._reply(400, {"error": f"bad prompt: {e}"}, trace_id)
                     return
                 req = reqs[0]
                 if stream:
@@ -175,9 +200,14 @@ class EngineServer:
                     # Stop burning chip time on a response nobody reads.
                     for r in reqs:
                         server.engine.cancel(r)
-                    self._reply(504, {"error": "generation timed out", "rid": req.rid})
+                    self._reply(
+                        504,
+                        {"error": "generation timed out", "rid": req.rid},
+                        trace_id,
+                    )
                     return
-                out = {"tokens": req.tokens, "rid": req.rid}
+                out = {"tokens": req.tokens, "rid": req.rid,
+                       "trace_id": trace_id}
                 if req.logprobs:
                     out["logprobs"] = req.token_logprobs
                 if n > 1:
@@ -193,7 +223,7 @@ class EngineServer:
                         }
                         for r in reqs
                     ]
-                self._reply(200, out)
+                self._reply(200, out, trace_id)
 
             def _trace_capture(self) -> None:
                 """POST /debug/trace {"seconds": s?}: capture
@@ -261,6 +291,8 @@ class EngineServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                if req.trace_id:
+                    self.send_header("X-Request-Id", req.trace_id)
                 self.end_headers()
                 deadline = time.monotonic() + server._timeout
                 sent = 0
@@ -301,14 +333,14 @@ class EngineServer:
                             self.wfile.flush()
                         while sent < limit:
                             ev = {"token": toks[sent], "index": sent,
-                                  "rid": req.rid}
+                                  "rid": req.rid, "trace_id": req.trace_id}
                             if req.logprobs and sent < len(req.token_logprobs):
                                 ev["logprob"] = req.token_logprobs[sent]
                             self._event(ev)
                             sent += 1
                         if done:
                             fin = {"done": True, "tokens": toks,
-                                   "rid": req.rid}
+                                   "rid": req.rid, "trace_id": req.trace_id}
                             if req.logprobs:
                                 fin["logprobs"] = req.token_logprobs
                             self._event(fin)
@@ -317,7 +349,7 @@ class EngineServer:
                             server.engine.cancel(req)
                             self._event(
                                 {"error": "generation timed out",
-                                 "rid": req.rid}
+                                 "rid": req.rid, "trace_id": req.trace_id}
                             )
                             return
                 except OSError:  # broken pipe & friends: client vanished
@@ -332,6 +364,21 @@ class EngineServer:
                 if path == "/healthz":
                     ok = server._loop_alive and not server._stop.is_set()
                     self._reply(200 if ok else 503, {"status": "ok" if ok else "down"})
+                elif path == "/debug/state":
+                    # Engine + span-ring snapshot: the first endpoint to
+                    # hit during an incident.  Contains ids and lengths,
+                    # never token content (see ServingEngine.debug_state),
+                    # so it can stay as open as /metrics.
+                    state = {
+                        "engine": server.engine.debug_state(),
+                        "loop_alive": server._loop_alive,
+                    }
+                    rec = server.engine.spans
+                    if rec is not None:
+                        state["spans"] = rec.snapshot()
+                        state["spans_dropped"] = rec.dropped
+                        state["span_capacity"] = rec.capacity
+                    self._reply(200, state)
                 elif path == "/metrics" and registry is not None:
                     body = registry.render().encode()
                     self.send_response(200)
@@ -345,10 +392,14 @@ class EngineServer:
                 else:
                     self.send_error(404)
 
-            def _reply(self, code: int, obj: dict) -> None:
+            def _reply(
+                self, code: int, obj: dict, trace_id: Optional[str] = None
+            ) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                if trace_id:
+                    self.send_header("X-Request-Id", trace_id)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -511,6 +562,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         "restarts); empty = no persistent cache",
     )
     p.add_argument(
+        "--span-ring",
+        type=_positive_int,
+        default=512,
+        help="capacity of the in-memory request-span ring served by "
+        "GET /debug/state (bounded: overflow drops the oldest spans "
+        "and counts them)",
+    )
+    p.add_argument(
         "--debug-trace",
         action="store_true",
         help="enable POST /debug/trace (on-demand jax.profiler capture of "
@@ -645,6 +704,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         paged,
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
+        spans=SpanRecorder(capacity=args.span_ring),
         prefill_chunk=args.prefill_chunk,
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
         admission=args.admission,
@@ -655,7 +715,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         enable_trace=args.debug_trace,
     ).start()
     print(
-        f"serving on :{server.port} (POST /generate, GET /healthz /metrics)",
+        f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
+        "/debug/state)",
         file=sys.stderr,
         flush=True,
     )

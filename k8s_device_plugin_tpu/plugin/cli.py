@@ -99,7 +99,12 @@ def _build_multi_manager(args):
     def new_plugin(name: str) -> TpuDevicePlugin:
         return TpuDevicePlugin(
             discover=lambda: discovery.discover(root=args.root),
-            health_checker=ChipHealthChecker(root=args.root),
+            health_checker=ChipHealthChecker(
+                root=args.root,
+                observe_sweep_seconds=(
+                    default_plugin_metrics().health_sweep_seconds.observe
+                ),
+            ),
             metrics=default_plugin_metrics(),
         )
 
@@ -115,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.json_logs)
 
+    debug_endpoints = None
     if args.resources:
         # Multi-resource mode builds one plugin per resource inside the
         # manager; probe inventory directly rather than via a throwaway plugin.
@@ -123,11 +129,18 @@ def main(argv: list[str] | None = None) -> int:
     else:
         plugin = TpuDevicePlugin(
             discover=lambda: discovery.discover(root=args.root),
-            health_checker=ChipHealthChecker(root=args.root),
+            health_checker=ChipHealthChecker(
+                root=args.root,
+                observe_sweep_seconds=(
+                    default_plugin_metrics().health_sweep_seconds.observe
+                ),
+            ),
             metrics=default_plugin_metrics(),
         )
         inventory = plugin.inventory  # discovery already ran once in the ctor
         served = args.resource
+        # Device snapshot next to /metrics: what this node is advertising.
+        debug_endpoints = {"/debug/devices": plugin.debug_state}
     if args.require_chips and inventory.chip_count == 0:
         log.error("no TPU chips found under %s and --require-chips is set", args.root)
         return 1
@@ -165,10 +178,17 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.metrics_port:
             metrics_server = MetricsServer(
-                DEFAULT_REGISTRY, port=args.metrics_port, health=manager.alive
+                DEFAULT_REGISTRY,
+                port=args.metrics_port,
+                health=manager.alive,
+                debug=debug_endpoints,
             )
             metrics_server.start()
-            log.info("metrics on :%d/metrics", metrics_server.port)
+            log.info(
+                "metrics on :%d/metrics%s",
+                metrics_server.port,
+                " (+ /debug/devices)" if debug_endpoints else "",
+            )
         manager.run()
     finally:
         if metrics_server is not None:

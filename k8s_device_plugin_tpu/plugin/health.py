@@ -13,6 +13,7 @@ import errno
 import logging
 import os
 import stat
+import time
 
 from . import native
 from .discovery import TpuChip
@@ -42,10 +43,16 @@ class ChipHealthChecker:
         self,
         root: str = "/",
         prober: native.NativeProber | None | object = "auto",
+        observe_sweep_seconds=None,
     ):
         self._root = root
         # "auto" → process-wide shared library; None → force Python path.
         self._prober = native.shared_prober() if prober == "auto" else prober
+        # Optional telemetry hook: called with the wall seconds of every
+        # check_many sweep (cli.py wires it to the plugin's
+        # tpu_plugin_health_sweep_seconds histogram) — the ONE place
+        # sweep latency is observed, whoever drives the sweep.
+        self._observe_sweep = observe_sweep_seconds
 
     def _override(self, chip: TpuChip) -> bool | None:
         path = os.path.join(self._root, HEALTH_OVERRIDE_DIR, f"accel{chip.index}")
@@ -97,6 +104,14 @@ class ChipHealthChecker:
         """Health of a whole inventory, k8s_id -> healthy.  With the native
         prober this is ONE FFI crossing for every non-overridden chip (the
         per-pulse hot path of the daemon); otherwise it loops check()."""
+        t0 = time.perf_counter()
+        try:
+            return self._check_many(chips)
+        finally:
+            if self._observe_sweep is not None:
+                self._observe_sweep(time.perf_counter() - t0)
+
+    def _check_many(self, chips) -> dict[str, bool]:
         result: dict[str, bool] = {}
         if self._prober is None:
             return {chip.k8s_id: self.check(chip) for chip in chips}

@@ -383,4 +383,9 @@ class PluginManager:
             try:
                 self.plugin.poll_once()
             except Exception:
+                # Keep serving the last good snapshot, but meter the
+                # failure: a steadily climbing counter with a quiet
+                # device_updates series is how a wedged sysfs/devfs
+                # surfaces on a dashboard before it pages.
+                self.plugin.metrics.poll_failures.inc()
                 log.exception("health poll failed")

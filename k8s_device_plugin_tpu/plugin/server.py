@@ -105,6 +105,32 @@ class PluginMetrics:
             "tpu_plugin_allocation_latency_seconds",
             "Wall time of Allocate RPCs (BASELINE.json secondary metric)",
         )
+        self.allocate_seconds = registry.histogram(
+            "tpu_plugin_allocate_seconds",
+            "Wall time of Allocate RPCs (histogram: the p99 < 50 ms "
+            "budget of docs/operations.md needs quantiles, which the "
+            "older summary series cannot provide)",
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0,
+            ),
+        )
+        self.device_health = registry.gauge(
+            "tpu_plugin_device_health",
+            "Per-chip health (1 Healthy, 0 Unhealthy) as streamed to the "
+            "kubelet; series are removed when a chip is unplugged",
+            ["device"],
+        )
+        self.health_sweep_seconds = registry.histogram(
+            "tpu_plugin_health_sweep_seconds",
+            "Wall time of one full-inventory health sweep (the per-pulse "
+            "hot path; native-prober sweeps are one FFI crossing)",
+        )
+        self.poll_failures = registry.counter(
+            "tpu_plugin_poll_failures_total",
+            "Heartbeat discovery/health polls that raised (the daemon "
+            "keeps serving the last good snapshot)",
+        )
         self.preferred_allocations = registry.counter(
             "tpu_plugin_preferred_allocations_total",
             "GetPreferredAllocation container requests by result",
@@ -171,6 +197,16 @@ class TpuDevicePlugin:
                     self.metrics.health_transitions.inc(
                         direction="to_unhealthy" if was else "to_healthy"
                     )
+            # Per-device health series track the streamed device list
+            # exactly: an unplugged chip's series is removed, not frozen
+            # at its last value (a flat 1 for a missing chip would read
+            # as healthy on a dashboard).
+            for k8s_id in self._health.keys() - health.keys():
+                self.metrics.device_health.remove(device=k8s_id)
+            for k8s_id, healthy in health.items():
+                self.metrics.device_health.set(
+                    1.0 if healthy else 0.0, device=k8s_id
+                )
             self._inventory = inventory
             self._health = health
             if changed:
@@ -197,6 +233,32 @@ class TpuDevicePlugin:
     def inventory(self) -> TpuHostInventory:
         """Latest discovered inventory (for CLI/observability consumers)."""
         return self._snapshot()[1]
+
+    def debug_state(self) -> dict:
+        """JSON-safe daemon snapshot for the MetricsServer's
+        ``/debug/devices`` endpoint: the device list as the kubelet sees
+        it — ids, device paths, NUMA placement, topology coordinates,
+        health — plus the state version, so an operator can confirm what
+        a node is ADVERTISING without gRPC-poking the kubelet socket
+        (the daemon-side analogue of the engine's /debug/state)."""
+        version, inventory, health = self._snapshot()
+        return {
+            "resource": RESOURCE,
+            "state_version": version,
+            "chip_count": inventory.chip_count,
+            "accelerator_type": inventory.accelerator_type,
+            "host_bounds": inventory.host_bounds,
+            "chips": [
+                {
+                    "id": chip.k8s_id,
+                    "index": chip.index,
+                    "device_path": chip.device_path,
+                    "numa_node": chip.numa_node,
+                    "healthy": bool(health.get(chip.k8s_id)),
+                }
+                for chip in inventory.chips
+            ],
+        }
 
     def _device_list(self, inventory: TpuHostInventory, health: dict[str, bool]):
         devices = []
@@ -307,7 +369,8 @@ class TpuDevicePlugin:
     # ---------------------------------------------------------- RPC: allocate
 
     def Allocate(self, request, context):
-        with self.metrics.allocation_latency.time():
+        with self.metrics.allocation_latency.time(), \
+                self.metrics.allocate_seconds.time():
             _, inventory, health = self._snapshot()
             resp = pb.AllocateResponse()
             granted_chips = 0

@@ -22,9 +22,17 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        # Structured events (utils/spans.py emission, or any caller passing
+        # ``extra={"event": {...}}``): merged into the line so one JSON
+        # record carries the machine-readable fields alongside the message.
+        # The fixed keys above win on collision — a span attr must not be
+        # able to spoof the log level.
+        event = getattr(record, "event", None)
+        if isinstance(event, dict):
+            entry = {**event, **entry}
         if record.exc_info:
             entry["exc"] = self.formatException(record.exc_info)
-        return json.dumps(entry, separators=(",", ":"))
+        return json.dumps(entry, separators=(",", ":"), default=str)
 
 
 def setup_logging(level: str = "INFO", json_logs: bool = False) -> None:

@@ -104,6 +104,12 @@ class Gauge(_Metric):
         with self._lock:
             return self._series.get(self._key(labels), 0.0)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled series (a per-device gauge whose device was
+        unplugged must stop exporting, not freeze at its last value)."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
 
 class _Timer:
     """Context manager observing elapsed wall seconds into any metric
@@ -200,6 +206,41 @@ class Histogram:
         with self._lock:
             return self._count
 
+    def snapshot(self) -> tuple[tuple[int, ...], int, float]:
+        """(bucket_counts, count, sum) at this instant — the ``since``
+        anchor for :meth:`quantile`, so a benchmark can report the timed
+        region's percentiles with warmup observations subtracted."""
+        with self._lock:
+            return tuple(self._bucket_counts), self._count, self._sum
+
+    def quantile(self, q: float, since=None) -> float | None:
+        """Estimate the q-quantile (0 <= q <= 1) the way PromQL's
+        histogram_quantile() does: find the bucket where the cumulative
+        count crosses q*total and interpolate linearly inside it.  With
+        ``since`` (a prior :meth:`snapshot`), only observations recorded
+        after that snapshot count.  Returns None on an empty window; a
+        crossing in the +Inf bucket reports the highest finite bound
+        (the same clamp PromQL applies)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        counts, total, _ = self.snapshot()
+        if since is not None:
+            prev_counts, prev_total, _ = since
+            counts = tuple(c - p for c, p in zip(counts, prev_counts))
+            total -= prev_total
+        if total <= 0:
+            return None
+        rank = q * total
+        cum = 0
+        for le, n, lower in zip(
+            self.buckets, counts, (0.0,) + self.buckets[:-1]
+        ):
+            cum += n
+            if cum >= rank and n > 0:
+                frac = (rank - (cum - n)) / n
+                return lower + (le - lower) * frac
+        return self.buckets[-1]
+
     def collect(self) -> list[str]:
         with self._lock:
             lines = [
@@ -260,6 +301,12 @@ class MetricsServer:
     ``health`` is an optional callable consulted by /healthz: True (or no
     callable) ⇒ 200 "ok", False ⇒ 503 — so a liveness probe reflects the
     daemon's actual state, not just this HTTP thread's.
+
+    ``debug`` maps extra GET paths (e.g. ``/debug/devices``) to no-arg
+    callables returning a JSON-serializable snapshot — the plugin-side
+    introspection companion to the serving engine's ``/debug/state``.
+    A snapshot callable that raises answers 500 with the error, never
+    kills the metrics thread.
     """
 
     def __init__(
@@ -268,13 +315,32 @@ class MetricsServer:
         host: str = "0.0.0.0",
         port: int = 9100,
         health=None,
+        debug=None,
     ):
+        import json as _json
+
         registry_ref = registry
         health_ref = health
+        debug_ref = dict(debug or {})
 
         class Handler(BaseHTTPRequestHandler):
+            def _json_reply(self, code: int, obj) -> None:
+                body = _json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] == "/metrics":
+                if self.path.split("?")[0] in debug_ref:
+                    try:
+                        snap = debug_ref[self.path.split("?")[0]]()
+                    except Exception as e:  # snapshot bug must not kill scrapes
+                        self._json_reply(500, {"error": str(e)})
+                        return
+                    self._json_reply(200, snap)
+                elif self.path.split("?")[0] == "/metrics":
                     body = registry_ref.render().encode()
                     self.send_response(200)
                     self.send_header(

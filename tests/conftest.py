@@ -52,6 +52,7 @@ PLUGIN_TIER_FILES = {
     "test_protocol.py",
     "test_resources.py",
     "test_server.py",
+    "test_spans.py",
     "test_stress.py",
     "test_topology.py",
     "test_watcher.py",

@@ -71,14 +71,58 @@ def test_bench_emits_json_and_exit0_even_when_all_backends_hang():
     assert "vs_baseline" in rec and "error" in rec
 
 
-def test_attach_builder_reference_on_fallback_only():
+def _write_ref(tmp_path, parsed):
+    (tmp_path / "LAST_TPU_BENCH.json").write_text(
+        json.dumps({"note": "builder-session measurement", "parsed": parsed})
+    )
+
+
+def test_attach_builder_reference_on_fallback_only(tmp_path):
     """A CPU/none fallback record carries the last builder-session TPU
     measurement as labeled context (round-5: a round-end relay wedge must
     not erase the round's hardware evidence); a tpu record stays clean."""
-    d = bench._attach_builder_reference({"platform": "cpu", "value": 1.6})
+    _write_ref(tmp_path, {"platform": "tpu", "value": 2596.62})
+    d = bench._attach_builder_reference(
+        {"platform": "cpu", "value": 1.6}, root=str(tmp_path)
+    )
     ref = d.get("builder_tpu_reference")
     assert ref is not None and ref["parsed"]["platform"] == "tpu"
     assert ref["parsed"]["value"] > 0
     assert "note" in ref  # provenance label, not a bare number
-    clean = bench._attach_builder_reference({"platform": "tpu", "value": 2596.6})
+    clean = bench._attach_builder_reference(
+        {"platform": "tpu", "value": 2596.6}, root=str(tmp_path)
+    )
     assert "builder_tpu_reference" not in clean
+
+
+def test_attach_builder_reference_rejects_non_tpu_records(tmp_path):
+    """Only a real hardware number may ride along as context: a CPU
+    smoke, a zeroed fallback, or a mangled file must attach NOTHING
+    (ADVICE.md round 5) rather than masquerade as the TPU reference."""
+    fallback = {"platform": "cpu", "value": 1.6}
+    for bad in (
+        {"platform": "cpu", "value": 9999.0},
+        {"platform": "tpu", "value": 0.0},
+        {"platform": "tpu"},
+        None,
+    ):
+        _write_ref(tmp_path, bad)
+        d = bench._attach_builder_reference(dict(fallback), root=str(tmp_path))
+        assert "builder_tpu_reference" not in d, bad
+    # Missing file: silently no context.
+    d = bench._attach_builder_reference(
+        dict(fallback), root=str(tmp_path / "nowhere")
+    )
+    assert "builder_tpu_reference" not in d
+
+
+def test_committed_builder_reference_schema():
+    """One smoke-assert on the COMMITTED LAST_TPU_BENCH.json: it must
+    keep the shape _attach_builder_reference trusts (provenance note +
+    parsed tpu record with a positive value), or fallback runs would
+    silently lose their hardware context."""
+    with open(os.path.join(REPO_ROOT, "LAST_TPU_BENCH.json")) as f:
+        ref = json.load(f)
+    assert "note" in ref
+    assert ref["parsed"]["platform"] == "tpu"
+    assert ref["parsed"]["value"] > 0

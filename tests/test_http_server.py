@@ -24,6 +24,7 @@ from k8s_device_plugin_tpu.models.transformer import (
     greedy_generate,
 )
 from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+from k8s_device_plugin_tpu.utils.spans import SpanRecorder
 
 
 @pytest.fixture(scope="module")
@@ -34,7 +35,8 @@ def served():
     paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
     registry = MetricsRegistry()
     engine = ServingEngine(
-        cfg, params, paged, max_slots=3, metrics=EngineMetrics(registry)
+        cfg, params, paged, max_slots=3, metrics=EngineMetrics(registry),
+        spans=SpanRecorder(),
     )
     server = EngineServer(
         engine, host="127.0.0.1", port=0, registry=registry,
@@ -354,6 +356,121 @@ def test_n_choices_sampling(served):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(server.port, {"prompt": [3], "max_new_tokens": 2, "n": 99})
     assert e.value.code == 422
+
+
+def _post_raw(port, payload, headers=None, timeout=120):
+    """POST /generate returning (parsed body, response headers)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _get_json(port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read())
+
+
+def test_client_trace_id_echoed_and_traced(served):
+    """The X-Request-Id contract end to end: a client-supplied id comes
+    back on the response header AND body, and the request's span tree —
+    >= 3 children (queue, prefill, decode) nested under one root — is
+    retrievable from /debug/state under that id."""
+    cfg, params, server = served
+    tid = "acceptance-trace-0001"
+    out, headers = _post_raw(
+        server.port,
+        {"prompt": [3, 141, 59], "max_new_tokens": 5},
+        headers={"X-Request-Id": tid},
+    )
+    assert out["trace_id"] == tid
+    assert headers.get("X-Request-Id") == tid
+    assert out["tokens"] == _oracle(cfg, params, [3, 141, 59], 5)
+    state = _get_json(server.port, "/debug/state")
+    mine = [s for s in state["spans"] if s["trace_id"] == tid]
+    root = [s for s in mine if s["name"] == "request"]
+    assert len(root) == 1
+    children = {
+        s["name"] for s in mine if s["parent_id"] == root[0]["span_id"]
+    }
+    assert {"queue", "prefill", "decode"} <= children
+    assert len(children) >= 3
+    # Engine snapshot rides along, shaped for an operator mid-incident.
+    eng = state["engine"]
+    assert eng["queue_depth"] == 0
+    assert eng["free_pages"] == eng["allocatable_pages"]
+    assert eng["config"]["max_slots"] == 3
+    assert state["span_capacity"] >= len(state["spans"])
+
+
+def test_generated_trace_id_when_header_absent_or_hostile(served):
+    """No header (or a hostile one) still yields a usable id, echoed
+    everywhere the same way."""
+    _, _, server = served
+    out, headers = _post_raw(
+        server.port, {"prompt": [9, 10], "max_new_tokens": 2}
+    )
+    assert out["trace_id"]
+    assert headers.get("X-Request-Id") == out["trace_id"]
+    int(out["trace_id"], 16)  # generated shape
+    bad, _ = _post_raw(
+        server.port,
+        {"prompt": [9, 10], "max_new_tokens": 2},
+        headers={"X-Request-Id": 'evil"id\\'},
+    )
+    assert bad["trace_id"] != 'evil"id\\'
+
+
+def test_stream_events_carry_trace_id(served):
+    """Every SSE event — per-token and done — carries the request's
+    trace id so a client can correlate a stream with server telemetry."""
+    cfg, params, server = served
+    events = _post_stream(
+        server.port, {"prompt": [3, 141, 59], "max_new_tokens": 4}
+    )
+    tids = {e.get("trace_id") for e in events}
+    assert len(tids) == 1 and tids != {None}
+
+
+def test_serving_metrics_cover_latency_and_pool(served):
+    """/metrics carries the canonical serving set with observations:
+    nonzero TTFT and ITL histogram counts, queue-depth and
+    KV-page-utilization gauges (the request traffic of this module's
+    earlier tests has already flowed through the shared registry)."""
+    import re
+
+    _, _, server = served
+    # Ensure at least one multi-token request contributed ITL samples.
+    _post(server.port, {"prompt": [5, 6, 7], "max_new_tokens": 4})
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=30
+    ) as r:
+        text = r.read().decode()
+
+    def series(name):
+        m = re.search(rf"^{name} (\S+)$", text, re.M)
+        assert m, f"{name} missing from exposition"
+        return float(m.group(1))
+
+    assert series("tpu_engine_ttft_seconds_count") > 0
+    assert series("tpu_engine_itl_seconds_count") > 0
+    assert series("tpu_engine_queued_requests") == 0
+    assert series("tpu_engine_free_pages") > 0
+    assert series("tpu_engine_kv_page_utilization") == 0.0
+    for name in (
+        "tpu_engine_ttft_seconds",
+        "tpu_engine_itl_seconds",
+        "tpu_engine_kv_page_utilization",
+        "tpu_engine_spec_rejected_total",
+    ):
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} " in text
 
 
 def test_decode_block_cli_resolution():

@@ -188,6 +188,189 @@ def test_histogram_timer_and_boundary():
     assert h.count == 2
 
 
+def test_histogram_quantile_interpolates_like_promql():
+    r = MetricsRegistry()
+    h = r.histogram("t_q", "help", buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.5) is None  # empty window
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    # rank 2 of 4 lands in the (0.1, 1.0] bucket (cumulative 1 -> 3):
+    # lower + (le-lower) * (2-1)/2 = 0.1 + 0.9*0.5 = 0.55.
+    assert h.quantile(0.5) == pytest.approx(0.55)
+    # p100 crosses in the (1.0, 10.0] bucket.
+    assert 1.0 < h.quantile(1.0) <= 10.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantile_since_snapshot_excludes_warmup():
+    r = MetricsRegistry()
+    h = r.histogram("t_qs", "help", buckets=(0.1, 1.0, 10.0))
+    h.observe(9.0)  # warmup outlier (compile-dominated)
+    snap = h.snapshot()
+    for _ in range(4):
+        h.observe(0.05)
+    # Without the anchor the outlier drags the p99 into the top bucket;
+    # with it the timed window is all sub-0.1.
+    assert h.quantile(0.99) > 1.0
+    assert h.quantile(0.99, since=snap) <= 0.1
+
+
+def test_histogram_quantile_clamps_inf_bucket():
+    r = MetricsRegistry()
+    h = r.histogram("t_qi", "help", buckets=(0.1, 1.0))
+    h.observe(50.0)  # lands in +Inf
+    assert h.quantile(0.99) == 1.0  # highest finite bound, PromQL's clamp
+
+
+def test_gauge_remove_drops_series():
+    reg = MetricsRegistry()
+    g = reg.gauge("per_dev", "per device", ["device"])
+    g.set(1, device="a")
+    g.set(0, device="b")
+    g.remove(device="b")
+    g.remove(device="never-set")  # no-op, not an error
+    text = reg.render()
+    assert 'per_dev{device="a"} 1' in text
+    assert '"b"' not in text
+
+
+def _assert_exposition_valid(text):
+    """Every series line must belong to a metric with HELP and TYPE, and
+    parse as name{labels} value with properly quoted label values."""
+    import re
+
+    helped = set(re.findall(r"# HELP (\S+) ", text))
+    typed = set(re.findall(r"# TYPE (\S+) ", text))
+    assert helped == typed and helped
+    line_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(?:[a-zA-Z_][a-zA-Z0-9_]*='
+        r'"(?:[^"\\]|\\.)*",?)*\})? (-?\d+(?:\.\d+)?(?:e-?\d+)?|NaN)$'
+    )
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert m.group(1) in helped or base in helped, line
+
+
+def test_all_engine_and_plugin_metrics_expose_validly(tmp_path):
+    """Exposition validity of the ENTIRE canonical metric set, both
+    subsystems on one shared registry (the co-hosting topology): every
+    new series has HELP/TYPE and every line parses."""
+    from k8s_device_plugin_tpu.models.engine_types import EngineMetrics
+
+    reg = MetricsRegistry()
+    em = EngineMetrics(reg)
+    pm = PluginMetrics(reg)
+    # Touch the labeled/new series so they render non-trivially.
+    em.ttft_seconds.observe(0.2)
+    em.itl_seconds.observe(0.003)
+    em.page_utilization.set(0.5)
+    em.spec_rejected.inc(2)
+    pm.device_health.set(1, device="tpu-0")
+    pm.device_health.set(0, device='esc"aped\\dev')
+    pm.allocate_seconds.observe(0.004)
+    pm.health_sweep_seconds.observe(0.001)
+    pm.poll_failures.inc()
+    _assert_exposition_valid(reg.render())
+
+
+def test_plugin_device_health_gauge_tracks_inventory(tmp_path):
+    """Per-device health series follow the device list: value flips on
+    override faults, and an unplugged chip's series is REMOVED (a frozen
+    1 would read healthy on a dashboard)."""
+    import os
+
+    root = make_fake_tpu_host(tmp_path, n_chips=3)
+    reg = MetricsRegistry()
+    plugin = TpuDevicePlugin(
+        discover=lambda: discover(root=root),
+        health_checker=ChipHealthChecker(root=root),
+        metrics=PluginMetrics(reg),
+    )
+    m = plugin.metrics
+    assert [m.device_health.value(device=f"tpu-{i}") for i in range(3)] == [1, 1, 1]
+    over = os.path.join(root, "run/tpu/health")
+    os.makedirs(over, exist_ok=True)
+    with open(os.path.join(over, "accel2"), "w") as f:
+        f.write("Unhealthy\n")
+    plugin.poll_once()
+    assert m.device_health.value(device="tpu-2") == 0
+    os.unlink(os.path.join(root, "dev", "accel2"))
+    os.unlink(os.path.join(over, "accel2"))
+    plugin.poll_once()
+    assert 'device="tpu-2"' not in reg.render()
+    assert m.device_health.value(device="tpu-1") == 1
+
+
+def test_plugin_allocate_histogram_and_sweep_metric(tmp_path):
+    root = make_fake_tpu_host(tmp_path, n_chips=2)
+    reg = MetricsRegistry()
+    metrics = PluginMetrics(reg)
+    plugin = TpuDevicePlugin(
+        discover=lambda: discover(root=root),
+        health_checker=ChipHealthChecker(
+            root=root,
+            observe_sweep_seconds=metrics.health_sweep_seconds.observe,
+        ),
+        metrics=metrics,
+    )
+    from k8s_device_plugin_tpu.kubelet.api import pb
+
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=["tpu-0"])
+    plugin.Allocate(req, _FakeContext())
+    assert metrics.allocate_seconds.count == 1
+    assert metrics.allocation_latency.count == 1  # legacy summary intact
+    # The ctor's poll_once drove one full sweep through the checker hook.
+    assert metrics.health_sweep_seconds.count >= 1
+
+
+def test_metrics_server_debug_devices_endpoint(tmp_path):
+    """GET /debug/devices on the MetricsServer returns the advertised
+    device list as JSON — and a raising snapshot answers 500, not a dead
+    scrape thread."""
+    import json as _json
+
+    root = make_fake_tpu_host(tmp_path, n_chips=2)
+    reg = MetricsRegistry()
+    plugin = TpuDevicePlugin(
+        discover=lambda: discover(root=root),
+        health_checker=ChipHealthChecker(root=root),
+        metrics=PluginMetrics(reg),
+    )
+
+    def boom():
+        raise RuntimeError("snapshot bug")
+
+    server = MetricsServer(
+        reg,
+        host="127.0.0.1",
+        port=0,
+        debug={"/debug/devices": plugin.debug_state, "/debug/boom": boom},
+    )
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/debug/devices", timeout=5) as r:
+            snap = _json.loads(r.read())
+        assert snap["chip_count"] == 2
+        assert [c["id"] for c in snap["chips"]] == ["tpu-0", "tpu-1"]
+        assert all(c["healthy"] for c in snap["chips"])
+        assert snap["resource"] == "google.com/tpu"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/debug/boom", timeout=5)
+        assert e.value.code == 500
+        # /metrics still fine on the same server afterwards.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
 def test_engine_latency_histograms_populate():
     """EngineMetrics wires step/wait histograms: after serving one
     request, both carry observations in the exposition."""

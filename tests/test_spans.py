@@ -1,0 +1,150 @@
+"""Request-scoped tracing layer (utils/spans.py): span nesting via
+contextvars, ring-buffer bounds, trace-id hygiene, cross-thread parenting
+via reserved ids, and structured JSON emission through utils/logging.py.
+Pure stdlib — no JAX, runs in the hermetic plugin tier."""
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.utils.logging import JsonFormatter
+from k8s_device_plugin_tpu.utils.spans import (
+    SpanRecorder,
+    current_trace_id,
+    new_trace_id,
+    sanitize_trace_id,
+)
+
+
+def test_new_trace_ids_are_distinct_hex():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    for tid in ids:
+        assert len(tid) == 16
+        int(tid, 16)  # hex
+
+
+def test_sanitize_accepts_reasonable_client_ids():
+    for good in ("abc-123", "req/2024#7", "A" * 128, "x"):
+        assert sanitize_trace_id(good) == good
+    assert sanitize_trace_id("  padded  ") == "padded"
+
+
+def test_sanitize_regenerates_hostile_or_missing_ids():
+    for bad in (None, "", "A" * 129, 'has"quote', "back\\slash",
+                "new\nline", "\x00control", 42, b"bytes"):
+        out = sanitize_trace_id(bad)
+        assert out != bad
+        assert len(out) == 16
+        int(out, 16)
+
+
+def test_span_nesting_follows_contextvars():
+    rec = SpanRecorder()
+    with rec.span("outer", trace_id="t1") as outer:
+        assert current_trace_id() == "t1"
+        with rec.span("inner") as inner:  # inherits trace, parents on outer
+            assert current_trace_id() == "t1"
+    assert current_trace_id() == ""  # fully unwound
+    snap = {s["name"]: s for s in rec.snapshot()}
+    assert snap["inner"]["trace_id"] == "t1"
+    assert snap["inner"]["parent_id"] == outer.span_id
+    assert snap["outer"]["parent_id"] == 0
+    # Children finish before parents, but both are present with durations.
+    assert snap["outer"]["duration_ms"] >= snap["inner"]["duration_ms"] >= 0
+    assert inner.span_id != outer.span_id
+
+
+def test_span_records_exception_and_reraises():
+    rec = SpanRecorder()
+    with pytest.raises(ValueError):
+        with rec.span("boom", trace_id="t"):
+            raise ValueError("x")
+    (entry,) = rec.snapshot()
+    assert entry["attrs"]["error"] == "ValueError"
+
+
+def test_ring_buffer_bound_and_drop_count():
+    rec = SpanRecorder(capacity=4)
+    t0 = time.monotonic()
+    for i in range(10):
+        rec.record_span(f"s{i}", "t", start_monotonic=t0)
+    snap = rec.snapshot()
+    assert len(snap) == 4
+    assert [s["name"] for s in snap] == ["s6", "s7", "s8", "s9"]  # oldest out
+    assert rec.dropped == 6
+    rec.clear()
+    assert rec.snapshot() == [] and rec.dropped == 0
+
+
+def test_reserved_root_id_parents_across_threads():
+    """The engine's shape: the root id is reserved on the submitting
+    thread, children are recorded from the owner thread, the root lands
+    last — and the tree still links up."""
+    rec = SpanRecorder()
+    root = rec.reserve_id()
+    t0 = time.monotonic()
+
+    def owner():
+        rec.record_span("queue", "tid", start_monotonic=t0, parent_id=root)
+        rec.record_span("decode", "tid", start_monotonic=t0, parent_id=root)
+
+    th = threading.Thread(target=owner)
+    th.start()
+    th.join()
+    rec.record_span("request", "tid", start_monotonic=t0, span_id=root)
+    snap = rec.snapshot()
+    byname = {s["name"]: s for s in snap}
+    assert byname["request"]["span_id"] == root
+    assert byname["queue"]["parent_id"] == root
+    assert byname["decode"]["parent_id"] == root
+    # Reserved ids are never handed out twice.
+    assert len({s["span_id"] for s in snap}) == 3
+
+
+def test_record_span_wall_start_and_duration():
+    rec = SpanRecorder()
+    t0 = time.monotonic() - 0.5  # started half a second ago
+    before = time.time()
+    rec.record_span("w", "t", start_monotonic=t0, end_monotonic=t0 + 0.25)
+    (entry,) = rec.snapshot()
+    assert entry["duration_ms"] == pytest.approx(250.0, abs=1.0)
+    # Wall start ~0.5s before "now".
+    assert entry["start"] == pytest.approx(before - 0.5, abs=0.1)
+
+
+def test_emit_flows_through_json_formatter():
+    rec = SpanRecorder(emit=True)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("tpu.spans")
+    handler = Capture()
+    logger.addHandler(handler)
+    old_level = logger.level
+    logger.setLevel(logging.INFO)
+    try:
+        with rec.span("emitted", trace_id="t42", rid=7):
+            pass
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+    assert records
+    line = JsonFormatter().format(records[-1])
+    entry = json.loads(line)
+    # Structured fields merged into the line; fixed log keys win.
+    assert entry["name"] == "emitted"
+    assert entry["trace_id"] == "t42"
+    assert entry["attrs"] == {"rid": 7}
+    assert entry["level"] == "INFO"
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        SpanRecorder(capacity=0)

@@ -389,16 +389,20 @@ def int8_ab():
 def paged_regime():
     """Map the kernel-vs-gather crossover over the pool over-read ratio
     (docs/serving.md rule of thumb, unmeasured ≥3 regime): fixed
-    len=512, ps=16, ratio = max_pages*ps/len ∈ {1, 2, 4, 8, 16}.  The
+    len=517, ps=16, ratio ≈ max_pages*ps/len ∈ {1, 2, 4, 8, 16}.  The
     gather path reads max_pages*ps tokens per row regardless of length;
     the kernel reads ceil(len/ps) pages — its O(len) advantage should
-    overtake its ~2× per-token cost near ratio 3."""
+    overtake its ~2× per-token cost near ratio 3.  len deliberately NOT
+    page-aligned (517 = 32 full pages + 5): the parity sections prove
+    partial-last-page masking is CORRECT on Mosaic; this section must
+    also TIME it, or a masking-path slowdown would hide behind aligned
+    fills."""
     from k8s_device_plugin_tpu.ops.paged_attention import paged_attention
 
-    b, h, kv, d, ps, fill = 4, 16, 4, 64, 16, 512
+    b, h, kv, d, ps, fill = 4, 16, 4, 64, 16, 517
     iters = 2 if jax.default_backend() == "cpu" else 30
     for ratio in (1, 2, 4, 8, 16):
-        mpp = ratio * fill // ps
+        mpp = -(-ratio * fill // ps)  # ceil: ratio 1 still covers the tail
         q, pk, pv, table, lens = _pool_setup(b, h, kv, d, ps, mpp, fill)
 
         def gather_ref(qq):

@@ -6,7 +6,10 @@
 # Usage: tools/probe_loop.sh [logfile] [session-script]
 #   e.g.  tools/probe_loop.sh /tmp/probe.log tools/hw_session2.sh
 LOG=$(realpath -m "${1:-/tmp/probe_loop_r5.log}")
-SESSION="${2:-tools/hw_session.sh}"
+# Resolve SESSION against the CALLER's cwd before we cd to the repo root:
+# a relative path like ./my_session.sh must keep meaning what the caller
+# typed, not silently re-resolve under the repo.
+SESSION=$(realpath -m "${2:-$(dirname "$0")/hw_session.sh}")
 cd "$(dirname "$0")/.."
 . tools/_env.sh
 n=0
@@ -18,12 +21,18 @@ while true; do
     "$SESSION" /tmp/hw_session_r5.log
     rc=$?
     echo "=== hw_session rc=$rc $(date -u) ===" | tee -a "$LOG"
-    # Only a clean rc=0 means the queue ran to its end.  Anything else —
-    # its own preflight failing (rc=1: the relay wedged between our probe
-    # and its probe), exec failure (126/127), signal death (>128) — keeps
-    # the watch alive; re-running a partially-complete session is safe
-    # (each item overwrites its own results).
+    # Only a clean rc=0 means the queue ran to its end.  A transient
+    # failure — its own preflight failing (rc=1: the relay wedged between
+    # our probe and its probe), signal death (>128) — keeps the watch
+    # alive; re-running a partially-complete session is safe (each item
+    # overwrites its own results).  But rc 126/127 (not executable / not
+    # found) can never heal by waiting: exit so a typo'd session path
+    # fails loudly instead of probing forever.
     [ "$rc" -eq 0 ] && exit 0
+    if [ "$rc" -eq 126 ] || [ "$rc" -eq 127 ]; then
+      echo "=== session script not runnable (rc=$rc): $SESSION — giving up ===" | tee -a "$LOG"
+      exit "$rc"
+    fi
     sleep 60
     continue
   fi
