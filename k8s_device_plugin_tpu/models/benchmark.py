@@ -442,6 +442,26 @@ def run_serving(args) -> None:
     done = eng.run(jobs)
     dt = time.perf_counter() - t0
     tokens = sum(len(r.tokens) for r in done)
+    # The SAME per-step profile /debug/profile serves on a live server
+    # (models/engine_profiler.py): per-phase p50/p99 over the rolling
+    # window — so a BENCH round records where the steps' time went, not
+    # just how many tokens came out.
+    prof = eng.profiler.snapshot()
+    phase_p50 = {
+        phase: stats["window_p50_ms"]
+        for phase, stats in prof["phases"].items()
+        if stats["window_steps"]
+    }
+    log(
+        "perf-ledger row: | Serving step phase breakdown (b%d) | step p50 "
+        "%.3f ms (%s) | - | `benchmark.py --model serving` ≡ GET "
+        "/debug/profile | update on bench round |"
+        % (
+            args.slots,
+            prof["step_ms"]["p50"],
+            ", ".join(f"{k} {v:.3f}" for k, v in phase_p50.items()),
+        )
+    )
     print(
         json.dumps(
             {
@@ -458,6 +478,14 @@ def run_serving(args) -> None:
                 "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),
                 "itl_p99_ms": _ms(itl_h.quantile(0.99, since=itl_snap)),
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
+                "profile": {
+                    "steps": prof["steps"],
+                    "step_ms_p50": prof["step_ms"]["p50"],
+                    "step_ms_p99": prof["step_ms"]["p99"],
+                    "phase_ms_p50": phase_p50,
+                    "occupancy": prof["occupancy"],
+                    "incidents": eng.anomaly.snapshot()["incidents_total"],
+                },
             }
         ),
         flush=True,

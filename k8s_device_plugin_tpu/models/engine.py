@@ -64,7 +64,10 @@ from .engine_types import (  # noqa: F401  (re-export: public surface)
     Request,
     _pow2_int,
 )
+from ..utils.anomaly import AnomalyMonitor
+from ..utils.flight import FlightRecorder
 from ..utils.spans import ENGINE_TRACE, SpanRecorder
+from .engine_profiler import EngineProfiler
 from .transformer import (
     GPTConfig,
     PagedConfig,
@@ -111,6 +114,9 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         admission: str = "reserve",
         racecheck: bool = False,
         spans: Optional[SpanRecorder] = None,
+        flight: Optional[FlightRecorder] = None,
+        anomaly: Optional[AnomalyMonitor] = None,
+        profiler: Optional[EngineProfiler] = None,
     ):
         if cfg.paged is not None:
             raise ValueError("pass the base config; the engine adds paging")
@@ -320,6 +326,50 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         # step time (~100us) is comparable to one transfer.
         self._dev: Optional[dict] = None
         self.metrics = metrics
+        # Forensics layer (always on — a production incident cannot ask
+        # for instrumentation retroactively, and all three pieces are
+        # stdlib-cheap): a bounded flight-recorder black box of typed
+        # events, an EWMA anomaly monitor emitting incident records with
+        # the surrounding flight window attached (GET /debug/incidents),
+        # and a per-step phase profiler (GET /debug/profile).  Callers
+        # may pass shared/preconfigured instances (the serving main
+        # registers the flight box for SIGUSR2 dumps).
+        self.flight = (
+            flight
+            if flight is not None
+            else FlightRecorder(capacity=1024, name="engine")
+        )
+        if anomaly is None:
+            anomaly = AnomalyMonitor(
+                flight=self.flight,
+                on_incident=(
+                    (lambda m: metrics.incidents.inc(metric=m))
+                    if metrics
+                    else None
+                ),
+            )
+        self.anomaly = anomaly
+        # configure() is get-or-create: a caller-preconfigured monitor
+        # keeps its thresholds.  Step time warms over ~2 windows of
+        # steady decode; one-sided high (fast steps are never incidents).
+        self.anomaly.configure(
+            "engine.step_seconds", warmup=50, z_threshold=6.0, sustain=3
+        )
+        self.anomaly.configure(
+            "engine.ttft_seconds", warmup=20, z_threshold=6.0, sustain=2
+        )
+        self.profiler = (
+            profiler
+            if profiler is not None
+            else EngineProfiler(
+                flight=self.flight,
+                observe_step=lambda s: self.anomaly.observe(
+                    "engine.step_seconds", s
+                ),
+            )
+        )
+        self._prof_timer = None
+        self._step_tokens = 0  # tokens emitted by the step in flight
         # Request-scoped tracing (utils/spans.py): None = off, zero cost.
         # Per-slot monotonic stamp of the slot's last emitted token — the
         # inter-token-latency anchor (reset at activation and teardown).
@@ -511,6 +561,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         out = np.asarray(out)
         lps = np.asarray(lps)
+        self._mark("decode")
         now = time.monotonic()
         emitted_total = 0
         for s in active:
@@ -556,11 +607,19 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                     **att,
                     "seq_lens": jnp.array(self._slot_len, jnp.int32),
                 }
+        self._mark("sample")
+        self._step_tokens += emitted_total
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(emitted_total)
         self._update_gauges()
         return finished
+
+    def _mark(self, phase: str) -> None:
+        """Attribute the time since the previous mark of the CURRENT step
+        to ``phase`` (engine_profiler.PHASES); no-op outside step()."""
+        if self._prof_timer is not None:
+            self._prof_timer.mark(phase)
 
     def step(self) -> list[Request]:
         """Admit what fits, advance every active slot one token; returns
@@ -571,11 +630,33 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             if self.spans
             else contextlib.nullcontext()
         )
-        with span:
-            if self.metrics:
-                with self.metrics.step_seconds.time():
-                    return self._step_inner()
-            return self._step_inner()
+        timer = self._prof_timer = self.profiler.timer()
+        self._step_tokens = 0
+        try:
+            with span:
+                if self.metrics:
+                    with self.metrics.step_seconds.time():
+                        return self._step_inner()
+                return self._step_inner()
+        finally:
+            self._prof_timer = None
+            with self._lock:
+                active = sum(1 for s in self.slots if s is not None)
+                queued = len(self.queue)
+                allocatable = self.paged.num_pages - 1
+                util = (
+                    1.0 - len(self.free_pages) / allocatable
+                    if allocatable
+                    else 0.0
+                )
+            self.profiler.finish_step(
+                timer,
+                active_slots=active,
+                max_slots=self.max_slots,
+                queued=queued,
+                kv_page_utilization=util,
+                tokens=self._step_tokens,
+            )
 
     def _step_inner(self) -> list[Request]:
         finished = self._admit()
@@ -589,6 +670,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             if req is not None and req.cancelled and self._slot_ready[s]:
                 self._maybe_finish(s)
                 finished.append(req)
+        self._mark("schedule")
         # Advance every in-flight prefill job by ONE chunk (an unchunked
         # job completes right here, in the same step() it was admitted):
         # chunking bounds how long active slots stall per step while a
@@ -597,6 +679,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             if self._advance_prefill(job):
                 self._pending.remove(job)
                 finished.extend(self._activate(job))
+        self._mark("prefill")
         active = [
             s
             for s in range(self.max_slots)
@@ -670,6 +753,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         self._feed_forward(dev, ff_tok, ff_pos, ff_key)
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
+        self._mark("decode")
         now = time.monotonic()
         for s in active:
             req = self.slots[s]
@@ -688,6 +772,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 self._extend_frontier(s)
                 if self.cfg.attention_window is not None:
                     self._reclaim_windowed(s)
+        self._mark("sample")
+        self._step_tokens += len(active)
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(len(active))

@@ -39,6 +39,60 @@ class AdmissionMixin:
         logit_bias: Optional[dict] = None,
         trace_id: Optional[str] = None,
     ) -> Request:
+        try:
+            prompt, stop, logit_bias = self._validate_submit(
+                prompt, max_new_tokens, temperature, top_k, top_p,
+                adapter, logprobs, stop, logit_bias,
+            )
+        except (TypeError, ValueError) as e:
+            # Admission rejects are flight-recorder events: a burst of
+            # them right before an incident is exactly the kind of
+            # lead-up the black box exists to preserve (and rejects
+            # never reach the metrics/span paths — the request dies
+            # before it has a lifecycle).
+            if self.flight is not None:
+                try:
+                    ptoks = len(prompt)  # may be unsized/hostile input
+                except TypeError:
+                    ptoks = None
+                self.flight.record(
+                    "admission.reject",
+                    reason=str(e),
+                    prompt_tokens=ptoks,
+                    max_new_tokens=max_new_tokens,
+                )
+            raise
+        with self._lock:
+            req = Request(
+                prompt, max_new_tokens, temperature, top_k, top_p,
+                adapter=adapter, logprobs=logprobs, stop=stop,
+                logit_bias=logit_bias,
+                # Every request is traceable even when the caller didn't
+                # send an id — generated ids tie SSE events, spans, and
+                # log lines of one request together.
+                trace_id=trace_id or new_trace_id(),
+                rid=self._next_rid, submitted_at=time.monotonic(),
+            )
+            if self.spans:
+                # Root span id reserved NOW so the queue/prefill/decode
+                # children (recorded from the owner thread) can parent on
+                # it before the root itself is recorded at finish.
+                req.root_span = self.spans.reserve_id()
+            self._next_rid += 1
+            self.queue.append(req)
+            # Scrapes happen on the MetricsServer thread: reflect queue
+            # pressure immediately, not at the owner's next step().
+            self._update_gauges()
+        return req
+
+    def _validate_submit(
+        self, prompt, max_new_tokens, temperature, top_k, top_p,
+        adapter, logprobs, stop, logit_bias,
+    ) -> tuple:
+        """Normalize and validate one submit()'s arguments; raises
+        ValueError/TypeError on anything inadmissible (the one seam
+        submit() wraps to meter rejects).  Returns the normalized
+        (prompt, stop, logit_bias)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -133,28 +187,7 @@ class AdmissionMixin:
                 f"has {allocatable} ({self.paged.num_pages - 1} allocatable "
                 f"pages x {self.paged.page_size})"
             )
-        with self._lock:
-            req = Request(
-                prompt, max_new_tokens, temperature, top_k, top_p,
-                adapter=adapter, logprobs=logprobs, stop=stop,
-                logit_bias=logit_bias,
-                # Every request is traceable even when the caller didn't
-                # send an id — generated ids tie SSE events, spans, and
-                # log lines of one request together.
-                trace_id=trace_id or new_trace_id(),
-                rid=self._next_rid, submitted_at=time.monotonic(),
-            )
-            if self.spans:
-                # Root span id reserved NOW so the queue/prefill/decode
-                # children (recorded from the owner thread) can parent on
-                # it before the root itself is recorded at finish.
-                req.root_span = self.spans.reserve_id()
-            self._next_rid += 1
-            self.queue.append(req)
-            # Scrapes happen on the MetricsServer thread: reflect queue
-            # pressure immediately, not at the owner's next step().
-            self._update_gauges()
-        return req
+        return prompt, stop, logit_bias
 
     def cancel(self, req: Request) -> bool:
         """Stop generating for ``req`` (the client went away — the HTTP
@@ -304,6 +337,7 @@ class AdmissionMixin:
         # the decode-block gate reads it — with the head page-blocked,
         # nothing can admit until something frees, so fine-grained
         # stepping buys no admission latency (engine.py _step_inner).
+        was_page_blocked = self._admit_page_blocked
         self._admit_page_blocked = False
         for slot in range(self.max_slots):
             # Queue peek/pop under the lock (submit() appends from other
@@ -404,6 +438,18 @@ class AdmissionMixin:
                 )
             admitted.append((slot, req, pages, len(shared)))
 
+        if (
+            self._admit_page_blocked
+            and not was_page_blocked
+            and self.flight is not None
+        ):
+            # Edge-triggered (the gate re-trips every step while blocked;
+            # one event per episode is the black-box-legible shape).
+            with self._lock:
+                qd, free = len(self.queue), len(self.free_pages)
+            self.flight.record(
+                "admission.page_blocked", queue_depth=qd, free_pages=free
+            )
         if not admitted:
             return []
         # Group by length bucket; each group becomes ONE prefill job
@@ -507,6 +553,7 @@ class AdmissionMixin:
             # First emitted token: the TTFT/ITL anchor for this slot.
             req.first_token_at = now
             self._slot_emit_t[slot] = now
+            self._step_tokens += 1  # the admission token counts (profiler)
             if self.metrics:
                 # A preemption resume re-activates the SAME client
                 # request: counting it again would skew requests_total
@@ -516,6 +563,13 @@ class AdmissionMixin:
                     self.metrics.wait_seconds.observe(now - req.submitted_at)
                     self.metrics.ttft_seconds.observe(now - req.submitted_at)
                 self.metrics.tokens.inc()
+            if not resumed and self.anomaly is not None:
+                # A sustained TTFT blow-up (queue wait, prefill stall)
+                # becomes an incident record with the flight window of
+                # what the engine was doing attached.
+                self.anomaly.observe(
+                    "engine.ttft_seconds", now - req.submitted_at
+                )
             if self.spans and not resumed:
                 # Queue wait and prefill recorded post-hoc from the
                 # lifecycle stamps, nested under the request root (a

@@ -309,6 +309,13 @@ class PagingMixin:
                 self.metrics.preemptions.inc()
             self.queue.appendleft(req)
             self._update_gauges()
+        if self.flight is not None:
+            self.flight.record(
+                "engine.preempt",
+                rid=req.rid,
+                generated=len(req.tokens),
+                free_pages_after=len(self.free_pages),
+            )
 
     def _extend_frontier(self, slot: int, lookahead: Optional[int] = None) -> None:
         """Publish every page the next step can write — up to the one

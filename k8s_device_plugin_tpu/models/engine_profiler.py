@@ -1,0 +1,261 @@
+"""Always-on per-step engine profiler: phase breakdown, occupancy, memory.
+
+The step-time histogram (PR 1's ``tpu_engine_step_seconds``) says a step
+got slow; it cannot say WHERE — admission scheduling, a long prefill
+chunk, the jitted decode dispatch, host-side sample consumption, or a
+speculative verify round.  This profiler times those phases on every
+step (two ``perf_counter`` reads per phase — cheap enough to never turn
+off), tracks batch occupancy, KV-page utilization, and device-memory
+deltas, and keeps rolling windows so ``GET /debug/profile`` can answer
+with p50/p99 per phase over the recent past.  The step-time/HBM
+breakdown is the host-visible half of the TPU profiling story
+arXiv:2309.08918 motivates; the device-op half stays with
+``POST /debug/profile/capture`` (a jax.profiler trace of a live step).
+
+Every ``summary_every`` steps a compact aggregate goes into the flight
+recorder (utils/flight.py) as an ``engine.step`` event — the black box
+carries the performance timeline alongside the lifecycle events — and
+each step's wall time feeds the anomaly monitor when one is wired.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# Host-observable step phases, in execution order.  "schedule" covers
+# admission + cancel sweeps, "prefill" the chunked prefill advance and
+# graft/activation, "decode" the jitted dispatch + device sync,
+# "sample" the host-side consumption of sampled tokens (append, stop
+# scan, finish), "spec_verify" the whole speculative draft+verify round
+# (which replaces decode+sample on speculative engines).
+PHASES = ("schedule", "prefill", "decode", "sample", "spec_verify")
+
+
+class StepTimer:
+    """Per-step phase stopwatch: ``mark(phase)`` attributes the time
+    since the previous mark (or construction) to ``phase``.  One of
+    these is created per engine step; it is owner-thread-only."""
+
+    __slots__ = ("phases", "t0", "_t")
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+        self.t0 = time.perf_counter()
+        self._t = self.t0
+
+    def mark(self, phase: str) -> None:
+        now = time.perf_counter()
+        self.phases[phase] = self.phases.get(phase, 0.0) + (now - self._t)
+        self._t = now
+
+
+def _percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted window."""
+    if not sorted_values:
+        return 0.0
+    idx = min(int(q * len(sorted_values)), len(sorted_values) - 1)
+    return sorted_values[idx]
+
+
+class EngineProfiler:
+    """Rolling-window per-step profile of one ServingEngine.
+
+    ``window`` bounds host memory (one small dict per step).  ``flight``
+    receives an ``engine.step`` aggregate every ``summary_every`` steps;
+    ``observe_step`` (wired to the anomaly monitor) receives every
+    step's wall seconds.  ``snapshot()`` is the JSON body of
+    ``GET /debug/profile``; writers run on the engine owner thread,
+    readers on HTTP handler threads — hence the lock.
+    """
+
+    def __init__(
+        self,
+        window: int = 256,
+        flight=None,
+        summary_every: int = 64,
+        observe_step=None,
+    ):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.flight = flight
+        self.summary_every = max(int(summary_every), 1)
+        self.observe_step = observe_step
+        self._lock = threading.Lock()
+        self._window: deque[dict] = deque(maxlen=window)
+        self.steps = 0
+        self.tokens = 0
+        self._phase_totals = {p: 0.0 for p in PHASES}
+        self._mem_fn = "unprobed"  # "unprobed" -> callable | None
+        self._last_mem: Optional[int] = None
+
+    def timer(self) -> StepTimer:
+        return StepTimer()
+
+    # -------------------------------------------------------------- memory
+
+    def _memory_bytes(self) -> Optional[int]:
+        """Device bytes-in-use via PJRT memory_stats, when the backend
+        exposes it (TPU does; CPU returns None) — probed once, then
+        either read every step or never again."""
+        if self._mem_fn == "unprobed":
+            self._mem_fn = None
+            try:
+                import jax
+
+                dev = jax.local_devices()[0]
+                stats = dev.memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    self._mem_fn = lambda d=dev: d.memory_stats()["bytes_in_use"]
+            except Exception:
+                self._mem_fn = None
+        if self._mem_fn is None:
+            return None
+        try:
+            return int(self._mem_fn())
+        except Exception:
+            self._mem_fn = None
+            return None
+
+    # --------------------------------------------------------------- record
+
+    def finish_step(
+        self,
+        timer: StepTimer,
+        *,
+        active_slots: int,
+        max_slots: int,
+        queued: int,
+        kv_page_utilization: float,
+        tokens: int,
+    ) -> float:
+        """Close out one step: fold the timer into the windows, sample
+        memory, emit the periodic flight summary, feed the anomaly hook.
+        Returns the step's wall seconds."""
+        now = time.perf_counter()
+        wall = now - timer.t0
+        mem = self._memory_bytes()
+        record = {
+            "wall_s": wall,
+            "phases": timer.phases,
+            "active_slots": active_slots,
+            "queued": queued,
+            "kv_page_utilization": kv_page_utilization,
+            "tokens": tokens,
+        }
+        if mem is not None:
+            record["mem_bytes"] = mem
+            if self._last_mem is not None:
+                record["mem_delta"] = mem - self._last_mem
+            self._last_mem = mem
+        with self._lock:
+            self._window.append(record)
+            self.steps += 1
+            self.tokens += tokens
+            for phase, dt in timer.phases.items():
+                if phase in self._phase_totals:
+                    self._phase_totals[phase] += dt
+            emit_summary = (
+                self.flight is not None and self.steps % self.summary_every == 0
+            )
+            if emit_summary:
+                window = list(self._window)
+        if emit_summary:
+            walls = sorted(r["wall_s"] for r in window)
+            self.flight.record(
+                "engine.step",
+                steps=self.steps,
+                window=len(window),
+                step_ms_p50=round(_percentile(walls, 0.5) * 1e3, 3),
+                step_ms_p99=round(_percentile(walls, 0.99) * 1e3, 3),
+                active_slots=active_slots,
+                queued=queued,
+                kv_page_utilization=round(kv_page_utilization, 4),
+                tokens_per_step=round(
+                    sum(r["tokens"] for r in window) / len(window), 2
+                ),
+                occupancy=round(
+                    sum(r["active_slots"] for r in window)
+                    / (len(window) * max(max_slots, 1)),
+                    4,
+                ),
+            )
+        if self.observe_step is not None:
+            self.observe_step(wall)
+        return wall
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """JSON body for ``GET /debug/profile``: per-phase breakdown
+        (mean/p50/p99 over the rolling window, lifetime totals), batch
+        occupancy, KV-page utilization, and device-memory track."""
+        with self._lock:
+            window = list(self._window)
+            steps = self.steps
+            tokens = self.tokens
+            totals = dict(self._phase_totals)
+        n = len(window)
+        phases = {}
+        for phase in PHASES:
+            samples = sorted(r["phases"].get(phase, 0.0) for r in window)
+            in_window = [r for r in window if phase in r["phases"]]
+            phases[phase] = {
+                "total_s": round(totals[phase], 6),
+                "window_mean_ms": round(
+                    (sum(samples) / n * 1e3) if n else 0.0, 4
+                ),
+                "window_p50_ms": round(_percentile(samples, 0.5) * 1e3, 4),
+                "window_p99_ms": round(_percentile(samples, 0.99) * 1e3, 4),
+                "window_steps": len(in_window),
+            }
+        walls = sorted(r["wall_s"] for r in window)
+        out = {
+            "steps": steps,
+            "tokens": tokens,
+            "window": n,
+            "step_ms": {
+                "mean": round((sum(walls) / n * 1e3) if n else 0.0, 4),
+                "p50": round(_percentile(walls, 0.5) * 1e3, 4),
+                "p99": round(_percentile(walls, 0.99) * 1e3, 4),
+            },
+            "phases": phases,
+            "occupancy": {
+                "mean_active_slots": round(
+                    sum(r["active_slots"] for r in window) / n, 3
+                )
+                if n
+                else 0.0,
+                "mean_queued": round(sum(r["queued"] for r in window) / n, 3)
+                if n
+                else 0.0,
+                "mean_kv_page_utilization": round(
+                    sum(r["kv_page_utilization"] for r in window) / n, 4
+                )
+                if n
+                else 0.0,
+            },
+            "tokens_per_step_mean": round(
+                sum(r["tokens"] for r in window) / n, 3
+            )
+            if n
+            else 0.0,
+        }
+        mems = [r["mem_bytes"] for r in window if "mem_bytes" in r]
+        if mems:
+            deltas = [r.get("mem_delta", 0) for r in window if "mem_delta" in r]
+            out["device_memory"] = {
+                "bytes_in_use": mems[-1],
+                "window_min": min(mems),
+                "window_max": max(mems),
+                "delta_per_step_mean": round(
+                    sum(deltas) / len(deltas), 1
+                )
+                if deltas
+                else 0.0,
+            }
+        else:
+            out["device_memory"] = None
+        return out

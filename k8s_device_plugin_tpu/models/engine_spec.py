@@ -248,6 +248,7 @@ class SpeculativeMixin:
             )
         emitted = np.asarray(emitted)
         a_vec = np.asarray(a_vec)
+        self._mark("spec_verify")
         now = time.monotonic()
         if self.spans:
             # One engine-scoped span per draft+verify round: acceptance
@@ -318,6 +319,8 @@ class SpeculativeMixin:
         # Rounds advance each slot by a data-dependent 1..gamma+1: the
         # device-resident step state cannot be fed forward (engine.py).
         self._mark_state_dirty()
+        self._mark("sample")
+        self._step_tokens += emitted_total
         if self.metrics:
             self.metrics.steps.inc()
             self.metrics.tokens.inc(emitted_total)

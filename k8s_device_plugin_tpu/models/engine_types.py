@@ -120,6 +120,14 @@ class EngineMetrics:
             "Inter-token latency per emitted decode token "
             "(block dispatches amortize: each of T tokens observes dt/T)",
         )
+        self.incidents = registry.counter(
+            "tpu_engine_incidents_total",
+            "Anomaly incidents emitted by the engine-side monitor "
+            "(utils/anomaly.py): sustained deviations of step time or "
+            "TTFT from their EWMA baselines; the records themselves are "
+            "served at GET /debug/incidents",
+            ["metric"],
+        )
         self.page_utilization = registry.gauge(
             "tpu_engine_kv_page_utilization",
             "Allocated fraction of the allocatable KV page pool (0..1; "

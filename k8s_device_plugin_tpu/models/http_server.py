@@ -50,6 +50,20 @@ rest of the models/ stack which benchmarks on synthetic ids):
          (utils/spans.py) when the engine was built with a recorder —
          ids and lengths only, never token content.
 
+    GET /debug/profile -> 200 JSON per-step profiler snapshot
+         (models/engine_profiler.py): per-phase breakdown
+         (schedule/prefill/decode/sample/spec_verify p50/p99 over the
+         rolling window), batch occupancy, KV-page utilization,
+         device-memory track.  Always on.
+    GET /debug/incidents -> 200 JSON anomaly-monitor snapshot
+         (utils/anomaly.py): bounded incident list (cause metric,
+         baseline, observed, z-score, attached flight-recorder window)
+         plus per-metric baseline state.
+    GET /debug/flight -> 200 JSON flight-recorder snapshot
+         (utils/flight.py): the typed-event black box with drop
+         accounting — same payload a `kill -USR2` dumps to
+         TPU_PLUGIN_DUMP_DIR.
+
     Trace-ID contract: a request may send ``X-Request-Id``; a valid id
     (printable, <= 128 chars, no quotes/backslashes/newlines) is adopted,
     anything else gets a generated one.  The id comes back on the
@@ -60,6 +74,12 @@ rest of the models/ stack which benchmarks on synthetic ids):
       -> 200 {"trace_dir": ...} after capturing a jax.profiler trace of
          the live serving loop (XProf/Perfetto); 409 while one runs;
          404 unless the operator enabled the endpoint.
+    POST /debug/profile/capture {"steps": n?, "timeout_s": t?}
+         [opt-in: --debug-trace]
+      -> 200 {"trace_dir", "steps_captured"} after capturing a
+         jax.profiler trace spanning the next n engine steps (default 1)
+         — the device-op view of exactly the step(s) the host-side
+         profiler summarizes; 409 while any capture runs.
 """
 
 from __future__ import annotations
@@ -71,6 +91,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..utils import flight as flight_mod
 from ..utils.metrics import MetricsRegistry
 from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
@@ -106,14 +127,17 @@ class EngineServer:
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
                 path = self.path.split("?")[0]
-                if path == "/debug/trace":
+                if path in ("/debug/trace", "/debug/profile/capture"):
                     if not server._enable_trace:
                         # Off unless the operator opted in (--debug-trace):
                         # the server binds 0.0.0.0 by default, and an open
                         # profiler endpoint is a latency/disk DoS lever.
                         self.send_error(404)
                         return
-                    self._trace_capture()
+                    if path == "/debug/trace":
+                        self._trace_capture()
+                    else:
+                        self._step_capture()
                     return
                 if path != "/generate":
                     self.send_error(404)
@@ -282,6 +306,61 @@ class EngineServer:
                     server._trace_lock.release()
                 self._reply(200, {"trace_dir": tdir, "seconds": seconds})
 
+            def _step_capture(self) -> None:
+                """POST /debug/profile/capture {"steps": n?, "timeout_s"?}:
+                capture a jax.profiler trace spanning the next n engine
+                steps — the device-op (XProf/Perfetto) view of exactly
+                what /debug/profile summarizes host-side.  Step
+                completion is watched via the profiler's step counter on
+                the server condition; an idle engine simply times out
+                with steps_captured 0 (capture while traffic flows).
+                Shares the one-capture-at-a-time lock with /debug/trace."""
+                import tempfile
+
+                from ..utils import tracing
+
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise TypeError(f"body must be an object, got {body!r}")
+                    steps = int(body.get("steps", 1))
+                    if not 1 <= steps <= 64:
+                        raise ValueError(f"steps must be in [1, 64], got {steps}")
+                    timeout_s = min(max(float(body.get("timeout_s", 10.0)), 0.1), 60.0)
+                except (TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if not server._trace_lock.acquire(blocking=False):
+                    self._reply(409, {"error": "a trace capture is already running"})
+                    return
+                tdir = tempfile.mkdtemp(prefix="tpu-step-trace-")
+                profiler = server.engine.profiler
+                start = profiler.steps
+                target = start + steps
+                deadline = time.monotonic() + timeout_s
+                try:
+                    with tracing.trace(tdir):
+                        while (
+                            profiler.steps < target
+                            and time.monotonic() < deadline
+                        ):
+                            with server._cond:
+                                server._cond.wait(timeout=0.05)
+                except Exception as e:  # profiler state is global: report
+                    self._reply(500, {"error": f"trace failed: {e}"})
+                    return
+                finally:
+                    server._trace_lock.release()
+                self._reply(
+                    200,
+                    {
+                        "trace_dir": tdir,
+                        "steps_requested": steps,
+                        "steps_captured": min(profiler.steps - start, steps),
+                    },
+                )
+
             def _stream_reply(self, req) -> None:
                 """Server-sent events: one ``data:`` event per generated
                 token as the engine emits it, then a final ``done`` event
@@ -379,6 +458,18 @@ class EngineServer:
                         state["spans_dropped"] = rec.dropped
                         state["span_capacity"] = rec.capacity
                     self._reply(200, state)
+                elif path == "/debug/profile":
+                    # Per-step phase breakdown over the rolling window —
+                    # aggregates only, no request-identifying content, so
+                    # it stays as open as /metrics.
+                    self._reply(200, server.engine.profiler.snapshot())
+                elif path == "/debug/incidents":
+                    self._reply(200, server.engine.anomaly.snapshot())
+                elif path == "/debug/flight":
+                    # The black box, on demand (same payload SIGUSR2
+                    # dumps): ids/lengths/counts only by construction of
+                    # the event catalog — never token content.
+                    self._reply(200, server.engine.flight.snapshot())
                 elif path == "/metrics" and registry is not None:
                     body = registry.render().encode()
                     self.send_response(200)
@@ -572,9 +663,25 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument(
         "--debug-trace",
         action="store_true",
-        help="enable POST /debug/trace (on-demand jax.profiler capture of "
-        "the live serving loop) — off by default: the endpoint is "
-        "unauthenticated and the server binds 0.0.0.0",
+        help="enable POST /debug/trace and /debug/profile/capture "
+        "(on-demand jax.profiler capture of the live serving loop) — off "
+        "by default: the endpoints are unauthenticated and the server "
+        "binds 0.0.0.0",
+    )
+    p.add_argument(
+        "--flight-ring",
+        type=_positive_int,
+        default=2048,
+        help="capacity of the flight-recorder event ring (utils/flight.py) "
+        "served by GET /debug/flight and dumped on SIGUSR2/exit",
+    )
+    p.add_argument(
+        "--dump-dir",
+        default=flight_mod.default_dump_dir() or "",
+        help="directory for flight-recorder dumps: `kill -USR2 <pid>` "
+        "writes one on demand, and the process writes a final one at "
+        "exit when this is set (default: $TPU_PLUGIN_DUMP_DIR; the "
+        "deploy yamls mount an emptyDir here)",
     )
     p.add_argument(
         "--checkpoint-dir",
@@ -698,6 +805,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         use_kernel=args.use_kernel,
     )
     registry = MetricsRegistry()
+    # The black box: registered process-wide so `kill -USR2` (and, with a
+    # dump dir configured, process exit) writes it to disk — the
+    # post-mortem story when the pod is dead and /debug/flight is not
+    # answering anymore.
+    box = flight_mod.register(
+        flight_mod.FlightRecorder(capacity=args.flight_ring, name="engine")
+    )
+    flight_mod.install_dump_handlers(args.dump_dir or None)
     engine = ServingEngine(
         cfg,
         params,
@@ -705,6 +820,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
         spans=SpanRecorder(capacity=args.span_ring),
+        flight=box,
         prefill_chunk=args.prefill_chunk,
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
         admission=args.admission,
@@ -714,9 +830,29 @@ def main(argv: Optional[list[str]] = None) -> None:
         engine, port=args.http_port, registry=registry,
         enable_trace=args.debug_trace,
     ).start()
+
+    # A pod delete sends SIGTERM: stop the loop cleanly so shutdown runs
+    # the atexit flight dump (the default disposition would kill the
+    # process with the black box still in memory — exactly the moment it
+    # exists for).
+    import signal
+
+    def _on_signal(signum, _frame):
+        print(
+            f"received {signal.Signals(signum).name}; shutting down",
+            file=sys.stderr,
+            flush=True,
+        )
+        server._stop.set()
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_signal)
+    except ValueError:
+        pass  # not on the main thread (embedded/test use)
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
-        "/debug/state)",
+        "/debug/state /debug/profile /debug/incidents /debug/flight)",
         file=sys.stderr,
         flush=True,
     )

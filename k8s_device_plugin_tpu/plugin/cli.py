@@ -15,8 +15,11 @@ import signal
 import sys
 
 from ..kubelet import constants
+from ..utils import flight as flight_mod
+from ..utils.anomaly import AnomalyMonitor
 from ..utils.logging import setup_logging
 from ..utils.metrics import MetricsServer
+from ..utils.spans import SpanRecorder
 from . import discovery
 from .health import ChipHealthChecker
 from .manager import DEFAULT_ENDPOINT, PluginManager
@@ -69,13 +72,30 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-port",
         type=int,
         default=0,
-        help="serve Prometheus /metrics (+ /healthz) on this port (0 disables; "
-        "beyond-reference observability, SURVEY.md §5.5/§7)",
+        help="serve Prometheus /metrics (+ /healthz, /debug/devices, "
+        "/debug/incidents, /debug/flight, /debug/spans) on this port "
+        "(0 disables; beyond-reference observability, SURVEY.md §5.5/§7)",
+    )
+    p.add_argument(
+        "--flight-ring",
+        type=int,
+        default=2048,
+        help="capacity of the flight-recorder event ring (utils/flight.py: "
+        "registrations, ListAndWatch updates, Allocates, health "
+        "transitions) dumped on SIGUSR2/exit and served at /debug/flight",
+    )
+    p.add_argument(
+        "--dump-dir",
+        default=flight_mod.default_dump_dir() or "",
+        help="directory for flight-recorder dumps: `kill -USR2 <pid>` "
+        "writes one on demand, and the daemon writes a final one at exit "
+        "when this is set (default: $TPU_PLUGIN_DUMP_DIR; the DaemonSet "
+        "yamls mount /run/tpu/dump here)",
     )
     return p
 
 
-def _build_multi_manager(args):
+def _build_multi_manager(args, new_plugin):
     """--resources path: every listed name gets its own plugin server and
     registration under one shared kubelet watch (plugin/resources.py)."""
     from .resources import MultiResourceManager, StaticLister
@@ -96,20 +116,10 @@ def _build_multi_manager(args):
             f"--resources must share one namespace, got {sorted(namespaces)}"
         )
 
-    def new_plugin(name: str) -> TpuDevicePlugin:
-        return TpuDevicePlugin(
-            discover=lambda: discovery.discover(root=args.root),
-            health_checker=ChipHealthChecker(
-                root=args.root,
-                observe_sweep_seconds=(
-                    default_plugin_metrics().health_sweep_seconds.observe
-                ),
-            ),
-            metrics=default_plugin_metrics(),
-        )
-
     lister = StaticLister(
-        [name for _, name in pairs], new_plugin, namespace=namespaces.pop()
+        [name for _, name in pairs],
+        lambda name: new_plugin(),
+        namespace=namespaces.pop(),
     )
     return MultiResourceManager(
         lister, plugin_dir=args.plugin_dir, pulse=args.pulse
@@ -120,32 +130,70 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.json_logs)
 
-    debug_endpoints = None
+    # Forensics layer, one set per process shared by every resource's
+    # plugin: the flight-recorder black box (registered so `kill -USR2`
+    # and exit dump it — utils/flight.py), the anomaly monitor over
+    # Allocate latency and health-sweep duration, and the daemon span
+    # ring fed by timed_rpc (utils/tracing.py).
+    box = flight_mod.register(
+        flight_mod.FlightRecorder(capacity=args.flight_ring, name="daemon")
+    )
+    flight_mod.install_dump_handlers(args.dump_dir or None)
+    monitor = AnomalyMonitor(
+        flight=box,
+        on_incident=lambda m: default_plugin_metrics().incidents.inc(metric=m),
+    )
+    monitor.configure(
+        "plugin.health_sweep_seconds", warmup=30, z_threshold=6.0, sustain=3
+    )
+    spans = SpanRecorder(capacity=512)
+
+    def observe_sweep(dt: float) -> None:
+        # One hook, two sinks: the Prometheus histogram operators scrape
+        # and the EWMA baseline that turns a sustained slow sweep (wedged
+        # sysfs/devfs) into an incident record.
+        default_plugin_metrics().health_sweep_seconds.observe(dt)
+        monitor.observe("plugin.health_sweep_seconds", dt)
+
+    def new_plugin() -> TpuDevicePlugin:
+        return TpuDevicePlugin(
+            discover=lambda: discovery.discover(root=args.root),
+            health_checker=ChipHealthChecker(
+                root=args.root,
+                observe_sweep_seconds=observe_sweep,
+                flight=box,
+            ),
+            metrics=default_plugin_metrics(),
+            flight=box,
+            anomaly=monitor,
+            spans=spans,
+        )
+
+    debug_endpoints = {
+        "/debug/incidents": monitor.snapshot,
+        "/debug/flight": box.snapshot,
+        "/debug/spans": lambda: {
+            "spans": spans.snapshot(),
+            "dropped": spans.dropped,
+            "capacity": spans.capacity,
+        },
+    }
     if args.resources:
         # Multi-resource mode builds one plugin per resource inside the
         # manager; probe inventory directly rather than via a throwaway plugin.
         inventory = discovery.discover(root=args.root)
         served = args.resources
     else:
-        plugin = TpuDevicePlugin(
-            discover=lambda: discovery.discover(root=args.root),
-            health_checker=ChipHealthChecker(
-                root=args.root,
-                observe_sweep_seconds=(
-                    default_plugin_metrics().health_sweep_seconds.observe
-                ),
-            ),
-            metrics=default_plugin_metrics(),
-        )
+        plugin = new_plugin()
         inventory = plugin.inventory  # discovery already ran once in the ctor
         served = args.resource
         # Device snapshot next to /metrics: what this node is advertising.
-        debug_endpoints = {"/debug/devices": plugin.debug_state}
+        debug_endpoints["/debug/devices"] = plugin.debug_state
     if args.require_chips and inventory.chip_count == 0:
         log.error("no TPU chips found under %s and --require-chips is set", args.root)
         return 1
     if args.resources:
-        manager = _build_multi_manager(args)
+        manager = _build_multi_manager(args, new_plugin)
     else:
         manager = PluginManager(
             plugin,
@@ -185,9 +233,9 @@ def main(argv: list[str] | None = None) -> int:
             )
             metrics_server.start()
             log.info(
-                "metrics on :%d/metrics%s",
+                "metrics on :%d/metrics (+ %s)",
                 metrics_server.port,
-                " (+ /debug/devices)" if debug_endpoints else "",
+                " ".join(sorted(debug_endpoints)),
             )
         manager.run()
     finally:

@@ -44,15 +44,21 @@ class ChipHealthChecker:
         root: str = "/",
         prober: native.NativeProber | None | object = "auto",
         observe_sweep_seconds=None,
+        flight=None,
     ):
         self._root = root
         # "auto" → process-wide shared library; None → force Python path.
         self._prober = native.shared_prober() if prober == "auto" else prober
         # Optional telemetry hook: called with the wall seconds of every
         # check_many sweep (cli.py wires it to the plugin's
-        # tpu_plugin_health_sweep_seconds histogram) — the ONE place
-        # sweep latency is observed, whoever drives the sweep.
+        # tpu_plugin_health_sweep_seconds histogram AND the anomaly
+        # monitor's sweep-duration baseline) — the ONE place sweep
+        # latency is observed, whoever drives the sweep.
         self._observe_sweep = observe_sweep_seconds
+        # Optional flight recorder (utils/flight.py): probe open()
+        # failures are black-box events — the raw evidence behind a
+        # health transition the plugin later streams.
+        self._flight = flight
 
     def _override(self, chip: TpuChip) -> bool | None:
         path = os.path.join(self._root, HEALTH_OVERRIDE_DIR, f"accel{chip.index}")
@@ -88,6 +94,10 @@ class ChipHealthChecker:
             if e.errno in _BUSY_ERRNOS:
                 return True  # exclusively held by a workload: alive and in use
             log.warning("open(%s) failed: %s", dev_path, e)
+            if self._flight is not None:
+                self._flight.record(
+                    "health.probe_failure", device=dev_path, error=str(e)
+                )
             return False
         else:
             os.close(fd)
@@ -98,6 +108,12 @@ class ChipHealthChecker:
             log.warning(
                 "open(%s) failed: %s", dev_path, os.strerror(err) if err else err
             )
+            if self._flight is not None:
+                self._flight.record(
+                    "health.probe_failure",
+                    device=dev_path,
+                    error=os.strerror(err) if err else str(err),
+                )
         return native.is_healthy_code(code)
 
     def check_many(self, chips: tuple[TpuChip, ...] | list[TpuChip]) -> dict[str, bool]:

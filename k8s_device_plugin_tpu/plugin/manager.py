@@ -256,6 +256,10 @@ class PluginManager:
             raise
         self.registrations += 1
         self.plugin.metrics.registrations.inc()
+        if self.plugin.flight is not None:
+            self.plugin.flight.record(
+                "registration", resource=self.resource, endpoint=self.endpoint
+            )
         log.info("registered %s with kubelet (endpoint %s)", self.resource, self.endpoint)
 
     def _start_and_register(self) -> None:
@@ -344,6 +348,8 @@ class PluginManager:
             # event will kick us again).
             if self._registered_key is not None or self._server is not None:
                 log.info("kubelet socket absent; stopping plugin server")
+                if self.plugin.flight is not None:
+                    self.plugin.flight.record("kubelet.absent")
                 self._stop_server()
                 self._registered_key = None
             return True
@@ -355,6 +361,8 @@ class PluginManager:
             # must not inflate the restart metric.
             self._counted_key = key
             self.plugin.metrics.kubelet_restarts.inc()
+            if self.plugin.flight is not None:
+                self.plugin.flight.record("kubelet.restart")
         log.info("kubelet (re)start detected; re-registering")
         try:
             self._stop_server()

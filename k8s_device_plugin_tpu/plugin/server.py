@@ -20,13 +20,18 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable
 
 import grpc
 
 from ..kubelet import constants
 from ..kubelet.api import pb
+from ..utils import tracing
+from ..utils.anomaly import AnomalyMonitor
+from ..utils.flight import FlightRecorder
 from ..utils.metrics import MetricsRegistry
+from ..utils.spans import SpanRecorder
 from .discovery import TpuChip, TpuHostInventory
 from .envs import allocation_annotations, allocation_envs
 from .health import ChipHealthChecker
@@ -143,6 +148,13 @@ class PluginMetrics:
             "tpu_plugin_kubelet_restarts_total",
             "kubelet.sock recreations observed by the watcher",
         )
+        self.incidents = registry.counter(
+            "tpu_plugin_incidents_total",
+            "Anomaly incidents emitted by the daemon-side monitor "
+            "(utils/anomaly.py: Allocate latency, health-sweep duration); "
+            "records served at the MetricsServer's /debug/incidents",
+            ["metric"],
+        )
 
 
 class TpuDevicePlugin:
@@ -159,10 +171,38 @@ class TpuDevicePlugin:
         discover: Callable[[], TpuHostInventory],
         health_checker: ChipHealthChecker,
         metrics: PluginMetrics | None = None,
+        flight: FlightRecorder | None = None,
+        anomaly: AnomalyMonitor | None = None,
+        spans: SpanRecorder | None = None,
     ):
         self._discover = discover
         self._health_checker = health_checker
         self.metrics = metrics if metrics is not None else PluginMetrics(MetricsRegistry())
+        # Forensics (cli.py wires shared instances; all optional here so
+        # bare test constructions stay zero-cost): a flight-recorder
+        # black box of daemon lifecycle events, an anomaly monitor over
+        # Allocate latency, and a daemon span ring fed by timed_rpc.
+        self.flight = flight
+        self.anomaly = anomaly
+        self.spans = spans
+        if anomaly is not None:
+            anomaly.configure(
+                "plugin.allocate_seconds", warmup=20, z_threshold=6.0,
+                sustain=2,
+            )
+        # Route the kubelet-facing RPC surface through timed_rpc (one
+        # tracing story, two entry points): every Allocate /
+        # GetPreferredAllocation lands in the daemon span ring with the
+        # DAEMON_TRACE id.  Instance-level wrap because the recorder is
+        # per-instance; the metrics histograms inside Allocate are
+        # untouched (observe= stays for callers without a histogram).
+        if spans is not None:
+            self.Allocate = tracing.timed_rpc(
+                self.Allocate, spans=lambda: self.spans, threshold_ms=50.0
+            )
+            self.GetPreferredAllocation = tracing.timed_rpc(
+                self.GetPreferredAllocation, spans=lambda: self.spans
+            )
         self._cond = threading.Condition()
         self._version = 0
         self._epoch = 0  # bumped by interrupt_streams(); streams die on change
@@ -197,6 +237,12 @@ class TpuDevicePlugin:
                     self.metrics.health_transitions.inc(
                         direction="to_unhealthy" if was else "to_healthy"
                     )
+                    if self.flight is not None:
+                        self.flight.record(
+                            "health.transition",
+                            device=k8s_id,
+                            to="Unhealthy" if was else "Healthy",
+                        )
             # Per-device health series track the streamed device list
             # exactly: an unplugged chip's series is removed, not frozen
             # at its last value (a flat 1 for a missing chip would read
@@ -217,6 +263,13 @@ class TpuDevicePlugin:
         self.metrics.chips.set(len(health) - sum(health.values()), state="unhealthy")
         if changed:
             self.metrics.device_updates.inc()
+            if self.flight is not None:
+                self.flight.record(
+                    "listandwatch.update",
+                    version=version,
+                    chips=len(health),
+                    healthy=sum(health.values()),
+                )
             log.info(
                 "device state v%d: %s",
                 version,
@@ -291,6 +344,10 @@ class TpuDevicePlugin:
         version, inventory, health = self._snapshot()
         log.info("ListAndWatch stream opened (v%d, %d chips)", version, inventory.chip_count)
         self.metrics.streams.inc()
+        if self.flight is not None:
+            self.flight.record(
+                "listandwatch.stream", op="open", version=version
+            )
         try:
             yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
             while True:
@@ -312,6 +369,8 @@ class TpuDevicePlugin:
                 yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
         finally:
             self.metrics.streams.dec()
+            if self.flight is not None:
+                self.flight.record("listandwatch.stream", op="close")
 
     # --------------------------------------------------- RPC: preferred alloc
 
@@ -369,6 +428,7 @@ class TpuDevicePlugin:
     # ---------------------------------------------------------- RPC: allocate
 
     def Allocate(self, request, context):
+        t0 = time.monotonic()
         with self.metrics.allocation_latency.time(), \
                 self.metrics.allocate_seconds.time():
             _, inventory, health = self._snapshot()
@@ -380,12 +440,23 @@ class TpuDevicePlugin:
                     chips = [inventory.chip_by_k8s_id(d) for d in ids]
                 except KeyError as e:
                     self.metrics.allocations.inc(outcome="unknown_device")
+                    if self.flight is not None:
+                        self.flight.record(
+                            "allocate", ids=ids, outcome="unknown_device"
+                        )
                     context.abort(
                         grpc.StatusCode.NOT_FOUND, f"unknown device id {e.args[0]!r}"
                     )
                 unhealthy = [c.k8s_id for c in chips if not health.get(c.k8s_id)]
                 if unhealthy:
                     self.metrics.allocations.inc(outcome="unhealthy_device")
+                    if self.flight is not None:
+                        self.flight.record(
+                            "allocate",
+                            ids=ids,
+                            outcome="unhealthy_device",
+                            unhealthy=unhealthy,
+                        )
                     context.abort(
                         grpc.StatusCode.FAILED_PRECONDITION,
                         f"device(s) {unhealthy} are Unhealthy",
@@ -400,7 +471,21 @@ class TpuDevicePlugin:
                 len(request.container_requests), outcome="ok"
             )
             self.metrics.allocated_chips.inc(granted_chips)
-            return resp
+        dt = time.monotonic() - t0
+        if self.flight is not None:
+            self.flight.record(
+                "allocate",
+                outcome="ok",
+                containers=len(request.container_requests),
+                chips=granted_chips,
+                ms=round(dt * 1e3, 3),
+            )
+        if self.anomaly is not None:
+            # Sustained Allocate-latency blowups (wedged devfs, lock
+            # contention) become incident records with the lead-up
+            # events attached — the pod-startup-path SLO guard.
+            self.anomaly.observe("plugin.allocate_seconds", dt)
+        return resp
 
     def _allocate_one(
         self, inventory: TpuHostInventory, chips: list[TpuChip]

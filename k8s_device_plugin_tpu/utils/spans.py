@@ -44,6 +44,9 @@ log = logging.getLogger("tpu.spans")
 
 # Engine-scoped (not request-scoped) spans use this trace id.
 ENGINE_TRACE = "engine"
+# Daemon-side RPC spans (utils/tracing.timed_rpc) use this one: the one
+# span ring tells kubelet-RPC and engine-request timelines apart by trace.
+DAEMON_TRACE = "daemon"
 
 _MAX_TRACE_ID_LEN = 128
 _FORBIDDEN = set('"\\\n\r')

@@ -8,8 +8,10 @@ Two layers:
   HBM usage, ICI collective timing.  ``annotate()`` names a region so host
   Python shows up aligned with device ops.
 - Daemon (host) side: ``timed_rpc`` decorates gRPC servicer methods with
-  wall-time logging + optional metrics-registry observation; cheap enough to
-  leave on (one perf_counter pair per call).
+  wall-time logging, optional metrics-registry observation, AND a
+  daemon-side span into the utils/spans.py ring — one tracing story with
+  two entry points (request spans from the engine, RPC spans from the
+  daemon); cheap enough to leave on (one monotonic pair per call).
 """
 
 from __future__ import annotations
@@ -18,10 +20,23 @@ import contextlib
 import functools
 import logging
 import os
+import threading
 import time
 from typing import Iterator, Optional
 
+from .spans import DAEMON_TRACE
+
 log = logging.getLogger(__name__)
+
+# Traces started through this module, counted so annotate() can tell
+# whether naming a region would reach a profiler at all.
+_active_traces = 0
+_active_lock = threading.Lock()
+
+
+def trace_active() -> bool:
+    """True while a jax.profiler trace started via :func:`trace` runs."""
+    return _active_traces > 0
 
 
 @contextlib.contextmanager
@@ -29,6 +44,7 @@ def trace(trace_dir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace of the enclosed region into
     ``trace_dir`` (no-op when trace_dir is falsy, so callers can wire it
     straight to an optional flag/env)."""
+    global _active_traces
     if not trace_dir:
         yield
         return
@@ -37,14 +53,31 @@ def trace(trace_dir: Optional[str]) -> Iterator[None]:
     os.makedirs(trace_dir, exist_ok=True)
     log.info("profiler trace -> %s", trace_dir)
     with jax.profiler.trace(trace_dir):
-        yield
+        with _active_lock:
+            _active_traces += 1
+        try:
+            yield
+        finally:
+            with _active_lock:
+                _active_traces -= 1
 
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named sub-region inside an active trace (TraceAnnotation)."""
-    import jax
+    """Named sub-region inside an active trace (TraceAnnotation).
 
+    A guaranteed no-op when no profiler trace (started via this module)
+    is active or when jax is unavailable, so host-only callers — the
+    plugin daemon runs in an image that need not ship jax — can
+    annotate hot regions unconditionally."""
+    if not trace_active():
+        yield
+        return
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
     with jax.profiler.TraceAnnotation(name):
         yield
 
@@ -55,21 +88,45 @@ def default_trace_dir(environ=None) -> Optional[str]:
     return environ.get("TPU_PLUGIN_TRACE_DIR") or None
 
 
-def timed_rpc(fn=None, *, observe=None, threshold_ms: float = 0.0):
-    """Decorator for daemon RPC handlers: debug-log wall time per call, and
-    feed ``observe(seconds)`` (e.g. a metrics summary) when provided.
-    ``threshold_ms`` promotes slow calls to WARNING."""
+def timed_rpc(
+    fn=None,
+    *,
+    observe=None,
+    threshold_ms: float = 0.0,
+    spans=None,
+    name: Optional[str] = None,
+):
+    """Decorator for daemon RPC handlers: debug-log wall time per call,
+    feed ``observe(seconds)`` (e.g. a metrics summary — the hook is
+    unchanged), and record one daemon-side span per call into ``spans``
+    — either a utils/spans.py SpanRecorder or a no-arg callable
+    returning one/None (late binding: decoration happens before the
+    daemon wires its recorder).  RPC spans carry the DAEMON_TRACE trace
+    id, so the one span ring tells engine-request and kubelet-RPC
+    timelines apart by trace.  ``threshold_ms`` promotes slow calls to
+    WARNING."""
 
     def wrap(f):
+        span_name = name or f"rpc.{f.__name__}"
+
         @functools.wraps(f)
         def inner(*args, **kwargs):
-            t0 = time.perf_counter()
+            t0 = time.monotonic()
             try:
                 return f(*args, **kwargs)
             finally:
-                dt = time.perf_counter() - t0
+                end = time.monotonic()
+                dt = end - t0
                 if observe is not None:
                     observe(dt)
+                recorder = spans() if callable(spans) else spans
+                if recorder is not None:
+                    recorder.record_span(
+                        span_name,
+                        DAEMON_TRACE,
+                        start_monotonic=t0,
+                        end_monotonic=end,
+                    )
                 if threshold_ms and dt * 1e3 >= threshold_ms:
                     log.warning("%s took %.1f ms", f.__name__, dt * 1e3)
                 else:

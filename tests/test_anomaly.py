@@ -1,0 +1,166 @@
+"""Anomaly baselines + incident records (utils/anomaly.py): EWMA math,
+the sustained-deviation gate, and the incident-record shape under a
+forced anomaly — cause metric, baseline, observed, attached flight
+window."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from k8s_device_plugin_tpu.utils.anomaly import (
+    AnomalyDetector,
+    AnomalyMonitor,
+    EwmaBaseline,
+)
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+
+def test_ewma_tracks_mean():
+    b = EwmaBaseline(alpha=0.2, warmup=5)
+    for _ in range(50):
+        b.observe(10.0)
+    assert b.mean == pytest.approx(10.0)
+    assert math.sqrt(b.var) < 0.5
+
+
+def test_ewma_warmup_gates_z():
+    b = EwmaBaseline(alpha=0.1, warmup=10)
+    for i in range(10):
+        assert b.observe(1.0) is None  # absorbing the warmup samples
+    assert b.observe(1.0) is not None  # warmed: scores against history
+
+
+def test_ewma_scores_against_past_not_self():
+    b = EwmaBaseline(alpha=0.1, warmup=5)
+    for _ in range(20):
+        b.observe(1.0)
+    z = b.observe(100.0)
+    assert z is not None and z > 10.0
+
+
+def test_detector_sustained_gate():
+    det = AnomalyDetector("m", warmup=10, z_threshold=4.0, sustain=3)
+    for _ in range(20):
+        assert det.observe(1.0) is None
+    # One outlier is noise, two are suspicion, three are an incident.
+    assert det.observe(100.0) is None
+    assert det.observe(100.0) is None
+    incident = det.observe(100.0)
+    assert incident is not None
+    assert incident["metric"] == "m"
+    assert incident["observed"] == 100.0
+    assert incident["baseline_mean"] == pytest.approx(1.0, abs=0.1)
+    assert incident["z"] > 4.0
+    assert incident["sustained"] == 3
+
+
+def test_detector_broken_run_resets():
+    det = AnomalyDetector("m", warmup=10, z_threshold=4.0, sustain=3)
+    for _ in range(20):
+        det.observe(1.0)
+    assert det.observe(100.0) is None
+    assert det.observe(1.0) is None  # run broken
+    assert det.observe(100.0) is None
+    assert det.observe(100.0) is None  # only 2 in a row again
+    assert det.observe(100.0) is not None
+
+
+def test_detector_cooldown_suppresses_repeat():
+    det = AnomalyDetector(
+        "m", warmup=5, z_threshold=4.0, sustain=2, cooldown_s=1000.0
+    )
+    for _ in range(10):
+        det.observe(1.0)
+    assert det.observe(50.0) is None
+    assert det.observe(50.0) is not None  # first incident
+    # Continuing outage inside the cooldown window: no duplicate records.
+    assert all(det.observe(50.0) is None for _ in range(10))
+
+
+def test_detector_baseline_frozen_during_run():
+    det = AnomalyDetector("m", warmup=5, z_threshold=4.0, sustain=100)
+    for _ in range(10):
+        det.observe(1.0)
+    mean_before = det.baseline.mean
+    for _ in range(50):  # long sub-sustain run of anomalous samples
+        det.observe(100.0)
+    assert det.baseline.mean == pytest.approx(mean_before)
+
+
+def test_detector_low_direction():
+    det = AnomalyDetector(
+        "m", warmup=5, z_threshold=4.0, sustain=1, direction="low"
+    )
+    for _ in range(10):
+        det.observe(100.0)
+    assert det.observe(200.0) is None  # high deviation ignored
+    assert det.observe(0.001) is not None
+
+
+def test_monitor_incident_carries_flight_window():
+    """The acceptance-criteria shape: a forced anomaly yields an incident
+    record containing the surrounding flight-recorder window."""
+    box = FlightRecorder(capacity=32, name="engine")
+    monitor = AnomalyMonitor(flight=box, window_events=10)
+    monitor.configure("engine.step_seconds", warmup=5, z_threshold=4.0, sustain=2)
+    box.record("engine.step", steps=1)
+    box.record("admission.reject", reason="too big")
+    for _ in range(10):
+        assert monitor.observe("engine.step_seconds", 0.01) is None
+    monitor.observe("engine.step_seconds", 5.0)
+    incident = monitor.observe("engine.step_seconds", 5.0)
+    assert incident is not None
+    assert incident["metric"] == "engine.step_seconds"
+    assert incident["observed"] == 5.0
+    assert incident["baseline_mean"] == pytest.approx(0.01, rel=0.5)
+    window_kinds = [e["kind"] for e in incident["flight_window"]]
+    assert "engine.step" in window_kinds
+    assert "admission.reject" in window_kinds
+    # The incident also lands in the flight ring AFTER its window, so a
+    # later dump shows it in sequence.
+    assert box.window(kinds=["incident"])
+    json.dumps(incident)  # whole record is JSON-safe
+
+
+def test_monitor_snapshot_shape_and_counter_hook():
+    fired = []
+    monitor = AnomalyMonitor(on_incident=fired.append)
+    monitor.configure("m", warmup=5, z_threshold=4.0, sustain=1)
+    for _ in range(10):
+        monitor.observe("m", 1.0)
+    monitor.observe("m", 99.0)
+    snap = monitor.snapshot()
+    assert snap["incidents_total"] == 1
+    assert snap["detectors"]["m"]["warmed_up"] is True
+    assert snap["detectors"]["m"]["incidents"] == 1
+    assert len(snap["incidents"]) == 1
+    assert fired == ["m"]
+    json.dumps(snap)
+
+
+def test_monitor_lazy_default_detector():
+    monitor = AnomalyMonitor()
+    for _ in range(100):
+        monitor.observe("never.configured", 1.0)
+    assert "never.configured" in monitor.snapshot()["detectors"]
+
+
+def test_monitor_incident_ring_bounded():
+    monitor = AnomalyMonitor(capacity=2)
+    monitor.configure("m", warmup=2, z_threshold=4.0, sustain=1, cooldown_s=0.0)
+    for _ in range(5):
+        monitor.observe("m", 1.0)
+    for _ in range(5):
+        monitor.observe("m", 1000.0)
+        # Break the run so each spike can re-fire past the latch.
+        for _ in range(3):
+            monitor.observe("m", 1.0)
+    snap = monitor.snapshot()
+    assert len(snap["incidents"]) <= 2
+    assert snap["incidents_total"] >= 3
+    assert snap["incidents_dropped"] == snap["incidents_total"] - len(
+        snap["incidents"]
+    )

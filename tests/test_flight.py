@@ -1,0 +1,159 @@
+"""Flight recorder (utils/flight.py): bounded typed-event journal, drop
+accounting, and the SIGUSR2/atexit dump path — the black box must
+produce a valid JSON dump exactly when the process is in trouble."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.utils import flight
+
+
+@pytest.fixture
+def box():
+    rec = flight.FlightRecorder(capacity=8, name="test")
+    yield rec
+    flight.unregister(rec)
+
+
+def test_record_and_snapshot(box):
+    box.record("health.transition", device="tpu-0", to="Unhealthy")
+    box.record("allocate", ids=["tpu-0"], outcome="ok", ms=1.25)
+    snap = box.snapshot()
+    assert snap["name"] == "test"
+    assert snap["recorded"] == 2 and snap["dropped"] == 0
+    kinds = [e["kind"] for e in snap["events"]]
+    assert kinds == ["health.transition", "allocate"]
+    assert all("ts" in e for e in snap["events"])
+    json.dumps(snap)  # JSON-safe by construction
+
+
+def test_overflow_drop_accounting(box):
+    for i in range(20):
+        box.record("engine.step", i=i)
+    snap = box.snapshot()
+    assert len(snap["events"]) == 8
+    assert snap["recorded"] == 20
+    assert snap["dropped"] == 12
+    assert snap["dropped_by_kind"] == {"engine.step": 12}
+    # The ring keeps the RECENT past (oldest evicted first).
+    assert [e["i"] for e in snap["events"]] == list(range(12, 20))
+
+
+def test_fields_coerced_json_safe(box):
+    class Weird:
+        def __repr__(self):
+            return "<weird>"
+
+    box.record("x", obj=Weird(), tup=(1, 2), nested={"a": Weird()})
+    entry = box.snapshot()["events"][0]
+    assert entry["obj"] == "<weird>"
+    assert entry["tup"] == [1, 2]
+    assert entry["nested"] == {"a": "<weird>"}
+    json.dumps(entry)
+
+
+def test_window_filters(box):
+    box.record("a")
+    box.record("b")
+    box.record("a")
+    assert [e["kind"] for e in box.window(kinds=["a"])] == ["a", "a"]
+    assert len(box.window(last=2)) == 2
+    assert box.window(seconds=0.0) == [] or all(
+        e["ts"] >= time.time() - 0.5 for e in box.window(seconds=0.5)
+    )
+
+
+def test_dump_all_writes_valid_json(tmp_path, box):
+    box.record("registration", resource="google.com/tpu")
+    path = flight.dump_all(str(tmp_path), reason="manual", recorders=[box])
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["schema"] == "tpu-flight-dump/v1"
+    assert payload["reason"] == "manual"
+    assert payload["pid"] == os.getpid()
+    rec = payload["recorders"]["test"]
+    assert rec["events"][0]["kind"] == "registration"
+    assert {"recorded", "dropped", "dropped_by_kind"} <= rec.keys()
+
+
+def test_dump_all_without_recorders_is_none(tmp_path):
+    assert flight.dump_all(str(tmp_path), recorders=[]) is None
+
+
+@pytest.mark.skipif(
+    not hasattr(signal, "SIGUSR2"), reason="platform without SIGUSR2"
+)
+def test_sigusr2_dump(tmp_path, box):
+    """kill -USR2 on a live process must produce a valid JSON flight dump
+    with events and drop counts — the acceptance path of the black box."""
+    flight.register(box)
+    for i in range(12):  # overflow capacity 8 so drop counts are nonzero
+        box.record("engine.step", i=i)
+    handle = flight.install_dump_handlers(str(tmp_path))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # Python delivers the signal to the main thread at the next
+        # bytecode boundary; give it a moment.
+        deadline = time.time() + 5.0
+        dumps = []
+        while time.time() < deadline and not dumps:
+            dumps = [p for p in os.listdir(tmp_path) if "sigusr2" in p]
+            time.sleep(0.01)
+        assert dumps, "SIGUSR2 produced no dump file"
+        with open(tmp_path / dumps[0]) as f:
+            payload = json.load(f)
+        assert payload["reason"] == "sigusr2"
+        rec = payload["recorders"]["test"]
+        assert rec["dropped"] == 4
+        assert len(rec["events"]) == 8
+    finally:
+        handle.uninstall()
+
+
+def test_handle_uninstall_restores_previous(tmp_path, box):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("platform without SIGUSR2")
+    flight.register(box)
+    prev = signal.getsignal(signal.SIGUSR2)
+    handle = flight.install_dump_handlers(str(tmp_path))
+    assert signal.getsignal(signal.SIGUSR2) is not prev
+    handle.uninstall()
+    assert signal.getsignal(signal.SIGUSR2) is prev
+
+
+def test_atexit_dump_on_process_exit(tmp_path):
+    """A process with TPU_PLUGIN_DUMP_DIR configured writes a final dump
+    at interpreter exit — the crash-forensics contract."""
+    code = (
+        "from k8s_device_plugin_tpu.utils import flight\n"
+        "box = flight.register(flight.FlightRecorder(capacity=4, name='exitbox'))\n"
+        "flight.install_dump_handlers()\n"
+        "box.record('engine.step', i=1)\n"
+        "box.record('incident', metric='m')\n"
+    )
+    env = dict(os.environ, TPU_PLUGIN_DUMP_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, "-c", code], check=True, env=env, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    dumps = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+    assert dumps, "no exit dump written"
+    with open(tmp_path / dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "exit"
+    events = payload["recorders"]["exitbox"]["events"]
+    assert [e["kind"] for e in events] == ["engine.step", "incident"]
+
+
+def test_default_dump_dir_env():
+    assert flight.default_dump_dir({}) is None
+    assert flight.default_dump_dir({"TPU_PLUGIN_DUMP_DIR": "/d"}) == "/d"

@@ -324,6 +324,10 @@ def test_debug_trace_gated_off_by_default():
         with pytest.raises(urllib.error.HTTPError) as e:
             _post_path(server.port, "/debug/trace", {"seconds": 0.1})
         assert e.value.code == 404
+        # Same opt-in gates the single-step profiler capture.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_path(server.port, "/debug/profile/capture", {"steps": 1})
+        assert e.value.code == 404
     finally:
         server.stop()
 
@@ -486,3 +490,130 @@ def test_decode_block_cli_resolution():
     # reject — resolution must not silently override an operator choice.
     assert _resolve_decode_block(8, 2) == 8
     assert _resolve_decode_block(1, 0) == 1
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+def test_debug_endpoints_smoke(served):
+    """Endpoint-rot guard: every GET /debug/* answers 200 with parseable
+    JSON of the documented shape — state, profile (per-phase step
+    breakdown), incidents, flight."""
+    _, _, server = served
+    # Ensure the profiler has steps regardless of test ordering.
+    _post(server.port, {"prompt": [5, 6, 7], "max_new_tokens": 3})
+    state = _get(server.port, "/debug/state")
+    assert "engine" in state and state["loop_alive"]
+    prof = _get(server.port, "/debug/profile")
+    assert prof["steps"] > 0 and prof["window"] > 0
+    assert set(prof["phases"]) == {
+        "schedule", "prefill", "decode", "sample", "spec_verify"
+    }
+    # Real decode happened, so the decode phase has samples and the
+    # step percentiles are populated.
+    assert prof["phases"]["decode"]["window_steps"] > 0
+    assert prof["step_ms"]["p99"] >= prof["step_ms"]["p50"] > 0
+    assert prof["occupancy"]["mean_kv_page_utilization"] >= 0.0
+    inc = _get(server.port, "/debug/incidents")
+    assert "incidents" in inc and "detectors" in inc
+    fl = _get(server.port, "/debug/flight")
+    assert fl["name"] == "engine"
+    assert isinstance(fl["events"], list) and "dropped_by_kind" in fl
+
+
+def test_forced_incident_at_debug_incidents(served):
+    """Acceptance path: an injected slow step yields an incident record
+    at /debug/incidents containing the surrounding flight window."""
+    _, _, server = served
+    eng = server.engine
+    eng.flight.record("engine.step", steps=eng.profiler.steps)
+    mon = eng.anomaly
+    # Flood the baseline so earlier real steps (compiles included) wash
+    # out, then sustain a 400x deviation past the engine-configured
+    # gate (warmup 50, sustain 3).
+    for _ in range(200):
+        mon.observe("engine.step_seconds", 0.005)
+    for _ in range(4):
+        mon.observe("engine.step_seconds", 2.0)
+    data = _get(server.port, "/debug/incidents")
+    assert data["incidents_total"] >= 1
+    last = data["incidents"][-1]
+    assert last["metric"] == "engine.step_seconds"
+    assert last["observed"] == 2.0
+    assert last["baseline_mean"] < 0.1
+    assert last["z"] > 6.0
+    kinds = [e["kind"] for e in last["flight_window"]]
+    assert "engine.step" in kinds
+
+
+def test_sigusr2_dumps_live_engine_flight(served, tmp_path):
+    """Acceptance path: with the serving engine running, `kill -USR2`
+    produces a JSON flight dump (events + drop accounting) on disk."""
+    import os
+    import signal
+
+    from k8s_device_plugin_tpu.utils import flight as flight_mod
+
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("platform without SIGUSR2")
+    _, _, server = served
+    box = server.engine.flight
+    box.record("engine.step", marker="sigusr2-test")
+    flight_mod.register(box)
+    handle = flight_mod.install_dump_handlers(str(tmp_path))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.time() + 5.0
+        dumps = []
+        while time.time() < deadline and not dumps:
+            dumps = [p for p in os.listdir(tmp_path) if "sigusr2" in p]
+            time.sleep(0.01)
+        assert dumps, "SIGUSR2 produced no dump with the engine running"
+        with open(tmp_path / dumps[0]) as f:
+            payload = json.load(f)
+        rec = payload["recorders"]["engine"]
+        assert any(e.get("marker") == "sigusr2-test" for e in rec["events"])
+        assert "dropped" in rec and "dropped_by_kind" in rec
+    finally:
+        handle.uninstall()
+        flight_mod.unregister(box)
+
+
+def test_profile_capture_spans_live_steps(served):
+    """POST /debug/profile/capture grabs a jax.profiler trace spanning
+    the next engine step(s) of a LIVE serving loop."""
+    import os
+
+    _, _, server = served
+    # Retry the capture with a fresh background request if a scheduling
+    # hiccup lets the generate drain before the capture loop arms (the
+    # CI box is small; the 409-free path is what matters here).
+    for _ in range(3):
+        bg = threading.Thread(
+            target=lambda: _post(
+                server.port, {"prompt": [9, 8, 7], "max_new_tokens": 24}
+            ),
+            daemon=True,
+        )
+        bg.start()
+        out = _post_path(
+            server.port, "/debug/profile/capture", {"steps": 1, "timeout_s": 20}
+        )
+        bg.join(timeout=60)
+        if out["steps_captured"] >= 1:
+            break
+    assert out["steps_requested"] == 1
+    assert out["steps_captured"] >= 1
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(out["trace_dir"]) for f in fs
+    ]
+    assert found, "profiler wrote nothing into the capture dir"
+    # Malformed bodies answer 400.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_path(server.port, "/debug/profile/capture", {"steps": 0})
+    assert e.value.code == 400

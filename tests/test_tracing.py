@@ -117,3 +117,70 @@ def test_benchmark_pipelined_1f1b_smoke(capsys):
     assert out["model"] == "gpt-pp"
     assert out["schedule"] == "1f1b"
     assert out["throughput"] > 0
+
+
+def test_annotate_noop_outside_trace():
+    """annotate() outside any module-started trace is a pure no-op (and
+    must not import-require jax at all on that path)."""
+    assert not tracing.trace_active()
+    with tracing.annotate("outside"):
+        pass
+
+
+def test_annotate_noop_when_jax_unavailable(monkeypatch):
+    """Host-only callers (the plugin daemon image need not ship jax) can
+    annotate freely: an unimportable jax degrades to a no-op even while
+    a trace is marked active."""
+    import sys
+
+    monkeypatch.setattr(tracing, "_active_traces", 1)
+    monkeypatch.setitem(sys.modules, "jax", None)  # import jax -> ImportError
+    with tracing.annotate("no-jax"):
+        pass
+
+
+def test_trace_active_tracks_module_traces(tmp_path):
+    assert not tracing.trace_active()
+    with tracing.trace(str(tmp_path / "t2")):
+        assert tracing.trace_active()
+    assert not tracing.trace_active()
+
+
+def test_timed_rpc_records_daemon_span():
+    """timed_rpc routes each call into the span ring as a daemon-side
+    span (DAEMON_TRACE) while the observe= metrics hook keeps firing —
+    one tracing story, two entry points."""
+    from k8s_device_plugin_tpu.utils.spans import DAEMON_TRACE, SpanRecorder
+
+    rec = SpanRecorder()
+    seen = []
+
+    @tracing.timed_rpc(spans=rec, observe=seen.append)
+    def Allocate():
+        return "ok"
+
+    assert Allocate() == "ok"
+    assert Allocate() == "ok"
+    spans = rec.snapshot()
+    assert len(spans) == 2
+    assert spans[0]["name"] == "rpc.Allocate"
+    assert spans[0]["trace_id"] == DAEMON_TRACE
+    assert spans[0]["duration_ms"] >= 0
+    assert len(seen) == 2  # metrics hook intact alongside the span
+
+
+def test_timed_rpc_late_bound_recorder():
+    """spans= accepts a no-arg callable resolved per call: decoration at
+    class-definition time, recorder wired later (or never)."""
+    from k8s_device_plugin_tpu.utils.spans import SpanRecorder
+
+    holder = {"rec": None}
+
+    @tracing.timed_rpc(spans=lambda: holder["rec"])
+    def handler():
+        return 1
+
+    handler()  # no recorder yet: silently unrecorded, no crash
+    holder["rec"] = SpanRecorder()
+    handler()
+    assert len(holder["rec"].snapshot()) == 1

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Diff two driver BENCH_r*.json records into a perf-ledger-ready row.
+
+The driver captures one BENCH_rNN.json per round (headline metric,
+vs_baseline, platform, error state); comparing rounds by eyeballing two
+JSON blobs is how regressions slip.  This tool normalizes two records,
+prints a field-by-field diff, and emits a markdown row shaped for
+docs/perf-ledger.md's "Driver BENCH record history" table — which was
+backfilled from r01..r05 with exactly this tool.
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py --row-only BENCH_r01.json BENCH_r05.json
+
+A record whose ``parsed`` is null (the bench crashed before printing its
+JSON line — r01's state) renders as "failed"; the row still carries the
+rc and error tail so the ledger shows WHY there is no number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_record(path: str) -> dict:
+    with open(path) as f:
+        raw = json.load(f)
+    parsed = raw.get("parsed") or None
+    rec = {
+        "path": path,
+        "round": raw.get("n"),
+        "rc": raw.get("rc"),
+        "parsed": parsed,
+    }
+    if parsed:
+        rec.update(
+            metric=parsed.get("metric"),
+            value=parsed.get("value"),
+            unit=parsed.get("unit"),
+            vs_baseline=parsed.get("vs_baseline"),
+            baseline=parsed.get("baseline"),
+            platform=parsed.get("platform"),
+            error=parsed.get("error"),
+        )
+        # Builder-salvaged hardware reference (r05 carries one): the
+        # driver-captured value may be a CPU fallback while the real
+        # chip number rides in this nested record.
+        ref = (parsed.get("builder_tpu_reference") or {}).get("parsed")
+        if ref:
+            rec["tpu_reference_value"] = ref.get("value")
+            rec["tpu_reference_platform"] = ref.get("platform")
+    return rec
+
+
+def _fmt_value(rec: dict) -> str:
+    if not rec["parsed"]:
+        return f"failed (rc {rec['rc']})"
+    out = f"{rec['value']} ({rec['platform']})"
+    if rec.get("tpu_reference_value") is not None:
+        out += f", tpu ref {rec['tpu_reference_value']}"
+    return out
+
+
+def diff_lines(a: dict, b: dict) -> list[str]:
+    lines = [f"BENCH r{a['round']:02d} -> r{b['round']:02d}"]
+    for field in (
+        "metric", "value", "unit", "vs_baseline", "platform", "rc", "error",
+        "tpu_reference_value",
+    ):
+        va, vb = a.get(field), b.get(field)
+        if va is None and vb is None:
+            continue
+        marker = " " if va == vb else "*"
+        lines.append(f"  {marker} {field}: {va!r} -> {vb!r}")
+    if (
+        isinstance(a.get("value"), (int, float))
+        and isinstance(b.get("value"), (int, float))
+        and a["value"]
+    ):
+        ratio = b["value"] / a["value"]
+        lines.append(f"    value ratio: {ratio:.3f}x")
+    return lines
+
+
+def ledger_row(a: dict, b: dict) -> str:
+    metric = b.get("metric") or a.get("metric") or "?"
+    measured = f"{_fmt_value(a)} → {_fmt_value(b)}"
+    status = "both failed"
+    if b["parsed"]:
+        status = (
+            f"platform {b.get('platform')}"
+            + (f"; note: {b['error']}" if b.get("error") else "")
+        )
+    return (
+        f"| Driver BENCH headline r{a['round']:02d}→r{b['round']:02d} "
+        f"({metric}) | {measured} | r{b['round']} | `tools/bench_diff.py "
+        f"{a['path']} {b['path']}` | {status} |"
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench-diff",
+        description="diff two BENCH_r*.json records; emit a perf-ledger row",
+    )
+    p.add_argument("old", help="earlier BENCH_rNN.json")
+    p.add_argument("new", help="later BENCH_rNN.json")
+    p.add_argument(
+        "--row-only",
+        action="store_true",
+        help="print only the markdown ledger row (for shell backfills)",
+    )
+    args = p.parse_args(argv)
+    try:
+        a, b = load_record(args.old), load_record(args.new)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench-diff: {e}", file=sys.stderr)
+        return 1
+    if not args.row_only:
+        print("\n".join(diff_lines(a, b)), file=sys.stderr)
+    print(ledger_row(a, b))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
