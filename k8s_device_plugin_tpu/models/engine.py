@@ -33,7 +33,10 @@ Module layout (round-4 split; this module remains the import surface):
 - engine_admission.py  — submit/cancel, batched chunked prefill, admission
 - engine_paging.py     — page pool, prefix trie, frontier, reclamation
 - engine_spec.py       — speculative round builders + host consumption
-- here                 — ``ServingEngine`` wiring, step loop, CLI ``main``
+- here                 — ``ServingEngine`` wiring, step loop (split
+  dispatch/consume halves with one decode round in flight — the
+  overlapped pipeline; ``overlap_steps=0`` restores the strictly
+  synchronous loop), CLI ``main``
 """
 
 from __future__ import annotations
@@ -111,6 +114,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         draft_cfg: Optional[GPTConfig] = None,
         prefill_chunk: Optional[int] = None,
         decode_block: int = 1,
+        overlap_steps: int = 1,
         admission: str = "reserve",
         racecheck: bool = False,
         spans: Optional[SpanRecorder] = None,
@@ -136,6 +140,10 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         if admission not in ("reserve", "optimistic"):
             raise ValueError(
                 f"admission must be 'reserve' or 'optimistic', got {admission!r}"
+            )
+        if overlap_steps not in (0, 1):
+            raise ValueError(
+                f"overlap_steps must be 0 or 1, got {overlap_steps}"
             )
         if cfg.lora_serve and spec_gamma > 0:
             # The self-draft is the same model int8-quantized, and quant is
@@ -325,6 +333,22 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         # dispatch, which is what matters on a real TPU VM where device
         # step time (~100us) is comparable to one transfer.
         self._dev: Optional[dict] = None
+        # Overlapped decode pipeline: with overlap_steps == 1 the loop
+        # dispatches step N+1 from the fed-forward device state BEFORE
+        # consuming step N's readback, so per-token host work (EOS/stop
+        # checks, frontier extension, metrics) executes while the
+        # accelerator computes the next step instead of idling through
+        # it.  ``_inflight`` holds the pending dispatch's record; its
+        # validity token is the identity of the device-state dict it fed
+        # forward (any _mark_state_dirty breaks it — see _take_inflight).
+        # Speculative engines never overlap: a round's host consumption
+        # DECIDES the next dispatch's inputs (data-dependent acceptance),
+        # so there is nothing to dispatch ahead.
+        self._overlap_steps = 0 if spec_gamma else overlap_steps
+        self._inflight: Optional[dict] = None
+        self.overlap_hits = 0
+        self.overlap_discards = 0
+        self._inflight_guard = None
         self.metrics = metrics
         # Forensics layer (always on — a production incident cannot ask
         # for instrumentation retroactively, and all three pieces are
@@ -403,8 +427,15 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             # call site instead of corrupting state probabilistically.
             # The stress suites run with it on; production engines skip
             # the per-op check.
-            from ..utils.racecheck import GuardedDeque, GuardedDict
+            from ..utils.racecheck import GuardedDeque, GuardedDict, OwnerGuard
 
+            # The in-flight overlap record is owner-thread-only by
+            # contract (the step loop dispatches and consumes it while
+            # submit/cancel mutate slots under the lock); the guard
+            # raises if any other thread touches the handoff off-lock.
+            self._inflight_guard = OwnerGuard(
+                lock=self._lock, name="_inflight"
+            )
             self.free_pages = GuardedDeque(
                 self.free_pages, lock=self._lock, name="free_pages"
             )
@@ -456,16 +487,41 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 "aids": jnp.asarray(self._slot_aid, jnp.int32),
                 "key": sub,
             }
+            # Step-variant selector flags ride the state dict: they are a
+            # function of the occupied slots' sampler settings, which only
+            # ever change through an activation/teardown — events that
+            # dirty the whole state — so ONE slot scan per rebuild
+            # replaces three full-slot scans per step in the hot loop.
+            filtered = want_lp = biased = False
+            for s in range(self.max_slots):
+                req = self.slots[s]
+                if req is None:
+                    continue
+                if (
+                    self._slot_topk[s] < self.cfg.vocab_size
+                    or self._slot_topp[s] < 1.0
+                ):
+                    filtered = True
+                if req.logprobs:
+                    want_lp = True
+                if req.logit_bias:
+                    biased = True
+            dev["filtered"] = filtered
+            dev["want_lp"] = want_lp
+            dev["biased"] = biased
         return dev
 
-    def _feed_forward(self, dev: dict, tokens, positions, key) -> None:
+    def _feed_forward(self, dev: dict, tokens, positions, key) -> dict:
         """Install the step's returned next-inputs as the new device
-        state.  Runs BEFORE host consumption: a finish in consumption
-        tears the slot down through _clear_slot, which marks the state
-        dirty again — ordering keeps both paths correct."""
+        state (flags and cached variant arrays carry over).  Runs BEFORE
+        host consumption: a finish in consumption tears the slot down
+        through _clear_slot, which marks the state dirty again —
+        ordering keeps both paths correct.  Returns the installed dict
+        (the overlap pipeline's in-flight validity token)."""
         self._dev = {
             **dev, "tokens": tokens, "positions": positions, "key": key,
         }
+        return self._dev
 
     def _variant_arrays(self, dev: dict, filtered: bool, biased: bool) -> list:
         """The optional per-slot arrays matching
@@ -520,6 +576,164 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         entry of the *rest signature; empty for speculative engines)."""
         return [self._chain] if self._derive_tables else []
 
+    # --------------------------------------------- overlapped decode pipeline
+    #
+    # The loop's split dispatch/consume halves.  State machine, per
+    # step() call on the decode path:
+    #
+    #   no in-flight   -> dispatch N; if overlap allowed, dispatch N+1
+    #                     from N's fed-forward state; consume N.
+    #   valid in-flight-> dispatch N+1 from its fed-forward state FIRST
+    #                     (keep the device busy), then consume N while
+    #                     N+1 computes (the host_gap profiler phase).
+    #   stale in-flight-> discard (one wasted lane): any event that calls
+    #                     _mark_state_dirty (admission, finish, cancel,
+    #                     preemption, spec round) invalidated the inputs
+    #                     it was dispatched from.  A torn-down slot
+    #                     already behaves as idle in the jitted step and
+    #                     discarded K/V writes are overwritten before any
+    #                     masked read can see them, so the only device
+    #                     state a discard must repair is seq_lens (the
+    #                     paged append writes at the CARRIED seq_lens,
+    #                     not the traced positions) — one vector write
+    #                     per layer back to host truth.
+
+    def _overlap_allowed(self) -> bool:
+        """Whether dispatching one decode round ahead of host consumption
+        pays off right now.  Overlap is guaranteed-wasted work whenever
+        the queue head could actually admit this step (the activation
+        would invalidate the in-flight dispatch — the same reasoning as
+        the decode-block gate) or while a chunked prefill is streaming
+        in (its activation lands within a few steps), so those degrade
+        to the synchronous loop."""
+        if not self._overlap_steps or self._pending:
+            return False
+        return (
+            not self.queue
+            or all(s is not None for s in self.slots)
+            or self._admit_page_blocked
+        )
+
+    def _guard_inflight(self, op: str) -> None:
+        if self._inflight_guard is not None:
+            self._inflight_guard.check(op)
+
+    def _dispatch_decode(self, active: list[int], T: int = 1) -> dict:
+        """Enqueue one decode dispatch (a single step, or a T-step block)
+        from the current device state and install its fed-forward outputs
+        as the new state.  Returns the record consumption needs: the
+        packed readback handle, the want_lp flag it was compiled with,
+        and (slot, request) pairs pinned at dispatch time so a consumer
+        can skip lanes whose slot was evicted between dispatch and sync.
+        ``dev`` in the record is the state dict this dispatch installed —
+        identity-compared against self._dev at consume time, which makes
+        it the in-flight validity token (every _mark_state_dirty breaks
+        the identity)."""
+        self._guard_inflight("dispatch")
+        dev = self._device_state()
+        filtered, want_lp, biased = (
+            dev["filtered"], dev["want_lp"], dev["biased"],
+        )
+        fn = (
+            self._step_fn(filtered, want_lp, biased)
+            if T == 1
+            else self._block_fn(T, filtered, want_lp, biased)
+        )
+        out, ff_tok, ff_pos, ff_key, self.cache = fn(
+            self.params, self.cache, dev["tokens"], dev["positions"],
+            dev["temps"], dev["aids"], dev["key"],
+            *self._chain_args(),
+            *self._variant_arrays(dev, filtered, biased),
+        )
+        return {
+            "T": T,
+            "out": out,
+            "want_lp": want_lp,
+            "active": list(active),
+            "reqs": [self.slots[s] for s in active],
+            "dev": self._feed_forward(dev, ff_tok, ff_pos, ff_key),
+        }
+
+    def _take_inflight(self, T: int) -> Optional[dict]:
+        """Pop the in-flight record if it is still consumable: nothing
+        invalidated the device state it fed forward (dev identity) and
+        the loop is consuming the same dispatch shape it carries (T).
+        Anything else discards it — one wasted lane."""
+        inflight = self._inflight
+        if inflight is None:
+            return None
+        self._guard_inflight("consume")
+        self._inflight = None
+        if self._dev is None or inflight["dev"] is not self._dev:
+            self._discard(inflight, "state_dirty")
+            return None
+        if inflight["T"] != T:
+            self._discard(inflight, "shape_switch")
+            return None
+        return inflight
+
+    def _discard(self, inflight: dict, reason: str) -> None:
+        """Throw away an overlapped dispatch.  Its K/V writes are
+        harmless (a torn-down slot's pages are overwritten by the next
+        owner before any visible read; a surviving slot's re-dispatch
+        overwrites position L with identical rows), but the dispatch
+        advanced every row's carried seq_lens past host truth — re-align
+        in one vector write per layer.  A FRESH array per layer: sharing
+        one would hand the next dispatch's donation the same buffer
+        twice, which XLA rejects (see the identical note in _spec_step).
+        The fed-forward state derives from outputs the host never
+        consumed, so it is dropped too: the next dispatch rebuilds from
+        the host lists."""
+        self._dev = None
+        for name in self._layer_names:
+            att = self.cache[name]["attn"]
+            self.cache[name]["attn"] = {
+                **att,
+                "seq_lens": jnp.array(self._slot_len, jnp.int32),
+            }
+        self.overlap_discards += 1
+        if self.metrics:
+            self.metrics.overlap_discards.inc()
+        if self.flight is not None:
+            self.flight.record(
+                "overlap.discard",
+                reason=reason,
+                T=inflight["T"],
+                slots=len(inflight["active"]),
+            )
+
+    def _drop_stale_inflight(self, reason: str) -> None:
+        """Discard the pending overlap dispatch (if any) after a dirty
+        event that its consumption itself caused (finish/cancel found
+        during consume)."""
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            self._guard_inflight("discard")
+            self._discard(inflight, reason)
+
+    @staticmethod
+    def _unpack(rec: dict):
+        """Split a record's packed device→host readback (ONE transfer —
+        engine_sampling packs tokens with logprobs as float32 rows when
+        a slot asked, and ships the token vector alone otherwise)."""
+        arr = np.asarray(rec["out"])
+        if rec["want_lp"]:
+            return arr[0].astype(np.int64), arr[1]
+        return arr, None
+
+    def _record_hit(self) -> None:
+        self.overlap_hits += 1
+        if self.metrics:
+            self.metrics.overlap_hits.inc()
+
+    def _block_room(self, active: list[int]) -> int:
+        """Smallest remaining token budget over the active slots — the
+        bound on how many tokens any dispatch chain may run ahead."""
+        return min(
+            self.slots[s].max_new_tokens - len(self.slots[s].tokens)
+            for s in active
+        )
+
     def _block_step(
         self, active: list[int], finished: list[Request], T: int
     ) -> list[Request]:
@@ -528,47 +742,54 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         mid-block wastes its tail iterations (their K/V writes land past
         the row's final length and are masked forever after the rewind —
         the speculative round's exact discipline); everything the host
-        consumes is identical to T single steps."""
-        active = self._ensure_frontier(active, T - 1)
-        if not active:
-            self._update_gauges()
-            return finished
-        dev = self._device_state()
-        filtered = any(
-            self.slots[s] is not None
-            and (
-                self._slot_topk[s] < self.cfg.vocab_size
-                or self._slot_topp[s] < 1.0
+        consumes is identical to T single steps.  With overlap on, the
+        NEXT block is dispatched before this one's readback (gated on
+        room >= 2T so the overlapped block cannot overrun any slot's
+        budget) — same state machine as the single-step pipeline."""
+        overlap = self._overlap_allowed() and self._block_room(active) >= 2 * T
+        rec = self._take_inflight(T)
+        if rec is None:
+            # Cold (or just-invalidated) pipeline: the frontier ensure
+            # covers this block's writes — and the overlapped block's
+            # too (lookahead 2T-1) when one will follow.
+            active = self._ensure_frontier(
+                active, 2 * T - 1 if overlap else T - 1
             )
-            for s in range(self.max_slots)
-        )
-        want_lp = any(
-            self.slots[s] is not None and self.slots[s].logprobs
-            for s in range(self.max_slots)
-        )
-        biased = any(
-            self.slots[s] is not None and self.slots[s].logit_bias
-            for s in range(self.max_slots)
-        )
-        out, lps, ff_tok, ff_pos, ff_key, self.cache = self._block_fn(
-            T, filtered, want_lp, biased
-        )(
-            self.params, self.cache, dev["tokens"], dev["positions"],
-            dev["temps"], dev["aids"], dev["key"],
-            *self._chain_args(),
-            *self._variant_arrays(dev, filtered, biased),
-        )
-        self._feed_forward(dev, ff_tok, ff_pos, ff_key)
-        out = np.asarray(out)
-        lps = np.asarray(lps)
-        self._mark("decode")
+            if not active:
+                self._update_gauges()
+                return finished
+            rec = self._dispatch_decode(active, T)
+            if overlap:
+                self._inflight = self._dispatch_decode(active, T)
+            self._mark("dispatch")
+        else:
+            self._record_hit()
+            if overlap:
+                active = self._ensure_frontier(active, 2 * T - 1)
+                # An eviction inside the ensure dirtied the state: then
+                # this step consumes what it has and re-primes next call.
+                if active and self._dev is rec["dev"]:
+                    self._inflight = self._dispatch_decode(active, T)
+            self._mark("dispatch")
+        return self._consume_block(rec, finished)
+
+    def _consume_block(
+        self, rec: dict, finished: list[Request]
+    ) -> list[Request]:
+        """Host half of one decode block: sync the packed readback, then
+        per-slot consumption — under overlap this work executes while
+        the next block computes on device (the host_gap phase)."""
+        T = rec["T"]
+        toks, lps = self._unpack(rec)
+        self._mark("readback")
         now = time.monotonic()
         emitted_total = 0
-        for s in active:
-            req = self.slots[s]
+        for s, req in zip(rec["active"], rec["reqs"]):
+            if self.slots[s] is not req or not self._slot_ready[s]:
+                continue  # evicted between dispatch and sync
             consumed = 0
             for j in range(T):
-                tok = int(out[s, j])
+                tok = int(toks[s, j])
                 # Logprob BEFORE token: a streaming handler thread that
                 # snapshots between the two appends must never see a
                 # token whose logprob is missing.
@@ -593,21 +814,25 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 self._extend_frontier(s)
                 if self.cfg.attention_window is not None:
                     self._reclaim_windowed(s)
-        # The block left every row's device length at L+T.  When every
-        # active slot consumed all T tokens that IS the host truth and no
-        # realignment is needed; a mid-block finish tore its slot down
-        # (_clear_slot -> state dirty), and only then do device lengths
-        # disagree — re-align in one vector write per layer (fresh array
-        # per layer — see the identical note in _spec_step re double
-        # donation).
+        # The block left every row's device length at L+T (at L+2T with
+        # an overlapped block in flight).  When every active slot
+        # consumed all T tokens that IS the host truth (the in-flight
+        # block accounts for its own +T when it is consumed); a
+        # mid-block finish tore its slot down (_clear_slot -> state
+        # dirty), and only then do device lengths disagree — the
+        # in-flight discard re-aligns them, or the direct vector write
+        # below does when nothing was in flight.
         if self._dev is None:
-            for name in self._layer_names:
-                att = self.cache[name]["attn"]
-                self.cache[name]["attn"] = {
-                    **att,
-                    "seq_lens": jnp.array(self._slot_len, jnp.int32),
-                }
-        self._mark("sample")
+            if self._inflight is not None:
+                self._drop_stale_inflight("slot_teardown")
+            else:
+                for name in self._layer_names:
+                    att = self.cache[name]["attn"]
+                    self.cache[name]["attn"] = {
+                        **att,
+                        "seq_lens": jnp.array(self._slot_len, jnp.int32),
+                    }
+        self._mark("host_gap" if self._inflight is not None else "sample")
         self._step_tokens += emitted_total
         if self.metrics:
             self.metrics.steps.inc()
@@ -632,6 +857,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         )
         timer = self._prof_timer = self.profiler.timer()
         self._step_tokens = 0
+        hits0, discards0 = self.overlap_hits, self.overlap_discards
         try:
             with span:
                 if self.metrics:
@@ -656,6 +882,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 queued=queued,
                 kv_page_utilization=util,
                 tokens=self._step_tokens,
+                overlap_hits=self.overlap_hits - hits0,
+                overlap_discards=self.overlap_discards - discards0,
             )
 
     def _step_inner(self) -> list[Request]:
@@ -717,53 +945,60 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             T = min(self._decode_block, 1 << max(0, room.bit_length() - 1))
             if T > 1:
                 return self._block_step(active, finished, T)
-        if self._optimistic:
-            # The single-step path's next write (position len) must be
-            # addressable; _block_step/_spec_step run their own ensure
-            # with their larger lookaheads.
-            active = self._ensure_frontier(active, 0)
-            if not active:
-                self._update_gauges()
-                return finished
-        dev = self._device_state()
-        filtered = any(
-            self.slots[s] is not None
-            and (
-                self._slot_topk[s] < self.cfg.vocab_size
-                or self._slot_topp[s] < 1.0
-            )
-            for s in range(self.max_slots)
-        )
-        want_lp = any(
-            self.slots[s] is not None and self.slots[s].logprobs
-            for s in range(self.max_slots)
-        )
-        biased = any(
-            self.slots[s] is not None and self.slots[s].logit_bias
-            for s in range(self.max_slots)
-        )
-        nxt, lps, ff_tok, ff_pos, ff_key, self.cache = self._step_fn(
-            filtered, want_lp, biased
-        )(
-            self.params, self.cache, dev["tokens"], dev["positions"],
-            dev["temps"], dev["aids"], dev["key"],
-            *self._chain_args(),
-            *self._variant_arrays(dev, filtered, biased),
-        )
-        self._feed_forward(dev, ff_tok, ff_pos, ff_key)
-        nxt = np.asarray(nxt)
-        lps = np.asarray(lps)
-        self._mark("decode")
+        overlap = self._overlap_allowed()
+        rec = self._take_inflight(1)
+        if rec is None:
+            # Cold (or just-invalidated) pipeline: dispatch this step,
+            # then prime the overlap from its fed-forward state.  The
+            # next write (position len) must be addressable — and the
+            # overlapped write (len+1) too when one will follow, hence
+            # the one-token frontier lookahead; _block_step/_spec_step
+            # run their own ensure with their larger lookaheads.
+            if self._optimistic or overlap:
+                active = self._ensure_frontier(active, 1 if overlap else 0)
+                if not active:
+                    self._update_gauges()
+                    return finished
+            rec = self._dispatch_decode(active)
+            if overlap:
+                self._inflight = self._dispatch_decode(active)
+            self._mark("dispatch")
+        else:
+            self._record_hit()
+            if overlap:
+                # Keep one step in flight: ensure the NEXT write is
+                # addressable, then dispatch before the (blocking)
+                # readback of the consumed step.  An eviction inside the
+                # ensure dirtied the state — then this step consumes
+                # what it has and re-primes next call.
+                active = self._ensure_frontier(active, 1)
+                if active and self._dev is rec["dev"]:
+                    self._inflight = self._dispatch_decode(active)
+            self._mark("dispatch")
+        return self._consume_step(rec, finished)
+
+    def _consume_step(
+        self, rec: dict, finished: list[Request]
+    ) -> list[Request]:
+        """Host half of one single-token step: sync the packed readback,
+        then per-slot consumption (EOS/stop checks, frontier extension,
+        reclamation, metrics) — under overlap this host work executes
+        while the next step computes on device (the host_gap phase)."""
+        toks, lps = self._unpack(rec)
+        self._mark("readback")
         now = time.monotonic()
-        for s in active:
-            req = self.slots[s]
-            tok = int(nxt[s])
-            # Logprob BEFORE token (see _block_step note).
+        consumed = 0
+        for s, req in zip(rec["active"], rec["reqs"]):
+            if self.slots[s] is not req or not self._slot_ready[s]:
+                continue  # evicted between dispatch and sync
+            tok = int(toks[s])
+            # Logprob BEFORE token (see _consume_block note).
             if req.logprobs:
                 req.token_logprobs.append(float(lps[s]))
             req.tokens.append(tok)
             self._slot_last[s] = tok
             self._slot_len[s] += 1
+            consumed += 1
             self._observe_itl(s, 1, now)
             self._maybe_finish(s)
             if req.done:
@@ -772,11 +1007,15 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 self._extend_frontier(s)
                 if self.cfg.attention_window is not None:
                     self._reclaim_windowed(s)
-        self._mark("sample")
-        self._step_tokens += len(active)
+        if self._dev is None:
+            # A finish/cancel tore a slot down mid-consume: whatever is
+            # still in flight was dispatched from pre-teardown state.
+            self._drop_stale_inflight("slot_teardown")
+        self._mark("host_gap" if self._inflight is not None else "sample")
+        self._step_tokens += consumed
         if self.metrics:
             self.metrics.steps.inc()
-            self.metrics.tokens.inc(len(active))
+            self.metrics.tokens.inc(consumed)
         self._update_gauges()
         return finished
 
@@ -855,6 +1094,12 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                     1 for c in self._page_refs.values() if c > 1
                 ),
                 "preemptions": self.preemptions,
+                "overlap": {
+                    "steps": self._overlap_steps,
+                    "in_flight": self._inflight is not None,
+                    "hits": self.overlap_hits,
+                    "discards": self.overlap_discards,
+                },
                 "spec": {
                     "gamma": self._spec_gamma,
                     "proposed": self.spec_proposed,
@@ -976,6 +1221,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         "first-token wait; incompatible with --spec-gamma",
     )
     p.add_argument(
+        "--overlap-steps",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="decode dispatches kept in flight ahead of host consumption "
+        "(1: dispatch step N+1 before consuming step N's readback, hiding "
+        "per-token host work behind device compute; invalidating events — "
+        "admission, finish, cancel, preemption — discard the in-flight "
+        "step at the cost of one wasted lane; 0: strictly synchronous "
+        "loop; speculative engines always run synchronously)",
+    )
+    p.add_argument(
         "--admission",
         choices=["reserve", "optimistic"],
         default="reserve",
@@ -1032,6 +1289,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         cfg, params, paged, max_slots=args.slots,
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
+        overlap_steps=args.overlap_steps,
         admission=args.admission, **spec_kw,
     )
     sample_kw = dict(
@@ -1094,6 +1352,9 @@ def main(argv: Optional[list[str]] = None) -> None:
                 else None,
                 "tokens": tokens,
                 "wall_s": round(dt, 2),
+                "overlap_steps": args.overlap_steps,
+                "overlap_hits": eng.overlap_hits,
+                "overlap_discards": eng.overlap_discards,
                 "ttft_p50_ms": _ms(ttft_h.quantile(0.5, since=ttft_snap)),
                 "ttft_p99_ms": _ms(ttft_h.quantile(0.99, since=ttft_snap)),
                 "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),

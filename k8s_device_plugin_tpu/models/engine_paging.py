@@ -216,6 +216,13 @@ class PagingMixin:
         """Make every coming write in [len, len+lookahead] addressable for
         each active slot, then publish the covering pages.
 
+        ``lookahead`` callers: plain synchronous decode passes 0 (only
+        the next position's write), the overlapped pipeline passes 1 (the
+        in-flight step's write at len+1 must be addressable BEFORE the
+        host has consumed position len), decode blocks pass T-1 — or
+        2T-1 with an overlapped block in flight — and speculative rounds
+        run gamma lookahead through _extend_frontier directly.
+
         Reserve admission: pages were all allocated at admission, so this
         is pure publication.  Optimistic admission: generation pages are
         allocated HERE, on demand — processed oldest-admission-first, a
@@ -323,8 +330,9 @@ class PagingMixin:
         moment the frontier approaches it: tiny .at[slot, idx].set
         updates per layer, amortized O(1/page_size) dispatches per token.
         ``lookahead`` defaults to the speculative gamma (0 for plain
-        decode: only the next position's page); decode blocks pass T-1,
-        their furthest write."""
+        decode: only the next position's page); decode blocks and the
+        overlapped pipeline pass their furthest write via
+        _ensure_frontier (see its docstring for the caller table)."""
         if lookahead is None:
             lookahead = self._spec_gamma
         need = (

@@ -27,11 +27,21 @@ from typing import Optional
 
 # Host-observable step phases, in execution order.  "schedule" covers
 # admission + cancel sweeps, "prefill" the chunked prefill advance and
-# graft/activation, "decode" the jitted dispatch + device sync,
-# "sample" the host-side consumption of sampled tokens (append, stop
-# scan, finish), "spec_verify" the whole speculative draft+verify round
-# (which replaces decode+sample on speculative engines).
-PHASES = ("schedule", "prefill", "decode", "sample", "spec_verify")
+# graft/activation, "dispatch" the decode enqueue(s) (two per step when
+# the overlapped pipeline primes the next step before the readback),
+# "readback" the blocking device→host sync of the consumed step (in the
+# synchronous loop this includes the device compute — the old "decode"
+# phase), "sample" the host-side consumption when nothing is in flight,
+# "host_gap" the same consumption when it overlaps the next step's
+# device compute (the gap the accelerator used to idle through — a
+# well-overlapped engine shows host_gap ≈ the old sample time with
+# readback shrunk toward pure transfer), and "spec_verify" the whole
+# speculative draft+verify round (which replaces all of the above on
+# speculative engines).
+PHASES = (
+    "schedule", "prefill", "dispatch", "readback", "sample", "host_gap",
+    "spec_verify",
+)
 
 
 class StepTimer:
@@ -130,9 +140,14 @@ class EngineProfiler:
         queued: int,
         kv_page_utilization: float,
         tokens: int,
+        overlap_hits: int = 0,
+        overlap_discards: int = 0,
     ) -> float:
         """Close out one step: fold the timer into the windows, sample
         memory, emit the periodic flight summary, feed the anomaly hook.
+        ``overlap_hits``/``overlap_discards`` are THIS step's deltas from
+        the engine's overlapped-pipeline counters (a hit = the step was
+        consumed from an in-flight dispatch; a discard = a wasted lane).
         Returns the step's wall seconds."""
         now = time.perf_counter()
         wall = now - timer.t0
@@ -144,6 +159,8 @@ class EngineProfiler:
             "queued": queued,
             "kv_page_utilization": kv_page_utilization,
             "tokens": tokens,
+            "overlap_hits": overlap_hits,
+            "overlap_discards": overlap_discards,
         }
         if mem is not None:
             record["mem_bytes"] = mem
@@ -180,6 +197,18 @@ class EngineProfiler:
                     sum(r["active_slots"] for r in window)
                     / (len(window) * max(max_slots, 1)),
                     4,
+                ),
+                # Overlap health over the window: hit ratio near 1.0
+                # means steady decode consumed almost every step from an
+                # in-flight dispatch; a discard-heavy ratio says traffic
+                # churns faster than the pipeline can stay primed.
+                overlap_hit_ratio=round(
+                    sum(r.get("overlap_hits", 0) for r in window)
+                    / len(window),
+                    4,
+                ),
+                overlap_discards=sum(
+                    r.get("overlap_discards", 0) for r in window
                 ),
             )
         if self.observe_step is not None:
@@ -242,6 +271,19 @@ class EngineProfiler:
             )
             if n
             else 0.0,
+            "overlap": {
+                "window_hits": sum(
+                    r.get("overlap_hits", 0) for r in window
+                ),
+                "window_discards": sum(
+                    r.get("overlap_discards", 0) for r in window
+                ),
+                "hit_ratio": round(
+                    sum(r.get("overlap_hits", 0) for r in window) / n, 4
+                )
+                if n
+                else 0.0,
+            },
         }
         mems = [r["mem_bytes"] for r in window if "mem_bytes" in r]
         if mems:

@@ -100,18 +100,22 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False,
                   derive_tables: bool = False):
     """Build the jitted single-token decode step.  ``filtered`` compiles
     the top-k/top-p sort in; ``want_lp`` compiles the [slots, vocab]
-    log-softmax + gather whose result logprobs requests read (without it
-    the step returns a zeros placeholder so the host consumption code
-    stays uniform); ``biased`` compiles the [slots, MAX_BIAS] scatter-add
-    of per-slot logit biases onto the picking row (reported logprobs
-    stay unbiased).
+    log-softmax + gather whose result logprobs requests read; ``biased``
+    compiles the [slots, MAX_BIAS] scatter-add of per-slot logit biases
+    onto the picking row (reported logprobs stay unbiased).
 
-    Returns ``(nxt, lps, next_tokens, next_positions, next_key, cache)``:
-    the last three are the NEXT step's inputs, computed in-program so a
-    steady-state decode loop feeds device outputs straight back in — no
-    per-step host->device uploads, no separate key-split dispatch (the
-    engine's device-resident step state; it rebuilds from host lists only
-    when slot structure changes).
+    Returns ``(out, next_tokens, next_positions, next_key, cache)``.
+    ``out`` is the step's PACKED device→host readback: the [slots] int32
+    token vector alone when ``want_lp`` is off (no logprob compute, no
+    second transfer — no consumer would read it), else one [2, slots]
+    float32 array carrying tokens in row 0 and their logprobs in row 1,
+    so the host syncs a single array per step either way (float32 holds
+    token ids exactly below 2^24 — far beyond any realistic vocab).
+    The last three returns are the NEXT step's inputs, computed
+    in-program so a steady-state decode loop feeds device outputs
+    straight back in — no per-step host->device uploads, no separate
+    key-split dispatch (the engine's device-resident step state; it
+    rebuilds from host lists only when slot structure changes).
 
     ``derive_tables``: take a ``chain`` argument (the full allocated page
     chain, [slots, max_pages_per_seq]) and compute the visible page-table
@@ -151,12 +155,12 @@ def build_step_fn(model, filtered: bool, want_lp: bool, biased: bool = False,
             scaled = filter_top_k_top_p(scaled, topks, topps)
         sampled = jax.random.categorical(sub, scaled).astype(jnp.int32)
         nxt = jnp.where(temps > 0, sampled, greedy)
-        lps = (
-            _token_logprob(row, nxt)
+        out = (
+            jnp.stack([nxt.astype(jnp.float32), _token_logprob(row, nxt)])
             if want_lp
-            else jnp.zeros(nxt.shape, jnp.float32)
+            else nxt
         )
-        return nxt, lps, nxt[:, None], positions + 1, key, mut["cache"]
+        return out, nxt[:, None], positions + 1, key, mut["cache"]
 
     extra = (["chain"] if derive_tables else []) + variant_names(
         filtered, biased
@@ -181,9 +185,11 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
     sampled slots draw from the identical per-step distributions
     (different key schedule than T separate step() calls, same law).
 
-    Returns ``(toks, lps, next_tokens, next_positions, next_key, cache)``
-    — same feed-forward contract as build_step_fn, with toks/lps shaped
-    [slots, T].  ``derive_tables``: per-iteration in-program publication
+    Returns ``(out, next_tokens, next_positions, next_key, cache)`` —
+    same packed-readback and feed-forward contract as build_step_fn,
+    with ``out`` shaped [slots, T] int32 (tokens only) or [2, slots, T]
+    float32 (tokens + logprobs) when ``want_lp`` is on.
+    ``derive_tables``: per-iteration in-program publication
     from the chain (the scan's running position naturally publishes each
     page exactly as the write frontier reaches it — the host used to
     pre-publish the whole block's lookahead)."""
@@ -218,17 +224,18 @@ def build_block_fn(model, T: int, filtered: bool, want_lp: bool,
                 scaled = filter_top_k_top_p(scaled, topks, topps)
             sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
             nxt = jnp.where(temps > 0, sampled, greedy)
-            lp = (
-                _token_logprob(row, nxt)
-                if want_lp
-                else jnp.zeros(nxt.shape, jnp.float32)
-            )
-            return (mut["cache"], nxt[:, None], pos + 1), (nxt, lp)
+            ys = (nxt, _token_logprob(row, nxt)) if want_lp else nxt
+            return (mut["cache"], nxt[:, None], pos + 1), ys
 
-        (cache, last_tok, last_pos), (toks, lps) = jax.lax.scan(
+        (cache, last_tok, last_pos), ys = jax.lax.scan(
             body, (cache, tokens, positions), jax.random.split(sub, T)
         )
-        return toks.T, lps.T, last_tok, last_pos, key, cache  # [slots, T]
+        if want_lp:
+            toks, lps = ys
+            out = jnp.stack([toks.T.astype(jnp.float32), lps.T])
+        else:
+            out = ys.T  # [slots, T]
+        return out, last_tok, last_pos, key, cache
 
     # Same variant-signature split as build_step_fn: the common path
     # shouldn't upload filter/bias arrays it compiled out.

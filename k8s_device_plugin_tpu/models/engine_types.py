@@ -83,6 +83,21 @@ class EngineMetrics:
             "step through their own host-published state and never "
             "rebuild, so this stays 0 when spec_gamma > 0",
         )
+        self.overlap_hits = registry.counter(
+            "tpu_engine_overlap_hits_total",
+            "Decode rounds consumed from an overlapped in-flight "
+            "dispatch (issued before the previous round's readback); "
+            "in steady decode with overlap_steps=1 this tracks "
+            "steps_total",
+        )
+        self.overlap_discards = registry.counter(
+            "tpu_engine_overlap_discards_total",
+            "Overlapped dispatches thrown away because a slot event "
+            "(admission, finish, cancel, preemption) invalidated their "
+            "inputs — one wasted device lane each; a rate rivalling "
+            "overlap_hits says traffic churns too fast for "
+            "--overlap-steps 1 to pay off",
+        )
         self.step_seconds = registry.histogram(
             "tpu_engine_step_seconds",
             "Wall time of one engine step() call (admission + dispatch + "

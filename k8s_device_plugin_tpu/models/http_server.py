@@ -52,9 +52,10 @@ rest of the models/ stack which benchmarks on synthetic ids):
 
     GET /debug/profile -> 200 JSON per-step profiler snapshot
          (models/engine_profiler.py): per-phase breakdown
-         (schedule/prefill/decode/sample/spec_verify p50/p99 over the
-         rolling window), batch occupancy, KV-page utilization,
-         device-memory track.  Always on.
+         (schedule/prefill/dispatch/readback/sample/host_gap/spec_verify
+         p50/p99 over the rolling window), batch occupancy, KV-page
+         utilization, overlap hit/discard window counts, device-memory
+         track.  Always on.
     GET /debug/incidents -> 200 JSON anomaly-monitor snapshot
          (utils/anomaly.py): bounded incident list (cause metric,
          baseline, observed, z-score, attached flight-recorder window)
@@ -643,6 +644,19 @@ def main(argv: Optional[list[str]] = None) -> None:
         "recompute preemption under pool pressure (higher concurrency "
         "when generations finish early)",
     )
+    p.add_argument(
+        "--overlap-steps",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="decode dispatches kept in flight ahead of host consumption "
+        "(1: the step loop dispatches round N+1 before consuming round "
+        "N's readback, hiding per-token host work — EOS/stop checks, "
+        "frontier extension, metrics — behind device compute; events "
+        "that invalidate the in-flight round discard it for one wasted "
+        "lane, counted in tpu_engine_overlap_discards_total; 0: strictly "
+        "synchronous loop; speculative engines always run synchronously)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--compilation-cache-dir",
@@ -823,6 +837,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         flight=box,
         prefill_chunk=args.prefill_chunk,
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
+        overlap_steps=args.overlap_steps,
         admission=args.admission,
         **spec_kw,
     )

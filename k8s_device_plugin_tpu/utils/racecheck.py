@@ -32,7 +32,7 @@ from __future__ import annotations
 import threading
 import warnings
 from collections import deque
-from typing import Iterable
+from typing import Iterable, Optional
 
 # Lock types already warned about on the fail-open path (one warning per
 # type, not per call — _owned runs at every mutation site).
@@ -68,6 +68,45 @@ def _owned(lock) -> bool:
             )
         return True
     return probe()
+
+
+class OwnerGuard:
+    """Single-owner discipline for state that is NOT lock-protected but
+    owner-thread-only by contract — the engine's in-flight overlap
+    record: the step loop dispatches and consumes it (no lock — the hot
+    path) while ``submit()``/``cancel()`` mutate slots under the engine
+    lock.  The dispatch/consume handoff itself must therefore only ever
+    run on the ONE owner thread, or (for tests/tools that drive a
+    drained engine from elsewhere) with the engine lock held, which
+    serializes against the contract's other side.
+
+    The first thread to call :meth:`check` off-lock becomes the owner;
+    any other thread doing so afterwards raises
+    :class:`LockDisciplineError` at the faulty call site.  A lock-held
+    check re-binds ownership to the calling thread (holding the lock IS
+    the license to take over — e.g. the stress suites drain on the main
+    thread after stopping the server loop)."""
+
+    def __init__(self, lock, name: str = "owned"):
+        self._lock = lock
+        self._name = name
+        self._owner: Optional[threading.Thread] = None
+
+    def check(self, op: str) -> None:
+        me = threading.current_thread()
+        if _owned(self._lock):
+            self._owner = me
+            return
+        if self._owner is None or not self._owner.is_alive():
+            # First toucher (or the previous owner thread exited — a
+            # server loop died and another thread inherits the engine).
+            self._owner = me
+            return
+        if self._owner is not me:
+            raise LockDisciplineError(
+                f"{self._name}.{op} from thread {me.name!r} (owner: "
+                f"{self._owner.name!r}) without the engine lock held"
+            )
 
 
 class GuardedDeque(deque):

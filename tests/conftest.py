@@ -65,3 +65,42 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in PLUGIN_TIER_FILES:
             item.add_marker(_pytest.mark.plugin)
+
+
+# ---------------------------------------------------------------------------
+# Shared compiled serving-engine fixture.  The tier-1 suite runs within
+# ~30s of its 870s budget, so tests that only exercise host-side step-loop
+# scheduling (the overlap pipeline suite) must NOT compile their own
+# engines — they share this ONE instance and its jitted step/prefill
+# programs.  Safe to share because the engine drains to idle between
+# runs, and the overlap knob (``eng._overlap_steps``) selects host-side
+# scheduling over the SAME compiled programs, not a new program.
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def shared_engine():
+    """(cfg, params, engine): one compiled tiny engine, racecheck on so
+    the overlap dispatch/consume handoff runs under the OwnerGuard."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_device_plugin_tpu.models.engine import ServingEngine
+    from k8s_device_plugin_tpu.models.transformer import (
+        GPTConfig,
+        PagedConfig,
+        TransformerLM,
+    )
+
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
+    return cfg, params, ServingEngine(
+        cfg, params, paged, max_slots=2, racecheck=True
+    )

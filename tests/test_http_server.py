@@ -512,11 +512,17 @@ def test_debug_endpoints_smoke(served):
     prof = _get(server.port, "/debug/profile")
     assert prof["steps"] > 0 and prof["window"] > 0
     assert set(prof["phases"]) == {
-        "schedule", "prefill", "decode", "sample", "spec_verify"
+        "schedule", "prefill", "dispatch", "readback", "sample",
+        "host_gap", "spec_verify",
     }
-    # Real decode happened, so the decode phase has samples and the
-    # step percentiles are populated.
-    assert prof["phases"]["decode"]["window_steps"] > 0
+    # Real decode happened, so the dispatch/readback phases have samples
+    # and the step percentiles are populated; the overlap window counts
+    # are served alongside.
+    assert prof["phases"]["dispatch"]["window_steps"] > 0
+    assert prof["phases"]["readback"]["window_steps"] > 0
+    assert {"window_hits", "window_discards", "hit_ratio"} <= set(
+        prof["overlap"]
+    )
     assert prof["step_ms"]["p99"] >= prof["step_ms"]["p50"] > 0
     assert prof["occupancy"]["mean_kv_page_utilization"] >= 0.0
     inc = _get(server.port, "/debug/incidents")

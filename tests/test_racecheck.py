@@ -24,6 +24,7 @@ from k8s_device_plugin_tpu.utils.racecheck import (
     GuardedDeque,
     GuardedDict,
     LockDisciplineError,
+    OwnerGuard,
 )
 
 
@@ -109,6 +110,40 @@ def test_guard_checks_ownership_not_just_lockedness():
     finally:
         release.set()
         t.join(10)
+
+
+def test_owner_guard_single_owner_discipline():
+    """OwnerGuard (the overlap pipeline's dispatch/consume handoff
+    check): first off-lock toucher owns the state; a second thread
+    raises unless it holds the lock; a dead owner's state is
+    inheritable (the stress suites drain on the main thread after the
+    server loop stops)."""
+    lock = threading.RLock()
+    guard = OwnerGuard(lock=lock, name="_inflight")
+    guard.check("dispatch")  # this thread becomes the owner
+    guard.check("consume")  # owner re-checks freely
+    seen: list = []
+
+    def intruder():
+        try:
+            guard.check("consume")
+        except LockDisciplineError as e:
+            seen.append(e)
+        with lock:
+            guard.check("consume")  # lock held: licensed takeover
+            seen.append("locked-ok")
+
+    t = threading.Thread(target=intruder, name="intruder")
+    t.start()
+    t.join(10)
+    assert len(seen) == 2 and isinstance(seen[0], LockDisciplineError)
+    assert "_inflight.consume" in str(seen[0]) and "intruder" in str(seen[0])
+    assert seen[1] == "locked-ok"
+    # The locked takeover re-bound ownership to the (now dead) intruder
+    # thread; a dead owner must not wedge the engine — this thread
+    # inherits.
+    guard.check("dispatch")
+    guard.check("consume")
 
 
 def _tiny_engine(**kw):

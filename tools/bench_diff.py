@@ -50,6 +50,14 @@ def load_record(path: str) -> dict:
         if ref:
             rec["tpu_reference_value"] = ref.get("value")
             rec["tpu_reference_platform"] = ref.get("platform")
+        # Serving records carry the overlapped-pipeline block: the
+        # discard count is the regression tell (a round whose discards
+        # jump while throughput sags means the pipeline stopped staying
+        # primed — exactly what a diff row should surface).
+        overlap = parsed.get("overlap")
+        if isinstance(overlap, dict):
+            rec["overlap_discards"] = overlap.get("discards")
+            rec["overlap_speedup"] = overlap.get("speedup")
     return rec
 
 
@@ -66,7 +74,7 @@ def diff_lines(a: dict, b: dict) -> list[str]:
     lines = [f"BENCH r{a['round']:02d} -> r{b['round']:02d}"]
     for field in (
         "metric", "value", "unit", "vs_baseline", "platform", "rc", "error",
-        "tpu_reference_value",
+        "tpu_reference_value", "overlap_speedup", "overlap_discards",
     ):
         va, vb = a.get(field), b.get(field)
         if va is None and vb is None:
@@ -91,6 +99,11 @@ def ledger_row(a: dict, b: dict) -> str:
         status = (
             f"platform {b.get('platform')}"
             + (f"; note: {b['error']}" if b.get("error") else "")
+            + (
+                f"; overlap discards {b['overlap_discards']}"
+                if b.get("overlap_discards") is not None
+                else ""
+            )
         )
     return (
         f"| Driver BENCH headline r{a['round']:02d}→r{b['round']:02d} "
