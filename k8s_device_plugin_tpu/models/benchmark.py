@@ -426,6 +426,8 @@ def run_serving(args) -> None:
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
         spans=spans,
+        kv_retain=True,
+        kv_host_cache_mb=64,
     )
     jobs = [
         (
@@ -502,6 +504,77 @@ def run_serving(args) -> None:
             ", ".join(f"{k} {v:.3f}" for k, v in phase_p50.items()),
         )
     )
+
+    # --- KV cache tiering: repeated-prefix + preemption-churn workload ---
+    # Phase 1: one hot prompt with SERIAL (non-overlapping) lifetimes, so
+    # live prefix sharing cannot help — only the retained tier can.  Timed
+    # with tiering off (every lifetime re-grafts its prompt pages) then on
+    # (pages revive off the retained LRU; the graft skips them).
+    prefix_job = (jobs[0][0], args.decode_tokens)
+    n_rep = min(args.requests, 6)
+    eng._kv_retain = False
+    eng.kvcache_clear()
+    t0 = time.perf_counter()
+    rec_tokens = sum(
+        len(r.tokens) for _ in range(n_rep) for r in eng.run([prefix_job])
+    )
+    dt_recompute = time.perf_counter() - t0
+    eng._kv_retain = True
+    eng.kvcache_clear()
+    kv_hits0 = eng.kv_retained_hits + eng.kv_host_hits
+    t0 = time.perf_counter()
+    res_tokens = sum(
+        len(r.tokens) for _ in range(n_rep) for r in eng.run([prefix_job])
+    )
+    dt_restore = time.perf_counter() - t0
+    kv_hits = eng.kv_retained_hits + eng.kv_host_hits - kv_hits0
+    rec_tps = rec_tokens / dt_recompute if dt_recompute else 0.0
+    res_tps = res_tokens / dt_restore if dt_restore else 0.0
+    kv_speedup = res_tps / rec_tps if rec_tps else 0.0
+
+    # Phase 2: preemption churn — optimistic admission over a deliberately
+    # tightened pool (free pages parked aside), so growing slots preempt
+    # their juniors and the victims resume.  With the tiers on, resumes
+    # restore (zero prefill re-run) instead of recomputing.
+    eng.kvcache_clear()
+    pre0 = eng.preemptions
+    resumes0 = eng.kv_resumes_restored
+    recomputes0 = eng.kv_resumes_recompute
+    eng._optimistic = True
+    page_size = eng.paged.page_size
+    prompt_pages = (args.prompt_len + 1 + page_size - 1) // page_size
+    keep = mpp + 2 * prompt_pages  # oldest can finish; juniors must churn
+    with eng._lock:
+        parked = [
+            eng.free_pages.pop()
+            for _ in range(max(0, len(eng.free_pages) - keep))
+        ]
+    churn_done = eng.run(jobs[: max(2, args.slots)])
+    churn_tokens = sum(len(r.tokens) for r in churn_done)
+    with eng._lock:
+        eng.kvcache_clear()
+        for page in parked:
+            eng.free_pages.append(page)
+    eng._optimistic = False
+    churn_preempts = eng.preemptions - pre0
+    churn_restored = eng.kv_resumes_restored - resumes0
+    churn_recomputed = eng.kv_resumes_recompute - recomputes0
+    log(
+        "perf-ledger row: | KV cache tiering (b%d) | repeated-prefix "
+        "recompute %.2f → restore %.2f tokens/sec (%.3fx; tier hits %d) "
+        "| preemption churn: %d preempts, %d restored / %d recomputed "
+        "resumes | `benchmark.py --model serving` | update on bench round |"
+        % (
+            args.slots,
+            rec_tps,
+            res_tps,
+            kv_speedup,
+            kv_hits,
+            churn_preempts,
+            churn_restored,
+            churn_recomputed,
+        )
+    )
     print(
         json.dumps(
             {
@@ -527,6 +600,21 @@ def run_serving(args) -> None:
                 "ttft_p99_ms": _ms(ttft_h.quantile(0.99, since=ttft_snap)),
                 "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),
                 "itl_p99_ms": _ms(itl_h.quantile(0.99, since=itl_snap)),
+                "kvcache": {
+                    "prefix_recompute_tokens_per_sec": round(rec_tps, 2),
+                    "prefix_restore_tokens_per_sec": round(res_tps, 2),
+                    "restore_speedup": round(kv_speedup, 3),
+                    "hits": kv_hits,
+                    "retained_hits": eng.kv_retained_hits,
+                    "host_hits": eng.kv_host_hits,
+                    "restores": eng.kv_restores,
+                    "reclaims": eng.kv_reclaims,
+                    "offloads": eng.kv_offloads,
+                    "churn_tokens": churn_tokens,
+                    "preemptions": churn_preempts,
+                    "resumes_restored": churn_restored,
+                    "resumes_recomputed": churn_recomputed,
+                },
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {
                     "steps": prof["steps"],

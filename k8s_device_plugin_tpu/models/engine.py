@@ -32,6 +32,10 @@ Module layout (round-4 split; this module remains the import surface):
 - engine_sampling.py   — top-k/top-p filter, jitted step/block builders
 - engine_admission.py  — submit/cancel, batched chunked prefill, admission
 - engine_paging.py     — page pool, prefix trie, frontier, reclamation
+- engine_kvcache.py    — KV cache tiering: retained dead-but-valid pages
+  (LRU, reclaimed lazily under pool pressure) + bounded host-RAM offload
+  with restore-instead-of-recompute for repeated prefixes and
+  preemption resumes
 - engine_spec.py       — speculative round builders + host consumption
 - here                 — ``ServingEngine`` wiring, step loop (split
   dispatch/consume halves with one decode round in flight — the
@@ -53,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine_admission import AdmissionMixin
+from .engine_kvcache import KVCacheMixin
 from .engine_paging import PagingMixin
 from .engine_sampling import (  # noqa: F401  (re-export: public surface)
     _token_logprob,
@@ -79,7 +84,7 @@ from .transformer import (
 )
 
 
-class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
+class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin):
     """Batch-continuous greedy decoding server (single host, one model).
 
     ``MAX_BIAS``: per-request logit_bias entries are padded to this fixed
@@ -116,6 +121,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         decode_block: int = 1,
         overlap_steps: int = 1,
         admission: str = "reserve",
+        kv_retain: bool = False,
+        kv_host_cache_mb: float = 0,
         racecheck: bool = False,
         spans: Optional[SpanRecorder] = None,
         flight: Optional[FlightRecorder] = None,
@@ -420,6 +427,16 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         # and re-registered with different content — surviving child links
         # would then form a stale chain, so they die with the parent.
         self._child_keys: dict[int, list[tuple[int, tuple]]] = {}
+        # KV cache tiering (engine_kvcache.py): with kv_retain, a
+        # prefix-registered page whose refcount hits zero is RETAINED
+        # (trie links live, reclaimed lazily under pool pressure)
+        # instead of freed, and kv_host_cache_mb > 0 adds the bounded
+        # host-RAM arena that reclaimed pages and preemption snapshots
+        # spill into — repeated prefixes and preemption resumes then
+        # restore instead of recomputing.  Library default OFF (the
+        # exact-pool accounting other subsystems and tests rely on);
+        # the serving CLIs default it ON.
+        self._init_kvcache(kv_retain, kv_host_cache_mb)
         if racecheck:
             # Lock-discipline detection (utils/racecheck.py): every
             # mutation of the cross-thread state must hold the engine
@@ -858,6 +875,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
         timer = self._prof_timer = self.profiler.timer()
         self._step_tokens = 0
         hits0, discards0 = self.overlap_hits, self.overlap_discards
+        kv_hits0 = self.kv_retained_hits + self.kv_host_hits
+        kv_restores0 = self.kv_restores
         try:
             with span:
                 if self.metrics:
@@ -884,6 +903,10 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                 tokens=self._step_tokens,
                 overlap_hits=self.overlap_hits - hits0,
                 overlap_discards=self.overlap_discards - discards0,
+                kvcache_hits=(
+                    self.kv_retained_hits + self.kv_host_hits - kv_hits0
+                ),
+                kvcache_restores=self.kv_restores - kv_restores0,
             )
 
     def _step_inner(self) -> list[Request]:
@@ -1049,6 +1072,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
             self.metrics.page_utilization.set(
                 1.0 - len(self.free_pages) / allocatable if allocatable else 0.0
             )
+            self.metrics.kvcache_retained_pages.set(len(self._kv_retained))
+            self.metrics.kvcache_host_bytes.set(self._kv_arena.bytes)
 
     def debug_state(self) -> dict:
         """JSON-safe engine snapshot for the /debug/state endpoint: what
@@ -1105,6 +1130,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, SpeculativeMixin):
                     "proposed": self.spec_proposed,
                     "accepted": self.spec_accepted,
                 },
+                "kvcache": self.kvcache_state(),
                 "config": {
                     "max_slots": self.max_slots,
                     "page_size": self.paged.page_size,
@@ -1242,6 +1268,27 @@ def main(argv: Optional[list[str]] = None) -> None:
         "recompute-resume when the pool runs dry — higher concurrency "
         "when generations finish early",
     )
+    p.add_argument(
+        "--kv-retain",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="KV cache tier 1: keep dead-but-valid prefix pages on an "
+        "LRU instead of freeing them, so a repeated prompt prefix (or a "
+        "preemption resume) restores from the page pool instead of "
+        "recomputing; retained pages are reclaimed lazily whenever the "
+        "free pool alone cannot satisfy a request (default on)",
+    )
+    p.add_argument(
+        "--kv-host-cache-mb",
+        type=float,
+        default=64,
+        help="KV cache tier 2: byte budget (MiB) of the host-RAM arena "
+        "that reclaimed retained pages and preemption snapshots spill "
+        "into; matched entries restore device-side with sliced page "
+        "writes — no recompute, no new compiled shapes (0 disables the "
+        "host tier; default 64)",
+    )
     args = p.parse_args(argv)
     if args.spec_gamma and args.quant:
         raise SystemExit(
@@ -1290,7 +1337,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
         overlap_steps=args.overlap_steps,
-        admission=args.admission, **spec_kw,
+        admission=args.admission,
+        kv_retain=bool(args.kv_retain),
+        kv_host_cache_mb=args.kv_host_cache_mb,
+        **spec_kw,
     )
     sample_kw = dict(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
@@ -1355,6 +1405,9 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "overlap_steps": args.overlap_steps,
                 "overlap_hits": eng.overlap_hits,
                 "overlap_discards": eng.overlap_discards,
+                "kv_retain": bool(args.kv_retain),
+                "kv_retained_hits": eng.kv_retained_hits,
+                "kv_host_hits": eng.kv_host_hits,
                 "ttft_p50_ms": _ms(ttft_h.quantile(0.5, since=ttft_snap)),
                 "ttft_p99_ms": _ms(ttft_h.quantile(0.99, since=ttft_snap)),
                 "itl_p50_ms": _ms(itl_h.quantile(0.5, since=itl_snap)),

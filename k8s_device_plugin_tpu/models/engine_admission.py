@@ -209,6 +209,9 @@ class AdmissionMixin:
                 pass  # admitted (slot or mid-prefill): next step cleans up
             else:
                 req.done = True
+                # A preempted request dying in the queue will never
+                # resume: release its host-arena snapshot bytes now.
+                self._kv_drop_snapshot(req.rid)
             self._update_gauges()
             return True
 
@@ -349,9 +352,16 @@ class AdmissionMixin:
                 while self.queue and self.queue[0].cancelled:
                     dead = self.queue.popleft()
                     dead.done = True
+                    self._kv_drop_snapshot(dead.rid)
                 if self.slots[slot] is not None or not self.queue:
                     continue
                 req = self.queue[0]
+                # Preempted request back at the head: rebuild its slot
+                # from the kv-cache tiers and skip prefill entirely when
+                # coverage is complete (engine_kvcache.py); short
+                # coverage falls through to ordinary recompute-resume.
+                if self._kv_retain and self._kv_try_restore_resume(slot, req):
+                    continue
                 # The EFFECTIVE prompt: original tokens plus anything a
                 # previous occupancy already generated (recompute-resume
                 # after preemption — empty for fresh requests, and always
@@ -382,7 +392,30 @@ class AdmissionMixin:
                     if self.prefix_sharing
                     else []
                 )
+                # The trie walk continues into the host tier: consecutive
+                # offloaded full pages past the device match are restored
+                # into fresh pages below and counted as shared (the graft
+                # never rewrites them — their rows are already the bytes
+                # a recompute would write).
+                host = (
+                    self._kv_match_host(
+                        eff, req.adapter, len(shared),
+                        plen // self.paged.page_size,
+                    )
+                    if self.prefix_sharing and self._kv_retain
+                    else []
+                )
                 n_private = n_pages - len(shared)
+                if n_private > len(self.free_pages):
+                    # Retained pages are one reclaim away from free:
+                    # spill cold ones (LRU, leaf-first) before blocking.
+                    # The protect set pins this request's own match — a
+                    # matched-but-not-yet-referenced retained page must
+                    # not be reclaimed out from under it.
+                    self._kv_reclaim(
+                        n_private - len(self.free_pages),
+                        protect=frozenset(shared),
+                    )
                 if n_private > len(self.free_pages):
                     # FIFO: wait for pages rather than starving the head.
                     self._admit_page_blocked = True
@@ -397,33 +430,38 @@ class AdmissionMixin:
                 pages = shared + private
                 for page in shared:
                     self._page_refs[page] += 1
-                for page in private:
-                    self._page_refs[page] = 1
+                    if self._page_refs[page] == 1:
+                        # 0 -> 1: the page came off the retained tier.
+                        self._kv_revive(page)
+                n_restored = len(host)
+                if n_restored:
+                    self._kv_restore_pages(
+                        private[:n_restored], [e["rows"] for e in host]
+                    )
+                for page in private[n_restored:]:
                     # Ungrafted until _activate: shareable within this
-                    # burst's same-bucket group only.
+                    # burst's same-bucket group only.  Restored pages are
+                    # excluded — their content is already on device, so
+                    # they are shareable immediately, like live pages.
                     burst_pages[page] = bucket
                     self._pending_pages.add(page)
+                for page in private:
+                    self._page_refs[page] = 1
                 if self.prefix_sharing:
-                    # Register this prompt's full pages (shared or fresh) as
-                    # trie links so later same-prefix requests can ride them
-                    # — including requests admitted in this SAME burst: a
-                    # same-burst match is sound because every shared page's
-                    # content is written by its first owner's graft before
-                    # any decode step reads it.
-                    ps = self.paged.page_size
-                    parent = self._trie_root(req.adapter)
-                    for i in range(plen // ps):
-                        key = (parent, tuple(eff[i * ps : (i + 1) * ps]))
-                        if key not in self._prefix_pages:
-                            self._prefix_pages[key] = pages[i]
-                            self._page_keys.setdefault(pages[i], []).append(key)
-                            if parent >= 0:
-                                self._child_keys.setdefault(parent, []).append(key)
-                        parent = pages[i]
+                    # Register this prompt's full pages (shared, restored,
+                    # or fresh) as trie links so later same-prefix requests
+                    # can ride them — including requests admitted in this
+                    # SAME burst: a same-burst match is sound because every
+                    # shared page's content is written by its first owner's
+                    # graft before any decode step reads it.
+                    self._register_prefix(
+                        eff, pages, plen // self.paged.page_size, req.adapter
+                    )
                 self.slots[slot] = req
                 self._slot_pages[slot] = pages
                 self._slot_seq[slot] = self._seq_counter
                 self._seq_counter += 1
+                shared = pages[: len(shared) + n_restored]
             if self.spans:
                 self.spans.record_span(
                     "pages.alloc",
@@ -463,6 +501,32 @@ class AdmissionMixin:
             self._start_prefill(items)
         return []
 
+    def _set_slot_sampler(self, slot: int, req: Request) -> None:
+        """Install a request's sampler scalars on its slot.  A greedy
+        slot's token is the argmax regardless of top_k/top_p, so they
+        normalize to "off" — otherwise one greedy+top_k request would
+        drag the whole batch onto the filtered (sorting) step path for
+        zero output change.  Shared by activation and the kv-cache
+        restore-resume path (which rebuilds a slot without a graft)."""
+        if req.temperature > 0:
+            topk = req.top_k if req.top_k is not None else self.cfg.vocab_size
+            topp = req.top_p if req.top_p is not None else 1.0
+        else:
+            topk, topp = self.cfg.vocab_size, 1.0
+        self._slot_temp[slot] = req.temperature
+        self._slot_topk[slot] = topk
+        self._slot_topp[slot] = topp
+        if req.logit_bias:
+            ids_l = list(req.logit_bias)
+            vals_l = list(req.logit_bias.values())
+            pad = self.MAX_BIAS - len(ids_l)
+            self._slot_bias_ids[slot] = ids_l + [0] * pad
+            self._slot_bias_vals[slot] = vals_l + [0.0] * pad
+        else:
+            self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
+            self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
+        self._slot_aid[slot] = req.adapter if req.adapter is not None else -1
+
     def _activate(self, job: dict) -> list[Request]:
         """Graft a completed prefill job's K/V into pages, sample each
         request's first token, and mark the slots ready to decode."""
@@ -492,10 +556,9 @@ class AdmissionMixin:
                 )
             else:
                 picked_logits = last_logits
-            # A greedy slot's token is the argmax regardless of
-            # top_k/top_p, so normalize them to "off" — otherwise one
-            # greedy+top_k request would drag the whole batch onto the
-            # filtered (sorting) step path for zero output change.
+            # Same normalization the slot scalars get (see
+            # _set_slot_sampler): a greedy slot's token is the argmax
+            # regardless of top_k/top_p.
             if req.temperature > 0:
                 topk = (
                     req.top_k
@@ -533,22 +596,29 @@ class AdmissionMixin:
             req.tokens.append(first)
             self._slot_last[slot] = first
             self._slot_len[slot] = plen
-            self._slot_temp[slot] = req.temperature
-            self._slot_topk[slot] = topk
-            self._slot_topp[slot] = topp
-            if req.logit_bias:
-                ids_l = list(req.logit_bias)
-                vals_l = list(req.logit_bias.values())
-                pad = self.MAX_BIAS - len(ids_l)
-                self._slot_bias_ids[slot] = ids_l + [0] * pad
-                self._slot_bias_vals[slot] = vals_l + [0.0] * pad
-            else:
-                self._slot_bias_ids[slot] = [0] * self.MAX_BIAS
-                self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
-            self._slot_aid[slot] = (
-                req.adapter if req.adapter is not None else -1
-            )
+            self._set_slot_sampler(slot, req)
             self._slot_ready[slot] = True
+            if resumed:
+                # Preemption-resume accounting, recompute flavor: the
+                # whole effective prompt re-ran through prefill (the
+                # restore path — engine_kvcache._kv_try_restore_resume —
+                # records its zero-recompute counterpart; together the
+                # two say whether victims actually got back in and what
+                # their second admission cost).
+                self.kv_resumes_recompute += 1
+                self.kv_resume_recomputed_tokens += plen
+                if self.metrics:
+                    self.metrics.resumes.inc(mode="recompute")
+                    self.metrics.resume_recomputed_tokens.inc(plen)
+                if self.flight is not None:
+                    self.flight.record(
+                        "engine.resume",
+                        rid=req.rid,
+                        mode="recompute",
+                        restored_tokens=0,
+                        recomputed_tokens=plen,
+                        pages_shared=n_shared,
+                    )
             now = time.monotonic()
             # First emitted token: the TTFT/ITL anchor for this slot.
             req.first_token_at = now

@@ -143,29 +143,41 @@ class PagingMixin:
         self._mark_state_dirty()
 
     def _release_page(self, page: int) -> None:
-        """Drop one reference; at zero, tear down every trie link touching
-        the page (keys registered FOR it and keys in which it is the
-        PARENT — a freed id can be reallocated and re-registered with
-        different content, so a surviving child link would let a later
-        prompt walk into another request's K/V) and return it to the
-        pool.  The ONE page-free path: _clear_slot and windowed
-        reclamation both come through here.  Runs under the engine lock:
-        _update_gauges iterates _page_refs from the scraping/submitting
-        threads, and a resize here mid-iteration would crash them."""
+        """Drop one reference; at zero, either RETAIN the page (trie
+        links intact — the kv-cache tier 1, engine_kvcache.py: a later
+        same-prefix request matches it for free, and the allocator
+        reclaims it lazily when the pool runs dry) or tear down every
+        trie link touching the page and return it to the pool.  The ONE
+        page-free path: _clear_slot and windowed reclamation both come
+        through here.  Runs under the engine lock: _update_gauges
+        iterates _page_refs from the scraping/submitting threads, and a
+        resize here mid-iteration would crash them."""
         with self._lock:
             self._page_refs[page] -= 1
             if self._page_refs[page] > 0:
                 return
+            if self._kv_retain and self._kv_retain_page(page):
+                return  # refcount parks at 0; revived on the next match
             del self._page_refs[page]
-            for key in self._page_keys.pop(page, []):
-                self._prefix_pages.pop(key, None)
-            for key in self._child_keys.pop(page, []):
-                child = self._prefix_pages.pop(key, None)
-                if child is not None:
-                    keys = self._page_keys.get(child)
-                    if keys and key in keys:
-                        keys.remove(key)
+            self._teardown_page_links(page)
             self.free_pages.append(page)
+
+    def _teardown_page_links(self, page: int) -> None:
+        """Remove every trie link touching a dying page: keys registered
+        FOR it and keys in which it is the PARENT — a freed id can be
+        reallocated and re-registered with different content, so a
+        surviving child link would let a later prompt walk into another
+        request's K/V.  Shared by the free path above and the retained-
+        tier reclaim (engine_kvcache.py), which must uphold the same
+        invariant.  Caller holds the engine lock."""
+        for key in self._page_keys.pop(page, []):
+            self._prefix_pages.pop(key, None)
+        for key in self._child_keys.pop(page, []):
+            child = self._prefix_pages.pop(key, None)
+            if child is not None:
+                keys = self._page_keys.get(child)
+                if keys and key in keys:
+                    keys.remove(key)
 
     @staticmethod
     def _trie_root(adapter: Optional[int]) -> int:
@@ -212,6 +224,27 @@ class PagingMixin:
             parent = page
         return pages
 
+    def _register_prefix(
+        self, eff: list[int], pages: list[int], n: int, adapter: Optional[int]
+    ) -> None:
+        """Register ``eff``'s first ``n`` full pages as trie links so
+        later same-prefix requests can ride them (idempotent: an
+        existing key wins and the walk follows the CANONICAL page, which
+        in the admission path is always ``pages[i]`` itself).  Callers:
+        the admission burst, the preemption snapshot (publishing a
+        victim's generated pages), and restore-resume (re-linking
+        restored pages).  Caller holds the engine lock."""
+        ps = self.paged.page_size
+        parent = self._trie_root(adapter)
+        for i in range(n):
+            key = (parent, tuple(eff[i * ps : (i + 1) * ps]))
+            if key not in self._prefix_pages:
+                self._prefix_pages[key] = pages[i]
+                self._page_keys.setdefault(pages[i], []).append(key)
+                if parent >= 0:
+                    self._child_keys.setdefault(parent, []).append(key)
+            parent = self._prefix_pages[key]
+
     def _ensure_frontier(self, active: list[int], lookahead: int) -> list[int]:
         """Make every coming write in [len, len+lookahead] addressable for
         each active slot, then publish the covering pages.
@@ -245,6 +278,10 @@ class PagingMixin:
             need = (self._slot_len[s] + lookahead) // ps + 1
             while need > self._slot_page_base[s] + len(self._slot_pages[s]):
                 with self._lock:
+                    if not self.free_pages and self._kv_retained:
+                        # Retained pages are reclaimable-on-demand: spill
+                        # one to the host tier before robbing a newer slot.
+                        self._kv_reclaim(1)
                     page = (
                         self.free_pages.popleft() if self.free_pages else None
                     )
@@ -297,6 +334,14 @@ class PagingMixin:
         client already cancelled it, in which case eviction doubles as
         the teardown."""
         req = self.slots[slot]
+        # Snapshot BEFORE teardown: the tail page's rows and the decode
+        # state scalars (engine_kvcache.py) — _clear_slot's release then
+        # RETAINS the full pages (registered below) rather than freeing
+        # them, so the victim's own resume matches them device-side.  A
+        # racing cancel is reconciled under the lock below.
+        snapshotted = (
+            self._kv_snapshot_slot(slot, req) if not req.cancelled else False
+        )
         self._clear_slot(slot)
         with self._lock:
             # Atomic with cancel(): a disconnect racing this eviction
@@ -305,6 +350,8 @@ class PagingMixin:
             # (cancel removes it there) — never a cancelled request
             # silently re-admitted.
             if req.cancelled:
+                if snapshotted:
+                    self._kv_drop_snapshot(req.rid)
                 req.done = True
                 self._update_gauges()
                 return
@@ -322,6 +369,7 @@ class PagingMixin:
                 rid=req.rid,
                 generated=len(req.tokens),
                 free_pages_after=len(self.free_pages),
+                snapshot=snapshotted,
             )
 
     def _extend_frontier(self, slot: int, lookahead: Optional[int] = None) -> None:

@@ -142,13 +142,17 @@ class EngineProfiler:
         tokens: int,
         overlap_hits: int = 0,
         overlap_discards: int = 0,
+        kvcache_hits: int = 0,
+        kvcache_restores: int = 0,
     ) -> float:
         """Close out one step: fold the timer into the windows, sample
         memory, emit the periodic flight summary, feed the anomaly hook.
         ``overlap_hits``/``overlap_discards`` are THIS step's deltas from
         the engine's overlapped-pipeline counters (a hit = the step was
-        consumed from an in-flight dispatch; a discard = a wasted lane).
-        Returns the step's wall seconds."""
+        consumed from an in-flight dispatch; a discard = a wasted lane);
+        ``kvcache_hits``/``kvcache_restores`` likewise from the KV
+        tiering counters (pages served from a tier / restored
+        host->device this step).  Returns the step's wall seconds."""
         now = time.perf_counter()
         wall = now - timer.t0
         mem = self._memory_bytes()
@@ -161,6 +165,8 @@ class EngineProfiler:
             "tokens": tokens,
             "overlap_hits": overlap_hits,
             "overlap_discards": overlap_discards,
+            "kvcache_hits": kvcache_hits,
+            "kvcache_restores": kvcache_restores,
         }
         if mem is not None:
             record["mem_bytes"] = mem
@@ -209,6 +215,10 @@ class EngineProfiler:
                 ),
                 overlap_discards=sum(
                     r.get("overlap_discards", 0) for r in window
+                ),
+                kvcache_hits=sum(r.get("kvcache_hits", 0) for r in window),
+                kvcache_restores=sum(
+                    r.get("kvcache_restores", 0) for r in window
                 ),
             )
         if self.observe_step is not None:
@@ -283,6 +293,12 @@ class EngineProfiler:
                 )
                 if n
                 else 0.0,
+            },
+            "kvcache": {
+                "window_hits": sum(r.get("kvcache_hits", 0) for r in window),
+                "window_restores": sum(
+                    r.get("kvcache_restores", 0) for r in window
+                ),
             },
         }
         mems = [r["mem_bytes"] for r in window if "mem_bytes" in r]

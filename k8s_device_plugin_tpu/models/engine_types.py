@@ -149,6 +149,65 @@ class EngineMetrics:
             "sustained ~1.0 with queued requests means the pool, not "
             "compute, caps concurrency)",
         )
+        # KV cache tiering (models/engine_kvcache.py): tier sizes, hit and
+        # demotion flow, and what restore-instead-of-recompute costs.
+        self.kvcache_retained_pages = registry.gauge(
+            "tpu_engine_kvcache_retained_pages",
+            "Dead-but-valid KV pages held on the retained (tier-1) LRU — "
+            "trie-reachable at zero refcount, reclaimed lazily under "
+            "pool pressure",
+        )
+        self.kvcache_host_bytes = registry.gauge(
+            "tpu_engine_kvcache_host_bytes",
+            "Bytes held in the host-RAM KV arena (tier 2, bounded by "
+            "--kv-host-cache-mb): offloaded pages plus preemption "
+            "snapshots",
+        )
+        self.kvcache_hits = registry.counter(
+            "tpu_engine_kvcache_hits_total",
+            "Prefix pages served from a KV cache tier instead of "
+            "recomputed (tier=retained: revived device page; tier=host: "
+            "restored from the arena)",
+            ["tier"],
+        )
+        self.kvcache_evictions = registry.counter(
+            "tpu_engine_kvcache_evictions_total",
+            "KV tier demotions/evictions (tier=retained: page reclaimed "
+            "into the free pool, offloading first when the arena is on; "
+            "tier=host: arena entries dropped to hold the byte budget)",
+            ["tier"],
+        )
+        self.kvcache_restores = registry.counter(
+            "tpu_engine_kvcache_restores_total",
+            "Pages restored host->device via sliced page writes (no "
+            "recompute, no new compiled shapes)",
+        )
+        self.kvcache_restore_seconds = registry.histogram(
+            "tpu_engine_kvcache_restore_seconds",
+            "Wall time of one host->device restore batch (all pages of "
+            "one admission, every layer); compare against the prefill "
+            "it replaced to validate the tier pays off",
+        )
+        self.resumes = registry.counter(
+            "tpu_engine_resumes_total",
+            "Preempted requests re-admitted after eviction "
+            "(mode=restored: slot rebuilt from the KV tiers, zero "
+            "prefill; mode=recompute: full prefill over prompt + "
+            "generated tokens) — preemptions_total minus this is the "
+            "victims still waiting",
+            ["mode"],
+        )
+        self.resume_restored_tokens = registry.counter(
+            "tpu_engine_resume_restored_tokens_total",
+            "Tokens whose K/V a preemption resume restored instead of "
+            "recomputing",
+        )
+        self.resume_recomputed_tokens = registry.counter(
+            "tpu_engine_resume_recomputed_tokens_total",
+            "Tokens re-prefilled by recompute-resumes (the work the KV "
+            "tiers exist to avoid; a rising rate says the host arena is "
+            "too small for the preemption churn)",
+        )
 
 
 @dataclasses.dataclass

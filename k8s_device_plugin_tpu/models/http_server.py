@@ -56,6 +56,10 @@ rest of the models/ stack which benchmarks on synthetic ids):
          p50/p99 over the rolling window), batch occupancy, KV-page
          utilization, overlap hit/discard window counts, device-memory
          track.  Always on.
+    GET /debug/kvcache -> 200 JSON KV-cache tiering snapshot
+         (models/engine_kvcache.py): retained-tier size, host-arena
+         bytes/entries vs budget, hit/evict/restore counters, and
+         preemption-resume accounting (restored vs recomputed).
     GET /debug/incidents -> 200 JSON anomaly-monitor snapshot
          (utils/anomaly.py): bounded incident list (cause metric,
          baseline, observed, z-score, attached flight-recorder window)
@@ -464,6 +468,12 @@ class EngineServer:
                     # aggregates only, no request-identifying content, so
                     # it stays as open as /metrics.
                     self._reply(200, server.engine.profiler.snapshot())
+                elif path == "/debug/kvcache":
+                    # KV tiering snapshot (models/engine_kvcache.py):
+                    # tier sizes, hit/evict/restore counters, resume
+                    # accounting — counts and bytes only, never token
+                    # content, so it stays as open as /metrics.
+                    self._reply(200, server.engine.kvcache_state())
                 elif path == "/debug/incidents":
                     self._reply(200, server.engine.anomaly.snapshot())
                 elif path == "/debug/flight":
@@ -657,6 +667,27 @@ def main(argv: Optional[list[str]] = None) -> None:
         "lane, counted in tpu_engine_overlap_discards_total; 0: strictly "
         "synchronous loop; speculative engines always run synchronously)",
     )
+    p.add_argument(
+        "--kv-retain",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="KV cache tier 1 (default on): retain dead-but-valid "
+        "prefix pages on an LRU — a repeated system prompt or a "
+        "preemption resume restores them instead of recomputing; "
+        "reclaimed lazily, leaf-first, whenever the free pool alone "
+        "cannot satisfy a request (docs/operations.md \"KV cache "
+        "tiering\")",
+    )
+    p.add_argument(
+        "--kv-host-cache-mb",
+        type=float,
+        default=64,
+        help="KV cache tier 2: host-RAM arena byte budget (MiB) that "
+        "reclaimed pages and preemption snapshots spill into; size it "
+        "into the pod memory request (bytes-per-page are printed in "
+        "GET /debug/kvcache's host block; 0 disables)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--compilation-cache-dir",
@@ -839,6 +870,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
         overlap_steps=args.overlap_steps,
         admission=args.admission,
+        kv_retain=bool(args.kv_retain),
+        kv_host_cache_mb=args.kv_host_cache_mb,
         **spec_kw,
     )
     server = EngineServer(
@@ -867,7 +900,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         pass  # not on the main thread (embedded/test use)
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
-        "/debug/state /debug/profile /debug/incidents /debug/flight)",
+        "/debug/state /debug/profile /debug/kvcache /debug/incidents "
+        "/debug/flight)",
         file=sys.stderr,
         flush=True,
     )

@@ -68,6 +68,73 @@ def pytest_collection_modifyitems(config, items):
 
 
 # ---------------------------------------------------------------------------
+# Tier-1 wall-clock budget guard.  The tier-1 suite runs under a hard
+# 870 s driver timeout and currently sits within ~30 s of it; a new test
+# that compiles its own engine can silently eat that headroom and only
+# surface as a timeout kill (no report, no culprit).  This hook prints
+# the suite's wall clock against the budget on EVERY run and fails the
+# run with a clear message once it crosses the soft threshold (~860 s),
+# so drift is visible while there is still room to fix it.  Override
+# with TIER1_WALL_BUDGET_S (0 disables the failure, the report stays).
+# ---------------------------------------------------------------------------
+
+_TIER1_TIMEOUT_S = 870.0
+_tier1_t0 = None
+
+
+def _tier1_budget_s() -> float:
+    try:
+        return float(os.environ.get("TIER1_WALL_BUDGET_S", "860"))
+    except ValueError:
+        return 860.0
+
+
+def pytest_sessionstart(session):
+    global _tier1_t0
+    import time
+
+    _tier1_t0 = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import time
+
+    if _tier1_t0 is None:
+        return
+    elapsed = time.monotonic() - _tier1_t0
+    budget = _tier1_budget_s()
+    if budget > 0 and elapsed > budget and exitstatus == 0:
+        # Turn an otherwise-green over-budget run into a failure NOW,
+        # while there is still headroom to the hard timeout; a red run
+        # keeps its own status (the budget message still prints below).
+        session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    import time
+
+    if _tier1_t0 is None:
+        return
+    elapsed = time.monotonic() - _tier1_t0
+    budget = _tier1_budget_s()
+    terminalreporter.write_line(
+        f"tier-1 wall clock: {elapsed:.0f}s of the {_TIER1_TIMEOUT_S:.0f}s "
+        f"driver timeout (soft budget {budget:.0f}s, "
+        f"headroom {budget - elapsed:+.0f}s)"
+    )
+    if budget > 0 and elapsed > budget:
+        terminalreporter.write_line(
+            f"FAILED: suite wall clock {elapsed:.0f}s exceeded the "
+            f"{budget:.0f}s soft budget — new engine compiles are eating "
+            "the 870s driver-timeout headroom.  Reuse the session-scoped "
+            "`shared_engine` fixture (tests/conftest.py) instead of "
+            "compiling new engines, or raise TIER1_WALL_BUDGET_S "
+            "deliberately.",
+            red=True,
+        )
+
+
+# ---------------------------------------------------------------------------
 # Shared compiled serving-engine fixture.  The tier-1 suite runs within
 # ~30s of its 870s budget, so tests that only exercise host-side step-loop
 # scheduling (the overlap pipeline suite) must NOT compile their own

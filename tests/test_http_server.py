@@ -530,6 +530,19 @@ def test_debug_endpoints_smoke(served):
     fl = _get(server.port, "/debug/flight")
     assert fl["name"] == "engine"
     assert isinstance(fl["events"], list) and "dropped_by_kind" in fl
+    # KV tiering snapshot (models/engine_kvcache.py): present and shaped
+    # whether or not the tiers are enabled (this engine runs the library
+    # default, retention off) — operators read the same keys either way.
+    kv = _get(server.port, "/debug/kvcache")
+    assert {"retain", "retained_pages", "host", "hits", "restores",
+            "reclaims", "offloads", "resumes"} <= set(kv)
+    assert {"retained", "host"} <= set(kv["hits"])
+    assert {"restored", "recompute"} <= set(kv["resumes"])
+    assert kv["host"]["bytes"] <= kv["host"]["budget_bytes"] or not kv[
+        "host"
+    ]["enabled"]
+    # The engine snapshot carries the same block (debug_state parity).
+    assert state["engine"]["kvcache"]["retain"] == kv["retain"]
 
 
 def test_forced_incident_at_debug_incidents(served):

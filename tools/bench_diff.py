@@ -58,6 +58,20 @@ def load_record(path: str) -> dict:
         if isinstance(overlap, dict):
             rec["overlap_discards"] = overlap.get("discards")
             rec["overlap_speedup"] = overlap.get("speedup")
+        # KV cache tiering block (serving records): hit/restore/evict
+        # counters plus the restore-vs-recompute speedup.  A round whose
+        # hits collapse or whose recomputed resumes reappear means the
+        # tiers stopped carrying the repeated-prefix/preemption load.
+        kvcache = parsed.get("kvcache")
+        if isinstance(kvcache, dict):
+            rec["kvcache_hits"] = kvcache.get("hits")
+            rec["kvcache_restores"] = kvcache.get("restores")
+            rec["kvcache_reclaims"] = kvcache.get("reclaims")
+            rec["kvcache_restore_speedup"] = kvcache.get("restore_speedup")
+            rec["kvcache_resumes_restored"] = kvcache.get("resumes_restored")
+            rec["kvcache_resumes_recomputed"] = kvcache.get(
+                "resumes_recomputed"
+            )
     return rec
 
 
@@ -75,6 +89,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
     for field in (
         "metric", "value", "unit", "vs_baseline", "platform", "rc", "error",
         "tpu_reference_value", "overlap_speedup", "overlap_discards",
+        "kvcache_hits", "kvcache_restores", "kvcache_reclaims",
+        "kvcache_restore_speedup", "kvcache_resumes_restored",
+        "kvcache_resumes_recomputed",
     ):
         va, vb = a.get(field), b.get(field)
         if va is None and vb is None:
@@ -102,6 +119,14 @@ def ledger_row(a: dict, b: dict) -> str:
             + (
                 f"; overlap discards {b['overlap_discards']}"
                 if b.get("overlap_discards") is not None
+                else ""
+            )
+            + (
+                f"; kvcache hits {b['kvcache_hits']} "
+                f"restore {b.get('kvcache_restore_speedup')}x "
+                f"resumes {b.get('kvcache_resumes_restored')}r/"
+                f"{b.get('kvcache_resumes_recomputed')}c"
+                if b.get("kvcache_hits") is not None
                 else ""
             )
         )
