@@ -17,14 +17,22 @@ from __future__ import annotations
 import grpc
 
 from . import deviceplugin_pb2 as pb
-from .constants import DEVICE_PLUGIN_SERVICE, REGISTRATION_SERVICE
+from . import podresources_pb2 as prpb
+from .constants import (
+    DEVICE_PLUGIN_SERVICE,
+    POD_RESOURCES_SERVICE,
+    REGISTRATION_SERVICE,
+)
 
 __all__ = [
     "pb",
+    "prpb",
     "RegistrationStub",
     "DevicePluginStub",
+    "PodResourcesListerStub",
     "add_registration_servicer",
     "add_device_plugin_servicer",
+    "add_pod_resources_servicer",
 ]
 
 
@@ -71,6 +79,32 @@ class DevicePluginStub:
             f"/{DEVICE_PLUGIN_SERVICE}/PreStartContainer",
             request_serializer=pb.PreStartContainerRequest.SerializeToString,
             response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+
+class PodResourcesListerStub:
+    """Client for the kubelet's PodResourcesLister service (plugin -> kubelet).
+
+    Dialed on ``pod-resources/kubelet.sock`` (constants.POD_RESOURCES_SOCKET)
+    by plugin/attribution.py; the hermetic FakeKubelet serves the same
+    service in tests.
+    """
+
+    def __init__(self, channel: grpc.Channel):
+        self.List = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/List",
+            request_serializer=prpb.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=prpb.ListPodResourcesResponse.FromString,
+        )
+        self.GetAllocatableResources = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/GetAllocatableResources",
+            request_serializer=prpb.AllocatableResourcesRequest.SerializeToString,
+            response_deserializer=prpb.AllocatableResourcesResponse.FromString,
+        )
+        self.Get = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/Get",
+            request_serializer=prpb.GetPodResourcesRequest.SerializeToString,
+            response_deserializer=prpb.GetPodResourcesResponse.FromString,
         )
 
 
@@ -123,4 +157,30 @@ def add_device_plugin_servicer(servicer, server) -> None:
     }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(DEVICE_PLUGIN_SERVICE, handlers),)
+    )
+
+
+def add_pod_resources_servicer(servicer, server) -> None:
+    """Register a PodResourcesLister servicer (List, GetAllocatableResources,
+    Get) on a server — what the hermetic FakeKubelet uses to stand in for
+    the real kubelet's pod-resources endpoint."""
+    handlers = {
+        "List": grpc.unary_unary_rpc_method_handler(
+            servicer.List,
+            request_deserializer=prpb.ListPodResourcesRequest.FromString,
+            response_serializer=prpb.ListPodResourcesResponse.SerializeToString,
+        ),
+        "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+            servicer.GetAllocatableResources,
+            request_deserializer=prpb.AllocatableResourcesRequest.FromString,
+            response_serializer=prpb.AllocatableResourcesResponse.SerializeToString,
+        ),
+        "Get": grpc.unary_unary_rpc_method_handler(
+            servicer.Get,
+            request_deserializer=prpb.GetPodResourcesRequest.FromString,
+            response_serializer=prpb.GetPodResourcesResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(POD_RESOURCES_SERVICE, handlers),)
     )

@@ -26,3 +26,11 @@ PRE_START_CONTAINER_TIMEOUT_SECONDS = 30
 # gRPC method paths, fixed by the proto package/service/method names.
 REGISTRATION_SERVICE = "v1beta1.Registration"
 DEVICE_PLUGIN_SERVICE = "v1beta1.DevicePlugin"
+
+# The kubelet's PodResources introspection endpoint (podresources/v1):
+# which pod/container currently holds which device IDs — the kubelet-truth
+# side of the plugin's allocation-reconciliation audit.
+POD_RESOURCES_PATH = "/var/lib/kubelet/pod-resources/"
+POD_RESOURCES_SOCKET_NAME = "kubelet.sock"
+POD_RESOURCES_SOCKET = POD_RESOURCES_PATH + POD_RESOURCES_SOCKET_NAME
+POD_RESOURCES_SERVICE = "v1.PodResourcesLister"

@@ -97,7 +97,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..utils import flight as flight_mod
-from ..utils.metrics import MetricsRegistry
+from ..utils.metrics import MetricsRegistry, write_exposition
 from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
 
@@ -482,15 +482,7 @@ class EngineServer:
                     # the event catalog — never token content.
                     self._reply(200, server.engine.flight.snapshot())
                 elif path == "/metrics" and registry is not None:
-                    body = registry.render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8",
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    write_exposition(self, registry)
                 else:
                     self.send_error(404)
 

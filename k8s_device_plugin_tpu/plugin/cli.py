@@ -21,6 +21,7 @@ from ..utils.logging import setup_logging
 from ..utils.metrics import MetricsServer
 from ..utils.spans import SpanRecorder
 from . import discovery
+from .attribution import AllocationLedger, PodAttributionPoller
 from .health import ChipHealthChecker
 from .manager import DEFAULT_ENDPOINT, PluginManager
 from .server import DEFAULT_REGISTRY, RESOURCE, TpuDevicePlugin, default_plugin_metrics
@@ -85,6 +86,24 @@ def build_parser() -> argparse.ArgumentParser:
         "transitions) dumped on SIGUSR2/exit and served at /debug/flight",
     )
     p.add_argument(
+        "--pod-resources-socket",
+        default="",
+        help="kubelet PodResources socket to poll for per-pod chip "
+        "attribution (typically "
+        f"{constants.POD_RESOURCES_SOCKET}; the DaemonSet yamls mount "
+        "it).  Empty disables; an absent/unresponsive socket degrades "
+        "gracefully (tpu_podresources_up 0) and the daemon otherwise "
+        "runs exactly as without the flag",
+    )
+    p.add_argument(
+        "--pod-resources-interval",
+        type=float,
+        default=10.0,
+        help="seconds between PodResources attribution polls "
+        "(ownership series, /debug/pods, allocation-reconciliation "
+        "audit)",
+    )
+    p.add_argument(
         "--dump-dir",
         default=flight_mod.default_dump_dir() or "",
         help="directory for flight-recorder dumps: `kill -USR2 <pid>` "
@@ -147,6 +166,10 @@ def main(argv: list[str] | None = None) -> int:
         "plugin.health_sweep_seconds", warmup=30, z_threshold=6.0, sustain=3
     )
     spans = SpanRecorder(capacity=512)
+    # One allocation ledger per process, shared by every resource's
+    # plugin: Allocate grants land here and the attribution poller diffs
+    # kubelet PodResources truth against it (plugin/attribution.py).
+    ledger = AllocationLedger()
 
     def observe_sweep(dt: float) -> None:
         # One hook, two sinks: the Prometheus histogram operators scrape
@@ -167,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
             flight=box,
             anomaly=monitor,
             spans=spans,
+            ledger=ledger,
         )
 
     debug_endpoints = {
@@ -202,6 +226,29 @@ def main(argv: list[str] | None = None) -> int:
             resource=args.resource,
             pulse=args.pulse,
         )
+    poller = None
+    if args.pod_resources_socket:
+        # Per-pod chip attribution + allocation-reconciliation audit.
+        # In multi-resource mode the plugins live inside the manager, so
+        # the /debug/pods join degrades to device IDs without the
+        # discovery/topology fields; the single-resource daemon joins
+        # the full chip info.
+        resource_names = (
+            {p.strip() for p in args.resources.split(",") if p.strip()}
+            if args.resources
+            else {args.resource}
+        )
+        poller = PodAttributionPoller(
+            args.pod_resources_socket,
+            metrics=default_plugin_metrics(),
+            ledger=ledger,
+            resources=resource_names,
+            device_info=None if args.resources else plugin.device_info,
+            flight=box,
+            anomaly=monitor,
+            interval_s=args.pod_resources_interval,
+        )
+        debug_endpoints["/debug/pods"] = poller.snapshot
     metrics_server = None
 
     def _on_signal(signum, _frame):
@@ -237,8 +284,12 @@ def main(argv: list[str] | None = None) -> int:
                 metrics_server.port,
                 " ".join(sorted(debug_endpoints)),
             )
+        if poller is not None:
+            poller.start()
         manager.run()
     finally:
+        if poller is not None:
+            poller.stop()
         if metrics_server is not None:
             metrics_server.stop()
     return 0

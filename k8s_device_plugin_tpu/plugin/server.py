@@ -155,6 +155,59 @@ class PluginMetrics:
             "records served at the MetricsServer's /debug/incidents",
             ["metric"],
         )
+        # --- pod attribution (plugin/attribution.py).  Cardinality is
+        # bounded by the host's chip count (<= 16): at most one
+        # owner-info series per chip and one tpu_pod_chips series per
+        # chip-holding pod; series are removed the poll after their pod
+        # goes away (the unplug pattern of device_health).
+        self.chip_owner = registry.gauge(
+            "tpu_chip_owner_info",
+            "Chip ownership joined from the kubelet PodResources API: "
+            "constant 1 per (device, namespace, pod, container); series "
+            "removed when the pod releases the chip",
+            ["device", "namespace", "pod", "container"],
+        )
+        self.pod_chips = registry.gauge(
+            "tpu_pod_chips",
+            "Chips the kubelet currently attributes to each pod; series "
+            "removed when the pod goes away",
+            ["namespace", "pod"],
+        )
+        self.attribution_attributed = registry.gauge(
+            "tpu_attribution_attributed_chips",
+            "Chips the kubelet currently attributes to pods (attributed "
+            "< allocatable is normal slack; attributed > allocatable is "
+            "drift territory)",
+        )
+        self.attribution_allocatable = registry.gauge(
+            "tpu_attribution_allocatable_chips",
+            "Allocatable devices reported by the kubelet's "
+            "GetAllocatableResources for the plugin's resources",
+        )
+        self.podresources_up = registry.gauge(
+            "tpu_podresources_up",
+            "1 when the kubelet PodResources socket answered the last "
+            "attribution poll; 0 when unconfigured, absent, or "
+            "unresponsive (the daemon degrades gracefully either way)",
+        )
+        self.attribution_poll_seconds = registry.histogram(
+            "tpu_attribution_poll_seconds",
+            "Wall time of one PodResources attribution poll (List + "
+            "periodic GetAllocatableResources + ownership diff + "
+            "reconciliation audit); budget < 1 ms against a local socket",
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 1.0,
+            ),
+        )
+        self.attribution_drift = registry.counter(
+            "tpu_attribution_drift_total",
+            "Allocation-reconciliation drift: kubelet attributes a chip "
+            "the plugin never granted (kind=ungranted) or a granted chip "
+            "the kubelet never surfaced within the confirmation grace "
+            "(kind=unfulfilled)",
+            ["kind"],
+        )
 
 
 class TpuDevicePlugin:
@@ -174,10 +227,17 @@ class TpuDevicePlugin:
         flight: FlightRecorder | None = None,
         anomaly: AnomalyMonitor | None = None,
         spans: SpanRecorder | None = None,
+        ledger=None,
     ):
         self._discover = discover
         self._health_checker = health_checker
         self.metrics = metrics if metrics is not None else PluginMetrics(MetricsRegistry())
+        # Allocation ledger (plugin/attribution.py AllocationLedger):
+        # every granted device ID lands here so the attribution poller
+        # can diff kubelet truth against what we actually handed out.
+        # Optional like the forensics hooks — bare test constructions
+        # stay ledger-free.
+        self.ledger = ledger
         # Forensics (cli.py wires shared instances; all optional here so
         # bare test constructions stay zero-cost): a flight-recorder
         # black box of daemon lifecycle events, an anomaly monitor over
@@ -313,6 +373,22 @@ class TpuDevicePlugin:
             ],
         }
 
+    def device_info(self) -> dict[str, dict]:
+        """Per-chip discovery/topology/health join keyed by k8s device ID —
+        what the attribution poller merges under each pod's devices in
+        ``GET /debug/pods`` (chip index, ICI coords, NUMA, health)."""
+        _, inventory, health = self._snapshot()
+        return {
+            chip.k8s_id: {
+                "index": chip.index,
+                "device_path": chip.device_path,
+                "numa_node": chip.numa_node,
+                "coords": list(inventory.coords_of(chip)),
+                "healthy": bool(health.get(chip.k8s_id)),
+            }
+            for chip in inventory.chips
+        }
+
     def _device_list(self, inventory: TpuHostInventory, health: dict[str, bool]):
         devices = []
         for chip in inventory.chips:
@@ -434,6 +510,7 @@ class TpuDevicePlugin:
             _, inventory, health = self._snapshot()
             resp = pb.AllocateResponse()
             granted_chips = 0
+            granted_ids: list[str] = []
             for creq in request.container_requests:
                 ids = list(creq.devicesIDs)
                 try:
@@ -463,14 +540,18 @@ class TpuDevicePlugin:
                     )
                 resp.container_responses.append(self._allocate_one(inventory, chips))
                 granted_chips += len(chips)
+                granted_ids.extend(ids)
                 log.info("allocated %s", ids)
             # Success counters only once the WHOLE response is built: a later
             # container's abort discards the entire AllocateResponse, and the
-            # metrics must not claim chips were handed out.
+            # metrics must not claim chips were handed out.  Same rule for
+            # the reconciliation ledger: an aborted Allocate granted nothing.
             self.metrics.allocations.inc(
                 len(request.container_requests), outcome="ok"
             )
             self.metrics.allocated_chips.inc(granted_chips)
+            if self.ledger is not None:
+                self.ledger.grant(granted_ids)
         dt = time.monotonic() - t0
         if self.flight is not None:
             self.flight.record(

@@ -218,6 +218,25 @@ class AnomalyMonitor:
             return None
         return self._emit(fragment)
 
+    def report(self, metric: str, observed: float = 1.0, **fields) -> dict:
+        """Directly emit one incident for a DISCRETE fault — a condition
+        that is wrong on its first observation (attribution drift, an
+        invariant violation), where an EWMA baseline is meaningless.
+        Same fan-out as a detector-emitted incident (ring + JSON log +
+        flight + ``on_incident`` hook); ``fields`` ride in the record."""
+        fragment = {
+            "kind": "incident",
+            "metric": str(metric),
+            "observed": float(observed),
+            "baseline_mean": 0.0,
+            "baseline_std": 0.0,
+            "z": 0.0,
+            "direct": True,
+        }
+        for key, value in fields.items():
+            fragment.setdefault(key, value)
+        return self._emit(fragment)
+
     def _emit(self, fragment: dict) -> dict:
         incident = {"ts": round(time.time(), 3), **fragment}
         # Attach the black box BEFORE appending the incident event to it,

@@ -294,6 +294,24 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# Prometheus text exposition 0.0.4 — the one place the scrape
+# content-type lives.
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def write_exposition(handler, registry: "MetricsRegistry") -> None:
+    """Answer one GET /metrics on a BaseHTTPRequestHandler: render the
+    registry and write a 200 text-exposition response.  Shared by the
+    plugin's MetricsServer and the serving EngineServer so the two
+    /metrics endpoints cannot drift in content-type or framing."""
+    body = registry.render().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", PROM_CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 class MetricsServer:
     """Serves GET /metrics (exposition text) and GET /healthz on a daemon
     thread.  Port 0 picks a free port (tests); `.port` reports it.
@@ -333,23 +351,17 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] in debug_ref:
+                path = self.path.split("?")[0]
+                if path in debug_ref:
                     try:
-                        snap = debug_ref[self.path.split("?")[0]]()
+                        snap = debug_ref[path]()
                     except Exception as e:  # snapshot bug must not kill scrapes
                         self._json_reply(500, {"error": str(e)})
                         return
                     self._json_reply(200, snap)
-                elif self.path.split("?")[0] == "/metrics":
-                    body = registry_ref.render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                elif self.path.split("?")[0] == "/healthz":
+                elif path == "/metrics":
+                    write_exposition(self, registry_ref)
+                elif path == "/healthz":
                     try:
                         healthy = health_ref is None or bool(health_ref())
                     except Exception:

@@ -43,6 +43,7 @@ else:
 #     python -m pytest tests/ -q -m "not plugin"            # JAX tier
 #
 PLUGIN_TIER_FILES = {
+    "test_attribution.py",
     "test_cli.py",
     "test_discovery.py",
     "test_envs.py",

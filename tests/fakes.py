@@ -19,8 +19,10 @@ import grpc
 from k8s_device_plugin_tpu.kubelet import constants
 from k8s_device_plugin_tpu.kubelet.api import (
     DevicePluginStub,
+    add_pod_resources_servicer,
     add_registration_servicer,
     pb,
+    prpb,
 )
 
 # Sockets in these tests flap constantly; C-core's process-global
@@ -121,6 +123,15 @@ class FakeKubelet:
         self._dial_back = dial_back
         self._server = None
         self._dialers: list = []  # (channel, thread) per dial-back
+        # PodResources introspection state (the v1 PodResourcesLister the
+        # real kubelet serves on pod-resources/kubelet.sock): tests
+        # declare which fake pod owns which device IDs via
+        # set_pod_devices(), then start_pod_resources() serves it.
+        # (ns, pod) -> container -> resource -> [device ids]
+        self.pod_devices: dict = {}
+        self.allocatable: dict = {}  # resource -> [device ids]
+        self._pr_server = None
+        self.pod_resources_socket: str | None = None
 
     # --- Registration service ------------------------------------------------
     def Register(self, request, context):
@@ -188,6 +199,80 @@ class FakeKubelet:
         except (grpc.RpcError, StopIteration):
             pass
 
+    # --- PodResourcesLister service -------------------------------------------
+    def set_pod_devices(
+        self, namespace, pod, container, device_ids, resource="google.com/tpu"
+    ) -> None:
+        """Declare the fake pod's device ownership as the kubelet would
+        report it (replaces the container's prior list for `resource`)."""
+        self.pod_devices.setdefault((namespace, pod), {}).setdefault(
+            container, {}
+        )[resource] = list(device_ids)
+
+    def clear_pod(self, namespace, pod) -> None:
+        """The fake pod went away (kubelet stops reporting it)."""
+        self.pod_devices.pop((namespace, pod), None)
+
+    def set_allocatable(self, device_ids, resource="google.com/tpu") -> None:
+        self.allocatable[resource] = list(device_ids)
+
+    def List(self, request, context):
+        resp = prpb.ListPodResourcesResponse()
+        for (ns, pod), containers in sorted(self.pod_devices.items()):
+            pr = resp.pod_resources.add(name=pod, namespace=ns)
+            for cname, by_resource in sorted(containers.items()):
+                cr = pr.containers.add(name=cname)
+                for resource, ids in sorted(by_resource.items()):
+                    cr.devices.add(resource_name=resource, device_ids=ids)
+        return resp
+
+    def GetAllocatableResources(self, request, context):
+        resp = prpb.AllocatableResourcesResponse()
+        for resource, ids in sorted(self.allocatable.items()):
+            resp.devices.add(resource_name=resource, device_ids=ids)
+        return resp
+
+    def Get(self, request, context):
+        key = (request.pod_namespace, request.pod_name)
+        if key not in self.pod_devices:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"pod {request.pod_namespace}/{request.pod_name} not found",
+            )
+        resp = prpb.GetPodResourcesResponse()
+        resp.pod_resources.name = request.pod_name
+        resp.pod_resources.namespace = request.pod_namespace
+        for cname, by_resource in sorted(self.pod_devices[key].items()):
+            cr = resp.pod_resources.containers.add(name=cname)
+            for resource, ids in sorted(by_resource.items()):
+                cr.devices.add(resource_name=resource, device_ids=ids)
+        return resp
+
+    def start_pod_resources(self, socket_path: str | None = None) -> str:
+        """Serve the PodResourcesLister on its own socket (the real
+        kubelet uses a separate /var/lib/kubelet/pod-resources/ dir);
+        returns the socket path for the attribution poller to dial."""
+        assert self._pr_server is None
+        self.pod_resources_socket = socket_path or os.path.join(
+            self.plugin_dir, "pod-resources.sock"
+        )
+        self._pr_server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_pod_resources_servicer(self, self._pr_server)
+        self._pr_server.add_insecure_port(f"unix://{self.pod_resources_socket}")
+        self._pr_server.start()
+        return self.pod_resources_socket
+
+    def stop_pod_resources(self, remove_socket: bool = True) -> None:
+        if self._pr_server is not None:
+            self._pr_server.stop(grace=None).wait()
+            self._pr_server = None
+        if (
+            remove_socket
+            and self.pod_resources_socket
+            and os.path.exists(self.pod_resources_socket)
+        ):
+            os.unlink(self.pod_resources_socket)
+
     # --- lifecycle ------------------------------------------------------------
     def start(self) -> None:
         assert self._server is None
@@ -210,6 +295,7 @@ class FakeKubelet:
         self._dialers.clear()
         if remove_socket and os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
+        self.stop_pod_resources(remove_socket=remove_socket)
 
     def restart(self) -> None:
         """Simulate a kubelet restart: startup cleanup of the device-plugins

@@ -126,3 +126,45 @@ def test_committed_builder_reference_schema():
     assert "note" in ref
     assert ref["parsed"]["platform"] == "tpu"
     assert ref["parsed"]["value"] > 0
+
+
+def test_bench_diff_ignores_unknown_daemon_metric_blocks(tmp_path):
+    """The daemon-side attribution metrics (PR 5) do not ride in BENCH
+    records; a record that nonetheless carries unknown parsed blocks
+    (e.g. a future "attribution" section) must diff and row identically
+    to one without — no schema break in tools/bench_diff.py."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 6,
+        "rc": 0,
+        "parsed": {"metric": "resnet50_images_per_sec_per_chip",
+                   "value": 1500.0, "unit": "images/sec/chip",
+                   "vs_baseline": 1.0, "platform": "tpu"},
+    }
+    noisy = json.loads(json.dumps(base))
+    noisy["parsed"]["attribution"] = {
+        "attributed_chips": 4, "drift_total": 0, "podresources_up": 1,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(noisy))
+    plain = bench_diff.load_record(str(tmp_path / "a.json"))
+    extra = bench_diff.load_record(str(tmp_path / "b.json"))
+    # The unknown block is ignored wholesale: identical normalized
+    # fields (the raw "parsed" blob is carried but never diffed),
+    # identical diff output, identical ledger-row payload.
+    for rec in (plain, extra):
+        rec.pop("path"), rec.pop("parsed")
+    assert plain == extra
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "attribution" not in diff
+    assert "*" not in diff.replace("->", "")  # no field marked changed
+    assert "attribution" not in bench_diff.ledger_row(a, b)

@@ -30,6 +30,8 @@ def test_parser_defaults():
     assert args.endpoint == DEFAULT_ENDPOINT
     assert args.resource == "google.com/tpu"
     assert args.require_chips is False
+    assert args.pod_resources_socket == ""  # attribution is opt-in
+    assert args.pod_resources_interval == 10.0
 
 
 def test_require_chips_exits_nonzero_on_empty_host(tmp_path):
@@ -194,3 +196,91 @@ def test_resources_flag_rejects_mixed_namespaces(tmp_path):
                 "google.com/tpu,example.com/widget",
             ]
         )
+
+
+def test_daemon_pod_resources_attribution_end_to_end(tmp_path):
+    """Whole-daemon acceptance loop: subprocess with
+    --pod-resources-socket against the FakeKubelet's PodResourcesLister.
+    Ownership series and /debug/pods appear on the metrics port, an
+    injected ungranted device raises the drift counter AND an incident
+    at /debug/incidents, and SIGTERM still shuts down cleanly."""
+    import json
+    import socket
+    import time
+    import urllib.request
+
+    host_root = make_fake_tpu_host(tmp_path / "root", n_chips=4)
+    plugin_dir = str(tmp_path / "dp")
+    os.makedirs(plugin_dir)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    pr_sock = kubelet.start_pod_resources()
+    kubelet.set_allocatable(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        metrics_port = s.getsockname()[1]
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.plugin.cli",
+            "--root", host_root,
+            "--plugin-dir", plugin_dir,
+            "--metrics-port", str(metrics_port),
+            "--pod-resources-socket", pr_sock,
+            "--pod-resources-interval", "0.1",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    base = f"http://127.0.0.1:{metrics_port}"
+    try:
+        assert kubelet.registered.wait(timeout=20), "plugin never registered"
+        # Grant two chips the way the kubelet would, then attribute them
+        # to a fake pod — plus one device the plugin never granted.
+        stub = kubelet.plugin_stub()
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["tpu-0", "tpu-1"])
+        stub.Allocate(req, timeout=10)
+        kubelet.set_pod_devices("prod", "trainer-0", "main", ["tpu-0", "tpu-1"])
+        kubelet.set_pod_devices("rogue", "squatter-0", "main", ["tpu-3"])
+        deadline = time.monotonic() + 15
+        text = ""
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+                    text = r.read().decode()
+                if (
+                    'tpu_chip_owner_info{container="main",device="tpu-0",'
+                    'namespace="prod",pod="trainer-0"} 1'
+                ) in text and (
+                    'tpu_attribution_drift_total{kind="ungranted"} 1'
+                ) in text:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"attribution series never appeared:\n{text}")
+        assert "tpu_podresources_up 1" in text
+        with urllib.request.urlopen(f"{base}/debug/pods", timeout=5) as r:
+            snap = json.loads(r.read())
+        assert snap["up"] is True
+        assert snap["attributed_chips"] == 3
+        assert {p["pod"] for p in snap["pods"]} == {"trainer-0", "squatter-0"}
+        assert snap["ledger"]["outstanding"]["tpu-0"]["confirmed"] is True
+        assert [d["drift"] for d in snap["drift"]["active"]] == ["ungranted"]
+        with urllib.request.urlopen(f"{base}/debug/incidents", timeout=5) as r:
+            incidents = json.loads(r.read())
+        assert any(
+            i["metric"] == "plugin.attribution_drift"
+            for i in incidents["incidents"]
+        )
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5)
+        kubelet.stop()

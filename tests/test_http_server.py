@@ -636,3 +636,22 @@ def test_profile_capture_spans_live_steps(served):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post_path(server.port, "/debug/profile/capture", {"steps": 0})
     assert e.value.code == 400
+
+
+def test_metrics_lint_clean_on_live_engine_server(served):
+    """The serving /metrics (engine + shared-registry series after a
+    full suite of traffic) passes the strict exposition linter
+    (tools/metrics_lint.py) scraped from the LIVE EngineServer."""
+    import importlib.util
+    import os as _os
+
+    _, _, server = served
+    _post(server.port, {"prompt": [5, 4, 3], "max_new_tokens": 3})
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", _os.path.join(repo_root, "tools", "metrics_lint.py")
+    )
+    metrics_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(metrics_lint)
+    errors = metrics_lint.lint_url(f"http://127.0.0.1:{server.port}/metrics")
+    assert errors == [], errors

@@ -62,6 +62,12 @@ def load_record(path: str) -> dict:
         # counters plus the restore-vs-recompute speedup.  A round whose
         # hits collapse or whose recomputed resumes reappear means the
         # tiers stopped carrying the repeated-prefix/preemption load.
+        # Anything else in `parsed` (e.g. daemon-side attribution
+        # series, which live on the plugin's /metrics and have no
+        # business in a BENCH record) is deliberately NOT normalized:
+        # unknown blocks ride in rec["parsed"] untouched and never
+        # reach diff_lines/ledger_row, so new telemetry cannot break
+        # the ledger schema (pinned by tests/test_bench.py).
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")

@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Strict Prometheus text-exposition linter for the repo's /metrics.
+
+The stdlib exposition writer (k8s_device_plugin_tpu/utils/metrics.py)
+keeps growing series — PR 5's labeled ownership gauges are exactly the
+kind of change that can silently ship an unescaped label value, a
+duplicate series, or unbounded cardinality.  This tool re-parses the
+rendered text the way a Prometheus scraper would, strictly:
+
+- every sample line parses as ``name{labels} value`` with correctly
+  quoted/escaped label values (raw backslashes/quotes/newlines fail),
+- every sample belongs to a family that declared ``# HELP`` and
+  ``# TYPE`` BEFORE its first sample (suffix-aware: a histogram family
+  owns ``_bucket``/``_sum``/``_count``; a summary ``_sum``/``_count``),
+- HELP/TYPE appear at most once per family and TYPE is a known type,
+- no duplicate series (same name + label set twice),
+- histogram buckets are cumulative, carry ``le="+Inf"``, and the +Inf
+  bucket equals ``_count``,
+- per-family series cardinality stays under a budget (default 64 —
+  far above the per-chip/per-pod series a 16-chip host can emit, low
+  enough to catch a per-request label before it ships).
+
+Usage (CI or live debugging; exits nonzero on any finding):
+
+    python tools/metrics_lint.py http://127.0.0.1:9100/metrics \\
+                                 http://127.0.0.1:8000/metrics
+
+The tier-1 suite scrapes live MetricsServer and EngineServer instances
+through :func:`lint`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import urllib.request
+from collections import defaultdict
+
+NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+# One label: key="value" where value only contains non-special chars or
+# the three legal escapes (\\, \", \n).
+_LABEL_RE = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\\\|\\"|\\n)*"'
+_VALUE_RE = r"(?:-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|[+-]?Inf|NaN)"
+SAMPLE_RE = re.compile(
+    rf"^({NAME_RE})(\{{{_LABEL_RE}(?:,{_LABEL_RE})*\}}|\{{\}})? ({_VALUE_RE})$"
+)
+LABEL_ITEM_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+HELP_RE = re.compile(rf"^# HELP ({NAME_RE}) (.+)$")
+TYPE_RE = re.compile(rf"^# TYPE ({NAME_RE}) (\S+)$")
+
+KNOWN_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+# Which sample-name suffixes each family type owns beyond the bare name.
+TYPE_SUFFIXES = {
+    "histogram": ("_bucket", "_sum", "_count"),
+    "summary": ("_sum", "_count"),
+}
+
+DEFAULT_CARDINALITY_BUDGET = 64
+
+
+def _family_of(sample_name: str, types: dict[str, str]) -> str | None:
+    """Resolve a sample line's metric family: exact name, or a typed
+    family whose suffix set covers the sample's suffix."""
+    if sample_name in types:
+        return sample_name
+    for type_name, suffixes in TYPE_SUFFIXES.items():
+        for suffix in suffixes:
+            if sample_name.endswith(suffix):
+                base = sample_name[: -len(suffix)]
+                if types.get(base) == type_name:
+                    return base
+    return None
+
+
+def lint(
+    text: str, cardinality_budget: int = DEFAULT_CARDINALITY_BUDGET
+) -> list[str]:
+    """Return every format violation in one exposition body (empty list
+    = clean).  Messages carry the offending line where applicable."""
+    errors: list[str] = []
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    sampled: set[str] = set()  # families that already emitted samples
+    series_seen: set[tuple] = set()
+    family_series: dict[str, set[tuple]] = defaultdict(set)
+    # histogram bookkeeping: family -> non-le labelset -> [(le, value)]
+    buckets: dict[str, dict[tuple, list[tuple[float, float]]]] = defaultdict(
+        lambda: defaultdict(list)
+    )
+    counts: dict[str, dict[tuple, float]] = defaultdict(dict)
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = HELP_RE.match(line)
+            if m:
+                name, help_text = m.groups()
+                if name in helps:
+                    errors.append(f"line {lineno}: duplicate HELP for {name}")
+                if not help_text.strip():
+                    errors.append(f"line {lineno}: empty HELP for {name}")
+                helps[name] = help_text
+                continue
+            m = TYPE_RE.match(line)
+            if m:
+                name, type_name = m.groups()
+                if name in types:
+                    errors.append(f"line {lineno}: duplicate TYPE for {name}")
+                if type_name not in KNOWN_TYPES:
+                    errors.append(
+                        f"line {lineno}: unknown TYPE {type_name!r} for {name}"
+                    )
+                if name in sampled:
+                    errors.append(
+                        f"line {lineno}: TYPE for {name} after its samples"
+                    )
+                types[name] = type_name
+                continue
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                errors.append(f"line {lineno}: malformed comment: {line!r}")
+            continue  # other comments are legal and ignored
+        m = SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, labels_blob, _value = m.groups()
+        labels = (
+            tuple(sorted(LABEL_ITEM_RE.findall(labels_blob)))
+            if labels_blob
+            else ()
+        )
+        family = _family_of(name, types)
+        if family is None:
+            errors.append(
+                f"line {lineno}: series {name!r} has no # TYPE declaration"
+            )
+            family = name
+        if family not in helps:
+            errors.append(
+                f"line {lineno}: series {name!r} has no # HELP declaration"
+            )
+            helps.setdefault(family, "")  # report once per family
+        sampled.add(family)
+        key = (name, labels)
+        if key in series_seen:
+            errors.append(f"line {lineno}: duplicate series: {line!r}")
+        series_seen.add(key)
+        family_series[family].add(key)
+        if types.get(family) == "histogram":
+            non_le = tuple(kv for kv in labels if kv[0] != "le")
+            if name == f"{family}_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(f"line {lineno}: bucket without le: {line!r}")
+                else:
+                    le_value = float("inf") if le == "+Inf" else float(le)
+                    buckets[family][non_le].append((le_value, float(m.group(3))))
+            elif name == f"{family}_count":
+                counts[family][non_le] = float(m.group(3))
+
+    for family, by_labels in buckets.items():
+        for non_le, entries in by_labels.items():
+            entries.sort(key=lambda pair: pair[0])
+            values = [v for _, v in entries]
+            if values != sorted(values):
+                errors.append(
+                    f"{family}: buckets not cumulative for labels {non_le}"
+                )
+            if not entries or entries[-1][0] != float("inf"):
+                errors.append(f"{family}: missing le=\"+Inf\" bucket")
+            elif counts[family].get(non_le) is not None and entries[-1][
+                1
+            ] != counts[family][non_le]:
+                errors.append(
+                    f"{family}: +Inf bucket {entries[-1][1]} != _count "
+                    f"{counts[family][non_le]}"
+                )
+
+    for family, series in family_series.items():
+        if len(series) > cardinality_budget:
+            errors.append(
+                f"{family}: {len(series)} series exceeds the cardinality "
+                f"budget of {cardinality_budget}"
+            )
+    return errors
+
+
+def lint_url(url: str, cardinality_budget: int = DEFAULT_CARDINALITY_BUDGET):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        content_type = resp.headers.get("Content-Type", "")
+        text = resp.read().decode()
+    errors = lint(text, cardinality_budget=cardinality_budget)
+    if "text/plain" not in content_type:
+        errors.insert(0, f"unexpected Content-Type {content_type!r}")
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="metrics-lint",
+        description="strictly lint Prometheus text exposition endpoints",
+    )
+    p.add_argument("urls", nargs="+", help="one or more /metrics URLs")
+    p.add_argument(
+        "--cardinality-budget",
+        type=int,
+        default=DEFAULT_CARDINALITY_BUDGET,
+        help="max series per metric family (default %(default)s)",
+    )
+    args = p.parse_args(argv)
+    failed = False
+    for url in args.urls:
+        try:
+            errors = lint_url(url, cardinality_budget=args.cardinality_budget)
+        except OSError as e:
+            print(f"{url}: scrape failed: {e}", file=sys.stderr)
+            failed = True
+            continue
+        for error in errors:
+            print(f"{url}: {error}", file=sys.stderr)
+            failed = True
+        if not errors:
+            print(f"{url}: ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
