@@ -575,6 +575,71 @@ def run_serving(args) -> None:
             churn_recomputed,
         )
     )
+
+    # --- Tensor-parallel phase (MULTICHIP row) ---------------------------
+    # Same jobs through a tp=N engine built the CLI-facing way
+    # (mesh_from_allocation + the sharded ctor), timed against the tp=1
+    # overlapped number above.  Gated on a multi-device backend whose
+    # head counts the tp degree divides; the row carries decode tokens/s
+    # at tp=1 vs tp=N, the scaling efficiency, discards under tp, and
+    # whether the token streams stayed bit-identical.
+    tp_block = None
+    tp_n = len(jax.devices())
+    if tp_n > 1 and cfg.kv_heads % tp_n == 0 and cfg.num_heads % tp_n == 0:
+        from ..parallel.mesh import mesh_from_allocation
+
+        tp_mesh = mesh_from_allocation(tp_n)
+        tp_eng = ServingEngine(
+            cfg,
+            params,
+            paged,
+            max_slots=args.slots,
+            metrics=EngineMetrics(MetricsRegistry()),
+            mesh=tp_mesh,
+            kv_retain=True,
+            kv_host_cache_mb=64,
+        )
+        # Warmup MUST cover the tp-sharded step/block shapes: sharded
+        # params and pools compile DISTINCT executables, so reusing the
+        # single-chip warmup above would charge the tp compiles to the
+        # first measured round (the r6 warmup bug).  Same two shapes the
+        # tp=1 warmup covers — single prefill and the slots-wide burst.
+        tp_eng.run([(jobs[0][0], 2)])
+        tp_eng.run([(p, 2) for p, _ in jobs[: args.slots]])
+        tp_discards0 = tp_eng.overlap_discards
+        t0 = time.perf_counter()
+        tp_done = tp_eng.run(jobs)
+        tp_dt = time.perf_counter() - t0
+        tp_tokens = sum(len(r.tokens) for r in tp_done)
+        tp_tps = tp_tokens / tp_dt if tp_dt else 0.0
+        tp_match = [r.tokens for r in tp_done] == [r.tokens for r in done]
+        tp_speedup = tp_tps / overlap_tps if overlap_tps else 0.0
+        tp_block = {
+            "size": tp_n,
+            "tokens_per_sec": round(tp_tps, 2),
+            "tp1_tokens_per_sec": round(overlap_tps, 2),
+            "speedup": round(tp_speedup, 3),
+            "scaling_efficiency": round(tp_speedup / tp_n, 3),
+            "discards": tp_eng.overlap_discards - tp_discards0,
+            "tokens_match": tp_match,
+        }
+        log(
+            "perf-ledger row: | MULTICHIP tensor-parallel serving "
+            "(tp=%d, b%d) | tp=1 %.2f → tp=%d %.2f tokens/sec (%.3fx, "
+            "efficiency %.3f; discards %d; tokens %s) | - | `benchmark.py "
+            "--model serving` | update on bench round |"
+            % (
+                tp_n,
+                args.slots,
+                overlap_tps,
+                tp_n,
+                tp_tps,
+                tp_speedup,
+                tp_speedup / tp_n,
+                tp_block["discards"],
+                "bit-identical" if tp_match else "DIVERGED",
+            )
+        )
     print(
         json.dumps(
             {
@@ -615,6 +680,7 @@ def run_serving(args) -> None:
                     "resumes_restored": churn_restored,
                     "resumes_recomputed": churn_recomputed,
                 },
+                "tp": tp_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {
                     "steps": prof["steps"],

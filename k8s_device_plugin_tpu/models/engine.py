@@ -55,6 +55,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .engine_admission import AdmissionMixin
 from .engine_kvcache import KVCacheMixin
@@ -123,6 +124,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         admission: str = "reserve",
         kv_retain: bool = False,
         kv_host_cache_mb: float = 0,
+        mesh: Optional[Mesh] = None,
+        tp_axis: str = "tp",
         racecheck: bool = False,
         spans: Optional[SpanRecorder] = None,
         flight: Optional[FlightRecorder] = None,
@@ -201,9 +204,53 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         self.max_slots = max_slots
         self.eos_id = eos_id
 
+        # Tensor parallelism (ISSUE 6): an explicit sharding contract for
+        # the whole engine state dict (parallel/serving.py) over a 1-axis
+        # ``tp`` mesh — normally built from the chips the plugin
+        # allocated (parallel/mesh.mesh_from_allocation).  Params follow
+        # the Megatron path rules (parallel/tensor.py), KV pools split on
+        # the kv-heads axis, page tables / seq_lens / the step dict
+        # replicate.  Placement happens HERE and on every _dev=None
+        # rebuild (_rep), never implicitly: a rebuild that re-derived
+        # placement per leaf would reshard multi-MB pools mid-serve.
+        self.mesh = mesh
+        self._tp_axis = tp_axis
+        self.tp_size = 1
+        self._rep_sharding: Optional[NamedSharding] = None
+        if mesh is not None:
+            axes = dict(mesh.shape)
+            if tp_axis not in axes:
+                raise ValueError(
+                    f"engine mesh has no {tp_axis!r} axis (axes: {axes})"
+                )
+            self.tp_size = axes[tp_axis]
+            if self.tp_size > 1 and cfg.kv_heads % self.tp_size:
+                raise ValueError(
+                    f"tp={self.tp_size} does not divide "
+                    f"num_kv_heads={cfg.kv_heads}: KV pools shard on the "
+                    "kv-heads axis — pick a tp degree dividing the kv "
+                    "head count (or a config with more kv heads)"
+                )
+            from ..parallel.tensor import tp_param_sharding
+
+            self._rep_sharding = NamedSharding(mesh, PartitionSpec())
+            self.params = jax.device_put(
+                params, tp_param_sharding(params, mesh, tp_axis)
+            )
+            if draft_params is not None:
+                self.draft_params = jax.device_put(
+                    draft_params, tp_param_sharding(draft_params, mesh, tp_axis)
+                )
+
         model = TransformerLM(self.cfg, decode=True)
         spec = decode_cache_spec(model, max_slots)
         self.cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        if mesh is not None:
+            from ..parallel.serving import cache_sharding
+
+            self.cache = jax.device_put(
+                self.cache, cache_sharding(self.cache, mesh, tp_axis)
+            )
         self._layer_names = [f"layer_{i}" for i in range(cfg.num_layers)]
 
         # Single-token decode steps are built lazily per (filtered,
@@ -274,8 +321,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         # host-published cache tables (their round programs read the
         # table as carried cache state).
         self._derive_tables = spec_gamma == 0
-        self._chain = jnp.zeros(
-            (max_slots, paged.max_pages_per_seq), jnp.int32
+        self._chain = self._rep(
+            jnp.zeros((max_slots, paged.max_pages_per_seq), jnp.int32)
         )
         # Page 0 is the idle-slot scratch target — never allocated.
         self.free_pages: deque[int] = deque(range(1, paged.num_pages))
@@ -329,7 +376,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         self._lock = threading.RLock()
         self._next_rid = 0
         self._prefill_cache: dict[int, Any] = {}
-        self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        self._rng = self._rep(jax.random.PRNGKey(0) if rng is None else rng)
         # Device-resident step state: the per-slot arrays the jitted step
         # consumes (tokens/positions/temps/aids/filters/biases/key) live
         # on device between steps, with tokens/positions/key fed forward
@@ -357,6 +404,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         self.overlap_discards = 0
         self._inflight_guard = None
         self.metrics = metrics
+        if metrics:
+            metrics.tp_size.set(self.tp_size)
         # Forensics layer (always on — a production incident cannot ask
         # for instrumentation retroactively, and all three pieces are
         # stdlib-cheap): a bounded flight-recorder black box of typed
@@ -479,6 +528,46 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
 
     # ----------------------------------------------------------------- steps
 
+    def _rep(self, x):
+        """Place one host-built array REPLICATED on the engine mesh
+        (identity off-mesh).  Every fresh device array the host feeds the
+        jitted step — state rebuilds, seq_lens realigns, the PRNG key —
+        goes through here, so a ``_dev=None`` rebuild re-applies the
+        sharding contract instead of re-deriving placement (an unplaced
+        single-device array under a donated sharded step would reshard
+        every dispatch)."""
+        if self._rep_sharding is None:
+            return x
+        return jax.device_put(x, self._rep_sharding)
+
+    def assert_sharded(self) -> int:
+        """Sharding-coverage lint (parallel/serving.py): every leaf of
+        the engine state dict — params, cache, chain, and the
+        device-resident step dict when built — must carry an explicit
+        placement on the engine mesh, and KV pools must actually be
+        partitioned (no silent replication of multi-MB pools).  Raises
+        AssertionError naming the offending path; returns the leaf count
+        checked.  Meaningless without a mesh."""
+        if self.mesh is None:
+            raise ValueError(
+                "engine has no mesh: build it with mesh= to lint sharding"
+            )
+        from ..parallel.serving import assert_explicit_sharding
+
+        tree: dict = {
+            "params": self.params,
+            "cache": self.cache,
+            "chain": self._chain,
+            "rng": self._rng,
+        }
+        if self._dev is not None:
+            tree["dev"] = {
+                k: v for k, v in self._dev.items() if isinstance(v, jax.Array)
+            }
+        return assert_explicit_sharding(
+            tree, self.mesh, tp_axis=self._tp_axis
+        )
+
     def _mark_state_dirty(self) -> None:
         """Invalidate the device-resident step state: the next dispatch
         rebuilds every per-slot array from the host lists.  Called on any
@@ -497,12 +586,19 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
             if self.metrics:
                 self.metrics.state_rebuilds.inc()
             self._rng, sub = jax.random.split(self._rng)
+            # _rep: the rebuild re-applies the sharding contract (mesh
+            # engines replicate these per-slot vectors explicitly; the
+            # no-mesh path is identity).
             dev = self._dev = {
-                "tokens": jnp.asarray(self._slot_last, jnp.int32)[:, None],
-                "positions": jnp.asarray(self._slot_len, jnp.int32)[:, None],
-                "temps": jnp.asarray(self._slot_temp, jnp.float32),
-                "aids": jnp.asarray(self._slot_aid, jnp.int32),
-                "key": sub,
+                "tokens": self._rep(
+                    jnp.asarray(self._slot_last, jnp.int32)[:, None]
+                ),
+                "positions": self._rep(
+                    jnp.asarray(self._slot_len, jnp.int32)[:, None]
+                ),
+                "temps": self._rep(jnp.asarray(self._slot_temp, jnp.float32)),
+                "aids": self._rep(jnp.asarray(self._slot_aid, jnp.int32)),
+                "key": self._rep(sub),
             }
             # Step-variant selector flags ride the state dict: they are a
             # function of the occupied slots' sampler settings, which only
@@ -552,14 +648,20 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         arrays = []
         if filtered:
             if "topks" not in dev:
-                dev["topks"] = jnp.asarray(self._slot_topk, jnp.int32)
-                dev["topps"] = jnp.asarray(self._slot_topp, jnp.float32)
+                dev["topks"] = self._rep(
+                    jnp.asarray(self._slot_topk, jnp.int32)
+                )
+                dev["topps"] = self._rep(
+                    jnp.asarray(self._slot_topp, jnp.float32)
+                )
             arrays += [dev["topks"], dev["topps"]]
         if biased:
             if "bias_ids" not in dev:
-                dev["bias_ids"] = jnp.asarray(self._slot_bias_ids, jnp.int32)
-                dev["bias_vals"] = jnp.asarray(
-                    self._slot_bias_vals, jnp.float32
+                dev["bias_ids"] = self._rep(
+                    jnp.asarray(self._slot_bias_ids, jnp.int32)
+                )
+                dev["bias_vals"] = self._rep(
+                    jnp.asarray(self._slot_bias_vals, jnp.float32)
                 )
             arrays += [dev["bias_ids"], dev["bias_vals"]]
         return arrays
@@ -706,7 +808,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
             att = self.cache[name]["attn"]
             self.cache[name]["attn"] = {
                 **att,
-                "seq_lens": jnp.array(self._slot_len, jnp.int32),
+                "seq_lens": self._rep(jnp.array(self._slot_len, jnp.int32)),
             }
         self.overlap_discards += 1
         if self.metrics:
@@ -847,7 +949,9 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     att = self.cache[name]["attn"]
                     self.cache[name]["attn"] = {
                         **att,
-                        "seq_lens": jnp.array(self._slot_len, jnp.int32),
+                        "seq_lens": self._rep(
+                            jnp.array(self._slot_len, jnp.int32)
+                        ),
                     }
         self._mark("host_gap" if self._inflight is not None else "sample")
         self._step_tokens += emitted_total
@@ -1125,6 +1229,16 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     "hits": self.overlap_hits,
                     "discards": self.overlap_discards,
                 },
+                "tp": {
+                    "size": self.tp_size,
+                    "axis": self._tp_axis if self.mesh is not None else None,
+                    "mesh": dict(self.mesh.shape)
+                    if self.mesh is not None
+                    else None,
+                    "devices": [str(d) for d in self.mesh.devices.flat]
+                    if self.mesh is not None
+                    else None,
+                },
                 "spec": {
                     "gamma": self._spec_gamma,
                     "proposed": self.spec_proposed,
@@ -1289,6 +1403,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         "writes — no recompute, no new compiled shapes (0 disables the "
         "host tier; default 64)",
     )
+    p.add_argument(
+        "--tp",
+        type=_positive_int,
+        default=1,
+        help="tensor-parallel degree: shard params (Megatron path rules) "
+        "and KV pools (kv-heads axis) over a mesh built from the chips "
+        "the plugin allocated — TPU_VISIBLE_CHIPS in physical ICI snake "
+        "order (parallel/mesh.mesh_from_allocation); must equal the "
+        "granted chip count on-cluster, and kv-heads must divide by it; "
+        "off-cluster falls back to the first N jax.devices(); 1 = "
+        "single-chip (default)",
+    )
     args = p.parse_args(argv)
     if args.spec_gamma and args.quant:
         raise SystemExit(
@@ -1331,6 +1457,16 @@ def main(argv: Optional[list[str]] = None) -> None:
         )
     from ..utils.metrics import MetricsRegistry
 
+    mesh = None
+    if args.tp > 1:
+        from ..parallel.mesh import mesh_from_allocation
+
+        mesh = mesh_from_allocation(args.tp)
+        print(
+            f"tensor parallel: tp={args.tp} over "
+            f"{[str(d) for d in mesh.devices.flat]}",
+            file=sys.stderr,
+        )
     registry = MetricsRegistry()
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
@@ -1340,6 +1476,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         admission=args.admission,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
+        mesh=mesh,
         **spec_kw,
     )
     sample_kw = dict(
@@ -1388,6 +1525,7 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "unit": "tokens/sec",
                 "requests": len(done),
                 "slots": args.slots,
+                "tp": args.tp,
                 "quant": args.quant,
                 "kernel": paged.kernel_enabled(cfg.quant_kv),
                 "sampler": "greedy"

@@ -56,6 +56,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -271,14 +272,35 @@ class KVCacheMixin:
     def _kv_write_page_rows(self, pages: list[int], rows_list: list[dict]) -> None:
         """Restore host rows into device pages: ONE page-indexed scatter
         per pool per layer (the _graft discipline — per-page eager
-        ``.at`` updates would round-trip the whole pool once per page)."""
-        idx = jnp.asarray(pages, jnp.int32)
+        ``.at`` updates would round-trip the whole pool once per page).
+        Under tensor parallelism the update rows are device_put with the
+        pool's own kv-heads spec BEFORE the scatter, so a sharded pool
+        round-trips through the host arena without resharding churn (the
+        scatter's operands agree on layout and the result keeps the
+        pool's placement)."""
+        idx = self._rep(jnp.asarray(pages, jnp.int32))
+        if self.mesh is not None:
+            from ..parallel.serving import cache_leaf_spec
         for name in self._layer_names:
             att = self.cache[name]["attn"]
             new_att = dict(att)
             for pool in self._kv_pool_names(att):
-                stacked = np.stack([rows[name][pool] for rows in rows_list])
-                new_att[pool] = att[pool].at[idx].set(jnp.asarray(stacked))
+                stacked = jnp.asarray(
+                    np.stack([rows[name][pool] for rows in rows_list])
+                )
+                if self.mesh is not None:
+                    # The contract's spec for this pool, applied to the
+                    # update rows (same rank: [pages, ...] slices).
+                    stacked = jax.device_put(
+                        stacked,
+                        jax.sharding.NamedSharding(
+                            self.mesh,
+                            cache_leaf_spec(
+                                pool, stacked, self.tp_size, self._tp_axis
+                            ),
+                        ),
+                    )
+                new_att[pool] = att[pool].at[idx].set(stacked)
             self.cache[name]["attn"] = new_att
 
     # ------------------------------------------------------------- tier 2

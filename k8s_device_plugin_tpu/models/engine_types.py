@@ -143,6 +143,12 @@ class EngineMetrics:
             "served at GET /debug/incidents",
             ["metric"],
         )
+        self.tp_size = registry.gauge(
+            "tpu_engine_tp_size",
+            "Tensor-parallel degree of the serving engine (size of the "
+            "tp mesh axis built from the plugin's allocation; 1 = "
+            "single-chip).  Set once at engine construction",
+        )
         self.page_utilization = registry.gauge(
             "tpu_engine_kv_page_utilization",
             "Allocated fraction of the allocatable KV page pool (0..1; "

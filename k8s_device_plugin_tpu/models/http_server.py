@@ -680,6 +680,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         "into the pod memory request (bytes-per-page are printed in "
         "GET /debug/kvcache's host block; 0 disables)",
     )
+    p.add_argument(
+        "--tp",
+        type=_positive_int,
+        default=1,
+        help="tensor-parallel degree: shard params (Megatron path rules) "
+        "and KV pools (kv-heads axis) over a mesh built from the chips "
+        "the plugin allocated — TPU_VISIBLE_CHIPS in physical ICI snake "
+        "order (parallel/mesh.mesh_from_allocation); must equal the "
+        "granted chip count on-cluster, and kv-heads must divide by it; "
+        "mesh shape surfaces in GET /debug/state and the "
+        "tpu_engine_tp_size gauge; 1 = single-chip (default)",
+    )
     p.add_argument("--http-port", type=int, default=8000)
     p.add_argument(
         "--compilation-cache-dir",
@@ -841,6 +853,16 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.max_pages_per_seq,
         use_kernel=args.use_kernel,
     )
+    mesh = None
+    if args.tp > 1:
+        from ..parallel.mesh import mesh_from_allocation
+
+        mesh = mesh_from_allocation(args.tp)
+        print(
+            f"tensor parallel: tp={args.tp} over "
+            f"{[str(d) for d in mesh.devices.flat]}",
+            file=sys.stderr,
+        )
     registry = MetricsRegistry()
     # The black box: registered process-wide so `kill -USR2` (and, with a
     # dump dir configured, process exit) writes it to disk — the
@@ -864,6 +886,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         admission=args.admission,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
+        mesh=mesh,
         **spec_kw,
     )
     server = EngineServer(

@@ -6,6 +6,11 @@ module is the workload-side counterpart: turn `jax.devices()` plus the
 injected env into a `jax.sharding.Mesh` whose axes line up with the physical
 ICI block the plugin granted, so collectives ride ICI links instead of
 arbitrary permutations.
+
+`mesh_from_allocation` is the serving-side entry: a 1-axis ``tp`` mesh over
+EXACTLY the chips the plugin allocated, ordered so consecutive mesh
+neighbors are physical ICI neighbors (the all-reduce a tensor-parallel
+decode step inserts then rides nearest-neighbor links end to end).
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..plugin.topology import chip_index
+
 
 def chips_per_host_bounds(environ: Mapping[str, str] | None = None) -> tuple[int, ...] | None:
     environ = os.environ if environ is None else environ
@@ -28,6 +35,102 @@ def chips_per_host_bounds(environ: Mapping[str, str] | None = None) -> tuple[int
         return tuple(int(v) for v in text.split(","))
     except ValueError:
         return None
+
+
+def allocated_chip_indices(environ: Mapping[str, str] | None = None) -> list[int] | None:
+    """The host-local chip indices the plugin granted this container
+    (TPU_VISIBLE_CHIPS, plugin/envs.py), or None off-cluster / unparsable.
+    Order is the plugin's sorted-index order — the same order libtpu
+    enumerates the container's devices in."""
+    environ = os.environ if environ is None else environ
+    text = environ.get("TPU_VISIBLE_CHIPS")
+    if not text:
+        return None
+    try:
+        return [int(v) for v in text.split(",")]
+    except ValueError:
+        return None
+
+
+def snake_order(bounds: Sequence[int]) -> list[int]:
+    """Local chip indices of the ``bounds`` block in boustrophedon order:
+    x sweeps alternate direction per row, y per plane, so every
+    consecutive pair differs by one step along exactly one axis — i.e.
+    consecutive entries are physical ICI neighbors.  Laying the ``tp``
+    mesh axis along this walk keeps the decode all-reduce's ring on
+    nearest-neighbor links (the reason GetPreferredAllocation hands out
+    contiguous blocks in the first place)."""
+    bx, by, bz = (tuple(bounds) + (1, 1, 1))[:3]
+    order: list[int] = []
+    xdir = ydir = 1
+    for z in range(bz):
+        ys = range(by) if ydir > 0 else range(by - 1, -1, -1)
+        for y in ys:
+            xs = range(bx) if xdir > 0 else range(bx - 1, -1, -1)
+            for x in xs:
+                order.append(chip_index((x, y, z), (bx, by, bz)))
+            xdir = -xdir
+        ydir = -ydir
+    return order
+
+
+def mesh_from_allocation(
+    tp: int,
+    *,
+    environ: Mapping[str, str] | None = None,
+    devices: Sequence | None = None,
+    tp_axis: str = "tp",
+) -> Mesh:
+    """A 1-axis ``tp`` mesh over the chips the plugin actually allocated.
+
+    On-cluster (TPU_VISIBLE_CHIPS injected): the allocation IS the mesh —
+    ``tp`` must equal the granted chip count (a clear error names both
+    otherwise; a pod asking for tensor parallelism across chips it was not
+    granted would otherwise shard over whatever ``jax.devices()`` happens
+    to return), and the axis walks the granted block's ICI bounds in
+    snake order so neighboring shards sit on neighboring chips.
+
+    Off-cluster (no env): falls back to ``make_mesh`` over the first
+    ``tp`` of ``jax.devices()`` — the CPU-dryrun / local-dev path.
+
+    ``devices`` overrides device discovery (tests, dryruns); on-cluster it
+    must follow TPU_VISIBLE_CHIPS order like ``jax.local_devices()`` does.
+    """
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    environ = os.environ if environ is None else environ
+    chips = allocated_chip_indices(environ)
+    if chips is None:
+        devices = list(jax.devices()) if devices is None else list(devices)
+        if tp > len(devices):
+            raise ValueError(
+                f"--tp {tp} needs {tp} devices but only {len(devices)} are "
+                "visible (no TPU_VISIBLE_CHIPS injected: off-cluster "
+                "fallback over jax.devices())"
+            )
+        return make_mesh({tp_axis: tp}, devices=devices[:tp])
+    if len(chips) != tp:
+        raise ValueError(
+            f"--tp {tp} does not match the allocation: the plugin injected "
+            f"{len(chips)} chip(s) (TPU_VISIBLE_CHIPS="
+            f"{environ.get('TPU_VISIBLE_CHIPS')!r}).  Request a pod with "
+            f"exactly {tp} chips or set --tp {len(chips)}."
+        )
+    devices = list(jax.local_devices()) if devices is None else list(devices)
+    if len(devices) < tp:
+        raise ValueError(
+            f"the allocation grants {tp} chip(s) but only {len(devices)} "
+            "JAX device(s) are visible — libtpu did not honor "
+            "TPU_VISIBLE_CHIPS, or the process runs on the wrong backend"
+        )
+    devices = devices[:tp]
+    bounds = chips_per_host_bounds(environ)
+    if bounds is not None and math.prod(bounds) == tp:
+        # Device i is the chip at local block index i (x fastest — the
+        # injected-bounds convention, plugin/topology.py); reorder along
+        # the snake walk so the tp ring rides adjacent ICI links.
+        devices = [devices[i] for i in snake_order(bounds)]
+    return Mesh(np.array(devices), (tp_axis,))
 
 
 def make_mesh(

@@ -1,0 +1,137 @@
+"""Explicit sharding contract for the serving-engine state dict.
+
+Tensor-parallel serving used to work only by accident: sharded params
+propagated THROUGH the jitted paged-decode step, but nothing placed the
+engine's own state — KV pools, page tables, the device-resident step
+dict — so every ``_dev=None`` rebuild re-derived placement and a
+multi-MB pool could silently end up replicated on every chip.  This
+module is the contract: one spec per state-dict leaf, applied at engine
+construction and on every rebuild, plus a coverage lint that refuses
+silent replication.
+
+The layout (mirrors parallel/tensor.py's Megatron split):
+
+- ``params`` — tensor.tp_param_sharding (heads/ffn/vocab over ``tp``);
+- ``pool_key`` / ``pool_value``  [num_pages, page_size, kv_heads, head_dim]
+  — kv-heads axis over ``tp`` (each chip holds its head group's pages:
+  the paged append writes and the attention reads stay chip-local, the
+  only cross-chip traffic is the per-block attention-out all-reduce XLA
+  already inserts for the params);
+- ``pool_key_scale`` / ``pool_value_scale``  [num_pages, page_size,
+  kv_heads] (quant_kv) — kv-heads axis over ``tp``, riding their pools;
+- ``page_table`` / ``seq_lens`` / the chain — replicated (host-truth
+  indices every chip needs whole);
+- the device-resident step dict (tokens/positions/temps/aids/key,
+  filters/biases) — replicated (tiny per-slot vectors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tensor import _path_str
+
+# Leaf name -> which dimension carries kv heads.  Pools are 4-d
+# [pages, page_size, kv_heads, head_dim]; scale pools (quant_kv) 3-d
+# [pages, page_size, kv_heads].
+_POOL_KV_DIM = {
+    "pool_key": 2,
+    "pool_value": 2,
+    "pool_key_scale": 2,
+    "pool_value_scale": 2,
+}
+
+
+def cache_leaf_spec(path_str: str, leaf: Any, tp: int, tp_axis: str = "tp") -> P:
+    """PartitionSpec for one paged-cache leaf by name.
+
+    Pools shard their kv-heads dimension over ``tp``; everything else
+    (page tables, seq_lens) replicates.  A pool whose kv-heads dimension
+    ``tp`` does not divide raises — a silently replicated pool is
+    exactly the failure mode this contract exists to rule out (the
+    engine constructor validates divisibility up front, so this raise
+    is the backstop, not the UX).
+    """
+    name = path_str.rsplit("/", 1)[-1]
+    dim = _POOL_KV_DIM.get(name)
+    if dim is None:
+        return P()
+    if tp <= 1:
+        return P()
+    if leaf.shape[dim] % tp:
+        raise ValueError(
+            f"cannot shard {path_str}: kv-heads dim {leaf.shape[dim]} is "
+            f"not divisible by {tp_axis}={tp}"
+        )
+    spec = [None] * leaf.ndim
+    spec[dim] = tp_axis
+    return P(*spec)
+
+
+def cache_sharding(cache: Any, mesh: Mesh, tp_axis: str = "tp") -> Any:
+    """NamedSharding tree for the engine's paged decode cache (works on
+    concrete arrays or ShapeDtypeStructs — anything with shape/ndim)."""
+    tp = mesh.shape[tp_axis]
+
+    def rule(path, leaf):
+        return NamedSharding(
+            mesh, cache_leaf_spec(_path_str(path), leaf, tp, tp_axis)
+        )
+
+    return jax.tree_util.tree_map_with_path(rule, cache)
+
+
+def _leaf_paths(tree: Any):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield _path_str(path), leaf
+
+
+def assert_explicit_sharding(
+    tree: Any,
+    mesh: Mesh,
+    *,
+    tp_axis: str = "tp",
+    must_shard: Callable[[str], bool] | None = None,
+    label: str = "engine state",
+) -> int:
+    """Coverage lint: every array leaf of ``tree`` must be explicitly
+    placed over ``mesh`` — and leaves ``must_shard`` selects (by path)
+    must actually be PARTITIONED, not replicated, when the tp axis has
+    more than one device.  Raises AssertionError naming the offending
+    path; returns the number of leaves checked.
+
+    The check is functional, not type-based (a jit output's sharding
+    object may not literally be the NamedSharding the input carried):
+    placement = the leaf's device set equals the mesh's; partitioning =
+    the per-device shard shape is strictly smaller than the global shape.
+    """
+    if must_shard is None:
+        must_shard = lambda path: "pool_" in path  # noqa: E731
+    mesh_devices = set(mesh.devices.flat)
+    tp = dict(mesh.shape).get(tp_axis, 1)
+    checked = 0
+    for path, leaf in _leaf_paths(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        checked += 1
+        sharding = leaf.sharding
+        if set(sharding.device_set) != mesh_devices:
+            raise AssertionError(
+                f"{label}: leaf {path!r} is not placed on the engine mesh "
+                f"(devices {sorted(str(d) for d in sharding.device_set)} "
+                f"vs mesh {sorted(str(d) for d in mesh_devices)}) — every "
+                "state-dict leaf must carry an explicit spec"
+            )
+        if tp > 1 and leaf.size and must_shard(path):
+            if sharding.shard_shape(leaf.shape) == tuple(leaf.shape):
+                raise AssertionError(
+                    f"{label}: leaf {path!r} ({leaf.shape}, "
+                    f"{leaf.nbytes} bytes) is silently REPLICATED across "
+                    f"{tp_axis}={tp} — KV pools must shard their kv-heads "
+                    "axis (parallel/serving.cache_sharding)"
+                )
+    return checked

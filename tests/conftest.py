@@ -81,6 +81,10 @@ def pytest_collection_modifyitems(config, items):
 
 _TIER1_TIMEOUT_S = 870.0
 _tier1_t0 = None
+# Budget attribution: wall clock split plugin-tier vs jax/engine-tier so
+# a future over-budget run names which side grew (session-fixture
+# compiles accrue to the first test that triggers them).
+_tier_seconds = {"plugin": 0.0, "jax": 0.0}
 
 
 def _tier1_budget_s() -> float:
@@ -95,6 +99,15 @@ def pytest_sessionstart(session):
     import time
 
     _tier1_t0 = time.monotonic()
+
+
+def pytest_runtest_logreport(report):
+    tier = (
+        "plugin"
+        if os.path.basename(str(report.fspath)) in PLUGIN_TIER_FILES
+        else "jax"
+    )
+    _tier_seconds[tier] += getattr(report, "duration", 0.0) or 0.0
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -122,6 +135,11 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         f"tier-1 wall clock: {elapsed:.0f}s of the {_TIER1_TIMEOUT_S:.0f}s "
         f"driver timeout (soft budget {budget:.0f}s, "
         f"headroom {budget - elapsed:+.0f}s)"
+    )
+    terminalreporter.write_line(
+        f"tier-1 split: plugin tier {_tier_seconds['plugin']:.0f}s, "
+        f"jax/engine tier {_tier_seconds['jax']:.0f}s (session-fixture "
+        "compiles accrue to the first test that triggers them)"
     )
     if budget > 0 and elapsed > budget:
         terminalreporter.write_line(

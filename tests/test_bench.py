@@ -168,3 +168,51 @@ def test_bench_diff_ignores_unknown_daemon_metric_blocks(tmp_path):
     assert "attribution" not in diff
     assert "*" not in diff.replace("->", "")  # no field marked changed
     assert "attribution" not in bench_diff.ledger_row(a, b)
+
+
+def test_bench_diff_parses_tp_block(tmp_path):
+    """Serving records grew a MULTICHIP tensor-parallel block (ISSUE 6):
+    tp size, decode tokens/s under tp, scaling efficiency, discards, and
+    the bit-identity flag must surface in the normalized record, the
+    field diff, and the ledger row — the efficiency collapse (or a
+    tokens_match flip) is the regression tell bench rounds watch."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 5,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    tp = json.loads(json.dumps(base))
+    tp["n"] = 6
+    tp["parsed"]["tp"] = {
+        "size": 2, "tokens_per_sec": 170.0, "tp1_tokens_per_sec": 100.0,
+        "speedup": 1.7, "scaling_efficiency": 0.85, "discards": 3,
+        "tokens_match": True,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(tp))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["tp_size"] == 2
+    assert b["tp_tokens_per_sec"] == 170.0
+    assert b["tp_scaling_efficiency"] == 0.85
+    assert b["tp_discards"] == 3
+    assert b["tp_tokens_match"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "tp_scaling_efficiency" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "tp=2" in row and "eff 0.85" in row
+    assert "DIVERGED" not in row
+    # A diverged round screams in the row.
+    tp["parsed"]["tp"]["tokens_match"] = False
+    (tmp_path / "c.json").write_text(json.dumps(tp))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "DIVERGED" in bench_diff.ledger_row(a, c)

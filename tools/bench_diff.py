@@ -68,6 +68,18 @@ def load_record(path: str) -> dict:
         # unknown blocks ride in rec["parsed"] untouched and never
         # reach diff_lines/ledger_row, so new telemetry cannot break
         # the ledger schema (pinned by tests/test_bench.py).
+        # Tensor-parallel block (MULTICHIP serving rows): decode tokens/s
+        # at tp=N vs tp=1, the scaling efficiency, and discards under tp.
+        # An efficiency collapse (or tokens_match flipping false) between
+        # rounds is the regression tell for the sharded engine path.
+        tp = parsed.get("tp")
+        if isinstance(tp, dict):
+            rec["tp_size"] = tp.get("size")
+            rec["tp_tokens_per_sec"] = tp.get("tokens_per_sec")
+            rec["tp_speedup"] = tp.get("speedup")
+            rec["tp_scaling_efficiency"] = tp.get("scaling_efficiency")
+            rec["tp_discards"] = tp.get("discards")
+            rec["tp_tokens_match"] = tp.get("tokens_match")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -95,6 +107,8 @@ def diff_lines(a: dict, b: dict) -> list[str]:
     for field in (
         "metric", "value", "unit", "vs_baseline", "platform", "rc", "error",
         "tpu_reference_value", "overlap_speedup", "overlap_discards",
+        "tp_size", "tp_tokens_per_sec", "tp_speedup",
+        "tp_scaling_efficiency", "tp_discards", "tp_tokens_match",
         "kvcache_hits", "kvcache_restores", "kvcache_reclaims",
         "kvcache_restore_speedup", "kvcache_resumes_restored",
         "kvcache_resumes_recomputed",
@@ -125,6 +139,15 @@ def ledger_row(a: dict, b: dict) -> str:
             + (
                 f"; overlap discards {b['overlap_discards']}"
                 if b.get("overlap_discards") is not None
+                else ""
+            )
+            + (
+                f"; tp={b['tp_size']} {b.get('tp_tokens_per_sec')} tok/s "
+                f"(eff {b.get('tp_scaling_efficiency')}, discards "
+                f"{b.get('tp_discards')}"
+                + ("" if b.get("tp_tokens_match", True) else ", DIVERGED")
+                + ")"
+                if b.get("tp_size") is not None
                 else ""
             )
             + (
