@@ -73,6 +73,7 @@ from .engine_types import (  # noqa: F401  (re-export: public surface)
     Request,
     _pow2_int,
 )
+from ..utils import failpoints
 from ..utils.anomaly import AnomalyMonitor
 from ..utils.flight import FlightRecorder
 from ..utils.spans import ENGINE_TRACE, SpanRecorder
@@ -835,6 +836,12 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         """Split a record's packed device→host readback (ONE transfer —
         engine_sampling packs tokens with logprobs as float32 rows when
         a slot asked, and ships the token vector alone otherwise)."""
+        # Chaos seam (docs/chaos.md): delay stalls the readback sync —
+        # the injected step-time blowup the engine.step_seconds anomaly
+        # detector must catch; error escapes step() and kills the owner
+        # loop (the engine-death shape: /healthz flips 503).  Disarmed
+        # cost is one dict truthiness check per step.
+        failpoints.fire("engine.readback")
         arr = np.asarray(rec["out"])
         if rec["want_lp"]:
             return arr[0].astype(np.int64), arr[1]

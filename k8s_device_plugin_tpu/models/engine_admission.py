@@ -16,6 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils import failpoints
 from ..utils.spans import new_trace_id
 from .engine_sampling import _token_logprob, filter_top_k_top_p
 from .engine_types import Request
@@ -62,6 +63,21 @@ class AdmissionMixin:
                     max_new_tokens=max_new_tokens,
                 )
             raise
+        try:
+            # Chaos seam (docs/chaos.md): error rejects an otherwise-
+            # valid request at the admission door (surfacing as a 422 on
+            # the HTTP path, like any rejection); delay stalls admission
+            # without touching the compiled path.
+            failpoints.fire("engine.submit", prompt_tokens=len(prompt))
+        except failpoints.FailpointError as e:
+            if self.flight is not None:
+                self.flight.record(
+                    "admission.reject",
+                    reason=str(e),
+                    prompt_tokens=len(prompt),
+                    max_new_tokens=max_new_tokens,
+                )
+            raise ValueError(str(e)) from None
         with self._lock:
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,

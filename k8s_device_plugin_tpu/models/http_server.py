@@ -127,6 +127,13 @@ class EngineServer:
         self._timeout = request_timeout_s
         self._trace_lock = threading.Lock()
         self._enable_trace = enable_trace
+        # Graceful drain (SIGTERM path): admission stops the moment
+        # `_draining` is set; the loop keeps stepping until the engine
+        # runs dry (or the grace window expires), then `drained` fires
+        # and the loop stops — a pod delete finishes in-flight streams
+        # instead of cutting them mid-token.
+        self._draining = threading.Event()
+        self.drained = threading.Event()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -153,6 +160,21 @@ class EngineServer:
                 # on the response header, the JSON body, every SSE
                 # event, and every span the request produces.
                 trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
+                if server._draining.is_set():
+                    # Draining (SIGTERM): no new admissions; in-flight
+                    # requests keep decoding to completion.  503 +
+                    # Retry-After is the signal a router/load-balancer
+                    # needs to fail the replica out.
+                    self.send_response(503)
+                    body = json.dumps(
+                        {"error": "server is draining", "trace_id": trace_id}
+                    ).encode()
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -447,6 +469,11 @@ class EngineServer:
                 path = self.path.split("?")[0]
                 if path == "/healthz":
                     ok = server._loop_alive and not server._stop.is_set()
+                    if ok and server._draining.is_set():
+                        # Draining reads as not-ready: a router/probe must
+                        # stop sending traffic while in-flight work finishes.
+                        self._reply(503, {"status": "draining"})
+                        return
                     self._reply(200 if ok else 503, {"status": "ok" if ok else "down"})
                 elif path == "/debug/state":
                     # Engine + span-ring snapshot: the first endpoint to
@@ -542,6 +569,61 @@ class EngineServer:
         )
         self._http_thread.start()
         return self
+
+    # ----------------------------------------------------------- draining
+
+    def _engine_idle(self) -> bool:
+        eng = self.engine
+        return (
+            not eng.queue
+            and not eng._pending
+            and all(s is None for s in eng.slots)
+        )
+
+    def begin_drain(self, grace_s: float = 10.0) -> None:
+        """Graceful drain (the SIGTERM path): stop admitting (POST
+        /generate answers 503, /healthz flips to draining), keep the
+        step loop running until every in-flight request finishes — at
+        most ``grace_s`` seconds — then stop the loop and set
+        :attr:`drained`.  Requests still alive at the deadline are
+        cancelled (their streams end with the cancel, not a cut
+        mid-token at process kill).  Idempotent."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self.engine.flight.record("server.drain_begin", grace_s=grace_s)
+        threading.Thread(
+            target=self._drain_watch,
+            args=(float(grace_s),),
+            name="engine-drain",
+            daemon=True,
+        ).start()
+
+    def _drain_watch(self, grace_s: float) -> None:
+        t0 = time.monotonic()
+        with self._cond:
+            self._cond.notify_all()  # wake an idle loop to notice work
+            completed = self._cond.wait_for(self._engine_idle, timeout=grace_s)
+        cut = 0
+        if not completed:
+            # Grace expired: cancel the stragglers so their slots/pages
+            # release and their stream waiters see a definite end.
+            with self._cond:
+                leftovers = [r for r in self.engine.slots if r is not None]
+                leftovers += list(self.engine.queue)
+            for req in leftovers:
+                self.engine.cancel(req)
+                cut += 1
+        self.engine.flight.record(
+            "server.drain_end",
+            completed=completed,
+            cut_requests=cut,
+            seconds=round(time.monotonic() - t0, 3),
+        )
+        self._stop.set()
+        self.drained.set()
+        with self._cond:
+            self._cond.notify_all()
 
     def stop(self) -> None:
         self._stop.set()
@@ -733,6 +815,25 @@ def main(argv: Optional[list[str]] = None) -> None:
         "deploy yamls mount an emptyDir here)",
     )
     p.add_argument(
+        "--drain-grace",
+        type=float,
+        default=10.0,
+        help="graceful-drain window in seconds: on SIGTERM the server "
+        "stops admitting (503 + Retry-After, /healthz -> draining) and "
+        "keeps decoding until in-flight requests finish or this window "
+        "expires (stragglers are cancelled) — a pod delete stops cutting "
+        "streams mid-token; size it under the pod's "
+        "terminationGracePeriodSeconds",
+    )
+    p.add_argument(
+        "--failpoints",
+        default="",
+        help="arm chaos failpoints: 'name=mode[:arg][*count];...' with "
+        "modes error/delay/hang/flap (utils/failpoints.py; catalog in "
+        "docs/chaos.md).  Adds to any $TPU_FAILPOINTS arming; every "
+        "trigger lands in the flight recorder",
+    )
+    p.add_argument(
         "--checkpoint-dir",
         default="",
         help="restore params from an orbax checkpoint (models/checkpoint.py) "
@@ -872,6 +973,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         flight_mod.FlightRecorder(capacity=args.flight_ring, name="engine")
     )
     flight_mod.install_dump_handlers(args.dump_dir or None)
+    from ..utils import failpoints
+
+    # Chaos failpoints: env arming first, then the flag adds/overrides;
+    # triggers are flight events in the same box incidents attach.
+    failpoints.set_flight(box)
+    failpoints.arm_from_env()
+    if args.failpoints:
+        failpoints.arm_spec(args.failpoints)
     engine = ServingEngine(
         cfg,
         params,
@@ -894,19 +1003,22 @@ def main(argv: Optional[list[str]] = None) -> None:
         enable_trace=args.debug_trace,
     ).start()
 
-    # A pod delete sends SIGTERM: stop the loop cleanly so shutdown runs
-    # the atexit flight dump (the default disposition would kill the
-    # process with the black box still in memory — exactly the moment it
-    # exists for).
+    # A pod delete sends SIGTERM: drain gracefully — stop admitting,
+    # finish in-flight decodes inside --drain-grace, THEN stop the loop —
+    # so streams end at a token boundary and shutdown still runs the
+    # atexit flight dump (the default disposition would kill the process
+    # with the black box still in memory — exactly the moment it exists
+    # for).
     import signal
 
     def _on_signal(signum, _frame):
         print(
-            f"received {signal.Signals(signum).name}; shutting down",
+            f"received {signal.Signals(signum).name}; draining "
+            f"(grace {args.drain_grace:.1f}s)",
             file=sys.stderr,
             flush=True,
         )
-        server._stop.set()
+        server.begin_drain(args.drain_grace)
 
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):

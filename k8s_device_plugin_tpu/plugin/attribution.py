@@ -48,6 +48,7 @@ from typing import Callable, Iterable, Mapping, Optional
 import grpc
 
 from ..kubelet.api import PodResourcesListerStub, prpb
+from ..utils import failpoints
 from ..utils.anomaly import AnomalyMonitor
 from ..utils.flight import FlightRecorder
 from ..utils.metrics import MetricsRegistry
@@ -242,6 +243,10 @@ class PodAttributionPoller:
         refresh_allocatable = self.polls % self.allocatable_every == 0
         self.polls += 1
         try:
+            # Chaos seam (docs/chaos.md): error fails the poll exactly
+            # like an unreachable socket (down-mark, redial, degraded
+            # attribution); delay stretches the poll histogram.
+            failpoints.fire("attribution.poll", socket=self.socket_path)
             stub = self._dial()
             listed = stub.List(
                 prpb.ListPodResourcesRequest(), timeout=self.rpc_timeout_s
@@ -254,7 +259,7 @@ class PodAttributionPoller:
                 if refresh_allocatable
                 else None
             )
-        except (grpc.RpcError, OSError) as e:
+        except (grpc.RpcError, OSError, failpoints.FailpointError) as e:
             self._mark_down(e)
             self.metrics.attribution_poll_seconds.observe(
                 time.perf_counter() - t0

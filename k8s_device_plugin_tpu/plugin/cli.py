@@ -15,6 +15,7 @@ import signal
 import sys
 
 from ..kubelet import constants
+from ..utils import failpoints
 from ..utils import flight as flight_mod
 from ..utils.anomaly import AnomalyMonitor
 from ..utils.logging import setup_logging
@@ -111,6 +112,24 @@ def build_parser() -> argparse.ArgumentParser:
         "when this is set (default: $TPU_PLUGIN_DUMP_DIR; the DaemonSet "
         "yamls mount /run/tpu/dump here)",
     )
+    p.add_argument(
+        "--health-flap-threshold",
+        type=int,
+        default=2,
+        help="consecutive failed health sweeps before a Healthy chip is "
+        "reported Unhealthy (debounce: one transient probe error must "
+        "not flap the kubelet's device list and evict workloads; "
+        "suppressed flips emit health.flap_suppressed flight events; "
+        "1 restores report-on-first-failure)",
+    )
+    p.add_argument(
+        "--failpoints",
+        default="",
+        help="arm chaos failpoints: 'name=mode[:arg][*count];...' with "
+        "modes error/delay/hang/flap (utils/failpoints.py; catalog in "
+        "docs/chaos.md).  Adds to any $TPU_FAILPOINTS arming; every "
+        "trigger is a flight event, armed state at /debug/failpoints",
+    )
     return p
 
 
@@ -158,6 +177,14 @@ def main(argv: list[str] | None = None) -> int:
         flight_mod.FlightRecorder(capacity=args.flight_ring, name="daemon")
     )
     flight_mod.install_dump_handlers(args.dump_dir or None)
+    # Chaos failpoints (utils/failpoints.py): env arming first, then the
+    # flag adds/overrides; triggers become flight events in the same box
+    # the detectors attach to incidents — injected cause and detected
+    # effect land in one forensic timeline.
+    failpoints.set_flight(box)
+    failpoints.arm_from_env()
+    if args.failpoints:
+        failpoints.arm_spec(args.failpoints)
     monitor = AnomalyMonitor(
         flight=box,
         on_incident=lambda m: default_plugin_metrics().incidents.inc(metric=m),
@@ -185,6 +212,7 @@ def main(argv: list[str] | None = None) -> int:
                 root=args.root,
                 observe_sweep_seconds=observe_sweep,
                 flight=box,
+                flap_threshold=args.health_flap_threshold,
             ),
             metrics=default_plugin_metrics(),
             flight=box,
@@ -196,6 +224,7 @@ def main(argv: list[str] | None = None) -> int:
     debug_endpoints = {
         "/debug/incidents": monitor.snapshot,
         "/debug/flight": box.snapshot,
+        "/debug/failpoints": failpoints.snapshot,
         "/debug/spans": lambda: {
             "spans": spans.snapshot(),
             "dropped": spans.dropped,

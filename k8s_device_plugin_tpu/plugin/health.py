@@ -15,6 +15,7 @@ import os
 import stat
 import time
 
+from ..utils import failpoints
 from . import native
 from .discovery import TpuChip
 
@@ -31,12 +32,22 @@ _BUSY_ERRNOS = {errno.EBUSY, errno.EACCES, errno.EPERM}
 
 
 class ChipHealthChecker:
-    """Probes one chip at a time; stateless between calls.
+    """Probes one chip at a time; the single-probe path is stateless.
 
     The probe itself runs through libtpu_probe.so when available (one C call
     per chip, see plugin/native.py) with this file's pure-Python sequence as
     the fallback and the behavioral reference; override files are always
     handled in Python (cold path).
+
+    ``flap_threshold`` debounces the Healthy→Unhealthy transition on the
+    sweep path (:meth:`check_many`): a currently-Healthy chip must fail
+    ``flap_threshold`` CONSECUTIVE sweeps before it is reported
+    Unhealthy (suppressed probes emit a ``health.flap_suppressed``
+    flight event instead) — one transient open() error on a busy devfs
+    must not flap the kubelet's device list.  Recovery is never
+    debounced: one healthy probe flips a chip back immediately.  The
+    default (1) preserves the old report-on-first-failure behavior;
+    the CLI defaults to 2 (``--health-flap-threshold``).
     """
 
     def __init__(
@@ -45,6 +56,7 @@ class ChipHealthChecker:
         prober: native.NativeProber | None | object = "auto",
         observe_sweep_seconds=None,
         flight=None,
+        flap_threshold: int = 1,
     ):
         self._root = root
         # "auto" → process-wide shared library; None → force Python path.
@@ -59,6 +71,31 @@ class ChipHealthChecker:
         # failures are black-box events — the raw evidence behind a
         # health transition the plugin later streams.
         self._flight = flight
+        if flap_threshold < 1:
+            raise ValueError(
+                f"flap_threshold must be >= 1, got {flap_threshold}"
+            )
+        self._flap_threshold = int(flap_threshold)
+        self._fail_streak: dict[str, int] = {}  # k8s_id -> consecutive fails
+        self._last_reported: dict[str, bool] = {}  # k8s_id -> last sweep verdict
+
+    def _inject(self, chip: TpuChip) -> bool | None:
+        """The ``health.probe`` failpoint (docs/chaos.md): ``flap``
+        forces alternating probe failures (True = fault active →
+        Unhealthy probe), ``delay`` slows the sweep (feeding the sweep-
+        duration anomaly baseline), ``error`` raises out of the sweep
+        (the wedged-sysfs shape — the heartbeat's poll-failure counter
+        catches it).  Returns the forced verdict or None."""
+        hit = failpoints.fire("health.probe", device=chip.k8s_id)
+        if hit is not None and hit.mode == "flap" and hit.value:
+            if self._flight is not None:
+                self._flight.record(
+                    "health.probe_failure",
+                    device=chip.device_path,
+                    error=f"failpoint health.probe (trigger {hit.n})",
+                )
+            return False
+        return None
 
     def _override(self, chip: TpuChip) -> bool | None:
         path = os.path.join(self._root, HEALTH_OVERRIDE_DIR, f"accel{chip.index}")
@@ -70,12 +107,16 @@ class ChipHealthChecker:
         return text not in {"unhealthy", "0", "false"}
 
     def check(self, chip: TpuChip) -> bool:
-        """True iff the chip should be advertised Healthy."""
+        """True iff the chip's PROBE came back healthy (stateless — the
+        sweep-path debounce lives in :meth:`check_many`)."""
         # State transitions are logged once by the caller (poll_once), so the
         # per-probe path stays quiet even at high pulse rates.
         override = self._override(chip)
         if override is not None:
             return override
+        injected = self._inject(chip)
+        if injected is not None:
+            return injected
 
         dev_path = os.path.join(self._root, chip.device_path.lstrip("/"))
         if self._prober is not None:
@@ -122,7 +163,7 @@ class ChipHealthChecker:
         per-pulse hot path of the daemon); otherwise it loops check()."""
         t0 = time.perf_counter()
         try:
-            return self._check_many(chips)
+            return self._debounce(self._check_many(chips))
         finally:
             if self._observe_sweep is not None:
                 self._observe_sweep(time.perf_counter() - t0)
@@ -136,11 +177,57 @@ class ChipHealthChecker:
             override = self._override(chip)
             if override is not None:
                 result[chip.k8s_id] = override
-            else:
-                batched.append(
-                    (chip, os.path.join(self._root, chip.device_path.lstrip("/")))
-                )
+                continue
+            injected = self._inject(chip)
+            if injected is not None:
+                result[chip.k8s_id] = injected
+                continue
+            batched.append(
+                (chip, os.path.join(self._root, chip.device_path.lstrip("/")))
+            )
         codes = self._prober.probe_many([path for _, path in batched])
         for (chip, path), (code, err) in zip(batched, codes):
             result[chip.k8s_id] = self._classify(path, code, err)
         return result
+
+    def _debounce(self, raw: dict[str, bool]) -> dict[str, bool]:
+        """Suppress Healthy→Unhealthy flips until ``flap_threshold``
+        consecutive failed sweeps (recovery passes through untouched).
+        One transient probe error must not cycle a chip through the
+        kubelet's device list — unhealthy devices get their workloads
+        evicted, which is far more expensive than one skipped pulse."""
+        out: dict[str, bool] = {}
+        for k8s_id, healthy in raw.items():
+            if healthy:
+                self._fail_streak.pop(k8s_id, None)
+                self._last_reported[k8s_id] = True
+                out[k8s_id] = True
+                continue
+            streak = self._fail_streak.get(k8s_id, 0) + 1
+            self._fail_streak[k8s_id] = streak
+            # A never-seen chip debounces from Healthy: its first failing
+            # sweep could be the same transient this gate exists for.
+            was = self._last_reported.get(k8s_id, True)
+            if was and streak < self._flap_threshold:
+                out[k8s_id] = True
+                log.info(
+                    "suppressing health flap of %s (%d/%d consecutive "
+                    "failures)",
+                    k8s_id, streak, self._flap_threshold,
+                )
+                if self._flight is not None:
+                    self._flight.record(
+                        "health.flap_suppressed",
+                        device=k8s_id,
+                        streak=streak,
+                        threshold=self._flap_threshold,
+                    )
+            else:
+                out[k8s_id] = False
+                self._last_reported[k8s_id] = False
+        # Unplugged chips leave no stale streak state behind.
+        for k8s_id in set(self._fail_streak) - raw.keys():
+            del self._fail_streak[k8s_id]
+        for k8s_id in set(self._last_reported) - raw.keys():
+            del self._last_reported[k8s_id]
+        return out

@@ -27,7 +27,7 @@ import grpc
 
 from ..kubelet import constants
 from ..kubelet.api import pb
-from ..utils import tracing
+from ..utils import failpoints, tracing
 from ..utils.anomaly import AnomalyMonitor
 from ..utils.flight import FlightRecorder
 from ..utils.metrics import MetricsRegistry
@@ -306,9 +306,18 @@ class TpuDevicePlugin:
             # Per-device health series track the streamed device list
             # exactly: an unplugged chip's series is removed, not frozen
             # at its last value (a flat 1 for a missing chip would read
-            # as healthy on a dashboard).
+            # as healthy on a dashboard).  Inventory membership changes
+            # are also flight events BY DEVICE — /dev/accel* is
+            # authoritative for existence (discovery.py), so a yanked
+            # chip leaves the inventory without ever probing Unhealthy,
+            # and health.transition alone would never name it.
             for k8s_id in self._health.keys() - health.keys():
                 self.metrics.device_health.remove(device=k8s_id)
+                if self.flight is not None:
+                    self.flight.record("device.unplug", device=k8s_id)
+            if self._inventory is not None and self.flight is not None:
+                for k8s_id in health.keys() - self._health.keys():
+                    self.flight.record("device.plug", device=k8s_id)
             for k8s_id, healthy in health.items():
                 self.metrics.device_health.set(
                     1.0 if healthy else 0.0, device=k8s_id
@@ -415,6 +424,16 @@ class TpuDevicePlugin:
     # ------------------------------------------------------------ RPC: stream
 
     def ListAndWatch(self, request, context):
+        try:
+            # Chaos seam (docs/chaos.md): error refuses the stream (the
+            # kubelet's run loop re-dials), delay stalls its opening.
+            failpoints.fire("plugin.listandwatch", op="open")
+        except failpoints.FailpointError as e:
+            if self.flight is not None:
+                self.flight.record(
+                    "listandwatch.stream", op="failpoint", error=str(e)
+                )
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         with self._cond:
             epoch = self._epoch
         version, inventory, health = self._snapshot()
@@ -441,6 +460,21 @@ class TpuDevicePlugin:
                     version = self._version
                     inventory, health = self._inventory, dict(self._health)
                 if not context.is_active():
+                    return
+                try:
+                    # Per-update chaos seam: error kills the live stream
+                    # mid-flight (the kubelet must notice and re-dial);
+                    # delay stalls the device update — detection-latency
+                    # injection for the scenario suite.
+                    failpoints.fire(
+                        "plugin.listandwatch", op="update", version=version
+                    )
+                except failpoints.FailpointError as e:
+                    log.warning("ListAndWatch stream killed by failpoint: %s", e)
+                    if self.flight is not None:
+                        self.flight.record(
+                            "listandwatch.stream", op="failpoint", error=str(e)
+                        )
                     return
                 yield pb.ListAndWatchResponse(devices=self._device_list(inventory, health))
         finally:
@@ -507,6 +541,23 @@ class TpuDevicePlugin:
         t0 = time.monotonic()
         with self.metrics.allocation_latency.time(), \
                 self.metrics.allocate_seconds.time():
+            try:
+                # Chaos seam (docs/chaos.md): error aborts the RPC
+                # UNAVAILABLE (the kubelet fails the pod's admission and
+                # retries); delay/hang stall INSIDE the latency
+                # histograms, so the injected slowness feeds the same
+                # Allocate-latency anomaly baseline real slowness would.
+                failpoints.fire(
+                    "plugin.allocate",
+                    containers=len(request.container_requests),
+                )
+            except failpoints.FailpointError as e:
+                self.metrics.allocations.inc(outcome="failpoint")
+                if self.flight is not None:
+                    self.flight.record(
+                        "allocate", outcome="failpoint", error=str(e)
+                    )
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             _, inventory, health = self._snapshot()
             resp = pb.AllocateResponse()
             granted_chips = 0

@@ -203,6 +203,28 @@ class AnomalyMonitor:
                 det = self._detectors[metric] = AnomalyDetector(metric, **kwargs)
             return det
 
+    def recalibrate(self, metric: str) -> bool:
+        """Discard one detector's learned baseline (thresholds retained);
+        the next ``warmup`` observations re-learn "normal" from scratch.
+
+        The regime-change seam: deviating samples deliberately never
+        fold into the baseline, so a baseline that locked onto the wrong
+        regime — startup-compile outliers, a pre-migration traffic
+        shape — can never adapt on its own.  The chaos harness uses this
+        after its compile warmup so injected-fault precision is measured
+        against a baseline warmed on production-shaped load.  Returns
+        False when the metric has no detector."""
+        with self._lock:
+            det = self._detectors.get(metric)
+            if det is None:
+                return False
+            det.baseline = EwmaBaseline(
+                alpha=det.baseline.alpha, warmup=det.baseline.warmup
+            )
+            det._run = 0
+            det._run_peak = 0.0
+            return True
+
     def observe(self, metric: str, value: float) -> Optional[dict]:
         """Feed one observation; returns the full incident record (with
         flight window) when one fires.  Thread-safe: detector state

@@ -60,12 +60,31 @@ PLUGIN_TIER_FILES = {
 }
 
 
+# Chaos scenario files MUST collect-but-deselect under tier-1 (`-m 'not
+# slow'`): the scenario suite drives multi-node fleets and loaded
+# engines for minutes, and tier-1 runs ~841s of its 870s hard timeout —
+# ONE unmarked scenario leaking into tier-1 would kill the run with no
+# report.  The guard fails COLLECTION (every run, not just tier-1) the
+# moment a chaos test is missing the `slow` marker.
+CHAOS_SCENARIO_FILES = {"test_chaos_scenarios.py"}
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
     for item in items:
-        if os.path.basename(str(item.fspath)) in PLUGIN_TIER_FILES:
+        base = os.path.basename(str(item.fspath))
+        if base in PLUGIN_TIER_FILES:
             item.add_marker(_pytest.mark.plugin)
+        if base in CHAOS_SCENARIO_FILES and not any(
+            m.name == "slow" for m in item.iter_markers()
+        ):
+            raise _pytest.UsageError(
+                f"{item.nodeid}: chaos scenarios must carry the `slow` "
+                "marker (module-level `pytestmark = pytest.mark.slow`) so "
+                "tier-1 deselects them — the 870s budget has no headroom "
+                "for fleet simulations"
+            )
 
 
 # ---------------------------------------------------------------------------

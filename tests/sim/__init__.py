@@ -1,0 +1,13 @@
+"""Cluster-scale chaos simulation (ROADMAP item 5).
+
+``tests/sim/fleet.py`` grows tests/fakes.py's one-node doubles into a
+fleet of N simulated TPU nodes (real gRPC plugin servers against real
+fake kubelets, scripted chip unplug/replug, kubelet restarts, pod churn,
+drift injection); ``tests/sim/traffic.py`` replays production-shaped
+load against a serving engine.  The `--slow` scenario suite
+(tests/test_chaos_scenarios.py) drives both and scores detector
+precision/recall with tools/chaos_report.py.
+
+Import discipline: nothing here imports jax at module level — the chaos
+test module must collect (and deselect) under tier-1 for free.
+"""

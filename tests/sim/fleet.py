@@ -1,0 +1,341 @@
+"""Fleet simulator: N simulated TPU nodes with scripted faults.
+
+Each :class:`SimNode` is a REAL node stack in miniature — a fake host
+filesystem tree (devfs/sysfs/metadata), a :class:`tests.fakes.FakeKubelet`
+serving the Registration (and optionally PodResources) services on its
+own sockets, and the production plugin objects wired exactly as
+plugin/cli.py wires them: discovery, :class:`ChipHealthChecker`,
+:class:`TpuDevicePlugin`, :class:`PluginManager` (watcher + reconciler +
+heartbeat threads), per-node :class:`FlightRecorder` /
+:class:`AnomalyMonitor` / :class:`AllocationLedger`, and optionally a
+:class:`PodAttributionPoller`.  Nothing is stubbed between the plugin
+and the kubelet — faults travel the same sockets and code paths they
+would on a node.
+
+Scripted fault ops (the chaos scenarios' ground-truth injections):
+
+- :meth:`SimNode.unplug_chip` / :meth:`SimNode.replug_chip` — remove /
+  restore the devfs node (health sweep sees it next pulse),
+- :meth:`SimNode.transient_probe_blip` — the override-file seam forces
+  exactly ONE failing sweep (what the flap debounce must suppress),
+- :meth:`SimNode.restart_kubelet` — the FakeKubelet's full startup
+  cleanup (plugin sockets deleted from under live servers),
+- :meth:`SimNode.bind_pod` / :meth:`SimNode.remove_pod` — pod churn
+  through real Allocate RPCs + PodResources truth,
+- :meth:`SimNode.inject_ungranted` — kubelet attributes a chip the
+  plugin never granted (the drift audit's ``ungranted`` class).
+
+Telemetry accessors read the SAME surfaces operators would (flight
+events, incident records, metrics gauges) so the scenario scorer
+measures the real detectors, not test-only shortcuts.
+
+No jax imports — the fleet is pure plugin-tier machinery.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from k8s_device_plugin_tpu.kubelet.api import pb
+from k8s_device_plugin_tpu.plugin import discovery
+from k8s_device_plugin_tpu.plugin.attribution import (
+    AllocationLedger,
+    PodAttributionPoller,
+)
+from k8s_device_plugin_tpu.plugin.health import (
+    HEALTH_OVERRIDE_DIR,
+    ChipHealthChecker,
+)
+from k8s_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_device_plugin_tpu.plugin.server import PluginMetrics, TpuDevicePlugin
+from k8s_device_plugin_tpu.utils.anomaly import AnomalyMonitor
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+from tests.fakes import FakeKubelet, make_fake_tpu_host
+
+
+def wait_until(predicate, timeout: float = 10.0, interval: float = 0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class SimNode:
+    """One simulated TPU node: fake host tree + fake kubelet + the real
+    plugin daemon stack, with scripted fault injection."""
+
+    def __init__(
+        self,
+        base_dir: str,
+        node_id: int,
+        *,
+        n_chips: int = 4,
+        pulse: float = 0.05,
+        flap_threshold: int = 1,
+        attribution: bool = False,
+        attribution_interval: float = 0.1,
+        confirm_grace_s: float = 0.5,
+    ):
+        self.node_id = node_id
+        self.n_chips = n_chips
+        node_dir = os.path.join(str(base_dir), f"node{node_id:03d}")
+        self.root = make_fake_tpu_host(
+            os.path.join(node_dir, "host"), n_chips=n_chips
+        )
+        plugin_dir = os.path.join(node_dir, "device-plugins")
+        os.makedirs(plugin_dir, exist_ok=True)
+        self.kubelet = FakeKubelet(plugin_dir)
+        self.kubelet.start()
+
+        self.flight = FlightRecorder(capacity=4096, name=f"node{node_id:03d}")
+        self.registry = MetricsRegistry()
+        self.metrics = PluginMetrics(self.registry)
+        self.monitor = AnomalyMonitor(
+            flight=self.flight,
+            on_incident=lambda m: self.metrics.incidents.inc(metric=m),
+        )
+        self.ledger = AllocationLedger()
+        self.checker = ChipHealthChecker(
+            root=self.root,
+            prober=None,  # deterministic Python probe path on fixture trees
+            flight=self.flight,
+            flap_threshold=flap_threshold,
+        )
+        self.plugin = TpuDevicePlugin(
+            discover=lambda: discovery.discover(root=self.root, environ={}),
+            health_checker=self.checker,
+            metrics=self.metrics,
+            flight=self.flight,
+            anomaly=self.monitor,
+            ledger=self.ledger,
+        )
+        self.manager = PluginManager(
+            self.plugin,
+            plugin_dir=plugin_dir,
+            pulse=pulse,
+            watch_poll_interval=0.05,
+            register_retry_delay=0.1,
+        )
+        self.poller = None
+        if attribution:
+            sock = self.kubelet.start_pod_resources()
+            self.poller = PodAttributionPoller(
+                sock,
+                metrics=self.metrics,
+                ledger=self.ledger,
+                device_info=self.plugin.device_info,
+                flight=self.flight,
+                anomaly=self.monitor,
+                interval_s=attribution_interval,
+                confirm_grace_s=confirm_grace_s,
+            )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SimNode":
+        self.manager.start()
+        if self.poller is not None:
+            self.poller.start()
+        return self
+
+    def stop(self) -> None:
+        if self.poller is not None:
+            self.poller.stop()
+        self.manager.stop_all()
+        self.kubelet.stop()
+
+    def wait_registered(self, timeout: float = 10.0) -> bool:
+        return self.kubelet.registered.wait(timeout)
+
+    # ------------------------------------------------------- fault scripts
+
+    def _dev_path(self, chip: int) -> str:
+        return os.path.join(self.root, "dev", f"accel{chip}")
+
+    def unplug_chip(self, chip: int) -> None:
+        """Yank the devfs node: the next health sweep sees the chip gone."""
+        os.unlink(self._dev_path(chip))
+
+    def replug_chip(self, chip: int) -> None:
+        with open(self._dev_path(chip), "w") as f:
+            f.write("")
+
+    def force_unhealthy(self, chip: int) -> None:
+        """Operator kill-switch seam: override file forces the probe
+        Unhealthy until cleared."""
+        d = os.path.join(self.root, HEALTH_OVERRIDE_DIR)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"accel{chip}"), "w") as f:
+            f.write("Unhealthy\n")
+
+    def clear_override(self, chip: int) -> None:
+        try:
+            os.unlink(os.path.join(self.root, HEALTH_OVERRIDE_DIR, f"accel{chip}"))
+        except FileNotFoundError:
+            pass
+
+    def transient_probe_blip(self, chip: int, timeout: float = 5.0) -> bool:
+        """Force exactly ONE failing health sweep for ``chip`` — the
+        transient the flap debounce exists to suppress.  Forces the
+        probe Unhealthy, waits for the sweep to observe it (the
+        suppression/transition flight event), then clears.  Returns True
+        when a sweep observed the blip inside ``timeout``."""
+        device = f"tpu-{chip}"
+        seen_before = len(
+            self.flight.window(
+                kinds=["health.flap_suppressed", "health.transition"]
+            )
+        )
+
+        def observed() -> bool:
+            events = self.flight.window(
+                kinds=["health.flap_suppressed", "health.transition"]
+            )
+            return any(
+                e.get("device") == device for e in events[seen_before:]
+            )
+
+        self.force_unhealthy(chip)
+        try:
+            return wait_until(observed, timeout=timeout, interval=0.005)
+        finally:
+            self.clear_override(chip)
+
+    def restart_kubelet(self) -> None:
+        """Full kubelet restart: startup cleanup deletes every plugin
+        socket, then a fresh kubelet.sock comes up (tests/fakes.py
+        FakeKubelet.restart)."""
+        self.kubelet.restart()
+
+    # -------------------------------------------------------- pod lifecycle
+
+    def device_ids(self) -> list[str]:
+        return [c.k8s_id for c in self.plugin.inventory.chips]
+
+    def allocate(self, device_ids: list[str]):
+        """A real Allocate RPC through the plugin's own socket (grants
+        land in the node's AllocationLedger exactly as in production)."""
+        stub = self.kubelet.plugin_stub()
+        return stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=list(device_ids))
+                ]
+            ),
+            timeout=10,
+        )
+
+    def bind_pod(
+        self,
+        namespace: str,
+        pod: str,
+        device_ids: list[str],
+        container: str = "main",
+        allocate: bool = True,
+    ) -> None:
+        """Pod landing on this node: Allocate through the plugin, then
+        the kubelet's PodResources view attributes the chips."""
+        if allocate:
+            self.allocate(device_ids)
+        self.kubelet.set_pod_devices(namespace, pod, container, device_ids)
+
+    def remove_pod(self, namespace: str, pod: str) -> None:
+        self.kubelet.clear_pod(namespace, pod)
+
+    def inject_ungranted(
+        self, device_id: str, namespace: str = "chaos", pod: str = "ghost"
+    ) -> None:
+        """Drift injection: the kubelet attributes a chip the plugin
+        NEVER granted — the audit's ``ungranted`` fault class."""
+        self.kubelet.set_pod_devices(namespace, pod, "main", [device_id])
+
+    # ----------------------------------------------------------- telemetry
+
+    def flight_events(self, *kinds) -> list[dict]:
+        return self.flight.window(kinds=kinds or None)
+
+    def health_transitions(self, to: str | None = None) -> list[dict]:
+        events = self.flight.window(kinds=["health.transition"])
+        if to is not None:
+            events = [e for e in events if e.get("to") == to]
+        return events
+
+    def incidents(self, metric: str | None = None) -> list[dict]:
+        records = self.monitor.incidents()
+        if metric is not None:
+            records = [r for r in records if r.get("metric") == metric]
+        return records
+
+
+class FleetSim:
+    """N :class:`SimNode`\\ s plus whole-fleet lifecycle and collection.
+
+    Context-manager use keeps scenario teardown unconditional::
+
+        with FleetSim(tmp_path, n_nodes=6, pulse=0.1) as fleet:
+            fleet.node(2).unplug_chip(1)
+            ...
+
+    Nodes start CONCURRENTLY (each start blocks on its kubelet
+    registration; serializing N of them would make fleet spin-up the
+    slowest part of every scenario).
+    """
+
+    def __init__(self, base_dir, n_nodes: int, **node_kwargs):
+        self.nodes = [
+            SimNode(str(base_dir), i, **node_kwargs) for i in range(n_nodes)
+        ]
+
+    def node(self, i: int) -> SimNode:
+        return self.nodes[i]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def start(self) -> "FleetSim":
+        errors: list = []
+
+        def _start(n: SimNode):
+            try:
+                n.start()
+            except Exception as e:  # surfaced below, with the node named
+                errors.append((n.node_id, e))
+
+        threads = [
+            threading.Thread(target=_start, args=(n,), name=f"start-{n.node_id}")
+            for n in self.nodes
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            self.stop()
+            raise RuntimeError(f"fleet start failed on nodes: {errors}")
+        for n in self.nodes:
+            if not n.wait_registered(10):
+                self.stop()
+                raise RuntimeError(f"node {n.node_id} never registered")
+        return self
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "FleetSim":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def all_registered(self) -> bool:
+        return all(n.kubelet.registered.is_set() for n in self.nodes)

@@ -1,0 +1,175 @@
+"""Production-shaped traffic replay against the serving engine.
+
+The serving stack's telemetry has only ever watched a handful of
+hand-written requests.  This generator replays the load shape a real
+deployment sees, scaled to seconds instead of days:
+
+- **Diurnal bursts**: the arrival rate rides a sinusoid between
+  ``base_rps`` and ``base_rps * burst_factor`` with period
+  ``burst_period_s`` — a day's peak/trough compressed into seconds, so
+  admission, paging, and preemption all see both regimes.
+- **Long-tail prompt lengths**: lognormal (the empirically observed
+  shape of prompt-length distributions), clamped to the engine's
+  admissible range.
+- **Mid-stream cancels**: a fraction of requests is cancelled partway
+  through generation (clients vanish in production; slots and pages
+  must come back).
+- **Preemption storms**: bursts against a deliberately undersized page
+  pool force optimistic-admission preemption/resume churn (the scenario
+  fixture sizes the pool; the generator just applies pressure).
+
+Deterministic per seed (``random.Random(seed)``), so a scenario's
+injected-fault windows land against reproducible background load.
+
+SLO measurement deliberately reads the telemetry the stack already
+emits (TTFT/ITL histograms on the engine's MetricsRegistry, incident
+records at /debug/incidents) rather than instrumenting the client side —
+measuring the detectors is the whole point (ISSUE 7 / ROADMAP item 5).
+
+jax is only imported transitively via the engine the caller passes in;
+this module itself is import-light so chaos collection stays free.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+
+
+class TrafficReport:
+    """What one replay did: counts for the scenario ledger."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.rejected = 0
+        self.tokens = 0
+        self.duration_s = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "cancelled": self.cancelled,
+            "rejected": self.rejected,
+            "tokens": self.tokens,
+            "duration_s": round(self.duration_s, 3),
+        }
+
+
+class TrafficGenerator:
+    """Replays production-shaped load against an EngineServer's engine.
+
+    ``server`` is a models/http_server.EngineServer (started): requests
+    are submitted in-process (``engine.submit``) and the server's owner
+    loop is notified, exactly what the HTTP handler does minus socket
+    overhead — hundreds of requests without hundreds of client threads.
+    """
+
+    def __init__(self, server, *, seed: int = 0):
+        self.server = server
+        self.engine = server.engine
+        self.rng = random.Random(seed)
+
+    # ------------------------------------------------------------- helpers
+
+    def _notify(self) -> None:
+        with self.server._cond:
+            self.server._cond.notify_all()
+
+    def _prompt(self, lo: int, hi: int, mu: float, sigma: float) -> list[int]:
+        n = max(lo, min(hi, int(round(self.rng.lognormvariate(mu, sigma)))))
+        vocab = self.engine.cfg.vocab_size
+        return [self.rng.randrange(2, vocab) for _ in range(n)]
+
+    # --------------------------------------------------------------- replay
+
+    def run(
+        self,
+        duration_s: float = 10.0,
+        *,
+        base_rps: float = 6.0,
+        burst_factor: float = 4.0,
+        burst_period_s: float = 3.0,
+        cancel_fraction: float = 0.1,
+        cancel_after_s: float = 0.15,
+        prompt_len: tuple[int, int] = (1, 16),
+        lognorm_mu: float = 1.6,
+        lognorm_sigma: float = 0.7,
+        max_new: tuple[int, int] = (4, 10),
+        drain_timeout_s: float = 60.0,
+    ) -> TrafficReport:
+        """Replay for ``duration_s`` wall seconds, then wait for every
+        surviving request to finish.  Returns the replay's counts; SLOs
+        are read off the engine's own metrics by the caller."""
+        report = TrafficReport()
+        live: list = []
+        cancels: list[tuple[float, object]] = []  # (deadline, req)
+        t0 = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now - t0 >= duration_s:
+                break
+            # Diurnal-in-miniature arrival rate: sinusoidal burst on a
+            # base load (never below base_rps).
+            phase = (now - t0) / burst_period_s * 2.0 * math.pi
+            rate = base_rps * (
+                1.0 + (burst_factor - 1.0) * max(0.0, math.sin(phase))
+            )
+            gap = self.rng.expovariate(rate)
+            time.sleep(min(gap, max(0.0, t0 + duration_s - now)))
+            prompt = self._prompt(*prompt_len, lognorm_mu, lognorm_sigma)
+            new_tokens = self.rng.randint(*max_new)
+            try:
+                req = self.engine.submit(prompt, new_tokens)
+            except ValueError:
+                # Admission rejection (capacity, or an armed
+                # engine.submit failpoint) — production clients see the
+                # same 422; count and continue.
+                report.rejected += 1
+                continue
+            report.submitted += 1
+            live.append(req)
+            self._notify()
+            if self.rng.random() < cancel_fraction:
+                cancels.append((time.monotonic() + cancel_after_s, req))
+            # Fire any due mid-stream cancels.
+            due = [c for c in cancels if c[0] <= time.monotonic()]
+            for item in due:
+                cancels.remove(item)
+                if not item[1].done:
+                    self.engine.cancel(item[1])
+                    report.cancelled += 1
+                    self._notify()
+        for _, req in cancels:  # leftovers still cancel mid-stream
+            if not req.done:
+                self.engine.cancel(req)
+                report.cancelled += 1
+        self._notify()
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            if all(r.done for r in live):
+                break
+            self._notify()
+            time.sleep(0.02)
+        report.completed = sum(1 for r in live if r.done)
+        report.tokens = sum(len(r.tokens) for r in live)
+        report.duration_s = time.monotonic() - t0
+        return report
+
+    def run_in_thread(self, duration_s: float, **kwargs):
+        """Run the replay on a background thread (scenarios inject
+        faults against it from the test thread); returns (thread,
+        result_holder) where result_holder[0] is the TrafficReport once
+        the thread joins."""
+        holder: list = [None]
+
+        def _run():
+            holder[0] = self.run(duration_s, **kwargs)
+
+        t = threading.Thread(target=_run, name="chaos-traffic", daemon=True)
+        t.start()
+        return t, holder

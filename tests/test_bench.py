@@ -170,6 +170,110 @@ def test_bench_diff_ignores_unknown_daemon_metric_blocks(tmp_path):
     assert "attribution" not in bench_diff.ledger_row(a, b)
 
 
+def test_bench_diff_parses_chaos_block(tmp_path):
+    """Records grew a CHAOS block (ISSUE 7, tools/chaos_report.py
+    chaos_summary): scenario counts plus the WORST per-class detector
+    precision/recall and the SLO verdict must surface in the normalized
+    record, the field diff, and the ledger row — a precision sag or an
+    SLO flip between rounds is the detector-regression tell."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 6,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    chaotic = json.loads(json.dumps(base))
+    chaotic["n"] = 7
+    chaotic["parsed"]["chaos"] = {
+        "scenarios": 4, "passed": 4, "faults_injected": 12,
+        "precision": 0.92, "recall": 1.0, "slo_pass": True,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(chaotic))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["chaos_scenarios"] == 4
+    assert b["chaos_passed"] == 4
+    assert b["chaos_faults"] == 12
+    assert b["chaos_precision"] == 0.92
+    assert b["chaos_recall"] == 1.0
+    assert b["chaos_slo_pass"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "chaos_precision" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "chaos 4/4" in row and "p 0.92" in row
+    assert "SLO-FAIL" not in row
+    # An SLO-failing round screams in the row.
+    chaotic["parsed"]["chaos"]["slo_pass"] = False
+    (tmp_path / "c.json").write_text(json.dumps(chaotic))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "SLO-FAIL" in bench_diff.ledger_row(a, c)
+
+
+def test_chaos_report_scoring_and_summary(tmp_path):
+    """tools/chaos_report.py: the precision/recall join semantics the
+    scenario matrix depends on — window+key matching, multi-report
+    faults not double-counted as FPs, worst-class summary — pinned
+    hermetically (no fleet needed)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_report", os.path.join(REPO_ROOT, "tools", "chaos_report.py")
+    )
+    chaos_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_report)
+
+    injected = [
+        {"cls": "chip_unplug", "node": 0, "device": "tpu-1",
+         "t0": 100.0, "t1": 101.0},
+        {"cls": "chip_unplug", "node": 2, "device": "tpu-3",
+         "t0": 100.0, "t1": 101.0},
+    ]
+    detected = [
+        # Matches fault 1 (in window, keys agree)...
+        {"cls": "chip_unplug", "node": 0, "device": "tpu-1", "ts": 100.4},
+        # ...a cooldown re-fire of the SAME fault: matched window, not FP.
+        {"cls": "chip_unplug", "node": 0, "device": "tpu-1", "ts": 100.9},
+        # A detection nothing injected: false positive.
+        {"cls": "chip_unplug", "node": 5, "device": "tpu-0", "ts": 100.5},
+    ]
+    score = chaos_report.score_detections(injected, detected, grace_s=1.0)
+    c = score["per_class"]["chip_unplug"]
+    assert (c["tp"], c["fp"], c["fn"]) == (1, 1, 1)
+    assert c["precision"] == pytest.approx(2 / 3)
+    assert c["recall"] == pytest.approx(0.5)
+    assert c["latency_p50_s"] == pytest.approx(0.4)
+    results = [
+        {"scenario": "s1", "score": score, "slo": {"pass": True},
+         "pass": False},
+        {"scenario": "s2",
+         "score": chaos_report.score_detections(
+             [{"cls": "drift", "t0": 0.0, "t1": 1.0}],
+             [{"cls": "drift", "ts": 0.5}],
+         ),
+         "slo": {"pass": False}, "pass": True},
+    ]
+    summary = chaos_report.chaos_summary(results)
+    assert summary["scenarios"] == 2
+    assert summary["passed"] == 1
+    assert summary["precision"] == pytest.approx(2 / 3, abs=1e-3)  # worst class
+    assert summary["recall"] == 0.5  # worst class
+    assert summary["slo_pass"] is False
+    matrix = chaos_report.render_matrix(results)
+    assert "| s1 | chip_unplug |" in matrix
+    assert "| s2 | drift |" in matrix
+    row = chaos_report.ledger_row(results)
+    assert "1/2 scenarios" in row and "SLO FAIL" in row
+
+
 def test_bench_diff_parses_tp_block(tmp_path):
     """Serving records grew a MULTICHIP tensor-parallel block (ISSUE 6):
     tp size, decode tokens/s under tp, scaling efficiency, discards, and

@@ -1,0 +1,572 @@
+"""Cluster-scale chaos scenarios: measured detector precision/recall.
+
+Each scenario declares its injected ground-truth faults (chip unplugs,
+kubelet restarts, engine stalls, attribution drift) as timestamped
+windows, runs them against the fleet simulator (tests/sim/fleet.py)
+and/or a loaded serving engine (tests/sim/traffic.py), then joins what
+the stack's OWN detectors reported — health-transition flight events,
+kubelet-restart events, /debug/incidents records — with
+tools/chaos_report.score_detections.  The numbers in the report are
+MEASURED, never assumed; assertions use deliberately lenient floors
+(scheduling noise on a loaded CI box must not flake the suite) while the
+JSON result carries the exact figures for the scenario-matrix report:
+
+    TPU_CHAOS_RESULTS_DIR=/tmp/chaos python -m pytest \\
+        tests/test_chaos_scenarios.py -m slow -q
+    python tools/chaos_report.py /tmp/chaos        # or: --run (both)
+
+Every test is `slow`: tier-1 collects this module (imports stay
+jax-free at module scope) and deselects every item; a conftest guard
+fails collection if the marker ever goes missing (the 870s tier-1
+budget has no headroom for fleet simulation).
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from tests.sim.fleet import FleetSim, wait_until
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_report():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_report", os.path.join(REPO_ROOT, "tools", "chaos_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _publish(result: dict) -> None:
+    """Write one scenario's JSON result for tools/chaos_report.py (no-op
+    without $TPU_CHAOS_RESULTS_DIR — assertions below still enforce the
+    floors either way)."""
+    result.setdefault("schema", "tpu-chaos-scenario/v1")
+    result.setdefault("ts", round(time.time(), 3))
+    directory = os.environ.get("TPU_CHAOS_RESULTS_DIR")
+    if not directory:
+        return
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{result['scenario']}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+
+
+# ======================================================================
+# Scenario 1: chip unplug/replug across the fleet
+# ======================================================================
+
+
+def test_chaos_chip_unplug_replug(tmp_path):
+    """Unplug chips on 3 of 6 nodes (ground truth), blip two OTHER
+    chips for exactly one sweep (non-faults the flap debounce must
+    suppress), replug, and score the per-device detectors: a yanked
+    /dev/accel* leaves the inventory (device.unplug flight event — the
+    dev node is authoritative for existence), a failing-but-present chip
+    transitions Unhealthy (health.transition); BOTH count as unplug-
+    class detections.  Every unplug/replug must be caught (recall);
+    transients must not pollute the device list (precision)."""
+    chaos_report = _chaos_report()
+    pulse = 0.15
+    injected: list[dict] = []
+    with FleetSim(
+        tmp_path, n_nodes=6, n_chips=4, pulse=pulse, flap_threshold=2
+    ) as fleet:
+        time.sleep(3 * pulse)  # baseline sweeps on every node
+        faults = [(0, 1), (2, 3), (4, 0)]
+        for node_id, chip in faults:
+            t0 = time.time()
+            fleet.node(node_id).unplug_chip(chip)
+            injected.append({
+                "cls": "chip_unplug", "node": node_id,
+                "device": f"tpu-{chip}", "t0": t0, "t1": t0 + 8 * pulse,
+            })
+        # Transient single-sweep blips on healthy nodes: the debounce
+        # (flap_threshold=2) must SUPPRESS these — any transition they
+        # cause scores as a false positive below.
+        blips_observed = 0
+        for node_id, chip in [(1, 2), (3, 1)]:
+            if fleet.node(node_id).transient_probe_blip(chip, timeout=3.0):
+                blips_observed += 1
+        time.sleep(5 * pulse)  # debounced transitions (2 sweeps) land
+        for node_id, chip in faults:
+            t0 = time.time()
+            fleet.node(node_id).replug_chip(chip)
+            injected.append({
+                "cls": "chip_replug", "node": node_id,
+                "device": f"tpu-{chip}", "t0": t0, "t1": t0 + 6 * pulse,
+            })
+        time.sleep(5 * pulse)
+        detected: list[dict] = []
+        suppressed = 0
+        for node in fleet.nodes:
+            suppressed += len(
+                node.flight_events("health.flap_suppressed")
+            )
+            for e in node.flight_events("device.unplug"):
+                detected.append({
+                    "cls": "chip_unplug", "node": node.node_id,
+                    "device": e["device"], "ts": e["ts"],
+                })
+            for e in node.health_transitions(to="Unhealthy"):
+                detected.append({
+                    "cls": "chip_unplug", "node": node.node_id,
+                    "device": e["device"], "ts": e["ts"],
+                })
+            for e in node.flight_events("device.plug"):
+                detected.append({
+                    "cls": "chip_replug", "node": node.node_id,
+                    "device": e["device"], "ts": e["ts"],
+                })
+            for e in node.health_transitions(to="Healthy"):
+                detected.append({
+                    "cls": "chip_replug", "node": node.node_id,
+                    "device": e["device"], "ts": e["ts"],
+                })
+    score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+    unplug, replug = (
+        score["per_class"]["chip_unplug"], score["per_class"]["chip_replug"]
+    )
+    slo_target = 2 * pulse + 1.0  # debounce (2 sweeps) + scheduling slack
+    slo = {
+        "targets": {"unplug_detect_s": slo_target},
+        "measured": {
+            "unplug_detect_max_s": unplug["latency_max_s"],
+            "replug_detect_max_s": replug["latency_max_s"],
+            "transients_injected": 2,
+            "transients_observed": blips_observed,
+            "flaps_suppressed": suppressed,
+        },
+        "pass": (
+            unplug["latency_max_s"] is not None
+            and unplug["latency_max_s"] <= slo_target
+        ),
+    }
+    result = {
+        "scenario": "chip_unplug_replug", "nodes": 6,
+        "injected": injected, "detected": detected,
+        "score": score, "slo": slo,
+        "pass": unplug["recall"] == 1.0 and replug["recall"] == 1.0,
+    }
+    _publish(result)
+    # Floors (the report carries the exact measured figures):
+    assert unplug["recall"] == 1.0, score  # every unplug caught
+    assert replug["recall"] == 1.0, score  # every recovery caught
+    assert unplug["precision"] >= 0.7, score  # transients stayed quiet
+    assert suppressed >= 1, "flap debounce never engaged"
+
+
+# ======================================================================
+# Scenario 2: kubelet restart storm
+# ======================================================================
+
+
+def test_chaos_kubelet_restart_storm(tmp_path):
+    """Two waves of kubelet restarts across half the fleet, plus one
+    rapid double-flap (whose pair of restarts is ONE fault window —
+    level-triggered reconciliation may legitimately coalesce it).  The
+    kubelet.restart flight event is the detector; re-registration time
+    is the recovery SLO."""
+    chaos_report = _chaos_report()
+    injected: list[dict] = []
+    recovery_s: list[float] = []
+    with FleetSim(tmp_path, n_nodes=6, n_chips=2, pulse=0.0) as fleet:
+        for _wave in range(2):
+            for node_id in (1, 3, 5):
+                node = fleet.node(node_id)
+                before = node.manager.registrations
+                t0 = time.time()
+                node.restart_kubelet()
+                injected.append({
+                    "cls": "kubelet_restart", "node": node_id,
+                    "t0": t0, "t1": t0 + 5.0,
+                })
+                assert wait_until(
+                    lambda: node.manager.registrations > before, timeout=10
+                ), f"node {node_id} never re-registered"
+                recovery_s.append(time.time() - t0)
+        # Rapid double-flap: restarts faster than the reconciler can
+        # chase — the level-triggered design owes us ONE recovery
+        # against the final state, counted as one fault.
+        node = fleet.node(0)
+        before = node.manager.registrations
+        t0 = time.time()
+        node.restart_kubelet()
+        node.restart_kubelet()
+        injected.append({
+            "cls": "kubelet_flap", "node": 0, "t0": t0, "t1": t0 + 5.0,
+        })
+        assert wait_until(
+            lambda: node.manager.registrations > before, timeout=10
+        ), "flapped node never recovered"
+        recovery_s.append(time.time() - t0)
+        time.sleep(0.3)
+        detected: list[dict] = []
+        for n in fleet.nodes:
+            cls = "kubelet_flap" if n.node_id == 0 else "kubelet_restart"
+            for e in n.flight_events("kubelet.restart"):
+                detected.append({"cls": cls, "node": n.node_id, "ts": e["ts"]})
+        # Post-storm invariant: the whole fleet is registered + serving.
+        assert wait_until(fleet.all_registered, timeout=10)
+        assert all(n.manager.alive() for n in fleet.nodes)
+    score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+    restart = score["per_class"]["kubelet_restart"]
+    flap = score["per_class"]["kubelet_flap"]
+    slo = {
+        "targets": {"reregister_max_s": 5.0},
+        "measured": {
+            "reregister_max_s": round(max(recovery_s), 3),
+            "restarts_injected": len(injected),
+        },
+        "pass": max(recovery_s) <= 5.0,
+    }
+    result = {
+        "scenario": "kubelet_restart_storm", "nodes": 6,
+        "injected": injected, "detected": detected,
+        "score": score, "slo": slo,
+        "pass": restart["recall"] == 1.0 and flap["recall"] == 1.0,
+    }
+    _publish(result)
+    assert restart["recall"] == 1.0, score  # every spaced restart seen
+    assert flap["recall"] == 1.0, score  # the flap seen at least once
+    assert restart["precision"] >= 0.7, score
+    assert slo["pass"], slo
+
+
+# ======================================================================
+# Scenario 3: preemption storm under burst traffic + injected stalls
+# ======================================================================
+
+
+@pytest.fixture(scope="module")
+def chaos_server():
+    """One compiled engine + EngineServer for the traffic scenario:
+    optimistic admission over a deliberately undersized page pool (so
+    bursts preempt), short-cooldown anomaly detectors (scenario windows
+    are seconds apart, not the production 30s), and a warmup that
+    compiles every (batch, bucket) prefill shape traffic or
+    preemption-resume can hit — a mid-measurement XLA compile would
+    read as a fake incident."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_device_plugin_tpu.models.engine import (
+        EngineMetrics,
+        ServingEngine,
+    )
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+    from k8s_device_plugin_tpu.models.transformer import (
+        GPTConfig,
+        PagedConfig,
+        TransformerLM,
+    )
+    from k8s_device_plugin_tpu.utils import failpoints
+    from k8s_device_plugin_tpu.utils.anomaly import AnomalyMonitor
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+    from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    # 11 allocatable pages vs 4 slots of up-to-7-page requests:
+    # optimistic admission overcommits and bursts preempt.
+    paged = PagedConfig(page_size=4, num_pages=12, max_pages_per_seq=16)
+    registry = MetricsRegistry()
+    box = FlightRecorder(capacity=8192, name="chaos-engine")
+    monitor = AnomalyMonitor(flight=box)
+    monitor.configure(
+        "engine.step_seconds",
+        warmup=40, z_threshold=6.0, sustain=3, cooldown_s=1.5,
+    )
+    monitor.configure(
+        "engine.ttft_seconds",
+        warmup=20, z_threshold=6.0, sustain=2, cooldown_s=1.5,
+    )
+    engine = ServingEngine(
+        cfg, params, paged,
+        max_slots=4,
+        metrics=EngineMetrics(registry),
+        flight=box,
+        anomaly=monitor,
+        admission="optimistic",
+    )
+    failpoints.set_flight(box)  # injected cause lands in the same box
+    server = EngineServer(
+        engine, host="127.0.0.1", port=0, registry=registry,
+    ).start()
+
+    # Warmup: every (batch in {1,2,4}) x (bucket in {2,4,8,16,32})
+    # prefill program — bucket 32 is the preemption-resume re-prefill
+    # shape (prompt + generated tokens) — plus enough decode steps to
+    # warm the step-time baseline past its 40-sample gate.
+    def _drain(reqs):
+        deadline = time.monotonic() + 120
+        while not all(r.done for r in reqs):
+            with server._cond:
+                server._cond.notify_all()
+            time.sleep(0.01)
+            assert time.monotonic() < deadline, "warmup drain wedged"
+
+    for bucket, plen in ((2, 2), (4, 4), (8, 8), (16, 16), (32, 20)):
+        for group in (1, 2, 3):
+            reqs = [
+                engine.submit([7 + i] * plen, 6) for i in range(group)
+            ]
+            _drain(reqs)
+    # Baseline calibration: the compile steps above folded multi-second
+    # outliers into the EWMA baselines while their warmup gates were
+    # open, and deviating samples never fold afterwards — the baseline
+    # would stay deaf (huge var) or, once settled on pure decode, scream
+    # at every ordinary burst prefill.  Recalibrate (baseline reset,
+    # thresholds kept), then warm on a replay of the SAME traffic shape
+    # the measurement uses, so "normal" means production-shaped load.
+    from tests.sim.traffic import TrafficGenerator
+
+    monitor.recalibrate("engine.step_seconds")
+    monitor.recalibrate("engine.ttft_seconds")
+    TrafficGenerator(server, seed=3).run(
+        8.0,
+        base_rps=8.0,
+        burst_factor=5.0,
+        burst_period_s=3.0,
+        cancel_fraction=0.12,
+        prompt_len=(2, 16),
+        max_new=(4, 10),
+    )
+    yield server, engine, registry, box
+    failpoints.disarm_all()
+    failpoints.set_flight(None)
+    server.stop()
+
+
+def test_chaos_preemption_storm_under_burst(chaos_server, tmp_path):
+    """Diurnal-burst lognormal traffic with mid-stream cancels over an
+    undersized pool (preemption storm as BACKGROUND load), with two
+    injected engine-stall windows (engine.readback delay failpoint) as
+    ground truth.  The step-time/TTFT anomaly detectors at
+    /debug/incidents are scored against the stall windows; TTFT/ITL
+    SLOs come from the engine's own histograms; the flight dump proves
+    the injected cause sits in the same forensic timeline as the
+    detected effect."""
+    import urllib.request
+
+    from k8s_device_plugin_tpu.utils import failpoints
+    from k8s_device_plugin_tpu.utils import flight as flight_mod
+
+    from tests.sim.traffic import TrafficGenerator
+
+    chaos_report = _chaos_report()
+    server, engine, registry, box = chaos_server
+    preempts0 = engine.preemptions
+    # Warmup may have produced incidents; score only the replay's.
+    replay_start = time.time()
+    ttft_since = engine.metrics.ttft_seconds.snapshot()
+    itl_since = engine.metrics.itl_seconds.snapshot()
+
+    gen = TrafficGenerator(server, seed=7)
+    t_start = time.monotonic()
+    thread, holder = gen.run_in_thread(
+        14.0,
+        base_rps=8.0,
+        burst_factor=5.0,
+        burst_period_s=3.0,
+        cancel_fraction=0.12,
+        prompt_len=(2, 16),
+        max_new=(4, 10),
+    )
+    injected = []
+    for start_at in (3.5, 8.5):
+        delay = start_at - (time.monotonic() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        t0 = time.time()
+        failpoints.arm("engine.readback", "delay", arg="0.5", count=6)
+        wait_until(
+            lambda: not failpoints.is_armed("engine.readback"), timeout=10
+        )
+        failpoints.disarm("engine.readback")  # close the window regardless
+        injected.append({
+            "cls": "engine_stall", "t0": t0, "t1": time.time(),
+        })
+    thread.join(timeout=120)
+    report = holder[0]
+    assert report is not None, "traffic replay never finished"
+
+    # Detections: the serving stack's own incident endpoint.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/debug/incidents", timeout=10
+    ) as r:
+        snapshot = json.loads(r.read())
+    detected = [
+        {"cls": "engine_stall", "ts": i["ts"], "metric": i["metric"]}
+        for i in snapshot["incidents"]
+        if i["ts"] >= replay_start
+        and i["metric"] in ("engine.step_seconds", "engine.ttft_seconds")
+    ]
+
+    score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+    stall = score["per_class"]["engine_stall"]
+    preempts = engine.preemptions - preempts0
+    ttft_p99 = engine.metrics.ttft_seconds.quantile(0.99, since=ttft_since)
+    itl_p99 = engine.metrics.itl_seconds.quantile(0.99, since=itl_since)
+    # Targets are calibrated for THIS environment (tiny model, one CPU
+    # core, a deliberately undersized pool, and 6s of injected 0.5s
+    # stalls): TTFT p99 is dominated by queue wait at the storm peaks
+    # (~30s measured), ITL by the injected stalls themselves.  On real
+    # chips docs/chaos.md prescribes production targets.
+    slo = {
+        "targets": {"ttft_p99_s": 60.0, "itl_p99_s": 2.0},
+        "measured": {
+            "ttft_p99_s": ttft_p99,
+            "itl_p99_s": itl_p99,
+            "preemptions": preempts,
+            "traffic": report.as_dict(),
+        },
+        "pass": (
+            ttft_p99 is not None and ttft_p99 <= 60.0
+            and itl_p99 is not None and itl_p99 <= 2.0
+        ),
+    }
+    result = {
+        "scenario": "preemption_storm_burst_traffic",
+        "injected": injected, "detected": detected,
+        "score": score, "slo": slo,
+        "pass": stall["recall"] >= 0.5 and preempts > 0,
+    }
+    _publish(result)
+
+    # Forensic replayability: a flight dump carries the injected cause
+    # (failpoint.trigger) alongside the detected effect (incident).
+    dump = flight_mod.dump_all(str(tmp_path), reason="chaos", recorders=[box])
+    assert dump is not None
+    with open(dump) as f:
+        payload = json.load(f)
+    kinds = {e["kind"] for e in payload["recorders"]["chaos-engine"]["events"]}
+    assert "failpoint.trigger" in kinds
+    assert "incident" in kinds
+
+    # The storm actually stormed, the replay actually replayed.
+    assert preempts > 0, "no preemption under the burst (pool too large?)"
+    assert report.submitted >= 40, report.as_dict()
+    assert report.cancelled >= 1, "no mid-stream cancels exercised"
+    assert report.completed + report.cancelled >= report.submitted * 0.9
+    # Measured floors (exact figures ride in the report JSON).
+    assert stall["recall"] >= 0.5, score  # detectors caught the stalls
+    assert stall["precision"] >= 0.5, score  # and mostly only the stalls
+    assert slo["pass"], slo
+    # Engine drained whole after the storm.
+    assert all(s is None for s in engine.slots) and not engine.queue
+
+
+# ======================================================================
+# Scenario 4: attribution drift across the fleet
+# ======================================================================
+
+
+def test_chaos_attribution_drift(tmp_path):
+    """Normal pod churn on every node (real Allocate RPCs + PodResources
+    truth), then drift injected on a subset: kubelet attributing a chip
+    the plugin never granted (ungranted, nodes 0 and 2) and a grant the
+    kubelet never surfaces (unfulfilled, node 1).  The reconciliation
+    audit's direct incidents are the detector; clean nodes score the
+    precision."""
+    chaos_report = _chaos_report()
+    grace = 0.5
+    injected: list[dict] = []
+    with FleetSim(
+        tmp_path, n_nodes=4, n_chips=4, pulse=0.0,
+        attribution=True, attribution_interval=0.1, confirm_grace_s=grace,
+    ) as fleet:
+        for n in fleet.nodes:
+            n.bind_pod("prod", f"pod-{n.node_id}", n.device_ids()[:2])
+        time.sleep(0.4)  # polls confirm every grant
+        for n in fleet.nodes:
+            assert n.incidents(metric="plugin.attribution_drift") == [], (
+                "drift incident before any drift was injected"
+            )
+        for node_id in (0, 2):
+            t0 = time.time()
+            fleet.node(node_id).inject_ungranted("tpu-3")
+            injected.append({
+                "cls": "drift_ungranted", "node": node_id, "device": "tpu-3",
+                "drift": "ungranted", "t0": t0, "t1": t0 + 2.0,
+            })
+        # Unfulfilled: node 1 gets a grant the kubelet never surfaces.
+        node1 = fleet.node(1)
+        lost_chip = node1.device_ids()[3]
+        t0 = time.time()
+        node1.allocate([lost_chip])
+        injected.append({
+            "cls": "drift_unfulfilled", "node": 1, "device": lost_chip,
+            "drift": "unfulfilled", "t0": t0, "t1": t0 + grace + 2.0,
+        })
+
+        def _all_detected() -> bool:
+            return (
+                all(
+                    fleet.node(i).incidents(metric="plugin.attribution_drift")
+                    for i in (0, 2)
+                )
+                and node1.incidents(metric="plugin.attribution_drift")
+            )
+
+        wait_until(_all_detected, timeout=grace + 5.0)
+        detected: list[dict] = []
+        for n in fleet.nodes:
+            for inc in n.incidents(metric="plugin.attribution_drift"):
+                detected.append({
+                    "cls": (
+                        "drift_ungranted"
+                        if inc.get("drift") == "ungranted"
+                        else "drift_unfulfilled"
+                    ),
+                    "node": n.node_id,
+                    "device": inc.get("device"),
+                    "drift": inc.get("drift"),
+                    "ts": inc["ts"],
+                })
+        # Counters/flight agree with the incident ring (one surface
+        # cannot drift from another).
+        for node_id in (0, 2):
+            n = fleet.node(node_id)
+            assert n.metrics.attribution_drift.value(kind="ungranted") >= 1
+            assert n.flight_events("attribution.drift")
+        clean = fleet.node(3)
+        assert clean.incidents(metric="plugin.attribution_drift") == []
+    score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+    ungranted = score["per_class"]["drift_ungranted"]
+    unfulfilled = score["per_class"]["drift_unfulfilled"]
+    slo_target = grace + 1.5  # poll interval + grace + slack
+    worst_latency = max(
+        ungranted["latency_max_s"] or 0.0, unfulfilled["latency_max_s"] or 0.0
+    )
+    slo = {
+        "targets": {"drift_detect_s": slo_target},
+        "measured": {
+            "ungranted_detect_max_s": ungranted["latency_max_s"],
+            "unfulfilled_detect_max_s": unfulfilled["latency_max_s"],
+        },
+        "pass": worst_latency <= slo_target,
+    }
+    result = {
+        "scenario": "attribution_drift", "nodes": 4,
+        "injected": injected, "detected": detected,
+        "score": score, "slo": slo,
+        "pass": ungranted["recall"] == 1.0 and unfulfilled["recall"] == 1.0,
+    }
+    _publish(result)
+    assert ungranted["recall"] == 1.0, score
+    assert unfulfilled["recall"] == 1.0, score
+    assert ungranted["precision"] == 1.0, score  # clean nodes stayed clean
+    assert unfulfilled["precision"] == 1.0, score
+    assert slo["pass"], slo

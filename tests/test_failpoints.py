@@ -1,0 +1,372 @@
+"""Failpoint registry (utils/failpoints.py) + call-site integration.
+
+The chaos harness's injection layer must itself be trustworthy: arm and
+disarm exactly as specified, count every trigger, leave a flight-event
+trail, and cost nothing when disarmed.  Registry semantics are pinned on
+private registries; the call-site tests arm the process-wide DEFAULT
+(the one production code fires) and the autouse fixture guarantees no
+armed failpoint leaks into the rest of the suite.
+
+Engine call-site tests ride the session-scoped ``shared_engine`` fixture
+(tier-1 budget: no new XLA compiles; prompts stay in the fixture's
+compiled length buckets).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.utils import failpoints
+from k8s_device_plugin_tpu.utils.failpoints import (
+    FailpointError,
+    FailpointRegistry,
+    parse_spec,
+)
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_default_registry():
+    """No test may leak an armed failpoint into the suite (a stray
+    engine.readback delay would silently slow every later engine test)."""
+    yield
+    failpoints.disarm_all()
+    failpoints.set_flight(None)
+
+
+# ------------------------------------------------------------ spec grammar
+
+
+def test_parse_spec_full_grammar():
+    assert parse_spec(
+        "plugin.allocate=error*2; engine.readback=delay:0.25*6;"
+        "health.probe=flap:3;x=hang"
+    ) == [
+        ("plugin.allocate", "error", None, 2),
+        ("engine.readback", "delay", "0.25", 6),
+        ("health.probe", "flap", "3", None),
+        ("x", "hang", None, None),
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "noequals",
+        "a=explode",
+        "a=delay",  # delay requires seconds
+        "a=delay:fast",
+        "a=delay:-1",
+        "a=flap:0",
+        "a=error*0",
+        "a=error*two",
+        "=error",
+    ],
+)
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_arm_spec_is_atomic():
+    """A malformed entry must not leave the scenario half-armed."""
+    reg = FailpointRegistry("t")
+    with pytest.raises(ValueError):
+        reg.arm_spec("a=error;b=explode")
+    assert not reg.is_armed("a")
+
+
+# ------------------------------------------------------- arm/disarm/fire
+
+
+def test_disarmed_fire_is_none_and_uncounted():
+    reg = FailpointRegistry("t")
+    assert reg.fire("anything") is None
+    assert reg.triggers_total == 0
+
+
+def test_error_mode_raises_and_counts():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "error", arg="boom")
+    with pytest.raises(FailpointError, match="boom"):
+        reg.fire("p")
+    assert reg.triggers("p") == 1
+    assert reg.triggers_total == 1
+
+
+def test_trigger_budget_self_disarms():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "error", count=2)
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            reg.fire("p")
+    assert not reg.is_armed("p")
+    assert reg.fire("p") is None  # budget spent: back to zero-cost
+    assert reg.triggers("p") == 2  # lifetime count survives disarm
+
+
+def test_delay_mode_sleeps():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "delay", arg="0.05", count=1)
+    t0 = time.perf_counter()
+    hit = reg.fire("p")
+    assert time.perf_counter() - t0 >= 0.05
+    assert hit.mode == "delay" and hit.n == 1
+
+
+def test_hang_mode_blocks_until_disarm():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "hang")
+    released = threading.Event()
+
+    def _victim():
+        reg.fire("p")
+        released.set()
+
+    t = threading.Thread(target=_victim, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not released.is_set(), "hang released before disarm"
+    reg.disarm("p")
+    assert released.wait(2), "disarm did not release the hung caller"
+
+
+def test_flap_mode_alternates_with_period():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "flap", arg="2")
+    assert [reg.fire("p").value for _ in range(6)] == [
+        True, True, False, False, True, True,
+    ]
+
+
+def test_rearm_replaces():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "error")
+    reg.arm("p", "flap")
+    assert reg.fire("p").mode == "flap"  # no raise: error arm replaced
+
+
+def test_flight_trail_arm_trigger_disarm():
+    reg = FailpointRegistry("t")
+    box = FlightRecorder(name="chaos")
+    reg.set_flight(box)
+    reg.arm("p", "flap", count=1)
+    reg.fire("p", device="tpu-0")
+    reg.arm("q", "flap")
+    reg.disarm("q")
+    kinds = [e["kind"] for e in box.window()]
+    assert kinds == [
+        "failpoint.armed",
+        "failpoint.trigger",
+        "failpoint.armed",
+        "failpoint.disarmed",
+    ]
+    trigger = box.window(kinds=["failpoint.trigger"])[0]
+    assert trigger["name"] == "p"
+    assert trigger["device"] == "tpu-0"  # call-site ctx rides along
+    assert trigger["n"] == 1
+
+
+def test_snapshot_shape():
+    reg = FailpointRegistry("t")
+    reg.arm("p", "delay", arg="0.001", count=3)
+    reg.fire("p")
+    snap = reg.snapshot()
+    assert snap["armed"]["p"] == {
+        "mode": "delay", "arg": "0.001", "remaining": 2, "triggers": 1,
+    }
+    assert snap["triggered"] == {"p": 1}
+    assert snap["triggers_total"] == 1
+
+
+def test_disarmed_overhead_smoke():
+    """The engine fires engine.readback every decode step; a disarmed
+    registry must stay in the noise.  200k disarmed fires under a very
+    generous 1s bound (~5us/call ceiling; the real cost is ~100x less)."""
+    reg = FailpointRegistry("t")
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        reg.fire("engine.readback")
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_arm_from_env():
+    environ = {failpoints.ENV: "plugin.allocate=error*1"}
+    assert failpoints.arm_from_env(environ) == ["plugin.allocate"]
+    assert failpoints.is_armed("plugin.allocate")
+    failpoints.disarm_all()
+    assert failpoints.arm_from_env({}) == []
+
+
+# --------------------------------------------------- call sites: plugin
+
+
+def _make_checker(tmp_path, n=2, **kw):
+    from k8s_device_plugin_tpu.plugin.discovery import TpuChip
+    from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
+
+    os.makedirs(tmp_path / "dev", exist_ok=True)
+    chips = []
+    for i in range(n):
+        (tmp_path / "dev" / f"accel{i}").write_text("")
+        chips.append(TpuChip(index=i, device_path=f"/dev/accel{i}"))
+    return ChipHealthChecker(root=str(tmp_path), prober=None, **kw), chips
+
+
+def test_health_probe_failpoint_flap_forces_unhealthy(tmp_path):
+    box = FlightRecorder(name="t")
+    checker, chips = _make_checker(tmp_path, n=1, flight=box)
+    failpoints.arm("health.probe", "flap", count=1)
+    assert checker.check(chips[0]) is False  # forced fault
+    assert checker.check(chips[0]) is True  # budget spent: healthy again
+    failures = box.window(kinds=["health.probe_failure"])
+    assert failures and "failpoint" in failures[0]["error"]
+
+
+def test_health_probe_failpoint_error_escapes_sweep(tmp_path):
+    """Error mode models a wedged sysfs: the sweep raises, and the
+    daemon's heartbeat (which catches and meters poll failures) is the
+    layer that must absorb it."""
+    checker, chips = _make_checker(tmp_path, n=1)
+    failpoints.arm("health.probe", "error", count=1)
+    with pytest.raises(FailpointError):
+        checker.check_many(chips)
+
+
+def test_allocate_failpoint_aborts_unavailable(tmp_path):
+    """Armed plugin.allocate rejects the RPC UNAVAILABLE end-to-end
+    through a real gRPC channel, meters outcome=failpoint, leaves a
+    flight trail, and the next (disarmed) Allocate succeeds."""
+    import grpc
+    from concurrent import futures
+
+    from k8s_device_plugin_tpu.kubelet.api import (
+        DevicePluginStub,
+        add_device_plugin_servicer,
+    )
+    from k8s_device_plugin_tpu.plugin import discovery
+    from k8s_device_plugin_tpu.plugin.health import ChipHealthChecker
+    from k8s_device_plugin_tpu.plugin.server import TpuDevicePlugin
+    from tests.fakes import make_fake_tpu_host
+
+    root = make_fake_tpu_host(tmp_path / "host", n_chips=2)
+    box = FlightRecorder(name="t")
+    plugin = TpuDevicePlugin(
+        discover=lambda: discovery.discover(root=root, environ={}),
+        health_checker=ChipHealthChecker(root=root, prober=None),
+        flight=box,
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    add_device_plugin_servicer(plugin, server)
+    sock = str(tmp_path / "plugin.sock")
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    try:
+        from k8s_device_plugin_tpu.kubelet.api import pb
+
+        stub = DevicePluginStub(grpc.insecure_channel(f"unix://{sock}"))
+        req = pb.AllocateRequest(
+            container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=["tpu-0"])
+            ]
+        )
+        failpoints.arm("plugin.allocate", "error", count=1)
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(req, timeout=5)
+        assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert plugin.metrics.allocations.value(outcome="failpoint") == 1
+        events = box.window(kinds=["allocate"])
+        assert events[-1]["outcome"] == "failpoint"
+        # Budget spent: the retry (kubelet's natural reaction) succeeds.
+        resp = stub.Allocate(req, timeout=5)
+        assert len(resp.container_responses) == 1
+    finally:
+        server.stop(grace=None).wait()
+
+
+def test_attribution_poll_failpoint_degrades_and_recovers(tmp_path):
+    """Armed attribution.poll fails the poll exactly like an unreachable
+    socket (up 0, failure counted, redial) and the next poll recovers."""
+    from k8s_device_plugin_tpu.plugin.attribution import PodAttributionPoller
+    from tests.fakes import FakeKubelet
+
+    kubelet = FakeKubelet(str(tmp_path))
+    sock = kubelet.start_pod_resources()
+    try:
+        poller = PodAttributionPoller(sock, confirm_grace_s=0.0)
+        assert poller.poll_once() is True
+        assert poller.metrics.podresources_up.value() == 1
+        failpoints.arm("attribution.poll", "error", count=1)
+        assert poller.poll_once() is False
+        assert poller.metrics.podresources_up.value() == 0
+        assert poller.failures == 1
+        assert poller.poll_once() is True  # disarmed: redialed and up
+        assert poller.metrics.podresources_up.value() == 1
+    finally:
+        kubelet.stop_pod_resources()
+
+
+# --------------------------------------------------- call sites: engine
+
+
+def test_engine_submit_failpoint_rejects_then_recovers(shared_engine):
+    _, _, eng = shared_engine
+    failpoints.arm("engine.submit", "error", arg="chaos says no", count=1)
+    with pytest.raises(ValueError, match="chaos says no"):
+        eng.submit([3, 141, 59], 4)
+    rejects = eng.flight.window(kinds=["admission.reject"])
+    assert any("chaos says no" in e["reason"] for e in rejects)
+    # Disarmed: the same submit admits and decodes to completion.
+    req = eng.submit([3, 141, 59], 4)
+    guard = 200
+    while not req.done and guard:
+        eng.step()
+        guard -= 1
+    assert req.done and len(req.tokens) == 4
+    assert len(eng.free_pages) == eng.paged.num_pages - 1
+
+
+def test_engine_readback_delay_failpoint_stalls_but_stays_correct(
+    shared_engine,
+):
+    """An injected readback stall must slow steps (the chaos lever the
+    step-time anomaly detector is scored against) WITHOUT corrupting the
+    token stream — fault injection that changes results would make every
+    scenario meaningless."""
+    _, _, eng = shared_engine
+
+    def _serve(prompt, n):
+        req = eng.submit(prompt, n)
+        guard = 500
+        while not req.done and guard:
+            eng.step()
+            guard -= 1
+        assert req.done
+        return req.tokens
+
+    baseline = _serve([3, 141, 59], 6)
+    failpoints.arm("engine.readback", "delay", arg="0.02", count=4)
+    t0 = time.perf_counter()
+    stalled = _serve([3, 141, 59], 6)
+    elapsed = time.perf_counter() - t0
+    assert stalled == baseline  # injection is latency-only
+    assert elapsed >= 0.06  # >= 3 of the 4 x 20ms delays actually hit
+    assert failpoints.DEFAULT.triggers("engine.readback") == 4
+    assert not failpoints.is_armed("engine.readback")  # self-disarmed
+
+
+# ------------------------------------------------- chaos suite guardrails
+
+
+def test_chaos_suite_collects_and_is_slow_marked():
+    """The scenario suite must COLLECT under tier-1 (cheap imports, no
+    jax at module scope) while every test deselects via the module-level
+    slow mark — the conftest guard enforces the marker at collection,
+    this pins the mechanism it relies on."""
+    import tests.test_chaos_scenarios as chaos
+
+    marks = getattr(chaos, "pytestmark", None)
+    marks = marks if isinstance(marks, list) else [marks]
+    assert any(getattr(m, "name", None) == "slow" for m in marks)

@@ -94,3 +94,76 @@ def test_override_falsy_spellings(tmp_path):
     for text in ["unhealthy", "Unhealthy", "0", "false"]:
         write_override(tmp_path, 0, text)
         assert ChipHealthChecker(root=str(tmp_path)).check(chip(0)) is False
+
+
+# ----------------------------------------------------- flap debounce
+
+
+def sweep(checker, n=2):
+    return checker.check_many([chip(i) for i in range(n)])
+
+
+def test_flap_debounce_suppresses_single_transient(tmp_path):
+    """One failing sweep of a Healthy chip must NOT flip it Unhealthy
+    (threshold 2): the suppressed flip emits a health.flap_suppressed
+    flight event, and a recovering probe resets the streak."""
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    for i in range(2):
+        make_dev(tmp_path, i)
+    box = FlightRecorder(name="t")
+    checker = ChipHealthChecker(
+        root=str(tmp_path), prober=None, flight=box, flap_threshold=2
+    )
+    assert sweep(checker) == {"tpu-0": True, "tpu-1": True}
+    # Transient: accel1 vanishes for exactly one sweep.
+    os.unlink(os.path.join(str(tmp_path), "dev", "accel1"))
+    assert sweep(checker) == {"tpu-0": True, "tpu-1": True}  # suppressed
+    suppressed = box.window(kinds=["health.flap_suppressed"])
+    assert suppressed == [
+        {
+            "ts": suppressed[0]["ts"], "kind": "health.flap_suppressed",
+            "device": "tpu-1", "streak": 1, "threshold": 2,
+        }
+    ]
+    make_dev(tmp_path, 1)
+    assert sweep(checker) == {"tpu-0": True, "tpu-1": True}
+    # Streak reset: the next single failure is again suppressed.
+    os.unlink(os.path.join(str(tmp_path), "dev", "accel1"))
+    assert sweep(checker)["tpu-1"] is True
+
+
+def test_flap_debounce_sustained_failure_transitions(tmp_path):
+    """K consecutive failures DO transition (threshold is a debounce,
+    not a blindfold), and recovery is never debounced."""
+    make_dev(tmp_path, 0)
+    checker = ChipHealthChecker(
+        root=str(tmp_path), prober=None, flap_threshold=3
+    )
+    assert sweep(checker, n=1) == {"tpu-0": True}
+    os.unlink(os.path.join(str(tmp_path), "dev", "accel0"))
+    assert sweep(checker, n=1)["tpu-0"] is True  # streak 1: suppressed
+    assert sweep(checker, n=1)["tpu-0"] is True  # streak 2: suppressed
+    assert sweep(checker, n=1)["tpu-0"] is False  # streak 3: reported
+    # Once Unhealthy, staying broken keeps reporting Unhealthy with no
+    # re-suppression dance.
+    assert sweep(checker, n=1)["tpu-0"] is False
+    make_dev(tmp_path, 0)
+    assert sweep(checker, n=1)["tpu-0"] is True  # recovery is immediate
+
+
+def test_flap_threshold_one_keeps_first_failure_reporting(tmp_path):
+    """The library default (1) preserves report-on-first-failure — the
+    behavior every pre-debounce test and caller relies on."""
+    make_dev(tmp_path, 0)
+    checker = ChipHealthChecker(root=str(tmp_path), prober=None)
+    assert sweep(checker, n=1) == {"tpu-0": True}
+    os.unlink(os.path.join(str(tmp_path), "dev", "accel0"))
+    assert sweep(checker, n=1) == {"tpu-0": False}
+
+
+def test_flap_threshold_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ChipHealthChecker(flap_threshold=0)

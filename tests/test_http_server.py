@@ -638,6 +638,80 @@ def test_profile_capture_spans_live_steps(served):
     assert e.value.code == 400
 
 
+def test_graceful_drain_finishes_inflight_blocks_admission(shared_engine):
+    """SIGTERM-path drain (EngineServer.begin_drain): admission stops
+    (503 + Retry-After, /healthz -> draining) while the in-flight
+    request keeps decoding to completion inside the grace window, then
+    the loop stops and `drained` fires — a pod delete no longer cuts
+    streams mid-token.  Rides the session engine (no new compiles; the
+    in-flight request is slowed with an engine.readback delay failpoint
+    so the drain demonstrably overlaps live decoding)."""
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+    from k8s_device_plugin_tpu.utils import failpoints
+
+    _, _, eng = shared_engine
+    # The session engine normally steps on the pytest main thread; hand
+    # step ownership to this server's loop thread (the racecheck
+    # OwnerGuard re-binds to whoever touches first — after the loop
+    # thread dies at drain end, the main thread inherits back).
+    if eng._inflight_guard is not None:
+        eng._inflight_guard._owner = None
+    server = EngineServer(eng, host="127.0.0.1", port=0).start()
+    try:
+        # ~24 decode steps x 10ms injected readback delay: the request
+        # is mid-decode for ~250ms — ample room to drain around it.
+        failpoints.arm("engine.readback", "delay", arg="0.01", count=24)
+        results: dict = {}
+
+        def _client():
+            try:
+                results["resp"] = _post(
+                    server.port, {"prompt": [3, 141, 59], "max_new_tokens": 24}
+                )
+            except Exception as e:  # surfaced by the asserts below
+                results["err"] = e
+
+        client = threading.Thread(target=_client, daemon=True)
+        client.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (
+            eng.queue or any(s is not None for s in eng.slots)
+        ):
+            time.sleep(0.002)
+        assert eng.queue or any(s is not None for s in eng.slots)
+        server.begin_drain(grace_s=30.0)
+        server.begin_drain(grace_s=30.0)  # idempotent
+        # Admission is closed the moment draining starts...
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": [9], "max_new_tokens": 2})
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") is not None
+        # ...and readiness reads draining.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=5
+            )
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "draining"
+        # The in-flight request still finishes, full length, no cut.
+        assert server.drained.wait(30), "drain never completed"
+        client.join(timeout=10)
+        assert "err" not in results, results.get("err")
+        assert len(results["resp"]["tokens"]) == 24
+        events = {e["kind"]: e for e in eng.flight.window(
+            kinds=["server.drain_begin", "server.drain_end"]
+        )}
+        assert events["server.drain_begin"]["grace_s"] == 30.0
+        assert events["server.drain_end"]["completed"] is True
+        assert events["server.drain_end"]["cut_requests"] == 0
+        # Engine drained whole: every slot and page back in the pool.
+        assert all(s is None for s in eng.slots) and not eng.queue
+        assert len(eng.free_pages) == eng.paged.num_pages - 1
+    finally:
+        failpoints.disarm_all()
+        server.stop()
+
+
 def test_metrics_lint_clean_on_live_engine_server(served):
     """The serving /metrics (engine + shared-registry series after a
     full suite of traffic) passes the strict exposition linter

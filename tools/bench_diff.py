@@ -80,6 +80,19 @@ def load_record(path: str) -> dict:
             rec["tp_scaling_efficiency"] = tp.get("scaling_efficiency")
             rec["tp_discards"] = tp.get("discards")
             rec["tp_tokens_match"] = tp.get("tokens_match")
+        # Chaos block (tools/chaos_report.py chaos_summary): scenario
+        # counts plus the WORST per-class detector precision/recall of
+        # the run.  A precision/recall sag (or slo_pass flipping false)
+        # between rounds means a detector regressed against injected
+        # ground truth — the chaos analogue of a throughput collapse.
+        chaos = parsed.get("chaos")
+        if isinstance(chaos, dict):
+            rec["chaos_scenarios"] = chaos.get("scenarios")
+            rec["chaos_passed"] = chaos.get("passed")
+            rec["chaos_faults"] = chaos.get("faults_injected")
+            rec["chaos_precision"] = chaos.get("precision")
+            rec["chaos_recall"] = chaos.get("recall")
+            rec["chaos_slo_pass"] = chaos.get("slo_pass")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -112,6 +125,8 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "kvcache_hits", "kvcache_restores", "kvcache_reclaims",
         "kvcache_restore_speedup", "kvcache_resumes_restored",
         "kvcache_resumes_recomputed",
+        "chaos_scenarios", "chaos_passed", "chaos_faults",
+        "chaos_precision", "chaos_recall", "chaos_slo_pass",
     ):
         va, vb = a.get(field), b.get(field)
         if va is None and vb is None:
@@ -156,6 +171,14 @@ def ledger_row(a: dict, b: dict) -> str:
                 f"resumes {b.get('kvcache_resumes_restored')}r/"
                 f"{b.get('kvcache_resumes_recomputed')}c"
                 if b.get("kvcache_hits") is not None
+                else ""
+            )
+            + (
+                f"; chaos {b['chaos_passed']}/{b['chaos_scenarios']} "
+                f"(p {b.get('chaos_precision')}, r {b.get('chaos_recall')}"
+                + ("" if b.get("chaos_slo_pass", True) else ", SLO-FAIL")
+                + ")"
+                if b.get("chaos_scenarios") is not None
                 else ""
             )
         )
