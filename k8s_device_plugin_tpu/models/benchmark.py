@@ -383,6 +383,221 @@ def run_decode(args) -> None:
     )
 
 
+def _run_router_phase(args) -> dict | None:
+    """ROUTER perf phase: prefix-affinity routing vs a random-placement
+    control over the SAME seeded multi-session traffic, against K real
+    (tiny) serving replicas behind the router daemon.
+
+    What the row claims and how it is measured:
+
+    - **prefix-hit rate** — KV-tier hits (retained + host arena) summed
+      across the replica engines per routed request.  Affinity keeps a
+      session's shared prefix on one replica where the tiers revive it;
+      random placement scatters it, so each replica keeps re-grafting.
+      Engine counters, not router bookkeeping — the benefit is real KV
+      work avoided.
+    - **TTFT p99** — the router's own client-observed first-token
+      histogram (tpu_router_ttft_seconds), warm, measured over the
+      identical request sequence both times (same traffic seed).
+
+    The replicas are deliberately tiny (GPTConfig.tiny) so the phase
+    costs two small compiles, not two of the headline engines; both
+    phases run over the SAME compiled replicas with KV tiers cleared
+    in between, affinity first so any residual warmth favors the
+    CONTROL.  Returns the JSON `router` block (None when disabled via
+    --router-replicas 0)."""
+    import dataclasses
+    import os as _os
+    import sys as _sys
+    import threading
+
+    from ..router.server import RouterServer
+    from ..utils.metrics import MetricsRegistry
+    from .engine import EngineMetrics, ServingEngine
+    from .http_server import EngineServer
+    from .transformer import GPTConfig, PagedConfig, TransformerLM
+
+    n_replicas = getattr(args, "router_replicas", 2)
+    if n_replicas < 2:
+        return None
+    # The multi-session replay lives with the chaos/sim harness
+    # (tests/sim/traffic.py); the bench runs from the repo image, where
+    # the repo root may or may not already be importable.
+    try:
+        from tests.sim.traffic import RouterTraffic
+    except ImportError:
+        _sys.path.insert(
+            0,
+            _os.path.dirname(
+                _os.path.dirname(
+                    _os.path.dirname(_os.path.abspath(__file__))
+                )
+            ),
+        )
+        from tests.sim.traffic import RouterTraffic
+
+    page_size = 4
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    paged = PagedConfig(
+        page_size=page_size, num_pages=64, max_pages_per_seq=16
+    )
+    rng = jax.random.PRNGKey(0)
+    servers = []
+    engines = []
+    for i in range(n_replicas):
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(i), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg,
+            params,
+            paged,
+            max_slots=4,
+            metrics=EngineMetrics(registry),
+            kv_retain=True,
+            kv_host_cache_mb=16,
+        )
+        engines.append(engine)
+        servers.append(
+            EngineServer(
+                engine, host="127.0.0.1", port=0, registry=registry
+            ).start()
+        )
+
+    def _post_replica(port, prompt, max_new):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": max_new}
+            ).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=120).read()
+
+    # Warmup EVERY replica over the (batch, bucket) prefill grid the
+    # replay can hit (prefix 16 + suffix <= 4 tokens -> one bucket;
+    # concurrent admissions batch up to the client concurrency), so no
+    # XLA compile lands inside either measured pass — and neither
+    # policy's pass eats a compile the other skipped.
+    for server in servers:
+        for group in (1, 2, 3, 4):
+            threads = [
+                threading.Thread(
+                    target=_post_replica,
+                    args=(server.port, [7 + g] * 18, 6),
+                )
+                for g in range(group)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    replica_names = [f"127.0.0.1:{s.port}" for s in servers]
+    # More sessions than replicas: every session random placement
+    # scatters pays a cold prefix graft per EXTRA replica it touches,
+    # while affinity pays exactly one per session — the gap the
+    # hit-rate columns exist to show.
+    sessions, prefix_len, n_requests = 8, 16, 32
+
+    def _kv_hits():
+        return sum(e.kv_retained_hits + e.kv_host_hits for e in engines)
+
+    def _measure(mode):
+        router = RouterServer(
+            replica_names,
+            host="127.0.0.1",
+            port=0,
+            # One prefix block = one KV page of the tiny replicas; four
+            # blocks = exactly the shared session prefix.
+            prefix_block_tokens=page_size,
+            prefix_max_blocks=prefix_len // page_size,
+            poll_interval_s=0.2,
+            hedge=False,
+            policy_mode=mode,
+            seed=3,
+        ).start()
+        traffic = RouterTraffic(
+            "127.0.0.1",
+            router.port,
+            seed=17,
+            sessions=sessions,
+            prefix_len=prefix_len,
+            vocab=cfg.vocab_size,
+        )
+        # Warm pass (same seed as the measured pass: identical shapes),
+        # then clear every KV tier so the measurement starts cold.
+        traffic.run(
+            n_requests, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        for engine in engines:
+            engine.kvcache_clear()
+        hits0 = _kv_hits()
+        ttft_snap = router.metrics.ttft_seconds.snapshot()
+        report = traffic.run(
+            n_requests, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        placements = {
+            key: router.metrics.placements.value(placement=key)
+            for key in ("home", "overflow", "random", "failover")
+        }
+        out = {
+            "prefix_hits": _kv_hits() - hits0,
+            "hit_rate": round((_kv_hits() - hits0) / n_requests, 3),
+            "ttft_p99_ms": (
+                None
+                if (
+                    q := router.metrics.ttft_seconds.quantile(
+                        0.99, since=ttft_snap
+                    )
+                )
+                is None
+                else round(q * 1e3, 3)
+            ),
+            "home_rate": round(
+                placements["home"] / max(1, sum(placements.values())), 3
+            ),
+            "dropped": report.dropped,
+            "failovers": int(router.metrics.failovers.value()),
+            "retries": int(router.metrics.retries.value()),
+        }
+        router.stop()
+        return out
+
+    # Affinity FIRST: any residual warmth then biases toward the
+    # random CONTROL, never for the claim.
+    affinity = _measure("affinity")
+    random_ctl = _measure("random")
+    for server in servers:
+        server.stop()
+    block = {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "sessions": sessions,
+        "affinity": affinity,
+        "random": random_ctl,
+    }
+    log(
+        "perf-ledger row: | ROUTER prefix-affinity (K=%d, %d sessions) | "
+        "affinity %.2f KV hits/req, TTFT p99 %s ms (home rate %.2f) vs "
+        "random %.2f hits/req, %s ms | - | `benchmark.py --model serving` "
+        "| update on bench round |"
+        % (
+            n_replicas,
+            sessions,
+            affinity["hit_rate"],
+            affinity["ttft_p99_ms"],
+            affinity["home_rate"],
+            random_ctl["hit_rate"],
+            random_ctl["ttft_p99_ms"],
+        )
+    )
+    return block
+
+
 def run_serving(args) -> None:
     """Continuous-batching serving benchmark through the SAME telemetry
     operators scrape: the TTFT/ITL percentiles in the JSON line are read
@@ -640,6 +855,8 @@ def run_serving(args) -> None:
                 "bit-identical" if tp_match else "DIVERGED",
             )
         )
+    # --- Router phase (ROUTER row): affinity vs random placement -------
+    router_block = _run_router_phase(args)
     print(
         json.dumps(
             {
@@ -681,6 +898,7 @@ def run_serving(args) -> None:
                     "resumes_recomputed": churn_recomputed,
                 },
                 "tp": tp_block,
+                "router": router_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {
                     "steps": prof["steps"],
@@ -806,6 +1024,14 @@ def main(argv: list[str] | None = None) -> None:
         type=_positive_int,
         default=16,
         help="serving: synthetic requests pushed through the engine",
+    )
+    p.add_argument(
+        "--router-replicas",
+        type=int,
+        default=2,
+        help="serving: replicas in the ROUTER phase (prefix-affinity vs "
+        "random-placement control over K tiny real serving replicas "
+        "behind the router daemon; 0/1 skips the phase)",
     )
     p.add_argument(
         "--temperature",

@@ -48,7 +48,10 @@ rest of the models/ stack which benchmarks on synthetic ids):
     GET /debug/state -> 200 JSON engine snapshot (slots, queue, page
          pool, speculation counters) plus the recent span ring
          (utils/spans.py) when the engine was built with a recorder —
-         ids and lengths only, never token content.
+         ids and lengths only, never token content.  Top-level
+         ``queue_depth`` / ``active_slots`` / ``draining`` ride along;
+         ``?summary=1`` returns ONLY those (no engine lock, no spans) —
+         the shape the router's per-second poll loop reads.
 
     GET /debug/profile -> 200 JSON per-step profiler snapshot
          (models/engine_profiler.py): per-phase breakdown
@@ -476,13 +479,34 @@ class EngineServer:
                         return
                     self._reply(200 if ok else 503, {"status": "ok" if ok else "down"})
                 elif path == "/debug/state":
-                    # Engine + span-ring snapshot: the first endpoint to
-                    # hit during an incident.  Contains ids and lengths,
-                    # never token content (see ServingEngine.debug_state),
-                    # so it can stay as open as /metrics.
+                    # Cheap top-level summary a router's poll loop can
+                    # afford every second across the fleet: queue depth,
+                    # active slot count, and the draining flag (which was
+                    # otherwise only visible as a /healthz 503).  Plain
+                    # racy scalar reads — no engine lock, no span/profiler
+                    # assembly.
+                    summary = {
+                        "queue_depth": len(server.engine.queue),
+                        "active_slots": sum(
+                            1 for s in server.engine.slots if s is not None
+                        ),
+                        "draining": server._draining.is_set(),
+                        "loop_alive": server._loop_alive,
+                    }
+                    if "summary=1" in (self.path.split("?", 1) + [""])[1]:
+                        # ?summary=1: the summary ALONE — skips the
+                        # engine-lock snapshot and the span ring
+                        # entirely, so a K-replica poll fan-in costs the
+                        # fleet ~nothing.
+                        self._reply(200, summary)
+                        return
+                    # Full snapshot: the first endpoint to hit during an
+                    # incident.  Contains ids and lengths, never token
+                    # content (see ServingEngine.debug_state), so it can
+                    # stay as open as /metrics.
                     state = {
                         "engine": server.engine.debug_state(),
-                        "loop_alive": server._loop_alive,
+                        **summary,
                     }
                     rec = server.engine.spans
                     if rec is not None:

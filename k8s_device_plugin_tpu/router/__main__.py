@@ -1,0 +1,7 @@
+"""`python -m k8s_device_plugin_tpu.router` — the router daemon entry
+(deploy/k8s-deploy-router.yaml)."""
+
+from .server import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Replica state + the placement policy (affinity, overflow, failover).
+
+The policy answers one question per request: in what order should the
+router try the replicas?  The answer composes three signals:
+
+- **ring affinity** (`ring.py`): the prompt's prefix-block key names a
+  home replica whose KV tiers likely hold the prefix; the ring order
+  after it is the deterministic failover sequence.
+- **liveness/drain state** (this module, fed by the poll loop): a
+  draining replica takes NO new assignments (its in-flight streams keep
+  running — the `begin_drain()` rollout contract), an unreachable one
+  sorts last (poll state may be stale; it is still dialed as a final
+  resort, where its breaker decides).
+- **queue depth** (read from ``/debug/state?summary=1``): affinity is a
+  preference, not a law — when the home replica's queue is
+  ``overflow_depth`` deeper than the least-loaded eligible replica, the
+  request overflows along the ring instead of piling onto a hot shard.
+
+Breaker state is deliberately NOT consulted here: `try_acquire()` has
+side effects (it consumes the half-open probe slot), so the dispatch
+loop in server.py applies it per dial attempt.
+
+``mode="random"`` is the control policy the serving benchmark uses to
+measure what affinity buys (uniform seeded placement over the same
+eligible set, same failover semantics).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from .breaker import CircuitBreaker
+from .ring import HashRing, prefix_key
+
+# Placement tags (tpu_router_placements_total label values).
+HOME = "home"
+OVERFLOW = "overflow"
+RANDOM = "random"
+FAILOVER = "failover"
+
+
+class ReplicaState:
+    """One replica's router-side view: address, poll-derived load/drain
+    state, and its circuit breaker.  Mutable fields are plain scalars
+    updated by the poll loop and read racily by dispatch (GIL-atomic;
+    a one-poll-stale read is by design)."""
+
+    def __init__(self, name: str, breaker: CircuitBreaker):
+        self.name = name  # "host:port" — the ring node AND dial target
+        host, _, port = name.rpartition(":")
+        self.host = host
+        self.port = int(port)
+        self.breaker = breaker
+        self.reachable = True  # optimistic until a poll says otherwise
+        self.draining = False
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.last_poll = 0.0  # time.monotonic of last successful poll
+        self.dispatches = 0
+        self.failures = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "reachable": self.reachable,
+            "draining": self.draining,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "breaker": self.breaker.snapshot(),
+            "dispatches": self.dispatches,
+            "failures": self.failures,
+            "last_poll_age_s": (
+                round(time.monotonic() - self.last_poll, 3)
+                if self.last_poll
+                else None
+            ),
+        }
+
+
+class RoutingPolicy:
+    """Turns (prompt, replica states) into a dial order + placement tag.
+
+    Thread-safe for the reads it does; ring membership changes go
+    through the owning server's lock.
+    """
+
+    def __init__(
+        self,
+        ring: HashRing,
+        replicas: dict[str, ReplicaState],
+        *,
+        overflow_depth: int = 4,
+        prefix_block_tokens: int = 16,
+        prefix_max_blocks: int = 4,
+        mode: str = "affinity",
+        seed: int = 0,
+    ):
+        if mode not in ("affinity", "random"):
+            raise ValueError(f"unknown policy mode {mode!r}")
+        if overflow_depth < 1:
+            raise ValueError(f"overflow_depth must be >= 1, got {overflow_depth}")
+        self.ring = ring
+        self.replicas = replicas
+        self.overflow_depth = overflow_depth
+        self.prefix_block_tokens = prefix_block_tokens
+        self.prefix_max_blocks = prefix_max_blocks
+        self.mode = mode
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+
+    def key_of(self, prompt) -> int:
+        return prefix_key(
+            prompt, self.prefix_block_tokens, self.prefix_max_blocks
+        )
+
+    def candidates(self, prompt) -> tuple[list[str], str]:
+        """(ordered replica names, primary placement tag).
+
+        Draining replicas are excluded outright (no new assignments —
+        ever); unreachable ones are appended last as a stale-poll
+        hedge.  The tag describes position 0 only; the dispatch loop
+        tags anything after it ``failover``.
+        """
+        ring_order = self.ring.order(self.key_of(prompt))
+        eligible = [
+            n
+            for n in ring_order
+            if not self.replicas[n].draining and self.replicas[n].reachable
+        ]
+        stale = [
+            n
+            for n in ring_order
+            if not self.replicas[n].draining and not self.replicas[n].reachable
+        ]
+        if self.mode == "random":
+            with self._rng_lock:
+                self._rng.shuffle(eligible)
+            return eligible + stale, RANDOM
+        if not eligible:
+            return stale, FAILOVER
+        depths = {n: self.replicas[n].queue_depth for n in eligible}
+        home = eligible[0]
+        least = min(depths.values())
+        if depths[home] - least >= self.overflow_depth:
+            # Home is a hot shard: start at the least-loaded eligible
+            # replica, keeping ring order after it (rotation preserves
+            # the deterministic failover sequence).
+            start = min(
+                range(len(eligible)), key=lambda i: depths[eligible[i]]
+            )
+            rotated = eligible[start:] + eligible[:start]
+            return rotated + stale, OVERFLOW
+        return eligible + stale, HOME

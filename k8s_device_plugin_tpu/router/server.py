@@ -1,0 +1,1228 @@
+"""The router daemon: a fault-tolerant prefix-affinity /generate proxy.
+
+One process fronts K serving replicas (models/http_server.EngineServer)
+and owns everything between "client sent a prompt" and "a replica's
+engine decoded it":
+
+- **Placement** — `RoutingPolicy` (prefix-affinity consistent hashing +
+  queue-depth overflow over poll state from ``/debug/state?summary=1``).
+- **Failure containment** — per-replica `CircuitBreaker`s gate every
+  dial; a global `RetryBudget` bounds extra dispatches; retries use
+  exponential backoff with full jitter and honor ``Retry-After``.
+- **Hedging** (unary, opt-in) — when a response hasn't arrived within
+  the rolling TTFT p99, a second dispatch races the first along the
+  ring; first response wins, the loser's connection is closed.
+- **Mid-stream failover** — a streaming request whose replica dies
+  mid-decode is transparently resubmitted to the next ring replica as
+  ``prompt + already-emitted tokens`` with the remaining budget, under
+  the SAME request id (idempotent: the resubmission carries the emitted
+  tokens in its prompt, so nothing can double-emit).  On the failover
+  replica the content-addressed KV restore (models/engine_kvcache.py)
+  turns the re-prefill into a page restore when the prefix is warm.
+  The client sees one uninterrupted token stream — zero-drop is the
+  contract the chaos suite scores (docs/chaos.md).
+- **Drain awareness** — a replica answering 503/draining (or whose
+  summary poll says so) takes no NEW assignments immediately, while its
+  in-flight proxied streams run to completion; ``Retry-After`` feeds
+  the backoff when nothing else is dialable.
+
+Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
+(503 until a replica is reachable; ``draining`` during shutdown),
+``GET /metrics`` (Prometheus), ``GET /debug/router`` (full snapshot).
+Every fault-handling decision is a flight event (``router.*``) so a
+chaos run can join injected replica kills against what the router saw.
+
+Chaos seam: each upstream dial fires the ``router.replica_conn``
+failpoint scoped per replica (``router.replica_conn.<host:port>``) —
+error/delay/hang inject dial-level faults without touching sockets.
+
+Stdlib + utils only; jax is never imported here.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_mod
+import random
+import socket
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import http.client
+
+from ..utils import failpoints
+from ..utils.metrics import MetricsRegistry, write_exposition
+from ..utils.spans import sanitize_trace_id
+from .breaker import STATE_VALUE, CircuitBreaker, RetryBudget
+from .policy import FAILOVER, ReplicaState, RoutingPolicy
+from .ring import HashRing
+
+FAILPOINT_CONN = "router.replica_conn"
+
+# Upstream transport failures: everything that means "this replica did
+# not answer", as opposed to "this replica answered badly".
+_CONN_ERRORS = (OSError, http.client.HTTPException)
+
+
+class RouterMetrics:
+    """The router's Prometheus families (linted live in tier-1)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.requests = registry.counter(
+            "tpu_router_requests_total",
+            "Client requests by outcome (ok/error/rejected/timeout)",
+            ("outcome",),
+        )
+        self.placements = registry.counter(
+            "tpu_router_placements_total",
+            "Dispatches by placement decision (home/overflow/random/failover)",
+            ("placement",),
+        )
+        self.retries = registry.counter(
+            "tpu_router_retries_total",
+            "Upstream re-dispatches after a failed attempt",
+        )
+        self.failovers = registry.counter(
+            "tpu_router_failovers_total",
+            "Mid-stream failovers (stream resubmitted to another replica)",
+        )
+        self.hedges = registry.counter(
+            "tpu_router_hedges_total",
+            "Hedged dispatches by result (won/lost)",
+            ("result",),
+        )
+        self.breaker_transitions = registry.counter(
+            "tpu_router_breaker_transitions_total",
+            "Circuit breaker transitions by destination state",
+            ("state",),
+        )
+        self.replica_up = registry.gauge(
+            "tpu_router_replica_up",
+            "1 when the replica's summary poll succeeds, else 0",
+            ("replica",),
+        )
+        self.replica_queue_depth = registry.gauge(
+            "tpu_router_replica_queue_depth",
+            "Replica engine queue depth from the last summary poll",
+            ("replica",),
+        )
+        self.replica_draining = registry.gauge(
+            "tpu_router_replica_draining",
+            "1 while the replica reports draining (no new assignments)",
+            ("replica",),
+        )
+        self.breaker_state = registry.gauge(
+            "tpu_router_breaker_state",
+            "Breaker state per replica (0 closed, 1 open, 2 half-open)",
+            ("replica",),
+        )
+        self.retry_budget = registry.gauge(
+            "tpu_router_retry_budget",
+            "Retry-budget tokens currently available",
+        )
+        self.ttft_seconds = registry.histogram(
+            "tpu_router_ttft_seconds",
+            "Client-observed time to first token through the router",
+        )
+        self.request_seconds = registry.histogram(
+            "tpu_router_request_seconds",
+            "Client-observed total request latency through the router",
+        )
+        self.poll_seconds = registry.histogram(
+            "tpu_router_poll_seconds",
+            "Per-replica summary poll latency",
+        )
+
+    def drop_replica(self, name: str) -> None:
+        for gauge in (
+            self.replica_up,
+            self.replica_queue_depth,
+            self.replica_draining,
+            self.breaker_state,
+        ):
+            gauge.remove(replica=name)
+
+
+class _Rolling:
+    """Bounded rolling sample for the hedge threshold (TTFT p99): a
+    deque of the last N observations, quantile by sort — N is small
+    (256), so the sort is nanoseconds next to a network dial."""
+
+    def __init__(self, capacity: int = 256):
+        self._values: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self._values.append(float(value))
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._values:
+                return None
+            ordered = sorted(self._values)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+
+class _Upstream:
+    """One dialed upstream attempt: the connection (closable for
+    cancel/cleanup) and its response."""
+
+    __slots__ = ("name", "conn", "resp")
+
+    def __init__(self, name, conn, resp):
+        self.name = name
+        self.conn = conn
+        self.resp = resp
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+class RouterServer:
+    """Threaded HTTP proxy over K serving replicas.  ``port=0`` picks a
+    free port (tests); ``.port`` reports it.  ``replicas`` are
+    ``"host:port"`` strings (also the ring node names and the `replica`
+    metric label values)."""
+
+    def __init__(
+        self,
+        replicas: list[str],
+        host: str = "0.0.0.0",
+        port: int = 8100,
+        registry: Optional[MetricsRegistry] = None,
+        flight=None,
+        *,
+        prefix_block_tokens: int = 16,
+        prefix_max_blocks: int = 4,
+        vnodes: int = 64,
+        poll_interval_s: float = 1.0,
+        poll_timeout_s: float = 2.0,
+        overflow_depth: int = 4,
+        breaker_failures: int = 3,
+        breaker_open_s: float = 5.0,
+        retry_budget: float = 32.0,
+        retry_refill_per_s: float = 2.0,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        hedge: bool = True,
+        hedge_min_s: float = 0.25,
+        max_failovers: int = 3,
+        request_timeout_s: float = 600.0,
+        upstream_timeout_s: float = 30.0,
+        policy_mode: str = "affinity",
+        seed: int = 0,
+        replicas_dns: Optional[str] = None,
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.metrics = RouterMetrics(self.registry)
+        self.flight = flight
+        self._lock = threading.Lock()  # ring/replica-set membership
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self.drained = threading.Event()
+        self._active = 0  # in-flight client requests (drain watches this)
+        self._active_lock = threading.Lock()
+        self.ring = HashRing(vnodes=vnodes)
+        self.replicas: dict[str, ReplicaState] = {}
+        self.budget = RetryBudget(retry_budget, retry_refill_per_s)
+        self._backoff_base = backoff_base_s
+        self._backoff_max = backoff_max_s
+        self._hedge = hedge
+        self._hedge_min_s = hedge_min_s
+        self._max_failovers = max_failovers
+        self._timeout = request_timeout_s
+        self._upstream_timeout = upstream_timeout_s
+        self._poll_interval = poll_interval_s
+        self._poll_timeout = poll_timeout_s
+        self._breaker_failures = breaker_failures
+        self._breaker_open_s = breaker_open_s
+        self._ttft_rolling = _Rolling()
+        self._rng = random.Random(seed)
+        self._dns = replicas_dns
+        self.policy = RoutingPolicy(
+            self.ring,
+            self.replicas,
+            overflow_depth=overflow_depth,
+            prefix_block_tokens=prefix_block_tokens,
+            prefix_max_blocks=prefix_max_blocks,
+            mode=policy_mode,
+            seed=seed,
+        )
+        for name in replicas:
+            self.add_replica(name)
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] != "/generate":
+                    self.send_error(404)
+                    return
+                trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
+                if server._draining.is_set():
+                    self._reply(
+                        503,
+                        {"error": "router is draining", "trace_id": trace_id},
+                        trace_id,
+                        retry_after="1",
+                    )
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = list(body["prompt"])
+                    if not prompt:
+                        raise ValueError("empty prompt")
+                except (KeyError, TypeError, ValueError) as e:
+                    server.metrics.requests.inc(outcome="rejected")
+                    self._reply(
+                        400, {"error": f"bad request: {e}"}, trace_id
+                    )
+                    return
+                with server._active_lock:
+                    server._active += 1
+                try:
+                    if body.get("stream"):
+                        server._proxy_stream(self, body, prompt, trace_id)
+                    else:
+                        server._proxy_unary(self, body, prompt, trace_id)
+                finally:
+                    with server._active_lock:
+                        server._active -= 1
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    if server._draining.is_set():
+                        self._reply(503, {"status": "draining"})
+                        return
+                    reachable = sum(
+                        1 for s in server.replicas.values() if s.reachable
+                    )
+                    ok = reachable > 0 and not server._stop.is_set()
+                    self._reply(
+                        200 if ok else 503,
+                        {
+                            "status": "ok" if ok else "no reachable replicas",
+                            "replicas": len(server.replicas),
+                            "reachable": reachable,
+                        },
+                    )
+                elif path == "/metrics":
+                    server.metrics.retry_budget.set(server.budget.available())
+                    write_exposition(self, server.registry)
+                elif path == "/debug/router":
+                    self._reply(200, server.snapshot())
+                else:
+                    self.send_error(404)
+
+            def _reply(
+                self,
+                code: int,
+                obj: dict,
+                trace_id: Optional[str] = None,
+                retry_after: Optional[str] = None,
+            ) -> None:
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if trace_id:
+                    self.send_header("X-Request-Id", trace_id)
+                if retry_after:
+                    self.send_header("Retry-After", retry_after)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                except OSError:
+                    pass  # client vanished; nothing upstream to cancel
+
+            def log_message(self, *args):  # quiet under load
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- membership
+
+    def add_replica(self, name: str) -> None:
+        """Add one ``host:port`` replica to the ring and replica set
+        (idempotent).  Consistent hashing keeps existing placements for
+        all but ~1/K of the keyspace."""
+        with self._lock:
+            if name in self.replicas:
+                return
+            breaker = CircuitBreaker(
+                failure_threshold=self._breaker_failures,
+                open_s=self._breaker_open_s,
+                on_transition=lambda old, new, n=name: self._on_breaker(
+                    n, old, new
+                ),
+            )
+            self.replicas[name] = ReplicaState(name, breaker)
+            self.ring.add(name)
+        self.metrics.replica_up.set(1, replica=name)
+        self.metrics.breaker_state.set(STATE_VALUE["closed"], replica=name)
+        self._record("router.replica_added", replica=name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            if name not in self.replicas:
+                return
+            self.ring.remove(name)
+            del self.replicas[name]
+        self.metrics.drop_replica(name)
+        self._record("router.replica_removed", replica=name)
+
+    # ----------------------------------------------------------- wiring
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, **fields)
+
+    def _on_breaker(self, name: str, old: str, new: str) -> None:
+        self.metrics.breaker_transitions.inc(state=new)
+        self.metrics.breaker_state.set(STATE_VALUE[new], replica=name)
+        self._record(f"router.breaker_{new}", replica=name, previous=old)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # -------------------------------------------------------- poll loop
+
+    def _poll_once(self) -> None:
+        for name, st in list(self.replicas.items()):
+            if self._stop.is_set():
+                return
+            try:
+                with self.metrics.poll_seconds.time():
+                    conn = http.client.HTTPConnection(
+                        st.host, st.port, timeout=self._poll_timeout
+                    )
+                    try:
+                        conn.request("GET", "/debug/state?summary=1")
+                        resp = conn.getresponse()
+                        payload = json.loads(resp.read() or b"{}")
+                        if resp.status != 200:
+                            raise OSError(f"summary poll HTTP {resp.status}")
+                    finally:
+                        conn.close()
+            except (*_CONN_ERRORS, ValueError) as e:
+                if st.reachable:
+                    st.reachable = False
+                    self.metrics.replica_up.set(0, replica=name)
+                    self._record(
+                        "router.replica_down", replica=name, error=str(e)
+                    )
+                continue
+            if not st.reachable:
+                st.reachable = True
+                self.metrics.replica_up.set(1, replica=name)
+                self._record("router.replica_up", replica=name)
+            st.queue_depth = int(payload.get("queue_depth", 0))
+            st.active_slots = int(payload.get("active_slots", 0))
+            draining = bool(payload.get("draining", False))
+            if draining != st.draining:
+                self._mark_draining(name, draining)
+            st.last_poll = time.monotonic()
+            self.metrics.replica_queue_depth.set(
+                st.queue_depth, replica=name
+            )
+
+    def _mark_draining(self, name: str, draining: bool) -> None:
+        st = self.replicas.get(name)
+        if st is None or st.draining == draining:
+            return
+        st.draining = draining
+        self.metrics.replica_draining.set(1 if draining else 0, replica=name)
+        self._record(
+            "router.drain_begin" if draining else "router.drain_end",
+            replica=name,
+        )
+
+    def _refresh_dns(self) -> None:
+        """Re-resolve ``--replicas-dns`` (a headless Service name) and
+        reconcile ring membership — replicas scale without a router
+        restart, and consistent hashing keeps warm prefixes where they
+        are for the survivors."""
+        if not self._dns:
+            return
+        host, _, port = self._dns.rpartition(":")
+        try:
+            infos = socket.getaddrinfo(
+                host, int(port), socket.AF_INET, socket.SOCK_STREAM
+            )
+        except OSError as e:
+            self._record("router.dns_error", target=self._dns, error=str(e))
+            return
+        resolved = {f"{info[4][0]}:{info[4][1]}" for info in infos}
+        if not resolved:
+            return
+        current = set(self.replicas)
+        for name in resolved - current:
+            self.add_replica(name)
+        for name in current - resolved:
+            self.remove_replica(name)
+
+    def _poll_loop(self) -> None:
+        # Wait FIRST: start() already ran one synchronous poll, so the
+        # loop's job is the steady cadence, not an immediate re-poll.
+        while not self._stop.wait(self._poll_interval):
+            self._refresh_dns()
+            self._poll_once()
+
+    # ------------------------------------------------------ dispatching
+
+    def _dial(
+        self, name: str, payload: dict, trace_id: str, stream: bool
+    ) -> _Upstream:
+        """One upstream POST /generate.  Fires the per-replica
+        ``router.replica_conn`` failpoint first (the chaos seam: an
+        armed error here looks exactly like a dial failure).  Raises
+        ``_CONN_ERRORS`` / ``FailpointError`` on transport failure."""
+        failpoints.fire_scoped(FAILPOINT_CONN, name, replica=name)
+        st = self.replicas[name]
+        body = dict(payload)
+        body["stream"] = stream
+        conn = http.client.HTTPConnection(
+            st.host, st.port, timeout=self._upstream_timeout
+        )
+        try:
+            conn.request(
+                "POST",
+                "/generate",
+                json.dumps(body).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Request-Id": trace_id,
+                },
+            )
+            resp = conn.getresponse()
+        except BaseException:
+            conn.close()
+            raise
+        return _Upstream(name, conn, resp)
+
+    def _next_candidate(
+        self, prompt, exclude: set, attempt_index: int
+    ) -> Optional[tuple[str, str]]:
+        """(replica, placement) for the next dial, or None when nothing
+        is currently dialable.  Breaker acquisition happens HERE (it
+        consumes the half-open probe slot)."""
+        order, tag = self.policy.candidates(prompt)
+        for i, name in enumerate(order):
+            if name in exclude:
+                continue
+            st = self.replicas.get(name)
+            if st is None or not st.breaker.try_acquire():
+                continue
+            placement = tag if (i == 0 and attempt_index == 0) else FAILOVER
+            return name, placement
+        return None
+
+    def _backoff(self, attempt: int, retry_after: Optional[float]) -> float:
+        """Exponential backoff with full jitter, floored at the
+        strictest Retry-After a replica sent (the drain/overload
+        contract: the fleet told us when to come back)."""
+        exp = min(self._backoff_max, self._backoff_base * (2**attempt))
+        delay = self._rng.uniform(0, exp)
+        if retry_after is not None:
+            # Honor the fleet's Retry-After even past the backoff cap —
+            # the replicas told us when to come back.
+            delay = max(delay, retry_after)
+        return delay
+
+    def _classify(self, up: _Upstream) -> tuple[str, bytes, dict]:
+        """Read + classify a unary upstream response:
+        ``("ok"|"relay"|"draining"|"error", body, headers)``."""
+        resp = up.resp
+        data = resp.read()
+        headers = {
+            k: v
+            for k, v in resp.getheaders()
+            if k.lower() in ("content-type", "x-request-id", "retry-after")
+        }
+        if resp.status == 200:
+            return "ok", data, headers
+        if resp.status == 503:
+            # The begin_drain() contract: not a fault, a polite no.
+            return "draining", data, headers
+        if resp.status >= 500:
+            return "error", data, headers
+        # 4xx (validation) and 504 (the replica already timed the
+        # request out): deterministic verdicts retrying cannot change.
+        return "relay", data, headers
+
+    # ------------------------------------------------------------ unary
+
+    def _proxy_unary(self, handler, body, prompt, trace_id) -> None:
+        t0 = time.monotonic()
+        deadline = t0 + self._timeout
+        exclude: set = set()
+        retry_after: Optional[float] = None
+        attempt = 0
+        sleeps = 0
+        while time.monotonic() < deadline:
+            picked = self._next_candidate(prompt, exclude, attempt)
+            if picked is None:
+                if exclude:
+                    exclude.clear()  # everything failed once: start over
+                    continue
+                delay = self._backoff(sleeps, retry_after)
+                sleeps += 1
+                if time.monotonic() + delay >= deadline or sleeps > 16:
+                    break
+                time.sleep(delay)
+                retry_after = None
+                continue
+            name, placement = picked
+            if attempt > 0:
+                if not self.budget.try_spend():
+                    self._record(
+                        "router.retry_budget_exhausted", replica=name
+                    )
+                    break
+                self.metrics.retries.inc()
+                self._record("router.retry", replica=name, attempt=attempt)
+            st = self.replicas[name]
+            try:
+                result = self._dial_with_hedge(
+                    name, body, prompt, trace_id, exclude
+                )
+            except (failpoints.FailpointError, *_CONN_ERRORS) as e:
+                st.failures += 1
+                st.breaker.record_failure()
+                self._record(
+                    "router.dispatch_error", replica=name, error=str(e)
+                )
+                exclude.add(name)
+                attempt += 1
+                continue
+            up, winner_placement = result
+            kind, data, headers = self._classify(up)
+            up.close()
+            if kind == "draining":
+                ra = headers.get("Retry-After")
+                retry_after = float(ra) if ra else retry_after
+                self._mark_draining(up.name, True)
+                exclude.add(up.name)
+                # A polite 503 is not a breaker failure and not a retry:
+                # the replica is healthy, just leaving the rotation.
+                continue
+            if kind == "error":
+                st2 = self.replicas.get(up.name)
+                if st2 is not None:
+                    st2.failures += 1
+                    st2.breaker.record_failure()
+                self._record(
+                    "router.dispatch_error",
+                    replica=up.name,
+                    status=up.resp.status,
+                )
+                exclude.add(up.name)
+                attempt += 1
+                continue
+            # ok or relay: this is the client's answer.
+            st2 = self.replicas.get(up.name)
+            if st2 is not None:
+                st2.dispatches += 1
+                if kind == "ok":
+                    st2.breaker.record_success()
+            elapsed = time.monotonic() - t0
+            if kind == "ok":
+                self._ttft_rolling.add(elapsed)
+                self.metrics.ttft_seconds.observe(elapsed)
+                self.metrics.request_seconds.observe(elapsed)
+                self.metrics.placements.inc(
+                    placement=winner_placement or placement
+                )
+                self.metrics.requests.inc(outcome="ok")
+            else:
+                self.metrics.requests.inc(outcome="error")
+            handler.send_response(up.resp.status)
+            for key, value in headers.items():
+                if key.lower() != "x-request-id":
+                    handler.send_header(key, value)
+            handler.send_header("X-Request-Id", trace_id)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            try:
+                handler.wfile.write(data)
+            except OSError:
+                pass
+            return
+        self.metrics.requests.inc(outcome="timeout")
+        handler._reply(
+            503,
+            {"error": "no replica available", "trace_id": trace_id},
+            trace_id,
+            retry_after="1",
+        )
+
+    def _dial_with_hedge(
+        self, name, body, prompt, trace_id, exclude
+    ) -> tuple[_Upstream, Optional[str]]:
+        """Dial ``name``; when hedging is on and no response lands
+        within the rolling TTFT p99, race a second dispatch along the
+        ring.  Returns the winning upstream (loser closed) and its
+        placement override (``failover`` when the hedge won).  Raises
+        the primary's error when every leg fails."""
+        results: queue_mod.Queue = queue_mod.Queue()
+
+        def leg(leg_name: str):
+            try:
+                results.put((leg_name, self._dial(leg_name, body, trace_id, False), None))
+            except (failpoints.FailpointError, *_CONN_ERRORS) as e:
+                results.put((leg_name, None, e))
+
+        threading.Thread(
+            target=leg, args=(name,), name="router-dial", daemon=True
+        ).start()
+        in_flight = 1
+        hedged_name = None
+        p99 = self._ttft_rolling.quantile(0.99)
+        hedge_after = max(self._hedge_min_s, p99 if p99 else 0.0)
+        hedge_deadline = time.monotonic() + hedge_after
+        first_error: Optional[Exception] = None
+        while in_flight:
+            timeout = None
+            if self._hedge and hedged_name is None:
+                timeout = max(0.0, hedge_deadline - time.monotonic())
+            try:
+                leg_name, up, err = results.get(
+                    timeout=timeout if timeout is not None else self._upstream_timeout
+                )
+            except queue_mod.Empty:
+                if self._hedge and hedged_name is None:
+                    picked = self._next_candidate(
+                        prompt, exclude | {name}, 1
+                    )
+                    if picked is not None and self.budget.try_spend():
+                        hedged_name = picked[0]
+                        self._record(
+                            "router.hedge",
+                            replica=hedged_name,
+                            primary=name,
+                            after_s=round(hedge_after, 3),
+                        )
+                        threading.Thread(
+                            target=leg,
+                            args=(hedged_name,),
+                            name="router-hedge",
+                            daemon=True,
+                        ).start()
+                        in_flight += 1
+                    else:
+                        hedged_name = ""  # nothing to hedge with; stop trying
+                continue
+            in_flight -= 1
+            if err is not None:
+                st = self.replicas.get(leg_name)
+                if st is not None:
+                    st.failures += 1
+                    st.breaker.record_failure()
+                if leg_name == name:
+                    first_error = err
+                else:
+                    self.metrics.hedges.inc(result="lost")
+                continue
+            # First response wins; the loser leg (if still in flight)
+            # is drained and closed in the background — the losing
+            # replica sees a broken pipe and cancels its request.
+            if in_flight:
+                self._drain_legs(results, in_flight)
+            if hedged_name and leg_name == hedged_name:
+                self.metrics.hedges.inc(result="won")
+                self._record(
+                    "router.hedge_won", replica=leg_name, primary=name
+                )
+                return up, FAILOVER
+            if hedged_name and leg_name == name:
+                self.metrics.hedges.inc(result="lost")
+            return up, None
+        raise first_error if first_error is not None else OSError(
+            "all hedge legs failed"
+        )
+
+    def _drain_legs(self, results: queue_mod.Queue, n: int) -> None:
+        """Close the remaining hedge legs off-thread (their sockets must
+        not outlive the request, and the handler must not wait)."""
+
+        def drain():
+            for _ in range(n):
+                try:
+                    _, up, _err = results.get(
+                        timeout=self._upstream_timeout * 2
+                    )
+                except queue_mod.Empty:
+                    return
+                if up is not None:
+                    up.close()
+
+        threading.Thread(
+            target=drain, name="router-hedge-drain", daemon=True
+        ).start()
+
+    # ----------------------------------------------------------- stream
+
+    def _proxy_stream(self, handler, body, prompt, trace_id) -> None:
+        """SSE passthrough with zero-drop mid-stream failover.
+
+        Token events are re-emitted with a GLOBAL index (continuations
+        restart at 0 upstream); the final done event carries every
+        token the client was streamed.  A replica dying mid-stream
+        triggers resubmission of ``prompt + emitted`` with the
+        remaining budget to the next ring replica — the client stream
+        never breaks unless every replica is gone or the failover/retry
+        budget is spent."""
+        max_new = int(body.get("max_new_tokens", 16))
+        emitted: list = []
+        headers_sent = False
+        exclude: set = set()
+        failovers = 0
+        attempt = 0
+        sleeps = 0
+        retry_after: Optional[float] = None
+        t0 = time.monotonic()
+        deadline = t0 + self._timeout
+        first_token_at: Optional[float] = None
+
+        def client_error(message: str) -> None:
+            if headers_sent:
+                self._sse(handler, {"error": message, "trace_id": trace_id})
+            else:
+                handler._reply(
+                    503, {"error": message, "trace_id": trace_id}, trace_id,
+                    retry_after="1",
+                )
+
+        while True:
+            if time.monotonic() >= deadline:
+                self.metrics.requests.inc(outcome="timeout")
+                client_error("generation timed out")
+                return
+            picked = self._next_candidate(prompt, exclude, attempt)
+            if picked is None:
+                if exclude:
+                    exclude.clear()
+                    continue
+                delay = self._backoff(sleeps, retry_after)
+                sleeps += 1
+                if sleeps > 16 or time.monotonic() + delay >= deadline:
+                    self.metrics.requests.inc(outcome="error")
+                    client_error("no replica available")
+                    return
+                time.sleep(delay)
+                retry_after = None
+                continue
+            name, placement = picked
+            if attempt > 0:
+                if not self.budget.try_spend():
+                    self._record(
+                        "router.retry_budget_exhausted", replica=name
+                    )
+                    self.metrics.requests.inc(outcome="error")
+                    client_error("retry budget exhausted")
+                    return
+                if not emitted:
+                    self.metrics.retries.inc()
+                    self._record(
+                        "router.retry", replica=name, attempt=attempt
+                    )
+            attempt += 1
+            st = self.replicas[name]
+            upstream_body = dict(body)
+            upstream_body["prompt"] = prompt + emitted
+            upstream_body["max_new_tokens"] = max_new - len(emitted)
+            try:
+                up = self._dial(name, upstream_body, trace_id, True)
+            except (failpoints.FailpointError, *_CONN_ERRORS) as e:
+                st.failures += 1
+                st.breaker.record_failure()
+                self._record(
+                    "router.dispatch_error", replica=name, error=str(e)
+                )
+                exclude.add(name)
+                continue
+            if up.resp.status == 503:
+                ra = dict(up.resp.getheaders()).get("Retry-After")
+                retry_after = float(ra) if ra else retry_after
+                up.close()
+                self._mark_draining(name, True)
+                exclude.add(name)
+                continue
+            if up.resp.status != 200:
+                data = up.resp.read()
+                if headers_sent:
+                    up.close()
+                    self.metrics.requests.inc(outcome="error")
+                    client_error(f"replica HTTP {up.resp.status}")
+                    return
+                handler.send_response(up.resp.status)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("X-Request-Id", trace_id)
+                handler.send_header("Content-Length", str(len(data)))
+                handler.end_headers()
+                try:
+                    handler.wfile.write(data)
+                except OSError:
+                    pass
+                up.close()
+                self.metrics.requests.inc(outcome="error")
+                return
+            st.dispatches += 1
+            if not headers_sent:
+                handler.send_response(200)
+                handler.send_header("Content-Type", "text/event-stream")
+                handler.send_header("Cache-Control", "no-cache")
+                handler.send_header("X-Request-Id", trace_id)
+                handler.end_headers()
+                headers_sent = True
+                self.metrics.placements.inc(placement=placement)
+            done = False
+            try:
+                for event in self._iter_sse(up.resp):
+                    if event is None:  # heartbeat comment
+                        try:
+                            handler.wfile.write(b": ping\n\n")
+                            handler.wfile.flush()
+                        except OSError:
+                            up.close()
+                            return  # client vanished; upstream cancels
+                        continue
+                    if "token" in event:
+                        if first_token_at is None:
+                            first_token_at = time.monotonic()
+                            self._ttft_rolling.add(first_token_at - t0)
+                            self.metrics.ttft_seconds.observe(
+                                first_token_at - t0
+                            )
+                        out = dict(event)
+                        out["index"] = len(emitted)
+                        out["trace_id"] = trace_id
+                        emitted.append(event["token"])
+                        try:
+                            self._sse(handler, out)
+                        except OSError:
+                            up.close()
+                            return
+                        continue
+                    if event.get("done"):
+                        fin = dict(event)
+                        fin["tokens"] = list(emitted)
+                        fin["trace_id"] = trace_id
+                        if failovers:
+                            # Per-token logprobs cannot be stitched
+                            # across a failover; drop rather than lie.
+                            fin.pop("logprobs", None)
+                        try:
+                            self._sse(handler, fin)
+                        except OSError:
+                            pass
+                        done = True
+                        break
+                    if "error" in event:
+                        # The REPLICA gave up (its own request timeout):
+                        # a deterministic verdict, relayed not retried.
+                        out = dict(event)
+                        out["trace_id"] = trace_id
+                        try:
+                            self._sse(handler, out)
+                        except OSError:
+                            pass
+                        up.close()
+                        self.metrics.requests.inc(outcome="error")
+                        return
+            except (*_CONN_ERRORS, ValueError):
+                pass  # transport death mid-stream; handled below
+            up.close()
+            if done:
+                st.breaker.record_success()
+                elapsed = time.monotonic() - t0
+                self.metrics.request_seconds.observe(elapsed)
+                self.metrics.requests.inc(outcome="ok")
+                return
+            # Transport error or EOF before `done`: either way the
+            # replica died mid-stream.  Fail the stream over.
+            st.failures += 1
+            st.breaker.record_failure()
+            failovers += 1
+            if failovers > self._max_failovers:
+                self.metrics.requests.inc(outcome="error")
+                client_error("failover budget exhausted")
+                return
+            self.metrics.failovers.inc()
+            self._record(
+                "router.failover",
+                replica=name,
+                emitted=len(emitted),
+                remaining=max_new - len(emitted),
+            )
+            if len(emitted) >= max_new:
+                # Nothing left to generate: the death landed after the
+                # last token — finish the stream ourselves.
+                fin = {
+                    "done": True,
+                    "tokens": list(emitted),
+                    "trace_id": trace_id,
+                }
+                try:
+                    self._sse(handler, fin)
+                except OSError:
+                    pass
+                self.metrics.requests.inc(outcome="ok")
+                return
+            exclude.add(name)
+
+    @staticmethod
+    def _sse(handler, obj: dict) -> None:
+        handler.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+        handler.wfile.flush()
+
+    @staticmethod
+    def _iter_sse(resp):
+        """Yield parsed ``data:`` events from an upstream SSE response;
+        ``None`` for heartbeat comments.  Returns on EOF (the caller
+        decides whether that EOF was a clean close or a death)."""
+        while True:
+            line = resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(b":"):
+                yield None
+                continue
+            if line.startswith(b"data:"):
+                yield json.loads(line[5:].strip())
+
+    # -------------------------------------------------------- lifecycle
+
+    def snapshot(self) -> dict:
+        """JSON-safe router state for /debug/router."""
+        return {
+            "draining": self._draining.is_set(),
+            "active_requests": self._active,
+            "policy": {
+                "mode": self.policy.mode,
+                "overflow_depth": self.policy.overflow_depth,
+                "prefix_block_tokens": self.policy.prefix_block_tokens,
+                "prefix_max_blocks": self.policy.prefix_max_blocks,
+            },
+            "ring": self.ring.snapshot(),
+            "retry_budget": round(self.budget.available(), 2),
+            "retry_budget_spent": self.budget.spent_total,
+            "retry_budget_exhausted": self.budget.exhausted_total,
+            "replicas": {
+                name: st.snapshot() for name, st in self.replicas.items()
+            },
+        }
+
+    def start(self) -> "RouterServer":
+        self._poll_once()  # first poll before serving: no cold blind spot
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="router-poll", daemon=True
+        )
+        self._poll_thread.start()
+        self._http_thread = threading.Thread(
+            # 50ms shutdown poll (vs the 0.5s default): drains and test
+            # teardowns should not stall on the accept loop.
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="router-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        return self
+
+    def begin_drain(self, grace_s: float = 10.0) -> None:
+        """SIGTERM path: stop admitting (503 + Retry-After, /healthz →
+        draining), wait for in-flight proxied requests to finish (at
+        most ``grace_s``), then set :attr:`drained`.  Idempotent."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self._record("router.drain_begin_self", grace_s=grace_s)
+
+        def watch():
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                with self._active_lock:
+                    if self._active == 0:
+                        break
+                time.sleep(0.05)
+            self._record(
+                "router.drain_end_self", cut_requests=self._active
+            )
+            self.drained.set()
+
+        threading.Thread(
+            target=watch, name="router-drain", daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """Router daemon entry (`python -m k8s_device_plugin_tpu.router`):
+    deploy/k8s-deploy-router.yaml runs this in front of the serve
+    replicas."""
+    import argparse
+    import sys
+
+    from ..utils import flight as flight_mod
+
+    p = argparse.ArgumentParser(prog="tpu-serving-router")
+    p.add_argument(
+        "--replicas",
+        default="",
+        help="comma-separated host:port serving replicas (static set)",
+    )
+    p.add_argument(
+        "--replicas-dns",
+        default="",
+        help="name:port of a HEADLESS Service over the serving replicas: "
+        "A records are re-resolved every poll interval and ring "
+        "membership reconciled — replicas scale without a router restart",
+    )
+    p.add_argument("--http-port", type=int, default=8100)
+    p.add_argument(
+        "--prefix-block-tokens",
+        type=int,
+        default=16,
+        help="tokens per prefix block in the affinity key (match the "
+        "replicas' --page-size so one block is one KV page)",
+    )
+    p.add_argument(
+        "--prefix-blocks",
+        type=int,
+        default=4,
+        help="leading blocks hashed into the affinity key (the shared "
+        "system-prompt horizon; the unique tail stays out of the key)",
+    )
+    p.add_argument("--vnodes", type=int, default=64)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument(
+        "--overflow-depth",
+        type=int,
+        default=4,
+        help="queue-depth gap (home vs least-loaded) beyond which a "
+        "request overflows along the ring instead of joining the hot "
+        "shard",
+    )
+    p.add_argument("--breaker-failures", type=int, default=3)
+    p.add_argument("--breaker-open-s", type=float, default=5.0)
+    p.add_argument("--retry-budget", type=float, default=32.0)
+    p.add_argument("--retry-refill", type=float, default=2.0)
+    p.add_argument(
+        "--hedge",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="hedged dispatch for unary requests: when no response "
+        "lands within the rolling TTFT p99, race a second replica; "
+        "first response wins, loser cancelled (costs retry budget)",
+    )
+    p.add_argument("--hedge-min-s", type=float, default=0.25)
+    p.add_argument("--max-failovers", type=int, default=3)
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument(
+        "--policy",
+        choices=["affinity", "random"],
+        default="affinity",
+        help="random = uniform placement control (what the serving "
+        "benchmark diffs affinity against)",
+    )
+    p.add_argument("--drain-grace", type=float, default=10.0)
+    p.add_argument("--flight-ring", type=int, default=2048)
+    p.add_argument(
+        "--dump-dir", default=flight_mod.default_dump_dir() or ""
+    )
+    p.add_argument("--failpoints", default="")
+    args = p.parse_args(argv)
+    replicas = [r for r in args.replicas.split(",") if r]
+    if not replicas and not args.replicas_dns:
+        raise SystemExit("need --replicas and/or --replicas-dns")
+    box = flight_mod.register(
+        flight_mod.FlightRecorder(capacity=args.flight_ring, name="router")
+    )
+    flight_mod.install_dump_handlers(args.dump_dir or None)
+    failpoints.set_flight(box)
+    failpoints.arm_from_env()
+    if args.failpoints:
+        failpoints.arm_spec(args.failpoints)
+    server = RouterServer(
+        replicas,
+        port=args.http_port,
+        flight=box,
+        prefix_block_tokens=args.prefix_block_tokens,
+        prefix_max_blocks=args.prefix_blocks,
+        vnodes=args.vnodes,
+        poll_interval_s=args.poll_interval,
+        overflow_depth=args.overflow_depth,
+        breaker_failures=args.breaker_failures,
+        breaker_open_s=args.breaker_open_s,
+        retry_budget=args.retry_budget,
+        retry_refill_per_s=args.retry_refill,
+        hedge=bool(args.hedge),
+        hedge_min_s=args.hedge_min_s,
+        max_failovers=args.max_failovers,
+        request_timeout_s=args.request_timeout,
+        policy_mode=args.policy,
+        replicas_dns=args.replicas_dns or None,
+    ).start()
+
+    import signal
+
+    def _on_signal(signum, _frame):
+        print(
+            f"received {signal.Signals(signum).name}; draining "
+            f"(grace {args.drain_grace:.1f}s)",
+            file=sys.stderr,
+            flush=True,
+        )
+        server.begin_drain(args.drain_grace)
+        server.drained.wait(args.drain_grace + 1.0)
+        server.stop()
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_signal)
+    except ValueError:
+        pass
+    print(
+        f"routing on :{server.port} over {len(server.replicas)} replicas "
+        "(POST /generate, GET /healthz /metrics /debug/router)",
+        file=sys.stderr,
+        flush=True,
+    )
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -376,6 +376,23 @@ set_flight = DEFAULT.set_flight
 snapshot = DEFAULT.snapshot
 
 
+def fire_scoped(name: str, scope: str, **ctx) -> Optional[FailpointHit]:
+    """Fire a scoped site then its generic parent on :data:`DEFAULT`.
+
+    Call sites that fan out over dynamic peers (the router dials K
+    replicas through ONE code path) need per-peer arming without
+    minting K registry constants: ``fire_scoped("router.replica_conn",
+    "10.0.0.7:8000")`` fires ``router.replica_conn.10.0.0.7:8000``
+    first (arm it to fault ONE replica), then the bare
+    ``router.replica_conn`` (arm it to fault every dial).  An ``error``
+    arm on either raises before the other fires; ``ctx`` rides on both
+    trigger events.  Disarmed both ways it is still just two dict
+    truthiness checks."""
+    hit = DEFAULT.fire(f"{name}.{scope}", **ctx)
+    generic = DEFAULT.fire(name, scope=scope, **ctx)
+    return hit if hit is not None else generic
+
+
 def arm_from_env(environ=None) -> list[str]:
     """Arm :data:`DEFAULT` from ``TPU_FAILPOINTS`` (no-op when unset);
     returns the armed names.  Called by both CLI mains so a DaemonSet /

@@ -52,6 +52,7 @@ PLUGIN_TIER_FILES = {
     "test_native.py",
     "test_protocol.py",
     "test_resources.py",
+    "test_router.py",
     "test_server.py",
     "test_spans.py",
     "test_stress.py",
@@ -61,12 +62,18 @@ PLUGIN_TIER_FILES = {
 
 
 # Chaos scenario files MUST collect-but-deselect under tier-1 (`-m 'not
-# slow'`): the scenario suite drives multi-node fleets and loaded
-# engines for minutes, and tier-1 runs ~841s of its 870s hard timeout —
-# ONE unmarked scenario leaking into tier-1 would kill the run with no
-# report.  The guard fails COLLECTION (every run, not just tier-1) the
-# moment a chaos test is missing the `slow` marker.
+# slow'`): the scenario suite drives multi-node fleets, loaded engines,
+# and router fleets for minutes, and tier-1 runs ~841s of its 870s hard
+# timeout — ONE unmarked scenario leaking into tier-1 would kill the
+# run with no report.  The guard fails COLLECTION (every run, not just
+# tier-1) the moment a chaos test is missing the `slow` marker.  Any
+# file named test_chaos_*.py is guarded (the router scenarios of ISSUE 8
+# ride the same file today; a future split-out file is auto-covered).
 CHAOS_SCENARIO_FILES = {"test_chaos_scenarios.py"}
+
+
+def _is_chaos_file(base: str) -> bool:
+    return base in CHAOS_SCENARIO_FILES or base.startswith("test_chaos_")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -76,7 +83,7 @@ def pytest_collection_modifyitems(config, items):
         base = os.path.basename(str(item.fspath))
         if base in PLUGIN_TIER_FILES:
             item.add_marker(_pytest.mark.plugin)
-        if base in CHAOS_SCENARIO_FILES and not any(
+        if _is_chaos_file(base) and not any(
             m.name == "slow" for m in item.iter_markers()
         ):
             raise _pytest.UsageError(

@@ -10,9 +10,13 @@ allocation are testable hermetically.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
+import time
+import zlib
 from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
 
@@ -321,3 +325,251 @@ class FakeKubelet:
 
     def plugin_stub(self, endpoint: str | None = None) -> DevicePluginStub:
         return DevicePluginStub(self.plugin_channel(endpoint))
+
+
+# --- Fake serving replica (router tests) --------------------------------------
+
+FAKE_REPLICA_VOCAB = 50000
+
+
+def fake_next_token(seq) -> int:
+    """Deterministic next token as a pure function of the WHOLE sequence
+    so far (prompt + generated).  The property the router's mid-stream
+    failover leans on: resubmitting ``prompt + emitted`` to any other
+    replica continues the exact same token stream — a test can assert a
+    failed-over stream is bit-identical to an undisturbed one."""
+    blob = ",".join(str(int(t)) for t in seq).encode()
+    return zlib.crc32(blob) % FAKE_REPLICA_VOCAB + 2
+
+
+def fake_generate(prompt, n: int) -> list[int]:
+    """The full expected generation for ``prompt`` — the oracle every
+    router test checks streams against."""
+    seq = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = fake_next_token(seq)
+        seq.append(t)
+        out.append(t)
+    return out
+
+
+class FakeReplica:
+    """In-process double of models/http_server.EngineServer for router
+    tests: token-level ``POST /generate`` (unary + SSE streaming with a
+    configurable inter-token delay), the ``/debug/state?summary=1``
+    summary the router polls, ``/healthz``, and the ``begin_drain()``
+    503+Retry-After contract — plus what no real server offers a test:
+    :meth:`kill`, an ABRUPT death (every live socket reset mid-write,
+    the server gone) that looks to the router exactly like a replica
+    pod being OOM-killed mid-decode.
+
+    Tokens come from :func:`fake_next_token`, so streams are
+    deterministic and failover continuations are checkable against
+    :func:`fake_generate`.  jax-free, compile-free.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token_delay_s: float = 0.0,
+        prefill_delay_s: float = 0.0,
+    ):
+        self.token_delay_s = token_delay_s
+        self.prefill_delay_s = prefill_delay_s
+        self._draining = threading.Event()
+        self.retry_after = "1"
+        self.killed = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self.generate_requests = 0  # every /generate that got past drain
+        self.drain_rejects = 0  # 503s answered while draining
+        self.active_streams = 0
+        self.seen_trace_ids: list = []
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def setup(self):
+                super().setup()
+                with replica._lock:
+                    replica._conns.add(self.connection)
+
+            def finish(self):
+                with replica._lock:
+                    replica._conns.discard(self.connection)
+                try:
+                    super().finish()
+                except OSError:
+                    pass  # killed mid-flight
+
+            def do_POST(self):  # noqa: N802
+                if self.path.split("?")[0] != "/generate":
+                    self.send_error(404)
+                    return
+                trace_id = self.headers.get("X-Request-Id") or ""
+                if replica._draining.is_set():
+                    with replica._lock:
+                        replica.drain_rejects += 1
+                    body = json.dumps(
+                        {"error": "server is draining", "trace_id": trace_id}
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", replica.retry_after)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = [int(t) for t in body["prompt"]]
+                max_new = int(body.get("max_new_tokens", 16))
+                stream = bool(body.get("stream", False))
+                with replica._lock:
+                    replica.generate_requests += 1
+                    replica.seen_trace_ids.append(trace_id)
+                rid = replica.generate_requests
+                if replica.prefill_delay_s:
+                    time.sleep(replica.prefill_delay_s)
+                if not stream:
+                    tokens = []
+                    seq = list(prompt)
+                    for _ in range(max_new):
+                        if replica.token_delay_s:
+                            time.sleep(replica.token_delay_s)
+                        t = fake_next_token(seq)
+                        seq.append(t)
+                        tokens.append(t)
+                    out = json.dumps(
+                        {"tokens": tokens, "rid": rid, "trace_id": trace_id}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("X-Request-Id", trace_id)
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Request-Id", trace_id)
+                self.end_headers()
+                with replica._lock:
+                    replica.active_streams += 1
+                try:
+                    seq = list(prompt)
+                    tokens = []
+                    for i in range(max_new):
+                        if replica.token_delay_s:
+                            time.sleep(replica.token_delay_s)
+                        t = fake_next_token(seq)
+                        seq.append(t)
+                        tokens.append(t)
+                        ev = {"token": t, "index": i, "rid": rid,
+                              "trace_id": trace_id}
+                        self.wfile.write(
+                            f"data: {json.dumps(ev)}\n\n".encode()
+                        )
+                        self.wfile.flush()
+                    fin = {"done": True, "tokens": tokens, "rid": rid,
+                           "trace_id": trace_id}
+                    self.wfile.write(f"data: {json.dumps(fin)}\n\n".encode())
+                    self.wfile.flush()
+                except OSError:
+                    pass  # client (the router) went away / kill()
+                finally:
+                    with replica._lock:
+                        replica.active_streams -= 1
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if path == "/debug/state":
+                    with replica._lock:
+                        active = replica.active_streams
+                    self._json(200, {
+                        "queue_depth": active,  # the fake has no queue
+                        "active_slots": active,
+                        "draining": replica._draining.is_set(),
+                        "loop_alive": True,
+                    })
+                elif path == "/healthz":
+                    if replica._draining.is_set():
+                        self._json(503, {"status": "draining"})
+                    else:
+                        self._json(200, {"status": "ok"})
+                else:
+                    self.send_error(404)
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def name(self) -> str:
+        """The router-facing ``host:port`` replica name."""
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "FakeReplica":
+        self._thread = threading.Thread(
+            # 50ms shutdown poll: tests tear fleets down constantly and
+            # the default 0.5s poll would dominate the suite's wall clock.
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="fake-replica",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    # --- the EngineServer drain contract ---
+    def begin_drain(self, retry_after: str = "1") -> None:
+        """New /generate answers 503+Retry-After, /healthz and the
+        summary flip to draining; streams already in flight keep
+        running to completion — exactly EngineServer.begin_drain()."""
+        self.retry_after = retry_after
+        self._draining.set()
+
+    def undrain(self) -> None:
+        self._draining.clear()
+
+    # --- chaos ---
+    def kill(self) -> None:
+        """Abrupt death: reset every live connection (streams cut
+        mid-token) and stop serving — the replica-pod-OOM shape the
+        router's mid-stream failover exists for."""
+        self.killed.set()
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(2)  # SHUT_RDWR: readers see reset NOW
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self.stop()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

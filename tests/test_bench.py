@@ -320,3 +320,58 @@ def test_bench_diff_parses_tp_block(tmp_path):
     (tmp_path / "c.json").write_text(json.dumps(tp))
     c = bench_diff.load_record(str(tmp_path / "c.json"))
     assert "DIVERGED" in bench_diff.ledger_row(a, c)
+
+
+def test_bench_diff_parses_router_block(tmp_path):
+    """Serving records grew a ROUTER block (ISSUE 8): replica count,
+    affinity vs random-control KV hit rates and TTFT p99, home rate,
+    and dropped streams must surface in the normalized record, the
+    field diff, and the ledger row — the affinity hit rate collapsing
+    toward the control (or any dropped stream) is the regression tell."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 7,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    routed = json.loads(json.dumps(base))
+    routed["n"] = 8
+    routed["parsed"]["router"] = {
+        "replicas": 2, "requests": 32, "sessions": 4,
+        "affinity": {"prefix_hits": 96, "hit_rate": 3.0,
+                     "ttft_p99_ms": 41.5, "home_rate": 0.97,
+                     "dropped": 0, "failovers": 0, "retries": 0},
+        "random": {"prefix_hits": 16, "hit_rate": 0.5,
+                   "ttft_p99_ms": 63.2, "home_rate": 0.0,
+                   "dropped": 0, "failovers": 0, "retries": 0},
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(routed))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["router_replicas"] == 2
+    assert b["router_affinity_hit_rate"] == 3.0
+    assert b["router_affinity_ttft_p99_ms"] == 41.5
+    assert b["router_home_rate"] == 0.97
+    assert b["router_random_hit_rate"] == 0.5
+    assert b["router_random_ttft_p99_ms"] == 63.2
+    assert b["router_dropped"] == 0
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "router_affinity_hit_rate" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "router K=2" in row and "3.0 hits/req" in row
+    assert "vs random 0.5" in row
+    assert "DROPPED" not in row  # zero drops stay quiet
+    # Any dropped stream screams in the row.
+    routed["parsed"]["router"]["affinity"]["dropped"] = 2
+    (tmp_path / "c.json").write_text(json.dumps(routed))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "DROPPED 2" in bench_diff.ledger_row(a, c)

@@ -570,3 +570,378 @@ def test_chaos_attribution_drift(tmp_path):
     assert ungranted["precision"] == 1.0, score  # clean nodes stayed clean
     assert unfulfilled["precision"] == 1.0, score
     assert slo["pass"], slo
+
+
+# ======================================================================
+# Scenario 5: router replica kill mid-decode under burst traffic
+# ======================================================================
+
+
+def _router_fleet(n, token_delay_s=0.03, **router_kwargs):
+    """n FakeReplicas + a flight-wired RouterServer (jax-free)."""
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    from tests.fakes import FakeReplica
+
+    replicas = [
+        FakeReplica(token_delay_s=token_delay_s).start() for _ in range(n)
+    ]
+    flight = FlightRecorder(capacity=8192, name="chaos-router")
+    kwargs = dict(
+        poll_interval_s=0.15,
+        breaker_failures=2,
+        breaker_open_s=0.5,
+        backoff_base_s=0.02,
+        backoff_max_s=0.3,
+        hedge=False,
+        upstream_timeout_s=15.0,
+        request_timeout_s=60.0,
+    )
+    kwargs.update(router_kwargs)
+    router = RouterServer(
+        [r.name for r in replicas],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        **kwargs,
+    ).start()
+    return replicas, router, flight
+
+
+def _router_kill_detections(flight, kinds=("router.replica_down",
+                                           "router.breaker_open",
+                                           "router.failover")):
+    """Router flight events that constitute a replica-kill detection,
+    keyed by replica so clean replicas score the precision control."""
+    return [
+        {"cls": "replica_kill", "replica": e["replica"], "ts": e["ts"]}
+        for e in flight.snapshot()["events"]
+        if e["kind"] in kinds
+    ]
+
+
+def test_chaos_router_replica_kill_mid_decode(tmp_path):
+    """Kill one of 3 simulated replicas mid-decode under burst traffic
+    (the acceptance scenario): ZERO client-visible dropped streams —
+    every stream completes bit-identically via failover — the victim's
+    breaker trips and, after the replica comes back, recovers; the
+    injected kill scores precision/recall 1.0 against router flight
+    events with the two clean replicas as the control."""
+    from tests.fakes import FakeReplica, fake_generate
+    from tests.sim.fleet import wait_until
+    from tests.sim.traffic import RouterTraffic
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(3)
+    try:
+        traffic = RouterTraffic(
+            "127.0.0.1", router.port,
+            seed=11, sessions=5, prefix_len=32,
+            expected_fn=fake_generate,
+        )
+        thread, holder = traffic.run_in_thread(
+            72, concurrency=6, max_new=(8, 14), timeout_s=60.0
+        )
+        # Let the burst ramp, then kill a replica WHILE it decodes.
+        assert wait_until(
+            lambda: any(r.active_streams > 0 for r in replicas), timeout=10
+        ), "traffic never put a stream in flight"
+        time.sleep(0.8)
+        victim = max(replicas, key=lambda r: r.active_streams)
+        victim_name = victim.name
+        t0 = time.time()
+        in_flight_at_kill = victim.active_streams
+        victim.kill()
+        injected = [{
+            "cls": "replica_kill", "replica": victim_name,
+            "t0": t0, "t1": t0 + 3.0,
+        }]
+        # The "pod restart": a fresh replica on the same address.
+        time.sleep(1.2)
+        revived = FakeReplica(
+            port=int(victim_name.rsplit(":", 1)[1]), token_delay_s=0.03
+        ).start()
+        replicas.append(revived)
+        thread.join(timeout=90)
+        report = holder[0]
+        assert report is not None, "traffic replay never finished"
+        # Recovery: poll sees the revived replica; traffic homed on it
+        # drives the half-open probe so the breaker CLOSES again.
+        assert wait_until(
+            lambda: router.replicas[victim_name].reachable, timeout=5
+        ), "revived replica never polled back up"
+        for salt in range(200, 240):
+            prompt = [salt] * 32
+            if router.ring.order(router.policy.key_of(prompt))[0] != (
+                victim_name
+            ):
+                continue
+            import urllib.request as _url
+
+            req = _url.Request(
+                f"http://127.0.0.1:{router.port}/generate",
+                data=json.dumps(
+                    {"prompt": prompt, "max_new_tokens": 2}
+                ).encode(),
+                method="POST",
+            )
+            _url.urlopen(req, timeout=15).read()
+            if router.replicas[victim_name].breaker.state == "closed":
+                break
+        detected = _router_kill_detections(flight)
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        kill = score["per_class"]["replica_kill"]
+        breaker_state = router.replicas[victim_name].breaker.state
+        slo = {
+            "targets": {"dropped_streams": 0},
+            "measured": {
+                "dropped_streams": report.dropped,
+                "in_flight_at_kill": in_flight_at_kill,
+                "failovers": router.metrics.failovers.value(),
+                "breaker_state_after_recovery": breaker_state,
+                "traffic": report.as_dict(),
+            },
+            "pass": report.dropped == 0,
+        }
+        result = {
+            "scenario": "router_replica_kill_mid_decode", "replicas": 3,
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": (
+                kill["precision"] == 1.0 and kill["recall"] == 1.0
+                and report.dropped == 0
+            ),
+        }
+        _publish(result)
+        # THE contract: zero client-visible dropped streams — every
+        # submitted stream completed (bit-identical per expected_fn).
+        assert report.dropped == 0, report.as_dict()
+        assert report.completed == report.submitted, report.as_dict()
+        assert in_flight_at_kill > 0, "kill landed on an idle replica"
+        assert router.metrics.failovers.value() >= 1
+        # Breaker tripped on the kill and recovered after the restart.
+        kinds = {e["kind"] for e in flight.snapshot()["events"]}
+        assert "router.breaker_open" in kinds
+        assert breaker_state == "closed", breaker_state
+        # Measured detector quality: p/r 1.0, clean replicas silent.
+        assert kill["recall"] == 1.0, score
+        assert kill["precision"] == 1.0, score
+        clean = {r.name for r in replicas[:3]} - {victim_name}
+        assert not [d for d in detected if d["replica"] in clean], detected
+    finally:
+        _teardown_router(replicas, router)
+
+
+def _teardown_router(replicas, router):
+    router.stop()
+    for r in replicas:
+        if not r.killed.is_set():
+            r.stop()
+
+
+# ======================================================================
+# Scenario 6: drain-aware rollout through the router
+# ======================================================================
+
+
+def test_chaos_router_drain_rollout(tmp_path):
+    """Drain one of 3 replicas under traffic (the rolling-update shape):
+    the router stops NEW assignments the moment it learns of the drain
+    (503 or summary poll) while the draining replica's in-flight streams
+    run to completion; the drain scores p/r 1.0 against the router's
+    drain_begin events; nothing drops; the undrained replica rejoins."""
+    from tests.fakes import fake_generate
+    from tests.sim.fleet import wait_until
+    from tests.sim.traffic import RouterTraffic
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(3)
+    try:
+        traffic = RouterTraffic(
+            "127.0.0.1", router.port,
+            seed=23, sessions=5, prefix_len=32,
+            expected_fn=fake_generate,
+        )
+        thread, holder = traffic.run_in_thread(
+            60, concurrency=6, max_new=(8, 14), timeout_s=60.0
+        )
+        assert wait_until(
+            lambda: sum(r.active_streams for r in replicas) > 0, timeout=10
+        )
+        time.sleep(0.6)
+        victim = max(replicas, key=lambda r: r.generate_requests)
+        t0 = time.time()
+        victim.begin_drain(retry_after="0.5")
+        injected = [{
+            "cls": "drain", "replica": victim.name, "t0": t0, "t1": t0 + 2.0,
+        }]
+        assert wait_until(
+            lambda: router.replicas[victim.name].draining, timeout=3
+        ), "router never observed the drain"
+        detect_latency = time.time() - t0
+        served_at_detect = victim.generate_requests
+        streams_at_detect = victim.active_streams
+        thread.join(timeout=90)
+        report = holder[0]
+        assert report is not None
+        # No NEW assignment after detection (the 503 contract means a
+        # few requests may have bounced off the drain BEFORE the poll
+        # noticed — those retried elsewhere; none LANDED).
+        assert victim.generate_requests == served_at_detect
+        # Undrain: the replica rejoins the rotation.
+        victim.undrain()
+        assert wait_until(
+            lambda: not router.replicas[victim.name].draining, timeout=3
+        )
+        detected = [
+            {"cls": "drain", "replica": e["replica"], "ts": e["ts"]}
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "router.drain_begin"
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        drain = score["per_class"]["drain"]
+        slo = {
+            "targets": {
+                "dropped_streams": 0,
+                "drain_detect_s": 0.15 + 1.0,  # poll interval + slack
+            },
+            "measured": {
+                "dropped_streams": report.dropped,
+                "drain_detect_s": round(detect_latency, 3),
+                "streams_in_flight_at_detect": streams_at_detect,
+                "drain_rejects": victim.drain_rejects,
+                "traffic": report.as_dict(),
+            },
+            "pass": report.dropped == 0 and detect_latency <= 1.15,
+        }
+        result = {
+            "scenario": "router_drain_rollout", "replicas": 3,
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": (
+                drain["precision"] == 1.0 and drain["recall"] == 1.0
+                and report.dropped == 0
+            ),
+        }
+        _publish(result)
+        assert report.dropped == 0, report.as_dict()
+        assert report.completed == report.submitted
+        assert drain["recall"] == 1.0, score
+        assert drain["precision"] == 1.0, score
+        assert slo["pass"], slo
+    finally:
+        _teardown_router(replicas, router)
+
+
+# ======================================================================
+# Scenario 7: breaker trip via the replica-conn failpoint
+# ======================================================================
+
+
+def test_chaos_router_breaker_trip_and_recovery(tmp_path):
+    """Arm the per-replica ``router.replica_conn.<name>`` failpoint
+    (error*6) against one of 3 replicas under traffic: dials to it fail
+    like a black-holed pod, the breaker trips open (scored p/r 1.0 on
+    the clean-replica control), requests fail over with zero drops, and
+    once the failpoint budget self-disarms the half-open probe closes
+    the breaker again."""
+    from k8s_device_plugin_tpu.utils import failpoints
+
+    from tests.fakes import fake_generate
+    from tests.sim.fleet import wait_until
+    from tests.sim.traffic import RouterTraffic
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(
+        3, breaker_failures=2, breaker_open_s=0.4
+    )
+    try:
+        failpoints.set_flight(flight)
+        traffic = RouterTraffic(
+            "127.0.0.1", router.port,
+            seed=31, sessions=5, prefix_len=32,
+            expected_fn=fake_generate,
+        )
+        thread, holder = traffic.run_in_thread(
+            60, concurrency=6, max_new=(6, 10), timeout_s=60.0
+        )
+        assert wait_until(
+            lambda: sum(r.generate_requests for r in replicas) > 4,
+            timeout=10,
+        )
+        victim = max(replicas, key=lambda r: r.generate_requests)
+        site = f"router.replica_conn.{victim.name}"
+        t0 = time.time()
+        failpoints.arm(site, "error", count=6)
+        wait_until(lambda: not failpoints.is_armed(site), timeout=20)
+        injected = [{
+            "cls": "conn_fault", "replica": victim.name,
+            "t0": t0, "t1": time.time(),
+        }]
+        thread.join(timeout=90)
+        report = holder[0]
+        assert report is not None
+        # Recovery: with the failpoint spent, traffic homed on the
+        # victim drives the half-open probe shut.
+        import urllib.request as _url
+
+        for salt in range(300, 340):
+            prompt = [salt] * 32
+            if router.ring.order(router.policy.key_of(prompt))[0] != (
+                victim.name
+            ):
+                continue
+            req = _url.Request(
+                f"http://127.0.0.1:{router.port}/generate",
+                data=json.dumps(
+                    {"prompt": prompt, "max_new_tokens": 2}
+                ).encode(),
+                method="POST",
+            )
+            _url.urlopen(req, timeout=15).read()
+            if router.replicas[victim.name].breaker.state == "closed":
+                break
+        detected = [
+            {"cls": "conn_fault", "replica": e["replica"], "ts": e["ts"]}
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "router.breaker_open"
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        fault = score["per_class"]["conn_fault"]
+        breaker_state = router.replicas[victim.name].breaker.state
+        slo = {
+            "targets": {"dropped_streams": 0},
+            "measured": {
+                "dropped_streams": report.dropped,
+                "failpoint_triggers": failpoints.DEFAULT.triggers(site),
+                "breaker_state_after_recovery": breaker_state,
+                "traffic": report.as_dict(),
+            },
+            "pass": report.dropped == 0,
+        }
+        result = {
+            "scenario": "router_breaker_trip", "replicas": 3,
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": (
+                fault["precision"] == 1.0 and fault["recall"] == 1.0
+                and report.dropped == 0
+            ),
+        }
+        _publish(result)
+        assert report.dropped == 0, report.as_dict()
+        assert report.completed == report.submitted
+        assert failpoints.DEFAULT.triggers(site) == 6  # injection ran dry
+        assert fault["recall"] == 1.0, score
+        assert fault["precision"] == 1.0, score
+        assert breaker_state == "closed", breaker_state
+        # The injected cause (failpoint.trigger) and the detected effect
+        # (breaker_open) share one forensic timeline.
+        kinds = {e["kind"] for e in flight.snapshot()["events"]}
+        assert "failpoint.trigger" in kinds
+        assert "router.breaker_open" in kinds
+    finally:
+        failpoints.disarm_all()
+        failpoints.set_flight(None)
+        _teardown_router(replicas, router)

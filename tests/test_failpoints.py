@@ -370,3 +370,21 @@ def test_chaos_suite_collects_and_is_slow_marked():
     marks = getattr(chaos, "pytestmark", None)
     marks = marks if isinstance(marks, list) else [marks]
     assert any(getattr(m, "name", None) == "slow" for m in marks)
+
+
+def test_fire_scoped_per_scope_and_generic():
+    """fire_scoped (the router's per-replica dial seam): arming the
+    scoped name faults ONE scope; arming the bare name faults every
+    scope; disarmed it is a no-op returning None."""
+    assert failpoints.fire_scoped("site.conn", "10.0.0.7:8000") is None
+    failpoints.arm("site.conn.10.0.0.7:8000", "error", count=1)
+    with pytest.raises(FailpointError):
+        failpoints.fire_scoped("site.conn", "10.0.0.7:8000")
+    # Other scopes untouched; the budget spent itself.
+    assert failpoints.fire_scoped("site.conn", "10.0.0.8:8000") is None
+    assert failpoints.fire_scoped("site.conn", "10.0.0.7:8000") is None
+    # The bare name catches every scope (flap: a returned hit).
+    failpoints.arm("site.conn", "flap")
+    hit = failpoints.fire_scoped("site.conn", "10.0.0.9:8000")
+    assert hit is not None and hit.mode == "flap"
+    failpoints.disarm_all()

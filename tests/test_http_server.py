@@ -729,3 +729,24 @@ def test_metrics_lint_clean_on_live_engine_server(served):
     spec.loader.exec_module(metrics_lint)
     errors = metrics_lint.lint_url(f"http://127.0.0.1:{server.port}/metrics")
     assert errors == [], errors
+
+
+def test_debug_state_summary_mode(served):
+    """/debug/state grew the router-poll surface: top-level queue_depth/
+    active_slots/draining ride the full snapshot, and ?summary=1 returns
+    ONLY those four scalars — no engine-lock snapshot, no span ring —
+    so a K-replica poll fan-in costs the fleet ~nothing."""
+    _, _, server = served
+    full = _get_json(server.port, "/debug/state")
+    assert full["queue_depth"] == 0
+    assert full["active_slots"] == 0
+    assert full["draining"] is False
+    assert full["loop_alive"] is True
+    assert "engine" in full and "spans" in full
+    summary = _get_json(server.port, "/debug/state?summary=1")
+    assert summary == {
+        "queue_depth": 0,
+        "active_slots": 0,
+        "draining": False,
+        "loop_alive": True,
+    }

@@ -1,0 +1,696 @@
+"""Prefix-affinity router (k8s_device_plugin_tpu/router/): tier-1 suite.
+
+Everything here runs against FakeReplica doubles (tests/fakes.py) —
+deterministic token streams, real sockets, zero JIT compiles, no jax
+import — so the whole fault-handling surface (ring placement, breaker
+state machine, retry budget, drain contract, hedging, mid-stream
+failover) gets exercised in seconds inside the plugin tier.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_device_plugin_tpu.router.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    RetryBudget,
+)
+from k8s_device_plugin_tpu.router.policy import (
+    HOME,
+    OVERFLOW,
+    ReplicaState,
+    RoutingPolicy,
+)
+from k8s_device_plugin_tpu.router.ring import HashRing, prefix_key
+from k8s_device_plugin_tpu.router.server import RouterServer
+from k8s_device_plugin_tpu.utils import failpoints
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+from tests.fakes import FakeReplica, fake_generate
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_metrics_lint():
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(REPO_ROOT, "tools", "metrics_lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def wait_until(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ======================================================================
+# Ring + prefix keys (pure)
+# ======================================================================
+
+
+def test_prefix_key_shared_prefix_collapses():
+    """Prompts sharing their leading blocks key identically regardless
+    of tails or trailing partial blocks — the property that routes one
+    session's requests to one replica's warm KV."""
+    prefix = list(range(100, 164))  # 4 x 16-token blocks
+    k1 = prefix_key(prefix + [1, 2, 3])
+    k2 = prefix_key(prefix + [9, 9, 9, 9, 9])
+    k3 = prefix_key(prefix)
+    assert k1 == k2 == k3
+    # A different prefix keys elsewhere; a short prompt still keys.
+    assert prefix_key([7] * 64) != k1
+    assert isinstance(prefix_key([3]), int)
+    # Only the first max_blocks blocks count.
+    assert prefix_key(prefix + list(range(64))) == k1
+
+
+def test_prefix_key_partial_block_rounds_down():
+    """>= one block: trailing partial blocks are dropped (a 35-token
+    prompt keys on its first 32 tokens), so near-identical prompts
+    differing past the block boundary stay co-located."""
+    base = list(range(32))
+    assert prefix_key(base + [1, 2, 3], block_tokens=16) == prefix_key(
+        base, block_tokens=16
+    )
+    # Below one block the whole prompt is the key.
+    assert prefix_key([1, 2], block_tokens=16) != prefix_key(
+        [1, 3], block_tokens=16
+    )
+
+
+def test_ring_deterministic_and_minimal_remapping():
+    nodes = [f"10.0.0.{i}:8000" for i in range(4)]
+    r1 = HashRing(nodes, vnodes=64)
+    r2 = HashRing(list(reversed(nodes)), vnodes=64)
+    keys = [prefix_key([i, i * 3, i + 7]) for i in range(2000)]
+    # Construction order is irrelevant: same members, same placements.
+    assert [r1.lookup(k) for k in keys] == [r2.lookup(k) for k in keys]
+    # Adding a node remaps ~1/5 of keys, every one of them TO the new
+    # node; removing it restores the original placement exactly.
+    grown = HashRing(nodes + ["10.0.0.9:8000"], vnodes=64)
+    moved = [k for k in keys if grown.lookup(k) != r1.lookup(k)]
+    assert 0.10 < len(moved) / len(keys) < 0.35
+    assert all(grown.lookup(k) == "10.0.0.9:8000" for k in moved)
+    grown.remove("10.0.0.9:8000")
+    assert [grown.lookup(k) for k in keys] == [r1.lookup(k) for k in keys]
+
+
+def test_ring_order_is_distinct_failover_sequence():
+    nodes = [f"n{i}:1" for i in range(5)]
+    ring = HashRing(nodes, vnodes=32)
+    key = prefix_key([42] * 32)
+    order = ring.order(key)
+    assert sorted(order) == sorted(nodes)  # every node, exactly once
+    assert order[0] == ring.lookup(key)
+    assert ring.order(key, limit=2) == order[:2]
+    # Stable across instances (routers must agree without shared state).
+    assert HashRing(nodes, vnodes=32).order(key) == order
+
+
+# ======================================================================
+# Breaker + retry budget (pure, injected clocks)
+# ======================================================================
+
+
+def test_breaker_state_machine_trip_probe_close():
+    clock = [0.0]
+    transitions = []
+    cb = CircuitBreaker(
+        failure_threshold=3,
+        open_s=10.0,
+        clock=lambda: clock[0],
+        on_transition=lambda old, new: transitions.append((old, new)),
+    )
+    assert cb.state == CLOSED and cb.try_acquire()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == CLOSED  # below threshold
+    cb.record_failure()
+    assert cb.state == OPEN
+    assert not cb.try_acquire()  # cooldown running
+    clock[0] = 10.1
+    assert cb.try_acquire()  # the half-open probe
+    assert cb.state == HALF_OPEN
+    assert not cb.try_acquire()  # ONE probe at a time
+    cb.record_success()
+    assert cb.state == CLOSED
+    assert transitions == [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED),
+    ]
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    clock = [0.0]
+    cb = CircuitBreaker(
+        failure_threshold=1, open_s=5.0, clock=lambda: clock[0]
+    )
+    cb.record_failure()
+    assert cb.state == OPEN
+    clock[0] = 5.1
+    assert cb.try_acquire()
+    cb.record_failure()  # probe failed
+    assert cb.state == OPEN
+    clock[0] = 9.0  # old cooldown would have expired; the fresh one hasn't
+    assert not cb.try_acquire()
+    clock[0] = 10.2
+    assert cb.try_acquire()
+
+
+def test_breaker_success_resets_consecutive_failures():
+    cb = CircuitBreaker(failure_threshold=2, open_s=1.0)
+    cb.record_failure()
+    cb.record_success()
+    cb.record_failure()
+    assert cb.state == CLOSED  # never two CONSECUTIVE failures
+
+
+def test_retry_budget_exhaustion_and_refill():
+    clock = [0.0]
+    budget = RetryBudget(capacity=2, refill_per_s=1.0, clock=lambda: clock[0])
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend()  # dry: degrade, don't amplify
+    assert budget.exhausted_total == 1
+    clock[0] = 1.5
+    assert budget.try_spend()  # refilled at 1 token/s
+    assert not budget.try_spend()
+    clock[0] = 100.0
+    assert budget.available() == pytest.approx(2.0)  # capped at capacity
+
+
+# ======================================================================
+# Policy (pure, stub states)
+# ======================================================================
+
+
+def _policy(names, mode="affinity", overflow_depth=4):
+    ring = HashRing(names, vnodes=32)
+    states = {
+        n: ReplicaState(n, CircuitBreaker(failure_threshold=3, open_s=5.0))
+        for n in names
+    }
+    return RoutingPolicy(
+        ring, states, overflow_depth=overflow_depth, mode=mode
+    ), states
+
+
+def test_policy_home_then_ring_failover_order():
+    policy, _ = _policy(["a:1", "b:1", "c:1"])
+    prompt = [5] * 32
+    order, tag = policy.candidates(prompt)
+    assert tag == HOME
+    assert order == policy.ring.order(policy.key_of(prompt))
+
+
+def test_policy_excludes_draining_demotes_unreachable():
+    policy, states = _policy(["a:1", "b:1", "c:1"])
+    prompt = [5] * 32
+    home = policy.candidates(prompt)[0][0]
+    states[home].draining = True
+    order, _ = policy.candidates(prompt)
+    assert home not in order  # draining: NO new assignments, ever
+    states[home].draining = False
+    states[home].reachable = False
+    order, _ = policy.candidates(prompt)
+    assert order[-1] == home  # stale-poll hedge: last resort, not gone
+
+
+def test_policy_overflow_rotates_off_hot_shard():
+    policy, states = _policy(["a:1", "b:1", "c:1"], overflow_depth=3)
+    prompt = [5] * 32
+    ring_order = policy.ring.order(policy.key_of(prompt))
+    home = ring_order[0]
+    states[home].queue_depth = 10  # every other replica idle
+    order, tag = policy.candidates(prompt)
+    assert tag == OVERFLOW
+    assert order[0] != home
+    # Below the gap the home keeps its traffic (affinity beats a small
+    # imbalance — that is the point of the threshold).
+    states[home].queue_depth = 2
+    order, tag = policy.candidates(prompt)
+    assert tag == HOME and order[0] == home
+
+
+def test_policy_random_mode_spreads_over_eligible():
+    policy, _ = _policy(["a:1", "b:1", "c:1"], mode="random")
+    prompt = [5] * 32
+    firsts = {policy.candidates(prompt)[0][0] for _ in range(64)}
+    assert firsts == {"a:1", "b:1", "c:1"}  # uniform control, not sticky
+
+
+# ======================================================================
+# End-to-end against FakeReplicas
+# ======================================================================
+
+
+def _fleet(n, router_kwargs=None, **replica_kwargs):
+    """n started FakeReplicas + a started RouterServer over them."""
+    replicas = [FakeReplica(**replica_kwargs).start() for _ in range(n)]
+    flight = FlightRecorder(capacity=2048, name="router-test")
+    kwargs = dict(
+        poll_interval_s=0.1,
+        breaker_open_s=0.3,
+        backoff_base_s=0.02,
+        backoff_max_s=0.2,
+        hedge=False,
+        upstream_timeout_s=10.0,
+        request_timeout_s=30.0,
+    )
+    kwargs.update(router_kwargs or {})
+    router = RouterServer(
+        [r.name for r in replicas],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        **kwargs,
+    ).start()
+    return replicas, router, flight
+
+
+def _teardown(replicas, router):
+    router.stop()
+    for r in replicas:
+        if not r.killed.is_set():
+            r.stop()
+
+
+def _post(port, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _stream(port, payload, timeout=30):
+    """(events, tokens) from one SSE request through the router."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    payload = dict(payload, stream=True)
+    conn.request(
+        "POST", "/generate", json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    events = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        event = json.loads(line[5:].strip())
+        events.append(event)
+        if event.get("done") or "error" in event:
+            break
+    conn.close()
+    tokens = [e["token"] for e in events if "token" in e]
+    return events, tokens
+
+
+def _home_prompt(router, replica_name, base=0, length=32):
+    """A prompt whose ring home is ``replica_name``."""
+    for salt in range(base, base + 500):
+        prompt = [salt + 2] * length
+        if router.ring.order(router.policy.key_of(prompt))[0] == replica_name:
+            return prompt
+    raise AssertionError(f"no prompt homes on {replica_name}")
+
+
+def test_unary_roundtrip_affinity_sticky_and_correct():
+    replicas, router, _ = _fleet(3)
+    try:
+        prompt = [11, 12, 13, 14]
+        expect = fake_generate(prompt, 6)
+        counts_before = [r.generate_requests for r in replicas]
+        for _ in range(5):
+            got = _post(router.port, {"prompt": prompt, "max_new_tokens": 6})
+            assert got["tokens"] == expect
+        deltas = [
+            r.generate_requests - b
+            for r, b in zip(replicas, counts_before)
+        ]
+        # Affinity: every repeat landed on ONE replica.
+        assert sorted(deltas) == [0, 0, 5], deltas
+        assert router.metrics.placements.value(placement="home") == 5
+        assert router.metrics.requests.value(outcome="ok") == 5
+    finally:
+        _teardown(replicas, router)
+
+
+def test_stream_roundtrip_matches_oracle():
+    replicas, router, _ = _fleet(2, token_delay_s=0.002)
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        events, tokens = _stream(
+            router.port, {"prompt": prompt, "max_new_tokens": 8}
+        )
+        assert tokens == fake_generate(prompt, 8)
+        done = events[-1]
+        assert done["done"] and done["tokens"] == tokens
+        # Global indexes are contiguous from 0.
+        assert [e["index"] for e in events if "token" in e] == list(range(8))
+    finally:
+        _teardown(replicas, router)
+
+
+def test_unary_failover_on_dead_replica_and_breaker_trip():
+    replicas, router, flight = _fleet(
+        # Slow poll: the breaker (not the poll loop) must be what cuts
+        # the dead replica out of the dial path here.
+        3, router_kwargs=dict(breaker_failures=2, poll_interval_s=5.0)
+    )
+    try:
+        victim = replicas[0]
+        prompt = _home_prompt(router, victim.name)
+        victim.kill()
+        expect = fake_generate(prompt, 4)
+        for _ in range(3):
+            got = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+            assert got["tokens"] == expect  # failed over, same answer
+        # Two dial failures tripped the breaker; later requests skip the
+        # dead replica without dialing it (state visible in snapshot).
+        snap = router.snapshot()
+        assert snap["replicas"][victim.name]["breaker"]["state"] == "open"
+        kinds = {e["kind"] for e in flight.snapshot()["events"]}
+        assert "router.dispatch_error" in kinds
+        assert "router.breaker_open" in kinds
+        assert router.metrics.retries.value() >= 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_mid_stream_failover_zero_drop_bit_identical():
+    """THE zero-drop contract: kill the replica serving a stream
+    mid-decode; the client sees one uninterrupted, bit-identical token
+    stream completed by the failover replica (prompt + emitted tokens
+    resubmitted, remaining budget, deterministic continuation)."""
+    replicas, router, flight = _fleet(
+        2, token_delay_s=0.02, router_kwargs=dict(breaker_failures=1)
+    )
+    try:
+        victim = replicas[0]
+        survivor = replicas[1]
+        prompt = _home_prompt(router, victim.name)
+        n_new = 16
+        import threading
+
+        holder = [None]
+
+        def client():
+            holder[0] = _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": n_new}
+            )
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert wait_until(lambda: victim.active_streams > 0)
+        time.sleep(0.06)  # a few tokens into the decode
+        victim.kill()
+        t.join(timeout=20)
+        assert holder[0] is not None, "client stream never resolved"
+        events, tokens = holder[0]
+        assert tokens == fake_generate(prompt, n_new)  # bit-identical
+        assert events[-1]["done"] and events[-1]["tokens"] == tokens
+        assert [e["index"] for e in events if "token" in e] == list(
+            range(n_new)
+        )
+        assert router.metrics.failovers.value() == 1
+        fo = [
+            e
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "router.failover"
+        ]
+        assert fo and fo[0]["replica"] == victim.name
+        assert 0 < fo[0]["emitted"] < n_new  # genuinely MID-stream
+        assert survivor.generate_requests >= 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_drain_stops_new_assignments_keeps_streams():
+    """The rollout contract: a draining replica takes no new requests
+    the moment the router learns of it, while its in-flight proxied
+    stream runs to completion."""
+    replicas, router, flight = _fleet(2, token_delay_s=0.03)
+    try:
+        draining = replicas[0]
+        other = replicas[1]
+        prompt = _home_prompt(router, draining.name)
+        import threading
+
+        holder = [None]
+
+        def client():
+            holder[0] = _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": 20}
+            )
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert wait_until(lambda: draining.active_streams > 0)
+        draining.begin_drain()
+        assert wait_until(
+            lambda: router.replicas[draining.name].draining, timeout=3
+        ), "poll never observed the drain"
+        served_at_drain = draining.generate_requests
+        # New requests (even ones homed on the draining replica) go
+        # elsewhere — and still answer correctly.
+        for salt in range(4):
+            p2 = _home_prompt(router, draining.name, base=100 + salt * 7)
+            got = _post(router.port, {"prompt": p2, "max_new_tokens": 3})
+            assert got["tokens"] == fake_generate(p2, 3)
+        assert draining.generate_requests == served_at_drain
+        assert other.generate_requests >= 4
+        # The in-flight stream survived the whole drain.
+        t.join(timeout=20)
+        events, tokens = holder[0]
+        assert events[-1]["done"] and tokens == fake_generate(prompt, 20)
+        kinds = [e["kind"] for e in flight.snapshot()["events"]]
+        assert "router.drain_begin" in kinds
+    finally:
+        _teardown(replicas, router)
+
+
+def test_retry_after_honored_when_fleet_drains():
+    """With EVERY replica draining, the router's backoff floors at the
+    replicas' Retry-After instead of hammering them — and the request
+    succeeds once the drain lifts."""
+    replicas, router, _ = _fleet(1, router_kwargs=dict(poll_interval_s=0.05))
+    try:
+        replica = replicas[0]
+        replica.begin_drain(retry_after="0.4")
+        import threading
+
+        def undrain_later():
+            time.sleep(0.15)
+            replica.undrain()
+
+        threading.Thread(target=undrain_later, daemon=True).start()
+        t0 = time.monotonic()
+        got = _post(
+            router.port, {"prompt": [9, 9], "max_new_tokens": 3}, timeout=15
+        )
+        elapsed = time.monotonic() - t0
+        assert got["tokens"] == fake_generate([9, 9], 3)
+        assert elapsed >= 0.35, (
+            f"backoff ignored Retry-After (elapsed {elapsed:.3f}s)"
+        )
+        assert replica.drain_rejects >= 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_hedge_races_slow_home_and_cancels_loser():
+    """Home replica stalls in prefill; the hedge fires at the rolling-
+    p99 floor, the fast replica wins, and the client gets the (identical)
+    answer at hedge latency instead of stall latency."""
+    fast = FakeReplica().start()
+    slow = FakeReplica(prefill_delay_s=1.5).start()
+    flight = FlightRecorder(capacity=512, name="hedge-test")
+    router = RouterServer(
+        [fast.name, slow.name],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        poll_interval_s=0.1,
+        hedge=True,
+        hedge_min_s=0.1,
+        backoff_base_s=0.02,
+    ).start()
+    try:
+        prompt = _home_prompt(router, slow.name)
+        t0 = time.monotonic()
+        got = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        elapsed = time.monotonic() - t0
+        assert got["tokens"] == fake_generate(prompt, 4)
+        assert elapsed < 1.2, f"hedge never rescued the stall ({elapsed:.2f}s)"
+        assert router.metrics.hedges.value(result="won") == 1
+        kinds = {e["kind"] for e in flight.snapshot()["events"]}
+        assert "router.hedge" in kinds and "router.hedge_won" in kinds
+        assert router.metrics.placements.value(placement="failover") == 1
+    finally:
+        _teardown([fast, slow], router)
+
+
+def test_replica_conn_failpoint_scoped_to_one_replica():
+    """The chaos seam: arming router.replica_conn.<name> faults dials to
+    ONE replica (requests fail over); the generic site faults all."""
+    replicas, router, flight = _fleet(
+        2, router_kwargs=dict(breaker_failures=5)
+    )
+    try:
+        target = replicas[0]
+        prompt = _home_prompt(router, target.name)
+        failpoints.arm(
+            f"router.replica_conn.{target.name}", "error", count=2
+        )
+        got = _post(router.port, {"prompt": prompt, "max_new_tokens": 3})
+        assert got["tokens"] == fake_generate(prompt, 3)
+        assert replicas[1].generate_requests >= 1  # failed over
+        assert target.generate_requests == 0
+        kinds = {e["kind"] for e in flight.snapshot()["events"]}
+        assert "router.dispatch_error" in kinds
+    finally:
+        failpoints.disarm_all()
+        _teardown(replicas, router)
+
+
+def test_retry_budget_exhaustion_degrades_to_503():
+    """Budget capacity 0.5 token, no refill: the first extra dispatch is
+    refused — with the only replica dead, the client gets a clean 503
+    (degrade) instead of an infinite retry loop (amplify)."""
+    replica = FakeReplica().start()
+    router = RouterServer(
+        [replica.name],
+        host="127.0.0.1",
+        port=0,
+        poll_interval_s=5.0,  # poll must not mark it down first
+        retry_budget=0.5,
+        retry_refill_per_s=0.0,
+        breaker_failures=100,  # isolate the budget from the breaker
+        backoff_base_s=0.01,
+        backoff_max_s=0.02,
+        hedge=False,
+        request_timeout_s=5.0,
+    ).start()
+    try:
+        replica.kill()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.port, {"prompt": [1, 2], "max_new_tokens": 2})
+        assert e.value.code == 503
+        assert router.budget.exhausted_total >= 1
+    finally:
+        _teardown([replica], router)
+
+
+def test_router_validation_healthz_and_debug_snapshot():
+    replicas, router, _ = _fleet(2)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.port, {"max_new_tokens": 3})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.port, {"prompt": [], "max_new_tokens": 3})
+        assert e.value.code == 400
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/healthz", timeout=5
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["reachable"] == 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/router", timeout=5
+        ) as resp:
+            snap = json.loads(resp.read())
+        assert snap["policy"]["mode"] == "affinity"
+        assert set(snap["replicas"]) == {r.name for r in replicas}
+        for st in snap["replicas"].values():
+            assert st["breaker"]["state"] == "closed"
+        assert snap["ring"]["points"] == 2 * 64
+    finally:
+        _teardown(replicas, router)
+
+
+def test_poll_marks_replica_down_and_up():
+    replicas, router, flight = _fleet(2)
+    try:
+        victim = replicas[0]
+        port = victim.port
+        victim.kill()
+        assert wait_until(
+            lambda: not router.replicas[victim.name].reachable, timeout=3
+        )
+        assert router.metrics.replica_up.value(replica=victim.name) == 0
+        # "Replug": a fresh replica on the same address recovers it.
+        revived = FakeReplica(port=port).start()
+        replicas.append(revived)
+        assert wait_until(
+            lambda: router.replicas[victim.name].reachable, timeout=3
+        )
+        kinds = [e["kind"] for e in flight.snapshot()["events"]]
+        assert "router.replica_down" in kinds
+        assert "router.replica_up" in kinds
+    finally:
+        _teardown(replicas, router)
+
+
+def test_metrics_lint_clean_on_live_router(tmp_path):
+    """The same strict exposition lint the MetricsServer and
+    EngineServer endpoints pass, against a router that has actually
+    routed (every family populated the interesting way)."""
+    metrics_lint = _load_metrics_lint()
+    replicas, router, _ = _fleet(2)
+    try:
+        for i in range(3):
+            _post(router.port, {"prompt": [i + 1, 2], "max_new_tokens": 2})
+        _stream(router.port, {"prompt": [5, 6], "max_new_tokens": 3})
+        errors = metrics_lint.lint_url(
+            f"http://127.0.0.1:{router.port}/metrics"
+        )
+        assert errors == [], errors
+    finally:
+        _teardown(replicas, router)
+
+
+def test_ring_membership_change_updates_routing():
+    """add_replica/remove_replica (the DNS-refresh path): a removed
+    replica stops receiving traffic; the survivors keep their keyspace
+    (consistent hashing, not a reshuffle)."""
+    replicas, router, _ = _fleet(3)
+    try:
+        keys = [prefix_key([i + 2] * 32) for i in range(300)]
+        before = {k: router.ring.lookup(k) for k in keys}
+        gone = replicas[2]
+        router.remove_replica(gone.name)
+        after = {k: router.ring.lookup(k) for k in keys}
+        assert gone.name not in set(after.values())
+        stayed = [k for k in keys if before[k] != gone.name]
+        assert all(after[k] == before[k] for k in stayed)
+        got = _post(router.port, {"prompt": [1, 2, 3], "max_new_tokens": 2})
+        assert got["tokens"] == fake_generate([1, 2, 3], 2)
+        assert gone.generate_requests == 0  # never dialed after removal
+        snap = router.snapshot()
+        assert set(snap["replicas"]) == {replicas[0].name, replicas[1].name}
+    finally:
+        _teardown(replicas, router)

@@ -93,6 +93,29 @@ def load_record(path: str) -> dict:
             rec["chaos_precision"] = chaos.get("precision")
             rec["chaos_recall"] = chaos.get("recall")
             rec["chaos_slo_pass"] = chaos.get("slo_pass")
+        # Router block (ROUTER serving rows): KV prefix-hit rate and
+        # client-observed TTFT p99 under prefix-affinity routing vs the
+        # random-placement control over the same seeded traffic.  The
+        # affinity hit-rate collapsing toward the random control (or
+        # dropped streams appearing) between rounds means the router
+        # stopped keeping sessions on their warm replicas.
+        router = parsed.get("router")
+        if isinstance(router, dict):
+            rec["router_replicas"] = router.get("replicas")
+            affinity = router.get("affinity") or {}
+            control = router.get("random") or {}
+            rec["router_affinity_hit_rate"] = affinity.get("hit_rate")
+            rec["router_affinity_ttft_p99_ms"] = affinity.get("ttft_p99_ms")
+            rec["router_home_rate"] = affinity.get("home_rate")
+            rec["router_random_hit_rate"] = control.get("hit_rate")
+            rec["router_random_ttft_p99_ms"] = control.get("ttft_p99_ms")
+            rec["router_dropped"] = (
+                None
+                if affinity.get("dropped") is None
+                and control.get("dropped") is None
+                else (affinity.get("dropped") or 0)
+                + (control.get("dropped") or 0)
+            )
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -127,6 +150,10 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "kvcache_resumes_recomputed",
         "chaos_scenarios", "chaos_passed", "chaos_faults",
         "chaos_precision", "chaos_recall", "chaos_slo_pass",
+        "router_replicas", "router_affinity_hit_rate",
+        "router_affinity_ttft_p99_ms", "router_home_rate",
+        "router_random_hit_rate", "router_random_ttft_p99_ms",
+        "router_dropped",
     ):
         va, vb = a.get(field), b.get(field)
         if va is None and vb is None:
@@ -171,6 +198,20 @@ def ledger_row(a: dict, b: dict) -> str:
                 f"resumes {b.get('kvcache_resumes_restored')}r/"
                 f"{b.get('kvcache_resumes_recomputed')}c"
                 if b.get("kvcache_hits") is not None
+                else ""
+            )
+            + (
+                f"; router K={b['router_replicas']} affinity "
+                f"{b.get('router_affinity_hit_rate')} hits/req "
+                f"p99 {b.get('router_affinity_ttft_p99_ms')}ms vs random "
+                f"{b.get('router_random_hit_rate')} / "
+                f"{b.get('router_random_ttft_p99_ms')}ms"
+                + (
+                    f", DROPPED {b['router_dropped']}"
+                    if b.get("router_dropped")
+                    else ""
+                )
+                if b.get("router_replicas") is not None
                 else ""
             )
             + (

@@ -47,7 +47,10 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_MATCH_KEYS = ("node", "device", "drift")
+# "replica" is the router-scenario key (a "host:port" name): an
+# injected replica kill must only match detections naming THAT replica,
+# so the clean replicas score the precision control.
+_MATCH_KEYS = ("node", "device", "drift", "replica")
 
 
 def _matches(inj: dict, det: dict) -> bool:
