@@ -598,6 +598,155 @@ def _run_router_phase(args) -> dict | None:
     return block
 
 
+def _run_overload_phase(eng, args, baseline_tps: float) -> dict:
+    """OVERLOAD perf phase: a 2x sustained overload storm with mixed
+    priorities through the SAME compiled engine, with the overload
+    controller installed the way the serving CLI default installs it.
+
+    What the row claims and how it is measured:
+
+    - **hi-pri TTFT p99** — per-request submit→first-token wall time of
+      the high-priority class, measured unloaded (requests run alone)
+      then during the storm.  Priority admission is supposed to keep
+      the two within 1.2x: high-priority work jumps the queue while
+      normal/low absorb the wait.
+    - **goodput ratio** — in-deadline completed tokens over all emitted
+      tokens (the controller's own ledger): the fraction of chip work
+      clients could actually use.
+    - **sheds** — deadline-doomed low-priority requests must shed
+      (expired) instead of occupying slots; ``pool_exact`` pins that
+      sheds returned every page (free pool back to allocatable).
+
+    The storm sizes itself from the measured decode throughput: total
+    demanded tokens ≈ 2x what the engine can serve inside the low-pri
+    deadline, so low-priority deadline-carrying requests genuinely
+    cannot all fit — the shed path runs for real, not by injection."""
+    from .engine_overload import OverloadConfig, OverloadController
+
+    eng.overload = OverloadController(
+        eng.max_slots,
+        # Submit-side load shedding is disabled (huge wait factor) so
+        # the phase's shed ledger isolates the DEADLINE path — the
+        # storm's shape (which low-pri requests expire) stays a
+        # function of measured drain, not of the drain-rate estimate
+        # the previous phases happened to leave behind.
+        OverloadConfig(target_queue_wait_s=0.25, shed_wait_factor=1e9),
+        metrics=eng.metrics,
+        flight=eng.flight,
+    )
+    n_new = args.decode_tokens
+    prompt = lambda i: [  # noqa: E731 — same shape as the main jobs
+        (13 * i + j) % eng.cfg.vocab_size for j in range(args.prompt_len)
+    ]
+    # Warm the admission-burst batch shapes a mixed-priority storm can
+    # hit (2-wide and 3-wide groups pad to 2/4; 1 and slots-wide are
+    # already warm from the main serving warmup).
+    eng.run([(prompt(90 + i), 2) for i in range(2)])
+    eng.run([(prompt(94 + i), 2) for i in range(3)])
+
+    def _ttft_p99(reqs):
+        ttfts = sorted(
+            r.first_token_at - r.submitted_at
+            for r in reqs
+            if r.first_token_at
+        )
+        if not ttfts:
+            return None
+        return ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+
+    # Unloaded baseline: high-priority requests with the engine to
+    # themselves.
+    unloaded = []
+    for i in range(4):
+        unloaded += eng.run([(prompt(i), n_new)], priority=0)
+    hi_unloaded = _ttft_p99(unloaded)
+
+    # The storm: slots high + 2*slots normal + 2*slots low, all at
+    # once — a queue several times deeper than the engine.  Low-pri
+    # requests carry a deadline sized to HALF the storm's expected
+    # drain time: since priority admission serves them last, the tail
+    # genuinely cannot finish in time and must shed.
+    n_hi = eng.max_slots
+    n_norm = 2 * eng.max_slots
+    n_low = 2 * eng.max_slots
+    est_drain_s = ((n_hi + n_norm + n_low) * n_new) / max(baseline_tps, 1.0)
+    low_deadline_s = max(est_drain_s / 2, 0.05)
+    goodput0 = eng.overload.goodput_tokens
+    raw0 = eng.overload.raw_tokens
+    sheds0 = eng.overload.sheds_total
+    storm: list = []
+    hi_reqs = []
+    for i in range(n_norm):
+        storm.append(
+            eng.submit(prompt(10 + i), n_new, priority=1, tenant="norm")
+        )
+    for i in range(n_low):
+        storm.append(
+            eng.submit(
+                prompt(30 + i), n_new, priority=2, tenant="low",
+                deadline_s=low_deadline_s,
+            )
+        )
+    for i in range(n_hi):
+        req = eng.submit(prompt(50 + i), n_new, priority=0, tenant="hi")
+        storm.append(req)
+        hi_reqs.append(req)
+    t0 = time.perf_counter()
+    guard = 0
+    while not all(r.done for r in storm):
+        eng.step()
+        guard += 1
+        if guard > 200_000:
+            raise RuntimeError("overload storm failed to drain")
+    storm_s = time.perf_counter() - t0
+    hi_storm = _ttft_p99(hi_reqs)
+    sheds = eng.overload.sheds_total - sheds0
+    goodput = eng.overload.goodput_tokens - goodput0
+    raw = eng.overload.raw_tokens - raw0
+    pool_exact = (
+        len(eng.free_pages) == eng.paged.num_pages - 1
+        and all(s is None for s in eng.slots)
+    )
+    ratio = (hi_storm / hi_unloaded) if hi_unloaded and hi_storm else None
+    block = {
+        "storm_requests": len(storm),
+        "storm_seconds": round(storm_s, 2),
+        "low_deadline_s": round(low_deadline_s, 3),
+        "hi_ttft_p99_unloaded_ms": (
+            round(hi_unloaded * 1e3, 3) if hi_unloaded else None
+        ),
+        "hi_ttft_p99_storm_ms": (
+            round(hi_storm * 1e3, 3) if hi_storm else None
+        ),
+        "hi_ttft_p99_ratio": round(ratio, 3) if ratio else None,
+        "goodput_tokens": goodput,
+        "raw_tokens": raw,
+        "goodput_ratio": round(goodput / raw, 3) if raw else None,
+        "sheds": sheds,
+        "sheds_by_kind": dict(eng.overload.shed_counts),
+        "limit_final": round(eng.overload.limit, 2),
+        "pool_exact": pool_exact,
+    }
+    log(
+        "perf-ledger row: | OVERLOAD control (b%d, %d-req storm) | "
+        "hi-pri TTFT p99 %s -> %s ms (%sx), goodput %s, %d sheds, pool "
+        "exact %s | - | `benchmark.py --model serving` | update on bench "
+        "round |"
+        % (
+            eng.max_slots,
+            len(storm),
+            block["hi_ttft_p99_unloaded_ms"],
+            block["hi_ttft_p99_storm_ms"],
+            block["hi_ttft_p99_ratio"],
+            block["goodput_ratio"],
+            sheds,
+            pool_exact,
+        )
+    )
+    eng.overload = None  # leave the engine the way the next phase expects
+    return block
+
+
 def run_serving(args) -> None:
     """Continuous-batching serving benchmark through the SAME telemetry
     operators scrape: the TTFT/ITL percentiles in the JSON line are read
@@ -855,6 +1004,8 @@ def run_serving(args) -> None:
                 "bit-identical" if tp_match else "DIVERGED",
             )
         )
+    # --- Overload phase (OVERLOAD row): 2x storm, mixed priorities -----
+    overload_block = _run_overload_phase(eng, args, overlap_tps)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
     print(
@@ -898,6 +1049,7 @@ def run_serving(args) -> None:
                     "resumes_recomputed": churn_recomputed,
                 },
                 "tp": tp_block,
+                "overload": overload_block,
                 "router": router_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

@@ -123,6 +123,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         decode_block: int = 1,
         overlap_steps: int = 1,
         admission: str = "reserve",
+        overload=None,
         kv_retain: bool = False,
         kv_host_cache_mb: float = 0,
         mesh: Optional[Mesh] = None,
@@ -451,6 +452,23 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         )
         self._prof_timer = None
         self._step_tokens = 0  # tokens emitted by the step in flight
+        # Overload control (models/engine_overload.py): deadline expiry,
+        # priority + per-tenant-fair admission order, and the AIMD
+        # concurrency limiter.  Library default OFF (``overload=None`` —
+        # the queue stays strictly FIFO and streams are bit-identical to
+        # every prior round); the serving CLIs default it ON, matching
+        # the kv-retain convention.  Pass True for the default config or
+        # an OverloadConfig for tuned thresholds.
+        self.overload = None
+        if overload:
+            from .engine_overload import OverloadConfig, OverloadController
+
+            self.overload = OverloadController(
+                max_slots,
+                overload if isinstance(overload, OverloadConfig) else None,
+                metrics=metrics,
+                flight=self.flight,
+            )
         # Request-scoped tracing (utils/spans.py): None = off, zero cost.
         # Per-slot monotonic stamp of the slot's last emitted token — the
         # inter-token-latency anchor (reset at activation and teardown).
@@ -1021,7 +1039,12 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
             )
 
     def _step_inner(self) -> list[Request]:
-        finished = self._admit()
+        # Overload sweeps run BEFORE admission: an expired queued request
+        # must shed (without ever touching pages) rather than admit, and
+        # an infeasible slot must be marked so the cancel sweep below
+        # frees it for the queue head.
+        finished = self._overload_sweep() if self.overload is not None else []
+        finished += self._admit()
         # Cancelled slots tear down BEFORE the dispatch (no farewell
         # token).  Only ready slots: a cancelled request mid-prefill
         # keeps its job's slot/pages intact until activation, whose own
@@ -1161,9 +1184,15 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         stays wall-accurate and per-token quantiles stay meaningful."""
         last = self._slot_emit_t[slot]
         self._slot_emit_t[slot] = now
-        if not self.metrics or consumed <= 0 or last <= 0.0:
+        if consumed <= 0 or last <= 0.0:
             return
         per = (now - last) / consumed
+        if self.overload is not None:
+            # The feasibility predicate's input: measured per-token
+            # latency decides whether a deadline can still be met.
+            self.overload.observe_itl(per)
+        if not self.metrics:
+            return
         for _ in range(consumed):
             self.metrics.itl_seconds.observe(per)
 
@@ -1251,6 +1280,11 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     "proposed": self.spec_proposed,
                     "accepted": self.spec_accepted,
                 },
+                "overload": (
+                    self.overload.snapshot()
+                    if self.overload is not None
+                    else {"enabled": False}
+                ),
                 "kvcache": self.kvcache_state(),
                 "config": {
                     "max_slots": self.max_slots,
@@ -1262,6 +1296,15 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     "prefix_sharing": self.prefix_sharing,
                 },
             }
+
+    def overload_state(self) -> dict:
+        """JSON-safe overload-controller snapshot for GET
+        /debug/admission (``{"enabled": False}`` when the engine runs
+        without a controller)."""
+        with self._lock:
+            if self.overload is None:
+                return {"enabled": False}
+            return self.overload.snapshot()
 
     def run(self, requests: list[tuple[list[int], int]], **submit_kw) -> list[Request]:
         """Submit all (``submit_kw`` — temperature/top_k/top_p — applies to
@@ -1390,6 +1433,32 @@ def main(argv: Optional[list[str]] = None) -> None:
         "when generations finish early",
     )
     p.add_argument(
+        "--overload",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="overload control (models/engine_overload.py): priority + "
+        "deadline-aware admission with per-tenant fair sharing, expiry "
+        "sweeping, and an AIMD concurrency limiter driven by measured "
+        "queue wait (default on; 0 restores the plain FIFO queue — "
+        "streams are bit-identical either way for deadline-free "
+        "uniform-priority traffic)",
+    )
+    p.add_argument(
+        "--overload-target-wait",
+        type=float,
+        default=0.5,
+        help="AIMD setpoint: the queue wait (seconds) the overload "
+        "limiter steers admitted concurrency toward",
+    )
+    p.add_argument(
+        "--overload-max-queue",
+        type=int,
+        default=512,
+        help="hard queue cap: submits past this depth shed immediately "
+        "with 503 + Retry-After regardless of priority",
+    )
+    p.add_argument(
         "--kv-retain",
         type=int,
         choices=[0, 1],
@@ -1475,12 +1544,21 @@ def main(argv: Optional[list[str]] = None) -> None:
             file=sys.stderr,
         )
     registry = MetricsRegistry()
+    overload_cfg = None
+    if args.overload:
+        from .engine_overload import OverloadConfig
+
+        overload_cfg = OverloadConfig(
+            target_queue_wait_s=args.overload_target_wait,
+            max_queue=args.overload_max_queue,
+        )
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
         metrics=EngineMetrics(registry),
         prefill_chunk=args.prefill_chunk, decode_block=args.decode_block,
         overlap_steps=args.overlap_steps,
         admission=args.admission,
+        overload=overload_cfg,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
         mesh=mesh,

@@ -18,6 +18,13 @@ import jax.numpy as jnp
 
 from ..utils import failpoints
 from ..utils.spans import new_trace_id
+from .engine_overload import (
+    PRIORITY_NAMES,
+    SHED_EXPIRED,
+    SHED_INFEASIBLE,
+    ShedError,
+    parse_priority,
+)
 from .engine_sampling import _token_logprob, filter_top_k_top_p
 from .engine_types import Request
 from .transformer import decode_cache_spec
@@ -39,11 +46,17 @@ class AdmissionMixin:
         stop: Optional[list] = None,
         logit_bias: Optional[dict] = None,
         trace_id: Optional[str] = None,
+        priority: int = 1,
+        tenant: str = "",
+        deadline_s: Optional[float] = None,
     ) -> Request:
         try:
-            prompt, stop, logit_bias = self._validate_submit(
-                prompt, max_new_tokens, temperature, top_k, top_p,
-                adapter, logprobs, stop, logit_bias,
+            prompt, stop, logit_bias, priority, tenant, deadline_s = (
+                self._validate_submit(
+                    prompt, max_new_tokens, temperature, top_k, top_p,
+                    adapter, logprobs, stop, logit_bias,
+                    priority, tenant, deadline_s,
+                )
             )
         except (TypeError, ValueError) as e:
             # Admission rejects are flight-recorder events: a burst of
@@ -79,15 +92,43 @@ class AdmissionMixin:
                 )
             raise ValueError(str(e)) from None
         with self._lock:
+            now = time.monotonic()
+            deadline = None if deadline_s is None else now + deadline_s
+            if self.overload is not None:
+                # Submit-side overload gate: an already-expired deadline
+                # fails fast (504 on the HTTP path — never enqueued,
+                # never holds pages), and the adaptive shedder rejects
+                # lowest-priority first when the projected queue wait
+                # runs past the class headroom (503 + honest
+                # Retry-After from the measured drain rate).
+                try:
+                    if deadline is not None and deadline <= now:
+                        raise ShedError(
+                            "deadline expired before admission",
+                            SHED_EXPIRED,
+                            0.0,
+                        )
+                    self.overload.check_admission(priority, len(self.queue))
+                except ShedError as e:
+                    self.overload.record_shed(
+                        None,
+                        e.kind,
+                        priority=priority,
+                        tenant=tenant,
+                        prompt_tokens=len(prompt),
+                        at="submit",
+                    )
+                    raise
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
                 adapter=adapter, logprobs=logprobs, stop=stop,
                 logit_bias=logit_bias,
+                priority=priority, tenant=tenant, deadline=deadline,
                 # Every request is traceable even when the caller didn't
                 # send an id — generated ids tie SSE events, spans, and
                 # log lines of one request together.
                 trace_id=trace_id or new_trace_id(),
-                rid=self._next_rid, submitted_at=time.monotonic(),
+                rid=self._next_rid, submitted_at=now,
             )
             if self.spans:
                 # Root span id reserved NOW so the queue/prefill/decode
@@ -101,17 +142,31 @@ class AdmissionMixin:
             self._update_gauges()
         return req
 
+    MAX_TENANT_LEN = 64
+
     def _validate_submit(
         self, prompt, max_new_tokens, temperature, top_k, top_p,
         adapter, logprobs, stop, logit_bias,
+        priority=1, tenant="", deadline_s=None,
     ) -> tuple:
         """Normalize and validate one submit()'s arguments; raises
         ValueError/TypeError on anything inadmissible (the one seam
         submit() wraps to meter rejects).  Returns the normalized
-        (prompt, stop, logit_bias)."""
+        (prompt, stop, logit_bias, priority, tenant, deadline_s)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        priority = parse_priority(priority)
+        tenant = str(tenant or "")
+        if len(tenant) > self.MAX_TENANT_LEN:
+            raise ValueError(
+                f"tenant is capped at {self.MAX_TENANT_LEN} chars, "
+                f"got {len(tenant)}"
+            )
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if not math.isfinite(deadline_s):
+                raise ValueError(f"deadline_s must be finite, got {deadline_s}")
         if stop is not None:
             stop = [[int(t) for t in seq] for seq in stop]
             if not stop or any(not seq for seq in stop):
@@ -203,7 +258,7 @@ class AdmissionMixin:
                 f"has {allocatable} ({self.paged.num_pages - 1} allocatable "
                 f"pages x {self.paged.page_size})"
             )
-        return prompt, stop, logit_bias
+        return prompt, stop, logit_bias, priority, tenant, deadline_s
 
     def cancel(self, req: Request) -> bool:
         """Stop generating for ``req`` (the client went away — the HTTP
@@ -228,8 +283,71 @@ class AdmissionMixin:
                 # A preempted request dying in the queue will never
                 # resume: release its host-arena snapshot bytes now.
                 self._kv_drop_snapshot(req.rid)
+                if self.overload is not None:
+                    self.overload.on_finish(req)
             self._update_gauges()
             return True
+
+    def _overload_sweep(self) -> list["Request"]:
+        """Overload-control step work (step() calls this before
+        admission, only when a controller is installed): shed queued
+        requests whose deadline passed, preempt in-slot requests that
+        can no longer finish in time, and tick the AIMD limiter.
+        Returns the queued requests shed here (already done) so step()
+        reports them like any other finish."""
+        ctl = self.overload
+        now = time.monotonic()
+        finished: list[Request] = []
+        with self._lock:
+            expired = [
+                r for r in self.queue if not r.cancelled and ctl.expired(r, now)
+            ]
+            for req in expired:
+                # Shed from the queue: the request never held a slot or
+                # a page — it simply stops existing, and its waiter is
+                # answered (504) instead of burning capacity on a
+                # response nobody can use anymore.
+                self.queue.remove(req)
+                req.shed = SHED_EXPIRED
+                req.done = True
+                req.finished_at = now
+                self._kv_drop_snapshot(req.rid)
+                ctl.record_shed(
+                    req, SHED_EXPIRED,
+                    waited_s=round(now - req.submitted_at, 3),
+                )
+                ctl.on_finish(req)
+                finished.append(req)
+            if expired:
+                self._update_gauges()
+        # In-slot preemption: a ready slot whose deadline passed — or
+        # whose remaining token budget cannot fit the remaining time at
+        # the measured per-token latency — sheds NOW instead of decoding
+        # a tail the client will never accept.  Marking cancelled reuses
+        # the ordinary teardown (step()'s cancel sweep → _maybe_finish →
+        # _clear_slot), so the slot and its pages return through the
+        # exact path every other teardown uses.
+        for s in range(self.max_slots):
+            req = self.slots[s]
+            if (
+                req is None
+                or req.done
+                or req.cancelled
+                or req.shed is not None
+                or not self._slot_ready[s]
+            ):
+                continue
+            if ctl.infeasible(req, now):
+                req.shed = SHED_INFEASIBLE
+                req.cancelled = True
+                ctl.record_shed(
+                    req, SHED_INFEASIBLE,
+                    slot=s,
+                    remaining_tokens=req.max_new_tokens - len(req.tokens),
+                    remaining_s=round((req.deadline or now) - now, 3),
+                )
+        ctl.maybe_adjust()
+        return finished
 
     def _prefill_chunk_fn(self, chunk: int, batch: int, bucket: int):
         """Jitted CHUNK prefill: one multi-token cached append of ``chunk``
@@ -371,6 +489,28 @@ class AdmissionMixin:
                     self._kv_drop_snapshot(dead.rid)
                 if self.slots[slot] is not None or not self.queue:
                     continue
+                if self.overload is not None:
+                    # AIMD admitted-concurrency cap: slots beyond the
+                    # limit stay idle while the limiter says queue wait
+                    # is past target — admitting into them would add
+                    # wait for everything already queued.
+                    if (
+                        sum(1 for r in self.slots if r is not None)
+                        >= self.overload.concurrency_limit()
+                    ):
+                        break
+                    # Policy-ordered head: move the selected request
+                    # (best priority class, fairest tenant by token-cost
+                    # debt, earliest deadline, then arrival) to the
+                    # front.  Everything downstream — the restore-resume
+                    # fast path and the page-blocked head semantics
+                    # included — keeps operating on queue[0], so the
+                    # mechanics stay identical to the FIFO engine.
+                    idx = self.overload.select_index(self.queue)
+                    if idx:
+                        chosen = self.queue[idx]
+                        del self.queue[idx]
+                        self.queue.appendleft(chosen)
                 req = self.queue[0]
                 # Preempted request back at the head: rebuild its slot
                 # from the kv-cache tiers and skip prefill entirely when
@@ -438,6 +578,18 @@ class AdmissionMixin:
                     break
                 self.queue.popleft()
                 req.admitted_at = time.monotonic()
+                if not req.tokens:
+                    # Fresh admission (preemption resumes re-enter via
+                    # their own paths and already counted): observe the
+                    # queue wait — the AIMD limiter's input signal, made
+                    # scrapeable per priority class.
+                    wait_s = req.admitted_at - req.submitted_at
+                    if self.metrics:
+                        self.metrics.queue_wait_seconds.observe(
+                            wait_s, priority=PRIORITY_NAMES[req.priority]
+                        )
+                    if self.overload is not None:
+                        self.overload.observe_admission(req, wait_s)
                 # Refcounts and free-page moves stay under the lock too:
                 # _update_gauges (called from submit() on another thread)
                 # iterates _page_refs, and an unlocked resize here would
@@ -668,7 +820,15 @@ class AdmissionMixin:
                     start_monotonic=req.submitted_at,
                     end_monotonic=req.admitted_at,
                     parent_id=req.root_span,
-                    attrs={"rid": req.rid},
+                    attrs={
+                        "rid": req.rid,
+                        # The limiter's input, per request: grep-able
+                        # next to the tpu_engine_queue_wait_seconds
+                        # histogram it aggregates into.
+                        "wait_s": round(
+                            req.admitted_at - req.submitted_at, 6
+                        ),
+                    },
                 )
                 self.spans.record_span(
                     "prefill",
@@ -727,6 +887,19 @@ class AdmissionMixin:
         ):
             req.done = True
             req.finished_at = time.monotonic()
+            if self.overload is not None:
+                self.overload.on_finish(req)
+            if (
+                self.metrics
+                and req.tokens
+                and req.shed is None
+                and not req.cancelled
+                and (req.deadline is None or req.finished_at <= req.deadline)
+            ):
+                # Goodput: tokens a client will actually use — completed
+                # in-deadline work (deadline-free requests count on
+                # completion).  tokens_total minus this is burned work.
+                self.metrics.goodput_tokens.inc(len(req.tokens))
             if self.spans:
                 # The decode child covers first token -> finish; the root
                 # closes the trace with the whole-request wall time and
@@ -749,9 +922,13 @@ class AdmissionMixin:
                         "rid": req.rid,
                         "prompt_tokens": len(req.prompt),
                         "new_tokens": len(req.tokens),
-                        "outcome": "cancelled"
-                        if req.cancelled
-                        else ("stopped" if req.stopped else "completed"),
+                        "outcome": f"shed:{req.shed}"
+                        if req.shed
+                        else (
+                            "cancelled"
+                            if req.cancelled
+                            else ("stopped" if req.stopped else "completed")
+                        ),
                     },
                 )
             self._clear_slot(slot)

@@ -214,6 +214,51 @@ class EngineMetrics:
             "tiers exist to avoid; a rising rate says the host arena is "
             "too small for the preemption churn)",
         )
+        # Overload control (models/engine_overload.py).  The queue-wait
+        # histogram is the AIMD limiter's input signal made scrapeable:
+        # submit -> slot-assignment wait per admitted request, split by
+        # priority class (a closed 3-value label, never per-tenant).
+        self.queue_wait_seconds = registry.histogram(
+            "tpu_engine_queue_wait_seconds",
+            "Queue wait (submit to slot assignment) per admitted request "
+            "by priority class — the overload limiter steers this toward "
+            "--overload-target-wait; histogram_quantile() gives the "
+            "per-class admission-latency p99",
+            buckets=(
+                0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0, 120.0, 300.0,
+            ),
+            labelnames=("priority",),
+        )
+        self.sheds = registry.counter(
+            "tpu_engine_sheds_total",
+            "Requests shed by overload control, by kind (expired: queued "
+            "past deadline; infeasible: preempted from a slot that could "
+            "no longer finish in time; queue_full / overload: rejected "
+            "at submit) and priority class — shed requests never hold a "
+            "slot or KV pages",
+            ("kind", "priority"),
+        )
+        self.tenant_sheds = registry.counter(
+            "tpu_engine_tenant_sheds_total",
+            "Sheds per tenant (first 16 distinct tenants get their own "
+            "label; later ones aggregate under _other so client-supplied "
+            "names cannot mint unbounded series)",
+            ("tenant",),
+        )
+        self.goodput_tokens = registry.counter(
+            "tpu_engine_goodput_tokens_total",
+            "Tokens of requests that COMPLETED within their deadline "
+            "(deadline-free requests count on completion) — compare "
+            "against tpu_engine_tokens_total: the gap is work burned on "
+            "requests that were shed, cancelled, or finished too late",
+        )
+        self.admission_limit = registry.gauge(
+            "tpu_engine_admission_limit",
+            "Current AIMD admitted-concurrency limit (slots the overload "
+            "controller lets admission fill; max_slots when overload "
+            "control is off or fully recovered)",
+        )
 
 
 @dataclasses.dataclass
@@ -252,6 +297,19 @@ class Request:
     # Sampler settings change what gets picked, never what is reported.
     logprobs: bool = False
     rid: int = -1
+    # Overload-control contract (models/engine_overload.py): priority
+    # class (0 high / 1 normal / 2 low — lower admits first, sheds
+    # last), the tenant the request's token cost is charged to for fair
+    # sharing, and an ABSOLUTE monotonic deadline (converted from the
+    # wire's remaining-seconds form at submit; None = no deadline).
+    # All three are inert when the engine runs without a controller.
+    priority: int = 1
+    tenant: str = ""
+    deadline: Optional[float] = None
+    # Set when overload control shed this request (a shed kind from
+    # engine_overload.py: expired/infeasible/...); the HTTP layer maps
+    # it to 504 (deadline sheds) or 503 + Retry-After (load sheds).
+    shed: Optional[str] = None
     # End-to-end trace id: supplied by the client (X-Request-Id) or minted
     # at submit; echoed in responses/SSE events and stamped on every span
     # this request produces (utils/spans.py).

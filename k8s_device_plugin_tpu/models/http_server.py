@@ -43,8 +43,21 @@ rest of the models/ stack which benchmarks on synthetic ids):
          heartbeats flow while idle.  Disconnecting mid-stream cancels
          the request (engine.cancel) — its slot and pages return to the
          pool instead of decoding for nobody.
+      -> Overload contract (docs/operations.md "Overload control"):
+         ``X-Request-Deadline`` (REMAINING seconds; body ``deadline_s``),
+         ``X-Request-Priority`` (high/normal/low or 0..2; body
+         ``priority``), ``X-Tenant-Id`` (body ``tenant``).  A spent
+         deadline answers 504 WITHOUT enqueueing; a request shed by the
+         engine answers 504 (deadline sheds) or 503 + Retry-After +
+         ``X-Shed`` (load sheds — back off, the replica is healthy);
+         every 503 this server emits carries a Retry-After computed
+         from the measured drain rate.
     GET /healthz     -> 200 "ok" while the engine loop is alive
     GET /metrics     -> Prometheus exposition (when a registry is wired)
+    GET /debug/admission -> 200 JSON overload-control snapshot
+         (models/engine_overload.py): AIMD limit + its inputs (queue
+         wait EWMA, drain rate), shed ledger by kind, per-tenant
+         debt/admissions — {"enabled": false} without a controller.
     GET /debug/state -> 200 JSON engine snapshot (slots, queue, page
          pool, speculation counters) plus the recent span ring
          (utils/spans.py) when the engine was built with a recorder —
@@ -103,6 +116,7 @@ from ..utils import flight as flight_mod
 from ..utils.metrics import MetricsRegistry, write_exposition
 from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
+from .engine_overload import SHED_EXPIRED, SHED_INFEASIBLE, ShedError
 
 
 class EngineServer:
@@ -168,15 +182,12 @@ class EngineServer:
                     # requests keep decoding to completion.  503 +
                     # Retry-After is the signal a router/load-balancer
                     # needs to fail the replica out.
-                    self.send_response(503)
-                    body = json.dumps(
-                        {"error": "server is draining", "trace_id": trace_id}
-                    ).encode()
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Retry-After", "1")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(
+                        503,
+                        {"error": "server is draining", "trace_id": trace_id},
+                        trace_id,
+                        retry_after=server._retry_after(),
+                    )
                     return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -206,9 +217,48 @@ class EngineServer:
                             int(t): float(v)
                             for t, v in body["logit_bias"].items()
                         }
+                    # Overload-control contract (docs/operations.md
+                    # "Overload control"): the router stamps headers —
+                    # X-Request-Deadline (REMAINING seconds, re-computed
+                    # per hop), X-Request-Priority (high/normal/low or
+                    # 0..2), X-Tenant-Id — and direct clients may use
+                    # the equivalent body fields.  Headers win: the
+                    # router already decremented the deadline.
+                    raw_deadline = self.headers.get("X-Request-Deadline")
+                    if raw_deadline is None:
+                        raw_deadline = body.get("deadline_s")
+                    deadline_s = (
+                        None if raw_deadline is None else float(raw_deadline)
+                    )
+                    raw_priority = self.headers.get("X-Request-Priority")
+                    if raw_priority is None:
+                        raw_priority = body.get("priority")
+                    if raw_priority is not None:
+                        kwargs["priority"] = raw_priority
+                    tenant = self.headers.get("X-Tenant-Id")
+                    if tenant is None:
+                        tenant = body.get("tenant")
+                    if tenant is not None:
+                        kwargs["tenant"] = str(tenant)
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"}, trace_id)
                     return
+                if deadline_s is not None and deadline_s <= 0:
+                    # Fail fast, never enqueue: the budget is already
+                    # spent, and admitting would burn a slot producing
+                    # tokens the caller's own deadline forbids it to use.
+                    self._reply(
+                        504,
+                        {
+                            "error": "deadline expired before admission",
+                            "shed": SHED_EXPIRED,
+                            "trace_id": trace_id,
+                        },
+                        trace_id,
+                    )
+                    return
+                if deadline_s is not None:
+                    kwargs["deadline_s"] = deadline_s
                 stream = bool(body.get("stream", False))
                 if not 1 <= n <= 8:
                     self._reply(
@@ -234,6 +284,15 @@ class EngineServer:
                         )
                         for _ in range(n)
                     ]
+                except ShedError as e:
+                    # Overload shed at the admission door: deadline
+                    # sheds are 504 (the client's budget is the
+                    # boundary); load sheds are 503 with the honest
+                    # Retry-After the controller computed from the
+                    # measured drain rate.  X-Shed tells the router this
+                    # is overload, not drain — don't eject the replica.
+                    self._shed_reply(e.kind, str(e), e.retry_after_s, trace_id)
+                    return
                 except ValueError as e:  # validation: capacity, sampler args
                     self._reply(422, {"error": str(e)}, trace_id)
                     return
@@ -242,22 +301,50 @@ class EngineServer:
                     return
                 req = reqs[0]
                 if stream:
-                    self._stream_reply(req)
+                    self._stream_reply(req, deadline_s=deadline_s)
                     return
+                # The wait never outlives the client's own deadline
+                # (plus a small grace so the engine's expiry sweep —
+                # which sheds AT the deadline and answers with the typed
+                # shed verdict — wins the race against this generic
+                # timeout): a request with 2s of budget answers in ~2s,
+                # not after the server-wide timeout.
+                wait_timeout = server._timeout
+                if deadline_s is not None:
+                    wait_timeout = min(wait_timeout, deadline_s + 0.5)
                 with server._cond:
                     server._cond.notify_all()  # wake an idle loop
                     finished = server._cond.wait_for(
                         lambda: all(r.done for r in reqs),
-                        timeout=server._timeout,
+                        timeout=wait_timeout,
                     )
                 if not finished:
-                    # Stop burning chip time on a response nobody reads.
+                    # Stop burning chip time on a response nobody reads:
+                    # cancel NOW (slot and pages free at the next step
+                    # boundary) and wake the loop so the teardown is
+                    # immediate, not lazily discovered.
                     for r in reqs:
                         server.engine.cancel(r)
+                    with server._cond:
+                        server._cond.notify_all()
                     self._reply(
                         504,
                         {"error": "generation timed out", "rid": req.rid},
                         trace_id,
+                    )
+                    return
+                shed = next((r.shed for r in reqs if r.shed), None)
+                if shed is not None:
+                    # Shed while queued (expired) or preempted from a
+                    # slot (infeasible) by the engine's overload sweep.
+                    retry_after = 0.0
+                    if server.engine.overload is not None:
+                        retry_after = server.engine.overload.retry_after_s(
+                            len(server.engine.queue)
+                        )
+                    self._shed_reply(
+                        shed, f"request shed: {shed}", retry_after, trace_id,
+                        rid=req.rid,
                     )
                     return
                 out = {"tokens": req.tokens, "rid": req.rid,
@@ -391,7 +478,33 @@ class EngineServer:
                     },
                 )
 
-            def _stream_reply(self, req) -> None:
+            def _shed_reply(
+                self,
+                kind: str,
+                message: str,
+                retry_after_s: float,
+                trace_id,
+                rid=None,
+            ) -> None:
+                """Answer one overload shed: 504 for deadline sheds
+                (expired/infeasible — retrying cannot help, the client's
+                budget is gone), 503 + Retry-After + X-Shed for load
+                sheds (come back when the queue has drained)."""
+                body = {"error": message, "shed": kind, "trace_id": trace_id}
+                if rid is not None:
+                    body["rid"] = rid
+                if kind in (SHED_EXPIRED, SHED_INFEASIBLE):
+                    self._reply(504, body, trace_id)
+                    return
+                self._reply(
+                    503,
+                    body,
+                    trace_id,
+                    retry_after=f"{max(retry_after_s, 1.0):g}",
+                    shed=kind,
+                )
+
+            def _stream_reply(self, req, deadline_s=None) -> None:
                 """Server-sent events: one ``data:`` event per generated
                 token as the engine emits it, then a final ``done`` event
                 with the full sequence.  A client that disconnects
@@ -403,7 +516,14 @@ class EngineServer:
                 if req.trace_id:
                     self.send_header("X-Request-Id", req.trace_id)
                 self.end_headers()
-                deadline = time.monotonic() + server._timeout
+                timeout = server._timeout
+                if deadline_s is not None:
+                    # The stream's own watchdog never outlives the
+                    # client's deadline (the engine's overload sweep
+                    # normally sheds first and ends the stream with a
+                    # typed error event).
+                    timeout = min(timeout, deadline_s)
+                deadline = time.monotonic() + timeout
                 sent = 0
                 # Stop sequences truncate the matched suffix at the END:
                 # the last longest_stop tokens are provisional.  A lag of
@@ -448,6 +568,17 @@ class EngineServer:
                             self._event(ev)
                             sent += 1
                         if done:
+                            if req.shed:
+                                # Shed mid-stream by the overload sweep
+                                # (deadline expired / infeasible): a
+                                # typed error event, never a fake done.
+                                self._event(
+                                    {"error": f"request shed: {req.shed}",
+                                     "shed": req.shed,
+                                     "rid": req.rid,
+                                     "trace_id": req.trace_id}
+                                )
+                                return
                             fin = {"done": True, "tokens": toks,
                                    "rid": req.rid, "trace_id": req.trace_id}
                             if req.logprobs:
@@ -475,9 +606,17 @@ class EngineServer:
                     if ok and server._draining.is_set():
                         # Draining reads as not-ready: a router/probe must
                         # stop sending traffic while in-flight work finishes.
-                        self._reply(503, {"status": "draining"})
+                        self._reply(
+                            503,
+                            {"status": "draining"},
+                            retry_after=server._retry_after(),
+                        )
                         return
-                    self._reply(200 if ok else 503, {"status": "ok" if ok else "down"})
+                    self._reply(
+                        200 if ok else 503,
+                        {"status": "ok" if ok else "down"},
+                        retry_after=None if ok else "1",
+                    )
                 elif path == "/debug/state":
                     # Cheap top-level summary a router's poll loop can
                     # afford every second across the fleet: queue depth,
@@ -525,6 +664,13 @@ class EngineServer:
                     # accounting — counts and bytes only, never token
                     # content, so it stays as open as /metrics.
                     self._reply(200, server.engine.kvcache_state())
+                elif path == "/debug/admission":
+                    # Overload-control snapshot (engine_overload.py):
+                    # the AIMD limit and its inputs, the shed ledger,
+                    # and per-tenant debt — the first stop during an
+                    # overload incident.  Counts and tenant NAMES only
+                    # (tenants are routing identifiers, not content).
+                    self._reply(200, server.engine.overload_state())
                 elif path == "/debug/incidents":
                     self._reply(200, server.engine.anomaly.snapshot())
                 elif path == "/debug/flight":
@@ -538,13 +684,27 @@ class EngineServer:
                     self.send_error(404)
 
             def _reply(
-                self, code: int, obj: dict, trace_id: Optional[str] = None
+                self,
+                code: int,
+                obj: dict,
+                trace_id: Optional[str] = None,
+                retry_after: Optional[str] = None,
+                shed: Optional[str] = None,
             ) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 if trace_id:
                     self.send_header("X-Request-Id", trace_id)
+                if retry_after:
+                    # Every 503 this server emits carries Retry-After —
+                    # the router floors its backoff on it (the
+                    # drain/overload contract).
+                    self.send_header("Retry-After", retry_after)
+                if shed:
+                    # Overload, not drain: the router must keep the
+                    # replica in rotation (back off, don't eject).
+                    self.send_header("X-Shed", shed)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -560,6 +720,15 @@ class EngineServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def _retry_after(self) -> str:
+        """An honest Retry-After for drain/shed 503s: the overload
+        controller's drain-rate forecast when one is installed, else the
+        constant 1s every pre-overload round sent."""
+        eng = self.engine
+        if eng.overload is not None:
+            return f"{eng.overload.retry_after_s(len(eng.queue)):g}"
+        return "1"
 
     def _loop(self) -> None:
         """The engine owner thread: step while there is work, sleep on the
@@ -764,6 +933,36 @@ def main(argv: Optional[list[str]] = None) -> None:
         "that invalidate the in-flight round discard it for one wasted "
         "lane, counted in tpu_engine_overlap_discards_total; 0: strictly "
         "synchronous loop; speculative engines always run synchronously)",
+    )
+    p.add_argument(
+        "--overload",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="overload control (models/engine_overload.py, default on): "
+        "X-Request-Deadline/Priority/Tenant-aware admission — priority "
+        "classes, earliest-deadline ordering, per-tenant fair sharing "
+        "with token-cost accounting, deadline expiry sweeping (queued "
+        "sheds 504; in-slot infeasible decodes preempted), and an AIMD "
+        "concurrency limiter that sheds lowest-priority first with 503 "
+        "+ an honest Retry-After; 0 restores the plain FIFO queue "
+        "(bit-identical streams for deadline-free uniform-priority "
+        "traffic)",
+    )
+    p.add_argument(
+        "--overload-target-wait",
+        type=float,
+        default=0.5,
+        help="AIMD setpoint: the queue wait (seconds) the overload "
+        "limiter steers admitted concurrency toward (scrape "
+        "tpu_engine_queue_wait_seconds to watch it)",
+    )
+    p.add_argument(
+        "--overload-max-queue",
+        type=int,
+        default=512,
+        help="hard queue cap: submits past this depth shed immediately "
+        "with 503 + Retry-After regardless of priority",
     )
     p.add_argument(
         "--kv-retain",
@@ -1005,6 +1204,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     failpoints.arm_from_env()
     if args.failpoints:
         failpoints.arm_spec(args.failpoints)
+    overload_cfg = None
+    if args.overload:
+        from .engine_overload import OverloadConfig
+
+        overload_cfg = OverloadConfig(
+            target_queue_wait_s=args.overload_target_wait,
+            max_queue=args.overload_max_queue,
+        )
     engine = ServingEngine(
         cfg,
         params,
@@ -1017,6 +1224,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
         overlap_steps=args.overlap_steps,
         admission=args.admission,
+        overload=overload_cfg,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
         mesh=mesh,
@@ -1051,8 +1259,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         pass  # not on the main thread (embedded/test use)
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
-        "/debug/state /debug/profile /debug/kvcache /debug/incidents "
-        "/debug/flight)",
+        "/debug/state /debug/profile /debug/kvcache /debug/admission "
+        "/debug/incidents /debug/flight)",
         file=sys.stderr,
         flush=True,
     )

@@ -153,3 +153,24 @@ class RoutingPolicy:
             rotated = eligible[start:] + eligible[:start]
             return rotated + stale, OVERFLOW
         return eligible + stale, HOME
+
+    def min_wait_estimate_s(self, per_request_s: float) -> float:
+        """The fleet's BEST-case queue forecast: the smallest
+        (queue depth x router-measured per-request service time) over
+        the non-draining reachable replicas.  The deadline fast-fail
+        gate asks this before dispatching: when even the emptiest
+        replica cannot answer inside the remaining budget, 504 now
+        beats enqueueing work whose tokens will arrive too late.
+        Conservatively 0.0 (always feasible) when nothing is polled or
+        the service-time estimate is missing — fail-fast must never
+        fire on a guess."""
+        if per_request_s <= 0:
+            return 0.0
+        depths = [
+            st.queue_depth
+            for st in self.replicas.values()
+            if st.reachable and not st.draining
+        ]
+        if not depths:
+            return 0.0
+        return min(depths) * per_request_s

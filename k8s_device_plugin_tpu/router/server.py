@@ -24,7 +24,16 @@ engine decoded it":
 - **Drain awareness** — a replica answering 503/draining (or whose
   summary poll says so) takes no NEW assignments immediately, while its
   in-flight proxied streams run to completion; ``Retry-After`` feeds
-  the backoff when nothing else is dialable.
+  the backoff when nothing else is dialable.  A 503 carrying ``X-Shed``
+  is overload, not drain: the replica stays in rotation and only this
+  request moves on (still flooring its backoff on ``Retry-After``).
+- **Deadline propagation** — a client ``X-Request-Deadline`` (remaining
+  seconds; body ``deadline_s``) bounds the whole attempt budget: every
+  upstream dial re-stamps the REMAINING budget, retry sleeps and hedges
+  spend only when the budget still allows an answer, and a deadline no
+  replica's queue forecast can meet fails fast with 504 — never
+  enqueued anywhere.  ``X-Request-Priority``/``X-Tenant-Id`` fold into
+  the upstream body for the engine's priority admission.
 
 Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
 (503 until a replica is reachable; ``draining`` during shutdown),
@@ -73,7 +82,10 @@ class RouterMetrics:
     def __init__(self, registry: MetricsRegistry):
         self.requests = registry.counter(
             "tpu_router_requests_total",
-            "Client requests by outcome (ok/error/rejected/timeout)",
+            "Client requests by outcome (ok/error/rejected/timeout/"
+            "deadline — deadline = the client's X-Request-Deadline "
+            "expired or could not be met, answered 504 without "
+            "enqueueing)",
             ("outcome",),
         )
         self.placements = registry.counter(
@@ -281,19 +293,61 @@ class RouterServer:
                     prompt = list(body["prompt"])
                     if not prompt:
                         raise ValueError("empty prompt")
+                    # Overload contract: the client's deadline/priority/
+                    # tenant arrive as headers or body fields; the
+                    # router folds headers INTO the body (replicas read
+                    # both, but the re-stamped deadline always rides the
+                    # header — see _dial).
+                    raw_deadline = self.headers.get("X-Request-Deadline")
+                    if raw_deadline is None:
+                        raw_deadline = body.pop("deadline_s", None)
+                    else:
+                        body.pop("deadline_s", None)
+                    deadline_s = (
+                        None if raw_deadline is None else float(raw_deadline)
+                    )
+                    priority = self.headers.get("X-Request-Priority")
+                    if priority is not None:
+                        body["priority"] = priority
+                    tenant = self.headers.get("X-Tenant-Id")
+                    if tenant is not None:
+                        body["tenant"] = tenant
                 except (KeyError, TypeError, ValueError) as e:
                     server.metrics.requests.inc(outcome="rejected")
                     self._reply(
                         400, {"error": f"bad request: {e}"}, trace_id
                     )
                     return
+                if deadline_s is not None and deadline_s <= 0:
+                    # Fail fast, never dial: a spent deadline cannot be
+                    # served by ANY replica — 504 without spending a
+                    # connection, a retry token, or a queue entry.
+                    server.metrics.requests.inc(outcome="deadline")
+                    server._record(
+                        "router.deadline_exceeded",
+                        where="arrival",
+                        deadline_s=deadline_s,
+                    )
+                    self._reply(
+                        504,
+                        {
+                            "error": "deadline expired before routing",
+                            "trace_id": trace_id,
+                        },
+                        trace_id,
+                    )
+                    return
                 with server._active_lock:
                     server._active += 1
                 try:
                     if body.get("stream"):
-                        server._proxy_stream(self, body, prompt, trace_id)
+                        server._proxy_stream(
+                            self, body, prompt, trace_id, deadline_s
+                        )
                     else:
-                        server._proxy_unary(self, body, prompt, trace_id)
+                        server._proxy_unary(
+                            self, body, prompt, trace_id, deadline_s
+                        )
                 finally:
                     with server._active_lock:
                         server._active -= 1
@@ -484,17 +538,58 @@ class RouterServer:
 
     # ------------------------------------------------------ dispatching
 
+    def _per_request_s(self) -> float:
+        """Router-measured mean request service time (from the
+        request_seconds histogram operators already scrape) — the
+        multiplier behind every queue-depth wait forecast.  0.0 until
+        anything completed (forecasts then read as 'feasible')."""
+        hist = self.metrics.request_seconds
+        count = hist.count
+        if not count:
+            return 0.0
+        return hist.snapshot()[2] / count
+
+    def _deadline_infeasible(self, remaining_s: Optional[float]) -> bool:
+        """True when even the emptiest non-draining replica's queue
+        forecast exceeds the remaining deadline — the fail-fast (504,
+        never enqueue) gate."""
+        if remaining_s is None:
+            return False
+        if remaining_s <= 0:
+            return True
+        return (
+            self.policy.min_wait_estimate_s(self._per_request_s())
+            > remaining_s
+        )
+
     def _dial(
-        self, name: str, payload: dict, trace_id: str, stream: bool
+        self,
+        name: str,
+        payload: dict,
+        trace_id: str,
+        stream: bool,
+        deadline: Optional[float] = None,
     ) -> _Upstream:
         """One upstream POST /generate.  Fires the per-replica
         ``router.replica_conn`` failpoint first (the chaos seam: an
-        armed error here looks exactly like a dial failure).  Raises
+        armed error here looks exactly like a dial failure).  When the
+        request carries a deadline, the REMAINING budget is re-computed
+        at dial time and stamped as ``X-Request-Deadline`` — each hop
+        subtracts the time it already spent, so the replica's expiry
+        sweep judges the same clock the client does.  Raises
         ``_CONN_ERRORS`` / ``FailpointError`` on transport failure."""
         failpoints.fire_scoped(FAILPOINT_CONN, name, replica=name)
         st = self.replicas[name]
         body = dict(payload)
         body["stream"] = stream
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": trace_id,
+        }
+        if deadline is not None:
+            headers["X-Request-Deadline"] = (
+                f"{max(deadline - time.monotonic(), 0.0):.3f}"
+            )
         conn = http.client.HTTPConnection(
             st.host, st.port, timeout=self._upstream_timeout
         )
@@ -503,10 +598,7 @@ class RouterServer:
                 "POST",
                 "/generate",
                 json.dumps(body).encode(),
-                headers={
-                    "Content-Type": "application/json",
-                    "X-Request-Id": trace_id,
-                },
+                headers=headers,
             )
             resp = conn.getresponse()
         except BaseException:
@@ -545,17 +637,23 @@ class RouterServer:
 
     def _classify(self, up: _Upstream) -> tuple[str, bytes, dict]:
         """Read + classify a unary upstream response:
-        ``("ok"|"relay"|"draining"|"error", body, headers)``."""
+        ``("ok"|"relay"|"draining"|"shed"|"error", body, headers)``."""
         resp = up.resp
         data = resp.read()
         headers = {
             k: v
             for k, v in resp.getheaders()
-            if k.lower() in ("content-type", "x-request-id", "retry-after")
+            if k.lower()
+            in ("content-type", "x-request-id", "retry-after", "x-shed")
         }
         if resp.status == 200:
             return "ok", data, headers
         if resp.status == 503:
+            if headers.get("X-Shed"):
+                # Overload shed, not drain: the replica is healthy and
+                # stays in rotation — honor its Retry-After and try the
+                # next candidate instead of ejecting it.
+                return "shed", data, headers
             # The begin_drain() contract: not a fault, a polite no.
             return "draining", data, headers
         if resp.status >= 500:
@@ -566,18 +664,63 @@ class RouterServer:
 
     # ------------------------------------------------------------ unary
 
-    def _proxy_unary(self, handler, body, prompt, trace_id) -> None:
+    def _proxy_unary(
+        self, handler, body, prompt, trace_id, deadline_s=None
+    ) -> None:
         t0 = time.monotonic()
-        deadline = t0 + self._timeout
+        # The client's deadline bounds the whole attempt budget: every
+        # retry sleep, hedge, and re-dial below checks the remaining
+        # budget before spending — a doomed request 504s fast instead
+        # of churning through the ring.
+        deadline = t0 + (
+            self._timeout
+            if deadline_s is None
+            else min(self._timeout, deadline_s)
+        )
         exclude: set = set()
         retry_after: Optional[float] = None
         attempt = 0
         sleeps = 0
         while time.monotonic() < deadline:
+            if deadline_s is not None and self._deadline_infeasible(
+                deadline - time.monotonic()
+            ):
+                # Even the emptiest replica's queue forecast outruns the
+                # remaining budget: fail fast, never enqueue.
+                self.metrics.requests.inc(outcome="deadline")
+                self._record(
+                    "router.deadline_exceeded",
+                    where="forecast",
+                    remaining_s=round(deadline - time.monotonic(), 3),
+                )
+                handler._reply(
+                    504,
+                    {
+                        "error": "deadline cannot be met by any replica",
+                        "trace_id": trace_id,
+                    },
+                    trace_id,
+                )
+                return
             picked = self._next_candidate(prompt, exclude, attempt)
             if picked is None:
                 if exclude:
-                    exclude.clear()  # everything failed once: start over
+                    # Everything failed (or shed) once: start over — but
+                    # when a replica told us WHEN to come back
+                    # (Retry-After on an overload shed), honor it before
+                    # re-dialing, or the restart degenerates into a
+                    # hammer loop against a fleet that just said no.
+                    exclude.clear()
+                    if retry_after is not None:
+                        delay = self._backoff(sleeps, retry_after)
+                        sleeps += 1
+                        if (
+                            time.monotonic() + delay >= deadline
+                            or sleeps > 16
+                        ):
+                            break
+                        time.sleep(delay)
+                        retry_after = None
                     continue
                 delay = self._backoff(sleeps, retry_after)
                 sleeps += 1
@@ -598,7 +741,8 @@ class RouterServer:
             st = self.replicas[name]
             try:
                 result = self._dial_with_hedge(
-                    name, body, prompt, trace_id, exclude
+                    name, body, prompt, trace_id, exclude, deadline=
+                    deadline if deadline_s is not None else None,
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
@@ -612,10 +756,20 @@ class RouterServer:
             up, winner_placement = result
             kind, data, headers = self._classify(up)
             up.close()
-            if kind == "draining":
+            if kind in ("draining", "shed"):
                 ra = headers.get("Retry-After")
                 retry_after = float(ra) if ra else retry_after
-                self._mark_draining(up.name, True)
+                if kind == "draining":
+                    self._mark_draining(up.name, True)
+                else:
+                    # Overload shed: the replica is healthy — keep it
+                    # in rotation, just not for THIS request.
+                    self._record(
+                        "router.replica_shed",
+                        replica=up.name,
+                        shed=headers.get("X-Shed"),
+                        retry_after=ra,
+                    )
                 exclude.add(up.name)
                 # A polite 503 is not a breaker failure and not a retry:
                 # the replica is healthy, just leaving the rotation.
@@ -662,6 +816,15 @@ class RouterServer:
             except OSError:
                 pass
             return
+        if deadline_s is not None and time.monotonic() >= deadline:
+            self.metrics.requests.inc(outcome="deadline")
+            self._record("router.deadline_exceeded", where="retry_loop")
+            handler._reply(
+                504,
+                {"error": "deadline exceeded", "trace_id": trace_id},
+                trace_id,
+            )
+            return
         self.metrics.requests.inc(outcome="timeout")
         handler._reply(
             503,
@@ -671,18 +834,21 @@ class RouterServer:
         )
 
     def _dial_with_hedge(
-        self, name, body, prompt, trace_id, exclude
+        self, name, body, prompt, trace_id, exclude, deadline=None
     ) -> tuple[_Upstream, Optional[str]]:
         """Dial ``name``; when hedging is on and no response lands
         within the rolling TTFT p99, race a second dispatch along the
         ring.  Returns the winning upstream (loser closed) and its
         placement override (``failover`` when the hedge won).  Raises
-        the primary's error when every leg fails."""
+        the primary's error when every leg fails.  With a client
+        deadline, the hedge only fires while enough budget remains for
+        the second leg to actually answer — a hedge that cannot beat
+        the deadline is a wasted retry token."""
         results: queue_mod.Queue = queue_mod.Queue()
 
         def leg(leg_name: str):
             try:
-                results.put((leg_name, self._dial(leg_name, body, trace_id, False), None))
+                results.put((leg_name, self._dial(leg_name, body, trace_id, False, deadline), None))
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 results.put((leg_name, None, e))
 
@@ -705,6 +871,14 @@ class RouterServer:
                 )
             except queue_mod.Empty:
                 if self._hedge and hedged_name is None:
+                    if (
+                        deadline is not None
+                        and deadline - time.monotonic() <= hedge_after
+                    ):
+                        # Not enough budget left for a second leg to
+                        # win: spend nothing.
+                        hedged_name = ""
+                        continue
                     picked = self._next_candidate(
                         prompt, exclude | {name}, 1
                     )
@@ -776,7 +950,9 @@ class RouterServer:
 
     # ----------------------------------------------------------- stream
 
-    def _proxy_stream(self, handler, body, prompt, trace_id) -> None:
+    def _proxy_stream(
+        self, handler, body, prompt, trace_id, deadline_s=None
+    ) -> None:
         """SSE passthrough with zero-drop mid-stream failover.
 
         Token events are re-emitted with a GLOBAL index (continuations
@@ -785,7 +961,9 @@ class RouterServer:
         triggers resubmission of ``prompt + emitted`` with the
         remaining budget to the next ring replica — the client stream
         never breaks unless every replica is gone or the failover/retry
-        budget is spent."""
+        budget is spent.  A client deadline bounds the whole attempt
+        budget (dial, retry sleeps, failovers) and rides every upstream
+        dial as a re-stamped ``X-Request-Deadline``."""
         max_new = int(body.get("max_new_tokens", 16))
         emitted: list = []
         headers_sent = False
@@ -795,7 +973,12 @@ class RouterServer:
         sleeps = 0
         retry_after: Optional[float] = None
         t0 = time.monotonic()
-        deadline = t0 + self._timeout
+        deadline = t0 + (
+            self._timeout
+            if deadline_s is None
+            else min(self._timeout, deadline_s)
+        )
+        upstream_deadline = deadline if deadline_s is not None else None
         first_token_at: Optional[float] = None
 
         def client_error(message: str) -> None:
@@ -809,13 +992,37 @@ class RouterServer:
 
         while True:
             if time.monotonic() >= deadline:
+                if deadline_s is not None:
+                    self.metrics.requests.inc(outcome="deadline")
+                    self._record(
+                        "router.deadline_exceeded",
+                        where="stream",
+                        emitted=len(emitted),
+                    )
+                    client_error("deadline exceeded")
+                    return
                 self.metrics.requests.inc(outcome="timeout")
                 client_error("generation timed out")
                 return
             picked = self._next_candidate(prompt, exclude, attempt)
             if picked is None:
                 if exclude:
+                    # Same Retry-After floor as the unary restart: a
+                    # fleet-wide overload shed must back the stream off,
+                    # not hammer-loop the ring.
                     exclude.clear()
+                    if retry_after is not None:
+                        delay = self._backoff(sleeps, retry_after)
+                        sleeps += 1
+                        if (
+                            sleeps > 16
+                            or time.monotonic() + delay >= deadline
+                        ):
+                            self.metrics.requests.inc(outcome="error")
+                            client_error("no replica available")
+                            return
+                        time.sleep(delay)
+                        retry_after = None
                     continue
                 delay = self._backoff(sleeps, retry_after)
                 sleeps += 1
@@ -846,7 +1053,9 @@ class RouterServer:
             upstream_body["prompt"] = prompt + emitted
             upstream_body["max_new_tokens"] = max_new - len(emitted)
             try:
-                up = self._dial(name, upstream_body, trace_id, True)
+                up = self._dial(
+                    name, upstream_body, trace_id, True, upstream_deadline
+                )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
                 st.breaker.record_failure()
@@ -856,10 +1065,21 @@ class RouterServer:
                 exclude.add(name)
                 continue
             if up.resp.status == 503:
-                ra = dict(up.resp.getheaders()).get("Retry-After")
+                up_headers = dict(up.resp.getheaders())
+                ra = up_headers.get("Retry-After")
                 retry_after = float(ra) if ra else retry_after
                 up.close()
-                self._mark_draining(name, True)
+                shed = up_headers.get("X-Shed")
+                if shed:
+                    # Overload shed: healthy replica, keep in rotation.
+                    self._record(
+                        "router.replica_shed",
+                        replica=name,
+                        shed=shed,
+                        retry_after=ra,
+                    )
+                else:
+                    self._mark_draining(name, True)
                 exclude.add(name)
                 continue
             if up.resp.status != 200:

@@ -82,6 +82,7 @@ class FlightRecorder:
         self.recorded = 0
         self.dropped = 0
         self._dropped_by_kind: dict[str, int] = {}
+        self._recorded_by_kind: dict[str, int] = {}
 
     def record(self, kind: str, **fields) -> dict:
         """Append one typed event; returns the entry (already JSON-safe)."""
@@ -90,6 +91,8 @@ class FlightRecorder:
             entry[key] = _json_safe(value)
         with self._lock:
             self.recorded += 1
+            k = entry["kind"]
+            self._recorded_by_kind[k] = self._recorded_by_kind.get(k, 0) + 1
             if len(self._ring) == self.capacity:
                 evicted = self._ring[0]
                 self.dropped += 1
@@ -97,6 +100,13 @@ class FlightRecorder:
                 self._dropped_by_kind[ek] = self._dropped_by_kind.get(ek, 0) + 1
             self._ring.append(entry)
         return entry
+
+    def count(self, kind: str) -> int:
+        """Lifetime count of one event kind — survives ring eviction, so
+        a scorer can ask "how many admission.shed decisions happened"
+        even after a busy window rolled the events themselves out."""
+        with self._lock:
+            return self._recorded_by_kind.get(kind, 0)
 
     def window(
         self,
@@ -130,6 +140,7 @@ class FlightRecorder:
                 "recorded": self.recorded,
                 "dropped": self.dropped,
                 "dropped_by_kind": dict(self._dropped_by_kind),
+                "recorded_by_kind": dict(self._recorded_by_kind),
                 "events": [dict(e) for e in self._ring],
             }
 
@@ -139,6 +150,7 @@ class FlightRecorder:
             self.recorded = 0
             self.dropped = 0
             self._dropped_by_kind.clear()
+            self._recorded_by_kind.clear()
 
 
 # ---------------------------------------------------------------- dumping

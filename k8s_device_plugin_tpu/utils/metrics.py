@@ -170,7 +170,14 @@ class Summary:
 class Histogram:
     """Fixed-bucket histogram (cumulative ``le`` exposition): what
     PromQL's histogram_quantile() needs for p50/p99 dashboards — the
-    piece Summary (count+sum only) can't provide."""
+    piece Summary (count+sum only) can't provide.
+
+    ``labelnames`` (optional) makes it a labeled family: each distinct
+    labelset owns its own bucket counts, exported as
+    ``name_bucket{<labels>,le="..."}`` series the way prometheus_client
+    renders them (the exposition linter checks cumulative buckets per
+    non-le labelset).  Keep the label space SMALL and closed — a
+    per-priority-class split, never a per-request/tenant one."""
 
     TYPE = "histogram"
     # Log-spaced seconds, 1ms..10s: covers local-chip decode steps
@@ -180,19 +187,51 @@ class Histogram:
         1.0, 2.5, 5.0, 10.0,
     )
 
-    def __init__(self, name: str, help_text: str, buckets=None):
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        buckets=None,
+        labelnames: Iterable[str] = (),
+    ):
         self.name = name
         self.help = help_text
+        self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
         self._lock = threading.Lock()
         self._bucket_counts = [0] * len(self.buckets)
         self._count = 0
         self._sum = 0.0
+        # Labeled series: labelset key -> [bucket_counts, count, sum].
+        self._series: dict[tuple[str, ...], list] = {}
 
-    def observe(self, value: float) -> None:
+    def _key(self, labels: Mapping[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"want {sorted(self.labelnames)}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def observe(self, value: float, **labels: str) -> None:
         v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        if self.labelnames:
+            key = self._key(labels)
+            with self._lock:
+                series = self._series.get(key)
+                if series is None:
+                    series = self._series[key] = [
+                        [0] * len(self.buckets), 0, 0.0
+                    ]
+                if i < len(self.buckets):
+                    series[0][i] += 1
+                series[1] += 1
+                series[2] += v
+            return
+        if labels:
+            raise ValueError(f"{self.name} takes no labels")
         with self._lock:
-            i = bisect.bisect_left(self.buckets, v)
             if i < len(self._bucket_counts):
                 self._bucket_counts[i] += 1
             self._count += 1
@@ -247,6 +286,27 @@ class Histogram:
                 f"# HELP {self.name} {self.help}",
                 f"# TYPE {self.name} {self.TYPE}",
             ]
+            if self.labelnames:
+                for key in sorted(self._series):
+                    counts, count, total = self._series[key]
+                    labels = dict(zip(self.labelnames, key))
+                    blob = _format_labels(labels)  # "{k=\"v\",...}"
+                    inner = blob[1:-1]
+                    cum = 0
+                    for le, n in zip(self.buckets, counts):
+                        cum += n
+                        lines.append(
+                            f"{self.name}_bucket{{{inner},"
+                            f'le="{_format_value(le)}"}} {cum}'
+                        )
+                    lines.append(
+                        f'{self.name}_bucket{{{inner},le="+Inf"}} {count}'
+                    )
+                    lines.append(
+                        f"{self.name}_sum{blob} {_format_value(total)}"
+                    )
+                    lines.append(f"{self.name}_count{blob} {count}")
+                return lines
             cum = 0
             for le, n in zip(self.buckets, self._bucket_counts):
                 cum += n
@@ -282,8 +342,16 @@ class MetricsRegistry:
     def summary(self, name: str, help_text: str) -> Summary:
         return self._register(Summary(name, help_text))
 
-    def histogram(self, name: str, help_text: str, buckets=None) -> Histogram:
-        return self._register(Histogram(name, help_text, buckets))
+    def histogram(
+        self,
+        name: str,
+        help_text: str,
+        buckets=None,
+        labelnames: Iterable[str] = (),
+    ) -> Histogram:
+        return self._register(
+            Histogram(name, help_text, buckets, labelnames=labelnames)
+        )
 
     def render(self) -> str:
         with self._lock:

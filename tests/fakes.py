@@ -379,14 +379,18 @@ class FakeReplica:
         self.token_delay_s = token_delay_s
         self.prefill_delay_s = prefill_delay_s
         self._draining = threading.Event()
+        self._shedding = threading.Event()  # overload-shed mode (X-Shed)
+        self.shed_kind = "overload"
         self.retry_after = "1"
         self.killed = threading.Event()
         self._lock = threading.Lock()
         self._conns: set = set()
         self.generate_requests = 0  # every /generate that got past drain
         self.drain_rejects = 0  # 503s answered while draining
+        self.shed_rejects = 0  # 503+X-Shed answered while shedding
         self.active_streams = 0
         self.seen_trace_ids: list = []
+        self.seen_deadlines: list = []  # X-Request-Deadline header values
         replica = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -421,6 +425,23 @@ class FakeReplica:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if replica._shedding.is_set():
+                    # The EngineServer overload-shed contract: 503 +
+                    # Retry-After + X-Shed — healthy replica, back off.
+                    with replica._lock:
+                        replica.shed_rejects += 1
+                    body = json.dumps(
+                        {"error": "request shed: overload",
+                         "shed": replica.shed_kind, "trace_id": trace_id}
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", replica.retry_after)
+                    self.send_header("X-Shed", replica.shed_kind)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 prompt = [int(t) for t in body["prompt"]]
@@ -429,6 +450,9 @@ class FakeReplica:
                 with replica._lock:
                     replica.generate_requests += 1
                     replica.seen_trace_ids.append(trace_id)
+                    replica.seen_deadlines.append(
+                        self.headers.get("X-Request-Deadline")
+                    )
                 rid = replica.generate_requests
                 if replica.prefill_delay_s:
                     time.sleep(replica.prefill_delay_s)
@@ -547,6 +571,20 @@ class FakeReplica:
 
     def undrain(self) -> None:
         self._draining.clear()
+
+    # --- the EngineServer overload-shed contract ---
+    def begin_shed(
+        self, retry_after: str = "1", kind: str = "overload"
+    ) -> None:
+        """New /generate answers 503 + Retry-After + X-Shed (the
+        engine's load-shed shape): the router must back off and keep
+        the replica IN rotation — overload is not drain."""
+        self.retry_after = retry_after
+        self.shed_kind = kind
+        self._shedding.set()
+
+    def end_shed(self) -> None:
+        self._shedding.clear()
 
     # --- chaos ---
     def kill(self) -> None:

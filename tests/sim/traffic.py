@@ -46,6 +46,7 @@ class TrafficReport:
         self.completed = 0
         self.cancelled = 0
         self.rejected = 0
+        self.shed = 0
         self.tokens = 0
         self.duration_s = 0.0
 
@@ -55,6 +56,7 @@ class TrafficReport:
             "completed": self.completed,
             "cancelled": self.cancelled,
             "rejected": self.rejected,
+            "shed": self.shed,
             "tokens": self.tokens,
             "duration_s": round(self.duration_s, 3),
         }
@@ -101,11 +103,26 @@ class TrafficGenerator:
         lognorm_sigma: float = 0.7,
         max_new: tuple[int, int] = (4, 10),
         drain_timeout_s: float = 60.0,
+        priority_weights: dict | None = None,
+        deadline_fraction: float = 0.0,
+        deadline_range_s: tuple[float, float] = (0.5, 2.0),
+        tenants: list[str] | None = None,
     ) -> TrafficReport:
         """Replay for ``duration_s`` wall seconds, then wait for every
         surviving request to finish.  Returns the replay's counts; SLOs
-        are read off the engine's own metrics by the caller."""
+        are read off the engine's own metrics by the caller.
+
+        ``priority_weights`` ({priority: weight}) mixes overload-control
+        priority classes into the load; ``deadline_fraction`` of
+        requests carry a deadline drawn uniform from
+        ``deadline_range_s``; ``tenants`` round-robin-weights requests
+        over tenant names — all deterministic per seed, all inert on an
+        engine without an overload controller."""
         report = TrafficReport()
+        prio_classes = prio_weights = None
+        if priority_weights:
+            prio_classes = sorted(priority_weights)
+            prio_weights = [priority_weights[p] for p in prio_classes]
         live: list = []
         cancels: list[tuple[float, object]] = []  # (deadline, req)
         t0 = time.monotonic()
@@ -123,8 +140,17 @@ class TrafficGenerator:
             time.sleep(min(gap, max(0.0, t0 + duration_s - now)))
             prompt = self._prompt(*prompt_len, lognorm_mu, lognorm_sigma)
             new_tokens = self.rng.randint(*max_new)
+            submit_kw = {}
+            if prio_classes is not None:
+                submit_kw["priority"] = self.rng.choices(
+                    prio_classes, weights=prio_weights
+                )[0]
+            if tenants:
+                submit_kw["tenant"] = self.rng.choice(tenants)
+            if deadline_fraction and self.rng.random() < deadline_fraction:
+                submit_kw["deadline_s"] = self.rng.uniform(*deadline_range_s)
             try:
-                req = self.engine.submit(prompt, new_tokens)
+                req = self.engine.submit(prompt, new_tokens, **submit_kw)
             except ValueError:
                 # Admission rejection (capacity, or an armed
                 # engine.submit failpoint) — production clients see the
@@ -156,6 +182,7 @@ class TrafficGenerator:
             self._notify()
             time.sleep(0.02)
         report.completed = sum(1 for r in live if r.done)
+        report.shed = sum(1 for r in live if getattr(r, "shed", None))
         report.tokens = sum(len(r.tokens) for r in live)
         report.duration_s = time.monotonic() - t0
         return report

@@ -375,3 +375,58 @@ def test_bench_diff_parses_router_block(tmp_path):
     (tmp_path / "c.json").write_text(json.dumps(routed))
     c = bench_diff.load_record(str(tmp_path / "c.json"))
     assert "DROPPED 2" in bench_diff.ledger_row(a, c)
+
+
+def test_bench_diff_parses_overload_block(tmp_path):
+    """Records grew an OVERLOAD block (ISSUE 9, benchmark.py
+    _run_overload_phase): goodput ratio, shed count, and the
+    high-priority-TTFT storm/unloaded ratio must surface in the
+    normalized record, the field diff, and the ledger row — and the
+    row must scream when priority admission stops protecting the high
+    class (ratio > 1.2) or a shed leaks pages (pool_exact false)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 8,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 9
+    loaded["parsed"]["overload"] = {
+        "storm_requests": 20, "goodput_ratio": 0.91, "sheds": 4,
+        "sheds_by_kind": {"expired": 4},
+        "hi_ttft_p99_ratio": 1.05, "hi_ttft_p99_storm_ms": 12.5,
+        "pool_exact": True,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["overload_goodput_ratio"] == 0.91
+    assert b["overload_sheds"] == 4
+    assert b["overload_hi_ttft_ratio"] == 1.05
+    assert b["overload_pool_exact"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "overload_goodput_ratio" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "overload goodput 0.91" in row and "hi-p99 1.05x" in row
+    assert "HI-TTFT-REGRESSED" not in row and "PAGE-LEAK" not in row
+    # A round where the high class lost its protection screams...
+    loaded["parsed"]["overload"]["hi_ttft_p99_ratio"] = 1.4
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "HI-TTFT-REGRESSED" in bench_diff.ledger_row(a, c)
+    # ...and so does a shed that leaked pages.
+    loaded["parsed"]["overload"]["hi_ttft_p99_ratio"] = 1.0
+    loaded["parsed"]["overload"]["pool_exact"] = False
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "PAGE-LEAK" in bench_diff.ledger_row(a, d)

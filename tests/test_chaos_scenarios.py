@@ -945,3 +945,167 @@ def test_chaos_router_breaker_trip_and_recovery(tmp_path):
         failpoints.disarm_all()
         failpoints.set_flight(None)
         _teardown_router(replicas, router)
+
+
+# ======================================================================
+# Scenario 8: overload storm with mixed priorities (ISSUE 9)
+# ======================================================================
+
+
+def test_chaos_overload_storm_mixed_priorities(chaos_server, tmp_path):
+    """A 2x+ burst of mixed-priority traffic against the loaded engine
+    with the overload controller attached (the serving-CLI default).
+    Ground truth: 4 low-priority requests carrying deadlines that the
+    priority-ordered queue cannot possibly meet — they MUST shed
+    (expired), and NOTHING else may (every other request is
+    deadline-free).  Detections are the engine's own `admission.shed`
+    flight events, joined per-rid by tools/chaos_report.score_detections:
+    shed precision/recall must measure 1.0.  SLO: the high-priority
+    class's TTFT p99 during the storm stays within 1.2x its unloaded
+    value (+0.3s scheduling slack — module convention: lenient floors,
+    exact figures in the JSON), and shed requests never held a slot or
+    a page (pool exact after drain)."""
+    from k8s_device_plugin_tpu.models.engine_overload import (
+        OverloadConfig,
+        OverloadController,
+    )
+
+    chaos_report = _chaos_report()
+    server, engine, registry, box = chaos_server
+    engine.overload = OverloadController(
+        engine.max_slots,
+        # Submit-side load shedding off (huge factor): the scenario
+        # isolates the deadline path so ground truth stays exact.
+        OverloadConfig(target_queue_wait_s=1.0, shed_wait_factor=1e9),
+        metrics=engine.metrics,
+        flight=box,
+    )
+    try:
+        def _wait_done(reqs, timeout=60.0):
+            deadline = time.monotonic() + timeout
+            while not all(r.done for r in reqs):
+                with server._cond:
+                    server._cond.notify_all()
+                time.sleep(0.005)
+                assert time.monotonic() < deadline, "storm failed to drain"
+
+        def _ttft_p99(reqs):
+            vals = sorted(
+                r.first_token_at - r.submitted_at
+                for r in reqs
+                if r.first_token_at
+            )
+            assert vals, "no TTFT samples"
+            return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+        # Unloaded baseline: high-priority requests with the engine to
+        # themselves (warmed shapes: plen 4/bucket 4, batch 1).  The
+        # high class stays SMALL (2 requests, 2-3 pages each): this
+        # fixture's pool is deliberately undersized (11 pages) so the
+        # background load churns, and the scenario must measure what
+        # PRIORITY ADMISSION protects — an oversubscribed high class
+        # would be preempted by pool pressure, which is the page
+        # allocator's business, not the queue's.
+        unloaded = []
+        for i in range(4):
+            req = engine.submit([5 + i] * 4, 6, priority="high")
+            _wait_done([req])
+            unloaded.append(req)
+        hi_unloaded = _ttft_p99(unloaded)
+
+        # The storm, submitted ATOMICALLY w.r.t. admission (the owner
+        # loop's has_work check takes the same condition lock): 2 high
+        # + 14 normal + 4 doomed low-priority with a 20ms deadline
+        # behind an ~16-deep queue on 4 slots — the priority order
+        # admits them last, far past their deadline.
+        storm_start = time.time()
+        injected: list[dict] = []
+        storm: list = []
+        hi_reqs: list = []
+        doomed: list = []
+        with server._cond:
+            for i in range(14):
+                storm.append(
+                    engine.submit(
+                        [20 + i] * (4 + (i % 2) * 4), 6,
+                        priority="normal", tenant=f"t{i % 3}",
+                    )
+                )
+            for i in range(4):
+                t0 = time.time()
+                req = engine.submit(
+                    [40 + i] * 8, 6, priority="low", tenant="batch",
+                    deadline_s=0.02,
+                )
+                doomed.append(req)
+                storm.append(req)
+                injected.append(
+                    {"cls": "shed", "rid": req.rid, "t0": t0,
+                     "t1": t0 + 0.1}
+                )
+            for i in range(2):
+                req = engine.submit([60 + i] * 4, 6, priority="high")
+                hi_reqs.append(req)
+                storm.append(req)
+            server._cond.notify_all()
+        _wait_done(storm)
+        hi_storm = _ttft_p99(hi_reqs)
+
+        # Detections: the engine's own shed decisions, per rid.
+        detected = [
+            {"cls": "shed", "rid": e["rid"], "ts": e["ts"]}
+            for e in box.window(kinds=["admission.shed"])
+            if e["ts"] >= storm_start
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        shed_cls = score["per_class"]["shed"]
+
+        # Shed requests never held capacity; the pool is exact.  (The
+        # owner loop sets done a few statements before the slot
+        # teardown inside the same step — poll briefly rather than
+        # racing it.)
+        assert all(r.shed == "expired" for r in doomed), [
+            (r.rid, r.shed, len(r.tokens)) for r in doomed
+        ]
+        assert all(r.admitted_at == 0.0 and not r.tokens for r in doomed)
+        assert wait_until(
+            lambda: all(s is None for s in engine.slots)
+            and not engine.queue
+            and len(engine.free_pages) == engine.paged.num_pages - 1
+        ), (engine.slots, len(engine.queue), len(engine.free_pages))
+        pool_exact = True
+
+        slo_target = 1.2 * hi_unloaded + 0.3
+        slo = {
+            "targets": {
+                "hi_ttft_p99_s": round(slo_target, 4),
+                "shed_precision": 1.0,
+                "shed_recall": 1.0,
+            },
+            "measured": {
+                "hi_ttft_p99_unloaded_s": round(hi_unloaded, 4),
+                "hi_ttft_p99_storm_s": round(hi_storm, 4),
+                "hi_ttft_ratio": round(hi_storm / hi_unloaded, 3),
+                "sheds": len(detected),
+                "goodput_tokens": engine.overload.goodput_tokens,
+                "raw_tokens": engine.overload.raw_tokens,
+            },
+            "pass": hi_storm <= slo_target,
+        }
+        result = {
+            "scenario": "overload_storm_mixed_priorities",
+            "score": score,
+            "slo": slo,
+            "pass": (
+                shed_cls["precision"] == 1.0
+                and shed_cls["recall"] == 1.0
+                and slo["pass"]
+                and pool_exact
+            ),
+        }
+        _publish(result)
+        assert shed_cls["precision"] == 1.0, score
+        assert shed_cls["recall"] == 1.0, score
+        assert slo["pass"], slo
+    finally:
+        engine.overload = None

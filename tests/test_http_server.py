@@ -37,6 +37,11 @@ def served():
     engine = ServingEngine(
         cfg, params, paged, max_slots=3, metrics=EngineMetrics(registry),
         spans=SpanRecorder(),
+        # The serving-CLI default: overload control ON.  The module's
+        # default-priority deadline-free traffic is bit-identical either
+        # way (pinned in tests/test_overload.py), so every oracle test
+        # here ALSO exercises the controller-on admission path.
+        overload=True,
     )
     server = EngineServer(
         engine, host="127.0.0.1", port=0, registry=registry,
@@ -750,3 +755,149 @@ def test_debug_state_summary_mode(served):
         "draining": False,
         "loop_alive": True,
     }
+
+
+# ======================================================================
+# Overload control over HTTP (ISSUE 9): the deadline/priority/tenant
+# contract, typed shed verdicts, Retry-After on every 503, the
+# /debug/admission surface, and the timeout-cancel slot-release path.
+# ======================================================================
+
+
+def test_overload_headers_flow_and_queue_wait_metric(served):
+    """X-Request-Priority/X-Tenant-Id/X-Request-Deadline are adopted
+    (response still the oracle tokens), the queue-wait histogram gains
+    a priority-labeled observation, and /debug/admission reports the
+    tenant's admission."""
+    cfg, params, server = served
+    prompt, n = [11, 12, 13], 4
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": n}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-Priority": "high",
+            "X-Tenant-Id": "acme",
+            "X-Request-Deadline": "60",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        body = json.loads(resp.read())
+    assert body["tokens"] == _oracle(cfg, params, prompt, n)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=30
+    ).read().decode()
+    assert 'tpu_engine_queue_wait_seconds_bucket{priority="high"' in text
+    assert "tpu_engine_goodput_tokens_total" in text
+    adm = _get_json(server.port, "/debug/admission")
+    assert adm["enabled"] is True
+    assert adm["tenants"]["acme"]["admitted"] >= 1
+    # The queue span carries the limiter's per-request input signal.
+    state = _get_json(server.port, "/debug/state")
+    queue_spans = [s for s in state["spans"] if s["name"] == "queue"]
+    assert queue_spans and all(
+        "wait_s" in s["attrs"] for s in queue_spans
+    )
+
+
+def test_expired_deadline_fails_fast_504(served):
+    """A spent X-Request-Deadline answers 504 WITHOUT enqueueing (queue
+    depth untouched) — the fail-fast half of the deadline contract."""
+    _, _, server = served
+    depth0 = _get_json(server.port, "/debug/state?summary=1")["queue_depth"]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": [1, 2], "max_new_tokens": 4}).encode(),
+        headers={"X-Request-Deadline": "0"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 504
+    assert json.loads(e.value.read())["shed"] == "expired"
+    assert (
+        _get_json(server.port, "/debug/state?summary=1")["queue_depth"]
+        == depth0
+    )
+
+
+def test_every_engine_503_carries_retry_after(served):
+    """The 503 contract (drain AND overload shed): Retry-After on every
+    one, X-Shed marking load sheds so a router backs off without
+    ejecting the replica.  (The router-side floor is pinned in
+    tests/test_router.py — together they are the end-to-end pin.)"""
+    _, _, server = served
+    # Drain 503.
+    server._draining.set()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": [1], "max_new_tokens": 2})
+        assert e.value.code == 503
+        assert float(e.value.headers["Retry-After"]) >= 1.0
+        assert e.value.headers.get("X-Shed") is None  # drain, not shed
+        # /healthz during drain is a 503 with Retry-After too.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=5
+            )
+        assert e.value.code == 503
+        assert float(e.value.headers["Retry-After"]) >= 1.0
+    finally:
+        server._draining.clear()
+    # Submit-side overload shed 503 (queue cap forced to zero).
+    ctl = server.engine.overload
+    old_max = ctl.cfg.max_queue
+    ctl.cfg.max_queue = 0
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": [1, 2], "max_new_tokens": 2})
+        assert e.value.code == 503
+        body = json.loads(e.value.read())
+        assert body["shed"] == "queue_full"
+        assert float(e.value.headers["Retry-After"]) >= 1.0
+        assert e.value.headers["X-Shed"] == "queue_full"
+    finally:
+        ctl.cfg.max_queue = old_max
+
+
+def test_request_timeout_cancels_and_frees_slot(shared_engine):
+    """The wait-path bugfix pin: a unary request that outlives the
+    server's request timeout answers 504 AND is cancelled in the
+    engine — its slot and pages free immediately (asserted via the
+    /debug/state queue_depth/active_slots surface), instead of decoding
+    for a client that already gave up."""
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+    from k8s_device_plugin_tpu.utils import failpoints
+
+    _, _, eng = shared_engine
+    if eng._inflight_guard is not None:
+        eng._inflight_guard._owner = None  # loop thread takes ownership
+    server = EngineServer(
+        eng, host="127.0.0.1", port=0, request_timeout_s=0.2
+    ).start()
+    try:
+        # ~20ms of injected readback delay per step: the 25-token decode
+        # takes ~500ms, comfortably past the 0.2s request timeout.
+        failpoints.arm("engine.readback", "delay", arg="0.02", count=40)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": [3, 141, 59], "max_new_tokens": 25},
+                  timeout=30)
+        assert e.value.code == 504
+        # The cancel must release the slot/pages promptly: poll the
+        # same summary surface a router polls.
+        deadline = time.monotonic() + 5
+        summary = None
+        while time.monotonic() < deadline:
+            summary = _get_json(server.port, "/debug/state?summary=1")
+            if summary["queue_depth"] == 0 and summary["active_slots"] == 0:
+                break
+            time.sleep(0.02)
+        assert summary["queue_depth"] == 0, summary
+        assert summary["active_slots"] == 0, summary
+        assert len(eng.free_pages) == eng.paged.num_pages - 1
+    finally:
+        failpoints.disarm_all()
+        server.stop()
+        if eng._inflight_guard is not None:
+            eng._inflight_guard._owner = None  # hand back to pytest thread

@@ -694,3 +694,136 @@ def test_ring_membership_change_updates_routing():
         assert set(snap["replicas"]) == {replicas[0].name, replicas[1].name}
     finally:
         _teardown(replicas, router)
+
+
+# ======================================================================
+# Overload contract (ISSUE 9): deadline propagation, fail-fast 504,
+# shed-503 handling (back off without ejecting), budget gating.
+# ======================================================================
+
+
+def test_deadline_propagates_decremented_to_replica():
+    """The client's X-Request-Deadline rides every upstream dial as the
+    REMAINING budget — stamped at dial time, so the replica sees a
+    value no larger than what the client sent."""
+    replicas, router, _ = _fleet(2)
+    try:
+        got = _post(
+            router.port,
+            {"prompt": [4, 4], "max_new_tokens": 3, "deadline_s": 7.5},
+        )
+        assert got["tokens"] == fake_generate([4, 4], 3)
+        seen = [
+            d
+            for r in replicas
+            for d in r.seen_deadlines
+            if d is not None
+        ]
+        assert len(seen) == 1
+        assert 0.0 < float(seen[0]) <= 7.5
+    finally:
+        _teardown(replicas, router)
+
+
+def test_expired_deadline_fails_fast_without_dialing():
+    """A spent deadline answers 504 at the router's front door: no
+    upstream dial, no retry token, outcome=deadline."""
+    replicas, router, flight = _fleet(2)
+    try:
+        before = sum(r.generate_requests for r in replicas)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(
+                router.port,
+                {"prompt": [4, 4], "max_new_tokens": 3, "deadline_s": 0},
+            )
+        assert e.value.code == 504
+        assert sum(r.generate_requests for r in replicas) == before
+        assert router.metrics.requests.value(outcome="deadline") == 1
+        assert any(
+            ev["kind"] == "router.deadline_exceeded"
+            for ev in flight.window()
+        )
+    finally:
+        _teardown(replicas, router)
+
+
+def test_shed_503_backs_off_without_ejecting_replica():
+    """An engine overload shed (503 + Retry-After + X-Shed) floors the
+    router's backoff — end to end, through real sockets — and does NOT
+    mark the replica draining: overload is a busy replica, not a dying
+    one."""
+    import threading
+
+    replicas, router, flight = _fleet(
+        2, router_kwargs=dict(poll_interval_s=0.05)
+    )
+    try:
+        for r in replicas:
+            r.begin_shed(retry_after="0.4", kind="overload")
+
+        def recover_later():
+            time.sleep(0.15)
+            for r in replicas:
+                r.end_shed()
+
+        threading.Thread(target=recover_later, daemon=True).start()
+        t0 = time.monotonic()
+        got = _post(
+            router.port, {"prompt": [6, 6], "max_new_tokens": 3, "deadline_s": 20},
+            timeout=15,
+        )
+        elapsed = time.monotonic() - t0
+        assert got["tokens"] == fake_generate([6, 6], 3)
+        assert elapsed >= 0.35, (
+            f"backoff ignored shed Retry-After (elapsed {elapsed:.3f}s)"
+        )
+        assert sum(r.shed_rejects for r in replicas) >= 2
+        # Sheds never read as drain: the fleet stayed in rotation.
+        assert all(not st.draining for st in router.replicas.values())
+        kinds = [ev["kind"] for ev in flight.window()]
+        assert "router.replica_shed" in kinds
+        assert "router.drain_begin" not in kinds
+    finally:
+        _teardown(replicas, router)
+
+
+def test_stream_deadline_eventually_504s_and_shed_stream_retries():
+    """Streaming: a fleet-wide shed with a TIGHT deadline exhausts the
+    budget and the client sees a definite 5xx verdict (no silent hang);
+    with budget left, the stream retries past the shed and completes."""
+    import http.client
+    import threading
+
+    replicas, router, _ = _fleet(1, router_kwargs=dict(poll_interval_s=0.05))
+    try:
+        replica = replicas[0]
+        replica.begin_shed(retry_after="0.2")
+        # Tight deadline: the shed + Retry-After floor outlive the
+        # budget — a pre-stream 5xx, not a hang.
+        conn = http.client.HTTPConnection("127.0.0.1", router.port, timeout=15)
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompt": [8, 8], "max_new_tokens": 3,
+                        "stream": True, "deadline_s": 0.3}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status in (503, 504), resp.status
+        resp.read()
+        conn.close()
+
+        # Budget left: recovery mid-retry completes the stream whole.
+        def recover_later():
+            time.sleep(0.15)
+            replica.end_shed()
+
+        threading.Thread(target=recover_later, daemon=True).start()
+        events, tokens = _stream(
+            router.port,
+            {"prompt": [8, 9], "max_new_tokens": 3, "deadline_s": 20},
+            timeout=15,
+        )
+        assert tokens == fake_generate([8, 9], 3)
+        assert events[-1].get("done") is True
+    finally:
+        _teardown(replicas, router)

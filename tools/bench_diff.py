@@ -116,6 +116,23 @@ def load_record(path: str) -> dict:
                 else (affinity.get("dropped") or 0)
                 + (control.get("dropped") or 0)
             )
+        # Overload block (OVERLOAD serving rows, benchmark.py
+        # _run_overload_phase): high-priority TTFT p99 under a 2x
+        # mixed-priority storm vs unloaded, the goodput ratio
+        # (in-deadline tokens / all tokens), and the shed ledger.  The
+        # regression tells: hi_ttft_ratio creeping past 1.2 (priority
+        # admission stopped protecting the high class), goodput sagging,
+        # or pool_exact flipping false (a shed leaked pages) — the row
+        # screams on all three.
+        overload = parsed.get("overload")
+        if isinstance(overload, dict):
+            rec["overload_goodput_ratio"] = overload.get("goodput_ratio")
+            rec["overload_sheds"] = overload.get("sheds")
+            rec["overload_hi_ttft_ratio"] = overload.get("hi_ttft_p99_ratio")
+            rec["overload_hi_ttft_storm_ms"] = overload.get(
+                "hi_ttft_p99_storm_ms"
+            )
+            rec["overload_pool_exact"] = overload.get("pool_exact")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -150,6 +167,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "kvcache_resumes_recomputed",
         "chaos_scenarios", "chaos_passed", "chaos_faults",
         "chaos_precision", "chaos_recall", "chaos_slo_pass",
+        "overload_goodput_ratio", "overload_sheds",
+        "overload_hi_ttft_ratio", "overload_hi_ttft_storm_ms",
+        "overload_pool_exact",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -220,6 +240,23 @@ def ledger_row(a: dict, b: dict) -> str:
                 + ("" if b.get("chaos_slo_pass", True) else ", SLO-FAIL")
                 + ")"
                 if b.get("chaos_scenarios") is not None
+                else ""
+            )
+            + (
+                f"; overload goodput {b['overload_goodput_ratio']} "
+                f"sheds {b.get('overload_sheds')} hi-p99 "
+                f"{b.get('overload_hi_ttft_ratio')}x"
+                + (
+                    ", HI-TTFT-REGRESSED"
+                    if (b.get("overload_hi_ttft_ratio") or 0) > 1.2
+                    else ""
+                )
+                + (
+                    ""
+                    if b.get("overload_pool_exact", True)
+                    else ", PAGE-LEAK"
+                )
+                if b.get("overload_goodput_ratio") is not None
                 else ""
             )
         )

@@ -49,8 +49,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # "replica" is the router-scenario key (a "host:port" name): an
 # injected replica kill must only match detections naming THAT replica,
-# so the clean replicas score the precision control.
-_MATCH_KEYS = ("node", "device", "drift", "replica")
+# so the clean replicas score the precision control.  "rid" is the
+# overload-scenario key: an injected doomed request must only match a
+# shed decision naming THAT request id, so every survivor is a
+# precision control.
+_MATCH_KEYS = ("node", "device", "drift", "replica", "rid")
 
 
 def _matches(inj: dict, det: dict) -> bool:
