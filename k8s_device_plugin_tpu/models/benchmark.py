@@ -747,6 +747,128 @@ def _run_overload_phase(eng, args, baseline_tps: float) -> dict:
     return block
 
 
+def _run_restart_phase(eng, args) -> dict:
+    """RESTART perf phase: cold vs warm post-restart TTFT through the
+    crash-safe KV-arena snapshot (models/engine_snapshot.py).
+
+    What the row claims and how it is measured:
+
+    - A session set sharing a full-page prompt prefix runs once to warm
+      the tiers, then the arena persists to disk (the fence/drain/
+      SIGTERM save).  The "restart" is modeled on the SAME compiled
+      engine — ``kvcache_clear()`` is exactly the serving state a
+      process death loses, while the XLA programs stand in for the
+      restarted pod's persistent compilation cache
+      (--compilation-cache-dir); the genuinely-fresh-process path is
+      scored by the warm-restart chaos scenario.
+    - **cold** restart: tiers cleared, no snapshot — every session
+      re-prefills its prefix; per-request TTFT from the request's own
+      submit/first-token stamps (requests run serially so TTFT is
+      prefill, not queue wait).
+    - **warm** restart: tiers cleared, snapshot REHYDRATED — prefix
+      pages restore host->device instead of recomputing; same sessions,
+      same stamps.  The restore scatter shape is compiled during the
+      warmup pass so neither measured pass eats a compile.
+    """
+    import tempfile
+
+    from .engine_snapshot import load_arena_snapshot, save_arena_snapshot
+
+    page = eng.paged.page_size
+    plen = args.prompt_len
+    pl = (plen // page) * page  # the shareable FULL-page prefix
+    if pl < page:
+        return {"skipped": f"prompt_len {plen} < one page ({page})"}
+    prefix = [(17 + j) % eng.cfg.vocab_size for j in range(pl)]
+    sessions = [
+        prefix + [(70 + 3 * s + j) % eng.cfg.vocab_size
+                  for j in range(plen - pl)]
+        for s in range(4)
+    ]
+    n_new = args.decode_tokens
+
+    def _ttfts(reqs):
+        return sorted(
+            r.first_token_at - r.submitted_at
+            for r in reqs
+            if r.first_token_at
+        )
+
+    def _q(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+    # Warmup: populate the tiers, force the offload path, and compile
+    # the restore scatter (one restore round) before anything is timed.
+    eng.kvcache_clear()
+    for s in sessions:
+        eng.run([(s, n_new)])
+    with eng._lock:
+        eng._kv_reclaim(len(eng._kv_retained))
+    eng.run([(sessions[0], n_new)])  # restore-path compile
+    snapdir = tempfile.mkdtemp(prefix="tpu-kv-restart-")
+    path = f"{snapdir}/kv_arena.snapshot"
+    saved = save_arena_snapshot(eng, path, trigger="bench")
+    if not saved.get("ok"):
+        return {"skipped": f"snapshot save failed: {saved.get('reason')}"}
+
+    # COLD restart: serving state gone, nothing rehydrated.
+    eng.kvcache_clear()
+    hits0 = eng.kv_host_hits
+    cold_reqs = [eng.run([(s, n_new)])[0] for s in sessions]
+    cold_hits = eng.kv_host_hits - hits0
+    cold = _ttfts(cold_reqs)
+
+    # WARM restart: same death, snapshot rehydrated first.
+    eng.kvcache_clear()
+    loaded = load_arena_snapshot(eng, path)
+    hits0, restores0 = eng.kv_host_hits, eng.kv_restores
+    warm_reqs = [eng.run([(s, n_new)])[0] for s in sessions]
+    warm_hits = eng.kv_host_hits - hits0
+    restored_pages = eng.kv_restores - restores0
+    warm = _ttfts(warm_reqs)
+    eng.kvcache_clear()
+
+    cold_p99, warm_p99 = _q(cold, 0.99), _q(warm, 0.99)
+    block = {
+        "sessions": len(sessions),
+        "prefix_tokens": pl,
+        "snapshot_bytes": saved["bytes"],
+        "snapshot_entries": saved["entries"],
+        "entries_loaded": loaded.get("restored", 0),
+        "cold": {
+            "ttft_p50_ms": round(_q(cold, 0.5) * 1e3, 3),
+            "ttft_p99_ms": round(cold_p99 * 1e3, 3),
+            "prefix_hits": cold_hits,
+        },
+        "warm": {
+            "ttft_p50_ms": round(_q(warm, 0.5) * 1e3, 3),
+            "ttft_p99_ms": round(warm_p99 * 1e3, 3),
+            "prefix_hits": warm_hits,
+            "restored_pages": restored_pages,
+        },
+        "warm_speedup": round(cold_p99 / warm_p99, 3) if warm_p99 else None,
+    }
+    log(
+        "perf-ledger row: | RESTART warm vs cold (b%d, %d sessions) | "
+        "post-restart TTFT p99 cold %.3f → warm %.3f ms (%.3fx; %d pages "
+        "restored, %d arena entries, snapshot %d B) | - | `benchmark.py "
+        "--model serving` | update on bench round |"
+        % (
+            eng.max_slots,
+            len(sessions),
+            block["cold"]["ttft_p99_ms"],
+            block["warm"]["ttft_p99_ms"],
+            block["warm_speedup"] or 0.0,
+            restored_pages,
+            loaded.get("restored", 0),
+            saved["bytes"],
+        )
+    )
+    return block
+
+
 def run_serving(args) -> None:
     """Continuous-batching serving benchmark through the SAME telemetry
     operators scrape: the TTFT/ITL percentiles in the JSON line are read
@@ -1006,6 +1128,8 @@ def run_serving(args) -> None:
         )
     # --- Overload phase (OVERLOAD row): 2x storm, mixed priorities -----
     overload_block = _run_overload_phase(eng, args, overlap_tps)
+    # --- Restart phase (RESTART row): cold vs warm arena rehydration ---
+    restart_block = _run_restart_phase(eng, args)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
     print(
@@ -1050,6 +1174,7 @@ def run_serving(args) -> None:
                 },
                 "tp": tp_block,
                 "overload": overload_block,
+                "restart": restart_block,
                 "router": router_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

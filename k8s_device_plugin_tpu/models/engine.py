@@ -452,6 +452,13 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         )
         self._prof_timer = None
         self._step_tokens = 0  # tokens emitted by the step in flight
+        # Hung-step watchdog (models/engine_watchdog.py), installed by
+        # the serving server (EngineServer wires it to its fence path).
+        # The engine only feeds it: step start/finish stamps plus grace
+        # marks on legitimately-slow events (new jitted program built,
+        # prefill advanced, admission activated) so first-shape compiles
+        # never false-trip.  None = off, zero cost.
+        self.watchdog = None
         # Overload control (models/engine_overload.py): deadline expiry,
         # priority + per-tenant-fair admission order, and the AIMD
         # concurrency limiter.  Library default OFF (``overload=None`` —
@@ -537,6 +544,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         O(log max_len) instances ever exist."""
         model = self._dense_chunk_models.get(bucket)
         if model is None:
+            self._wd_grace(f"compile:prefill_bucket_{bucket}")
             model = TransformerLM(
                 dataclasses.replace(self.dense_cfg, max_seq=bucket),
                 decode=True,
@@ -544,6 +552,14 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
             )
             self._dense_chunk_models[bucket] = model
         return model
+
+    def _wd_grace(self, reason: str) -> None:
+        """Mark the in-flight step as legitimately slow for the hung-step
+        watchdog (a fresh XLA compile or admission/prefill work may run
+        orders of magnitude past the decode baseline).  No-op without a
+        watchdog installed."""
+        if self.watchdog is not None:
+            self.watchdog.note_grace(reason)
 
     # ----------------------------------------------------------------- steps
 
@@ -692,6 +708,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         params/pools beyond the engine's lifetime)."""
         key_ = (filtered, want_lp, biased)
         if key_ not in self._step_fns:
+            self._wd_grace("compile:step")
             self._step_fns[key_] = build_step_fn(
                 self._decode_model, filtered, want_lp, biased,
                 derive_tables=self._derive_tables,
@@ -703,6 +720,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         (T, filtered, want_lp, biased) — engine_sampling.build_block_fn."""
         key_ = (T, filtered, want_lp, biased)
         if key_ not in self._block_fns:
+            self._wd_grace(f"compile:block_{T}")
             self._block_fns[key_] = build_block_fn(
                 self._decode_model, T, filtered, want_lp, biased,
                 derive_tables=self._derive_tables,
@@ -1006,6 +1024,9 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         hits0, discards0 = self.overlap_hits, self.overlap_discards
         kv_hits0 = self.kv_retained_hits + self.kv_host_hits
         kv_restores0 = self.kv_restores
+        wd = self.watchdog
+        if wd is not None:
+            wd.step_started()
         try:
             with span:
                 if self.metrics:
@@ -1023,7 +1044,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     if allocatable
                     else 0.0
                 )
-            self.profiler.finish_step(
+            wall = self.profiler.finish_step(
                 timer,
                 active_slots=active,
                 max_slots=self.max_slots,
@@ -1037,6 +1058,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                 ),
                 kvcache_restores=self.kv_restores - kv_restores0,
             )
+            if wd is not None:
+                wd.step_finished(wall)
 
     def _step_inner(self) -> list[Request]:
         # Overload sweeps run BEFORE admission: an expired queued request

@@ -365,6 +365,8 @@ class AdmissionMixin:
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
+        # First (chunk, batch, bucket) shape: the dispatch below compiles.
+        self._wd_grace(f"compile:prefill_{chunk}x{batch}x{bucket}")
         model = self._dense_chunk_model(bucket)
 
         def run(params, cache, tokens, pos0, last_idx, aids):
@@ -440,6 +442,9 @@ class AdmissionMixin:
 
     def _advance_prefill(self, job: dict) -> bool:
         """Run ONE chunk of a pending prefill job; True when complete."""
+        # Prefill work legitimately dwarfs the decode baseline (and may
+        # hit a fresh XLA shape): grace the hung-step deadline.
+        self._wd_grace("prefill")
         chunk, pos = job["chunk"], job["pos"]
         fn = self._prefill_chunk_fn(chunk, job["batch"], job["bucket"])
         tokens = jax.lax.slice_in_dim(job["rows"], pos, pos + chunk, axis=1)
@@ -698,6 +703,9 @@ class AdmissionMixin:
     def _activate(self, job: dict) -> list[Request]:
         """Graft a completed prefill job's K/V into pages, sample each
         request's first token, and mark the slots ready to decode."""
+        # Graft/sample dispatches can hit fresh page-count shapes: grace
+        # the hung-step deadline for this admission step.
+        self._wd_grace("activate")
         finished: list[Request] = []
         for row_idx, (slot, req, pages, n_shared) in enumerate(job["items"]):
             # Effective length: a resumed request's prefill covered its

@@ -375,6 +375,9 @@ class KVCacheMixin:
     def _kv_restore_pages(self, pages: list[int], rows_list: list[dict]) -> None:
         """Write host-held page rows into freshly allocated device pages
         and meter the restore (counter, latency histogram, flight)."""
+        # The page-indexed scatter compiles per page-count shape on first
+        # use: grace the hung-step deadline for this step.
+        self._wd_grace("kv_restore")
         t0 = time.perf_counter()
         self._kv_write_page_rows(pages, rows_list)
         dt = time.perf_counter() - t0

@@ -1,0 +1,403 @@
+"""Crash-safe warm restart of the KV host arena.
+
+The KV tiers (models/engine_kvcache.py) make a hot prefix cheap — until
+the process dies: a liveness-probe restart, a fence-triggered rollout,
+or a plain pod delete throws away the retained pages AND the host arena,
+so the restarted replica re-prefills every system prompt from scratch
+exactly when the fleet is already degraded.  This module persists the
+content-addressed arena to disk and rehydrates it at startup, so the
+restarted replica's prefix restores hit warm:
+
+- **What is saved.**  Every ``("prefix", root, tokens)`` arena entry,
+  plus (optionally) the retained DEVICE pages read back through the
+  same per-layer row path the offload uses — a snapshot taken at
+  fence/drain time captures tier 1 too, not just what pool pressure
+  already spilled.  Preemption snapshots (``("snap", rid)``) are
+  deliberately excluded: they are keyed to request ids of a process
+  that is about to not exist.
+- **File format.**  ``MAGIC | version | header JSON | entries``, written
+  to a tempfile and atomically renamed (a crash mid-write leaves the
+  previous snapshot intact, never a torn one).  The header pins the
+  page layout (per-layer pool shapes/dtypes, page size) and a cheap
+  params fingerprint; each entry carries its own CRC32.  Arena entries
+  are content-addressed by token prefix, so the ONLY way a restore can
+  poison correctness is serving different weights or a different cache
+  layout under the same tokens — both refuse at load.
+- **Degradation contract** (pinned in tier-1): a corrupted or truncated
+  snapshot — or one from a different model/layout — degrades to a CLEAN
+  cold start: everything partially loaded is dropped, the load is
+  metered ``outcome=corrupt`` (or ``layout_mismatch``/``params_mismatch``),
+  and serving proceeds exactly as if no snapshot existed.  Never a
+  poisoned cache.
+
+Failpoint sites (docs/chaos.md): ``engine.snapshot.save`` (``error``
+aborts the save; ``truncate[:fraction]`` writes a torn file — the
+disk-corruption shape the load contract is scored against) and
+``engine.snapshot.load`` (``error`` = unreadable file, ``truncate``
+reads a prefix of the bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tempfile
+import time
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+from ..utils import failpoints
+
+MAGIC = b"TPUKVSN1"
+VERSION = 1
+SNAPSHOT_NAME = "kv_arena.snapshot"
+
+# Per-leaf byte cap on the params fingerprint sample: enough to tell two
+# weight sets apart, cheap enough to run at every save/load.
+_FP_SAMPLE_BYTES = 4096
+_FP_SAMPLE_LEAVES = 4
+
+
+class SnapshotError(RuntimeError):
+    """Raised internally on any parse/verify failure; the load call site
+    translates it into the clean-cold-start degradation."""
+
+
+def snapshot_layout(engine) -> dict:
+    """The page-row layout this engine's snapshot entries must match:
+    page size plus per-layer pool shapes/dtypes of ONE page's rows (the
+    exact arrays ``_kv_read_page_rows`` produces).  Serialized into the
+    header and compared verbatim at load — a restart with a different
+    model config refuses the snapshot instead of mis-slicing blobs."""
+    layers: dict[str, dict] = {}
+    for name in engine._layer_names:
+        att = engine.cache[name]["attn"]
+        layers[name] = {
+            pool: {
+                "shape": [int(d) for d in att[pool].shape[1:]],
+                "dtype": str(att[pool].dtype),
+            }
+            for pool in sorted(engine._kv_pool_names(att))
+        }
+    return {"page_size": int(engine.paged.page_size), "layers": layers}
+
+
+def params_fingerprint(params: Any) -> str:
+    """Cheap content fingerprint of a param tree: CRC32 over every
+    leaf's (path, shape, dtype) plus the first bytes of a few leaves.
+    Restored KV rows are only valid against the weights that produced
+    them; this catches a restart that loaded different weights under
+    the same architecture (same layout, different checkpoint)."""
+    import jax
+
+    crc = 0
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    for i, (path, leaf) in enumerate(leaves):
+        desc = f"{jax.tree_util.keystr(path)}|{tuple(leaf.shape)}|{leaf.dtype}"
+        crc = zlib.crc32(desc.encode(), crc)
+        if i < _FP_SAMPLE_LEAVES:
+            # Slice BEFORE materializing: only the sample crosses
+            # device->host, not the whole (possibly multi-MB) leaf.
+            flat = leaf.reshape(-1)
+            n = max(1, _FP_SAMPLE_BYTES // np.dtype(flat.dtype).itemsize)
+            sample = np.asarray(flat[:n])
+            crc = zlib.crc32(np.ascontiguousarray(sample).tobytes(), crc)
+    return f"{crc:08x}"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its serialized name, including the ml_dtypes family
+    (bfloat16 et al.) numpy cannot resolve by string alone."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _entry_blob(rows: dict, layout: dict) -> bytes:
+    """One entry's arrays concatenated in layout order (the order load
+    splits by)."""
+    parts: list[bytes] = []
+    for layer, pools in layout["layers"].items():
+        for pool in pools:
+            parts.append(np.ascontiguousarray(rows[layer][pool]).tobytes())
+    return b"".join(parts)
+
+
+def _split_blob(blob: bytes, layout: dict) -> dict:
+    rows: dict[str, dict[str, np.ndarray]] = {}
+    offset = 0
+    for layer, pools in layout["layers"].items():
+        rows[layer] = {}
+        for pool, spec in pools.items():
+            dtype = _resolve_dtype(spec["dtype"])
+            shape = tuple(spec["shape"])
+            nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+            chunk = blob[offset : offset + nbytes]
+            if len(chunk) != nbytes:
+                raise SnapshotError("entry blob shorter than its layout")
+            rows[layer][pool] = np.frombuffer(chunk, dtype=dtype).reshape(shape)
+            offset += nbytes
+    if offset != len(blob):
+        raise SnapshotError("entry blob longer than its layout")
+    return rows
+
+
+def collect_entries(engine, include_device: bool = True) -> dict[tuple, dict]:
+    """Every persistable prefix entry: the arena's ``("prefix", ...)``
+    contents plus (with ``include_device``) the retained tier-1 device
+    pages read back by cumulative prefix — the same content-addressed
+    key the offload path would have used.  ``("snap", rid)`` resume
+    snapshots are skipped (rid-keyed to a dying process).  Caller holds
+    the engine lock; a chip-health fence passes ``include_device=False``
+    (reading pages off a sick chip could persist garbage — the arena
+    copy in host RAM is the trustworthy subset)."""
+    entries: dict[tuple, dict] = {}
+    for key, entry in engine._kv_arena._entries.items():
+        if key and key[0] == "prefix":
+            entries[key] = entry["rows"]
+    if include_device:
+        for page in list(engine._kv_retained):
+            prefix = engine._kv_page_prefix(page)
+            if prefix is None:
+                continue
+            key = ("prefix", prefix[0], prefix[1])
+            if key not in entries:
+                entries[key] = engine._kv_read_page_rows(page)
+    return entries
+
+
+def _write_snapshot(
+    path: str,
+    layout: dict,
+    fingerprint: str,
+    entries: dict[tuple, dict],
+    truncate_fraction: Optional[float] = None,
+) -> int:
+    """Write MAGIC | version | header | entries to a tempfile in
+    ``path``'s directory and atomically rename it over ``path``.
+    Returns the byte size.  ``truncate_fraction`` (the
+    ``engine.snapshot.save`` failpoint's ``truncate`` mode) tears the
+    file AFTER the rename — the on-disk corruption shape (atomic rename
+    already rules out torn writes)."""
+    header = json.dumps(
+        {
+            "version": VERSION,
+            "layout": layout,
+            "params_fingerprint": fingerprint,
+            "entries": len(entries),
+            "created_unix": round(time.time(), 3),
+        }
+    ).encode()
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".kv_arena.", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<II", VERSION, len(header)))
+            f.write(header)
+            for key, rows in entries.items():
+                _, root, tokens = key
+                blob = _entry_blob(rows, layout)
+                meta = json.dumps(
+                    {
+                        "root": int(root),
+                        "tokens": [int(t) for t in tokens],
+                        "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                        "nbytes": len(blob),
+                    }
+                ).encode()
+                f.write(struct.pack("<I", len(meta)))
+                f.write(meta)
+                f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    size = os.path.getsize(path)
+    if truncate_fraction is not None:
+        keep = int(size * truncate_fraction)
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        size = keep
+    return size
+
+
+def _read_exact(f, n: int) -> bytes:
+    data = f.read(n)
+    if len(data) != n:
+        raise SnapshotError("snapshot truncated")
+    return data
+
+
+def read_snapshot(
+    path: str, expected_layout: Optional[dict] = None,
+    expected_fingerprint: Optional[str] = None,
+) -> tuple[dict, list[tuple[tuple, dict, int]]]:
+    """Parse + verify one snapshot file; returns (header, entries) where
+    entries are ``(("prefix", root, tokens), rows, nbytes)``.  Raises
+    :class:`SnapshotError` on ANY corruption, truncation, or
+    layout/fingerprint mismatch — the caller degrades to cold.  The
+    ``engine.snapshot.load`` failpoint: ``error`` = unreadable file,
+    ``truncate[:fraction]`` reads only a prefix of the bytes."""
+    hit = failpoints.fire("engine.snapshot.load")
+    if hit is not None and hit.mode == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            data = f.read(int(size * (float(hit.arg) if hit.arg else 0.5)))
+        import io
+
+        f = io.BytesIO(data)
+        return _parse_snapshot(f, expected_layout, expected_fingerprint)
+    with open(path, "rb") as f:
+        return _parse_snapshot(f, expected_layout, expected_fingerprint)
+
+
+def _parse_snapshot(f, expected_layout, expected_fingerprint):
+    if _read_exact(f, len(MAGIC)) != MAGIC:
+        raise SnapshotError("bad magic")
+    version, header_len = struct.unpack("<II", _read_exact(f, 8))
+    if version != VERSION:
+        raise SnapshotError(f"unsupported snapshot version {version}")
+    try:
+        header = json.loads(_read_exact(f, header_len))
+    except ValueError as e:
+        raise SnapshotError(f"bad header: {e}") from None
+    layout = header.get("layout")
+    if expected_layout is not None and layout != expected_layout:
+        raise SnapshotError("layout_mismatch")
+    if (
+        expected_fingerprint is not None
+        and header.get("params_fingerprint") != expected_fingerprint
+    ):
+        raise SnapshotError("params_mismatch")
+    entries: list[tuple[tuple, dict, int]] = []
+    for _ in range(int(header.get("entries", 0))):
+        (meta_len,) = struct.unpack("<I", _read_exact(f, 4))
+        try:
+            meta = json.loads(_read_exact(f, meta_len))
+        except ValueError as e:
+            raise SnapshotError(f"bad entry meta: {e}") from None
+        blob = _read_exact(f, int(meta["nbytes"]))
+        if (zlib.crc32(blob) & 0xFFFFFFFF) != int(meta["crc32"]):
+            raise SnapshotError("entry checksum mismatch")
+        rows = _split_blob(blob, layout)
+        key = ("prefix", int(meta["root"]), tuple(int(t) for t in meta["tokens"]))
+        entries.append((key, rows, len(blob)))
+    return header, entries
+
+
+# ----------------------------------------------------------- engine wiring
+
+
+def save_arena_snapshot(
+    engine, path: str, include_device: bool = True, trigger: str = "manual"
+) -> dict:
+    """Persist the engine's warm-prefix state to ``path`` (atomic).
+    Meters ``tpu_engine_snapshot_saves_total{outcome}`` + the
+    ``engine.snapshot.saved`` flight event; an armed
+    ``engine.snapshot.save`` error failpoint (or a real I/O error)
+    returns ``ok=False`` without touching the previous snapshot."""
+    t0 = time.perf_counter()
+    try:
+        hit = failpoints.fire("engine.snapshot.save")
+        truncate_fraction = None
+        if hit is not None and hit.mode == "truncate":
+            truncate_fraction = float(hit.arg) if hit.arg else 0.5
+        with engine._lock:
+            layout = snapshot_layout(engine)
+            fingerprint = params_fingerprint(engine.params)
+            entries = collect_entries(engine, include_device=include_device)
+        size = _write_snapshot(
+            path, layout, fingerprint, entries, truncate_fraction
+        )
+    except (failpoints.FailpointError, OSError, ValueError) as e:
+        if engine.metrics:
+            engine.metrics.snapshot_saves.inc(outcome="error")
+        if engine.flight is not None:
+            engine.flight.record(
+                "engine.snapshot.save_failed", trigger=trigger, error=str(e)
+            )
+        return {"ok": False, "reason": str(e), "trigger": trigger}
+    result = {
+        "ok": True,
+        "entries": len(entries),
+        "bytes": size,
+        "ms": round((time.perf_counter() - t0) * 1e3, 3),
+        "trigger": trigger,
+    }
+    if engine.metrics:
+        engine.metrics.snapshot_saves.inc(outcome="ok")
+        engine.metrics.snapshot_bytes.set(size)
+    if engine.flight is not None:
+        engine.flight.record("engine.snapshot.saved", **result)
+    return result
+
+
+def load_arena_snapshot(engine, path: str) -> dict:
+    """Rehydrate the host arena from ``path``.  Every entry re-enters
+    through ``HostKVArena.put`` (budget respected), so the next
+    same-prefix admission restores device-side instead of recomputing.
+    ANY verification failure clears whatever was partially admitted and
+    reports a clean cold start (``outcome=corrupt`` / ``layout_mismatch``
+    / ``params_mismatch``); a missing file is the ordinary first boot
+    (``outcome=missing``, not an error)."""
+    if not os.path.exists(path):
+        if engine.metrics:
+            engine.metrics.snapshot_loads.inc(outcome="missing")
+        return {"ok": False, "reason": "missing", "restored": 0}
+    if not engine._kv_arena.enabled:
+        if engine.metrics:
+            engine.metrics.snapshot_loads.inc(outcome="disabled")
+        return {"ok": False, "reason": "arena_disabled", "restored": 0}
+    t0 = time.perf_counter()
+    with engine._lock:
+        expected_layout = snapshot_layout(engine)
+        expected_fp = params_fingerprint(engine.params)
+    try:
+        header, entries = read_snapshot(path, expected_layout, expected_fp)
+        restored = 0
+        with engine._lock:
+            for key, rows, nbytes in entries:
+                engine._kv_arena.put(key, {"rows": rows}, nbytes)
+                restored += 1
+    except (failpoints.FailpointError, SnapshotError, OSError, ValueError) as e:
+        reason = str(e)
+        outcome = (
+            reason
+            if reason in ("layout_mismatch", "params_mismatch")
+            else "corrupt"
+        )
+        # Clean cold start, never a poisoned cache: drop EVERYTHING the
+        # arena holds (at startup that is exactly the partial load).
+        with engine._lock:
+            engine._kv_arena.clear()
+        if engine.metrics:
+            engine.metrics.snapshot_loads.inc(outcome=outcome)
+        if engine.flight is not None:
+            engine.flight.record(
+                "engine.snapshot.load_failed", reason=reason, outcome=outcome
+            )
+        return {"ok": False, "reason": reason, "restored": 0}
+    result = {
+        "ok": True,
+        "restored": restored,
+        "bytes": engine._kv_arena.bytes,
+        "ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+    if engine.metrics:
+        engine.metrics.snapshot_loads.inc(outcome="ok")
+    if engine.flight is not None:
+        engine.flight.record("engine.snapshot.loaded", **result)
+    return result

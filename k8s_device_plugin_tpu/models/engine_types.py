@@ -259,6 +259,52 @@ class EngineMetrics:
             "controller lets admission fill; max_slots when overload "
             "control is off or fully recovered)",
         )
+        # Replica self-fencing (models/engine_watchdog.py + EngineServer):
+        # a fenced replica stops admitting (503), reads fenced on
+        # /healthz and the router's summary poll, and its in-flight
+        # streams fail over — the metric pair is the rollout/alert
+        # surface.
+        self.fenced = registry.gauge(
+            "tpu_engine_fenced",
+            "1 while this replica is fenced (admission closed, router "
+            "demoted, streams failing over); 0 otherwise.  Fence reasons "
+            "ride tpu_engine_fences_total and GET /debug/state",
+        )
+        self.fences = registry.counter(
+            "tpu_engine_fences_total",
+            "Fence activations by source (watchdog: a dispatched step "
+            "outlived its deadline; chip_health: a chip in this "
+            "replica's mesh went Unhealthy/unplugged; operator: POST "
+            "/debug/fence)",
+            ["source"],
+        )
+        self.watchdog_deadline = registry.gauge(
+            "tpu_engine_watchdog_deadline_seconds",
+            "Current hung-step deadline (grace window during "
+            "warmup/compiles, else factor x rolling step p99) — the "
+            "wall-clock bound after which the watchdog fences",
+        )
+        # KV-arena warm restart (models/engine_snapshot.py): save/load
+        # outcomes and the on-disk size — a corrupt load shows up as
+        # outcome=corrupt with the replica serving cold, never poisoned.
+        self.snapshot_saves = registry.counter(
+            "tpu_engine_snapshot_saves_total",
+            "KV-arena snapshot writes by outcome (ok / error); saves run "
+            "on fence, drain, SIGTERM, and the periodic timer",
+            ["outcome"],
+        )
+        self.snapshot_loads = registry.counter(
+            "tpu_engine_snapshot_loads_total",
+            "KV-arena snapshot restores at startup by outcome (ok / "
+            "missing / corrupt / layout_mismatch / params_mismatch / "
+            "disabled); anything but ok degrades to a clean cold start",
+            ["outcome"],
+        )
+        self.snapshot_bytes = registry.gauge(
+            "tpu_engine_snapshot_bytes",
+            "Size of the last successfully written KV-arena snapshot "
+            "(size the snapshot volume from this plus headroom)",
+        )
 
 
 @dataclasses.dataclass

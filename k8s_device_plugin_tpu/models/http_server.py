@@ -117,6 +117,7 @@ from ..utils.metrics import MetricsRegistry, write_exposition
 from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
 from .engine_overload import SHED_EXPIRED, SHED_INFEASIBLE, ShedError
+from .engine_watchdog import ChipHealthFeed, StepWatchdog, visible_chip_paths
 
 
 class EngineServer:
@@ -136,6 +137,11 @@ class EngineServer:
         registry: Optional[MetricsRegistry] = None,
         request_timeout_s: float = 600.0,
         enable_trace: bool = False,
+        enable_admin: bool = True,
+        watchdog=None,
+        chip_health: Optional[ChipHealthFeed] = None,
+        snapshot_dir: str = "",
+        snapshot_interval_s: float = 60.0,
     ):
         self.engine = engine
         self._cond = threading.Condition()
@@ -144,6 +150,7 @@ class EngineServer:
         self._timeout = request_timeout_s
         self._trace_lock = threading.Lock()
         self._enable_trace = enable_trace
+        self._enable_admin = enable_admin
         # Graceful drain (SIGTERM path): admission stops the moment
         # `_draining` is set; the loop keeps stepping until the engine
         # runs dry (or the grace window expires), then `drained` fires
@@ -151,11 +158,88 @@ class EngineServer:
         # instead of cutting them mid-token.
         self._draining = threading.Event()
         self.drained = threading.Event()
+        # Replica self-fencing (ISSUE 10): a fenced replica stops
+        # admitting (503 + Retry-After), reads fenced on /healthz and
+        # the router's ?summary=1 poll, and CUTS its in-flight streams
+        # (no done event) so the router's zero-drop failover resubmits
+        # them elsewhere — a sick replica fails out of rotation instead
+        # of serving garbage or wedging clients.  Three triggers share
+        # this one path: the hung-step watchdog, the chip-health feed,
+        # and the POST /debug/fence operator endpoint.
+        self._fence = threading.Event()
+        self._fence_lock = threading.Lock()
+        self.fence_reason: Optional[str] = None
+        self.fence_source: Optional[str] = None
+        self.fence_detail = None
+        self.fence_at = 0.0
+        self.fences = 0
+        # Crash-safe warm restart (models/engine_snapshot.py): the KV
+        # host arena persists here on fence/drain/SIGTERM and on the
+        # periodic timer, and rehydrates via load_snapshot() at startup.
+        self._snapshot_dir = snapshot_dir
+        self._snapshot_interval_s = float(snapshot_interval_s)
+        self._snap_lock = threading.Lock()
+        self._snapshot_thread: Optional[threading.Thread] = None
+        self.last_snapshot_save: Optional[dict] = None
+        self.last_snapshot_load: Optional[dict] = None
+        # Hung-step watchdog: accept a preconfigured StepWatchdog (tests
+        # tune thresholds / inject clocks) or True for defaults; either
+        # way the fence callback binds HERE and the engine feeds it.
+        self.watchdog: Optional[StepWatchdog] = None
+        if watchdog:
+            wd = (
+                watchdog
+                if isinstance(watchdog, StepWatchdog)
+                else StepWatchdog(self._watchdog_fence)
+            )
+            wd.on_fence = self._watchdog_fence
+            if engine.metrics and wd._observe_deadline is None:
+                wd._observe_deadline = engine.metrics.watchdog_deadline.set
+            self.watchdog = wd
+            engine.watchdog = wd
+        # Chip-health feed: fence when a chip in this replica's mesh
+        # goes Unhealthy/unplugged (plugin daemon surface, devfs
+        # fallback).  Caller-constructed so tests inject probes.
+        self.chip_health = chip_health
+        if chip_health is not None:
+            chip_health.on_unhealthy = self._chip_fence
+            if chip_health.flight is None:
+                chip_health.flight = engine.flight
+        if engine.metrics:
+            engine.metrics.fenced.set(0)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
                 path = self.path.split("?")[0]
+                if path in ("/debug/fence", "/debug/unfence"):
+                    if not server._enable_admin:
+                        # Operator knob (--admin-endpoints 0): fencing
+                        # cancels in-flight work, and the server binds
+                        # 0.0.0.0 — an untrusted network gets a 404.
+                        self.send_error(404)
+                        return
+                    if path == "/debug/fence":
+                        try:
+                            length = int(self.headers.get("Content-Length", "0"))
+                            body = json.loads(self.rfile.read(length) or b"{}")
+                            reason = str(body.get("reason") or "operator")
+                        except (TypeError, ValueError) as e:
+                            self._reply(400, {"error": f"bad request: {e}"})
+                            return
+                        changed = server.begin_fence(reason, source="operator")
+                        self._reply(
+                            200,
+                            {
+                                "fenced": True,
+                                "reason": server.fence_reason,
+                                "changed": changed,
+                            },
+                        )
+                    else:
+                        changed = server.unfence()
+                        self._reply(200, {"fenced": False, "changed": changed})
+                    return
                 if path in ("/debug/trace", "/debug/profile/capture"):
                     if not server._enable_trace:
                         # Off unless the operator opted in (--debug-trace):
@@ -177,6 +261,22 @@ class EngineServer:
                 # on the response header, the JSON body, every SSE
                 # event, and every span the request produces.
                 trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
+                if server._fence.is_set():
+                    # Fenced: this replica may be decoding on a sick
+                    # chip or wedged mid-step — a plain 503 (no X-Shed)
+                    # tells the router to take it out of rotation and
+                    # retry the request elsewhere.
+                    self._reply(
+                        503,
+                        {
+                            "error": "replica is fenced",
+                            "reason": server.fence_reason,
+                            "trace_id": trace_id,
+                        },
+                        trace_id,
+                        retry_after=server._retry_after(),
+                    )
+                    return
                 if server._draining.is_set():
                     # Draining (SIGTERM): no new admissions; in-flight
                     # requests keep decoding to completion.  503 +
@@ -315,9 +415,29 @@ class EngineServer:
                 with server._cond:
                     server._cond.notify_all()  # wake an idle loop
                     finished = server._cond.wait_for(
-                        lambda: all(r.done for r in reqs),
+                        lambda: all(r.done for r in reqs)
+                        or server._fence.is_set(),
                         timeout=wait_timeout,
                     )
+                if server._fence.is_set() and not all(r.done for r in reqs):
+                    # Fenced mid-wait (hung step / sick chip): free the
+                    # engine side and answer the 503 the router's retry
+                    # path turns into a dispatch on a healthy replica.
+                    for r in reqs:
+                        server.engine.cancel(r)
+                    with server._cond:
+                        server._cond.notify_all()
+                    self._reply(
+                        503,
+                        {
+                            "error": "replica fenced mid-request",
+                            "reason": server.fence_reason,
+                            "trace_id": trace_id,
+                        },
+                        trace_id,
+                        retry_after=server._retry_after(),
+                    )
+                    return
                 if not finished:
                     # Stop burning chip time on a response nobody reads:
                     # cancel NOW (slot and pages free at the next step
@@ -541,11 +661,22 @@ class EngineServer:
                             server._cond.notify_all()  # wake an idle loop
                             server._cond.wait_for(
                                 lambda: req.done
-                                or len(req.tokens) - lag > sent,
+                                or len(req.tokens) - lag > sent
+                                or server._fence.is_set(),
                                 timeout=min(1.0, server._timeout),
                             )
                             toks = list(req.tokens)
                             done = req.done
+                        if server._fence.is_set():
+                            # Fenced: CUT the stream — no done, no error
+                            # event.  The fence's cancel sweep races this
+                            # wake, so a done observed here may be the
+                            # cancel's truncated teardown; emitting it
+                            # would hand the client a short stream that
+                            # LOOKS complete.  A cut stream is the shape
+                            # the router's zero-drop failover resubmits.
+                            server.engine.cancel(req)
+                            return
                         # Emit up to the lag horizon mid-flight; once done,
                         # everything left (req.tokens is already
                         # stop-truncated, so the held-back suffix that
@@ -603,6 +734,20 @@ class EngineServer:
                 path = self.path.split("?")[0]
                 if path == "/healthz":
                     ok = server._loop_alive and not server._stop.is_set()
+                    if server._fence.is_set():
+                        # Fenced beats draining/ok: the replica must
+                        # read as not-ready until an operator (or the
+                        # underlying fault clearing + unfence) releases
+                        # it.
+                        self._reply(
+                            503,
+                            {
+                                "status": "fenced",
+                                "reason": server.fence_reason,
+                            },
+                            retry_after=server._retry_after(),
+                        )
+                        return
                     if ok and server._draining.is_set():
                         # Draining reads as not-ready: a router/probe must
                         # stop sending traffic while in-flight work finishes.
@@ -630,6 +775,10 @@ class EngineServer:
                             1 for s in server.engine.slots if s is not None
                         ),
                         "draining": server._draining.is_set(),
+                        # The router's poll loop demotes a fenced
+                        # replica exactly like a draining one (no new
+                        # assignments; streams fail over).
+                        "fenced": server._fence.is_set(),
                         "loop_alive": server._loop_alive,
                     }
                     if "summary=1" in (self.path.split("?", 1) + [""])[1]:
@@ -645,6 +794,7 @@ class EngineServer:
                     # stay as open as /metrics.
                     state = {
                         "engine": server.engine.debug_state(),
+                        "fence": server.fence_state(),
                         **summary,
                     }
                     rec = server.engine.spans
@@ -761,7 +911,192 @@ class EngineServer:
             target=self._httpd.serve_forever, name="engine-http", daemon=True
         )
         self._http_thread.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
+        if self.chip_health is not None:
+            self.chip_health.start()
+        if self._snapshot_dir and self._snapshot_interval_s > 0:
+            self._snapshot_thread = threading.Thread(
+                target=self._snapshot_loop, name="engine-snapshot", daemon=True
+            )
+            self._snapshot_thread.start()
         return self
+
+    # ------------------------------------------------------------ fencing
+
+    def _watchdog_fence(self, info: dict) -> None:
+        self.begin_fence("hung_step", source="watchdog", detail=info)
+
+    def _chip_fence(self, info: dict) -> None:
+        self.begin_fence(
+            f"chip_{info.get('kind', 'fault')}", source="chip_health",
+            detail=info,
+        )
+
+    def begin_fence(
+        self, reason: str, source: str = "operator", detail=None
+    ) -> bool:
+        """Fence this replica: admission answers 503, ``/healthz`` and
+        the router's summary poll read fenced, in-flight streams are CUT
+        (the router's zero-drop failover resubmits them), the warm KV
+        state snapshots to disk, and everything still queued/slotted is
+        cancelled.  The step loop keeps running — an unfence resumes
+        serving without a restart.  Idempotent (False when already
+        fenced); ``source`` is the bounded metrics label
+        (watchdog / chip_health / operator)."""
+        with self._fence_lock:
+            if self._fence.is_set():
+                return False
+            self.fence_reason = str(reason)
+            self.fence_source = str(source)
+            self.fence_detail = detail
+            self.fence_at = time.monotonic()
+            self.fences += 1
+            self._fence.set()
+        eng = self.engine
+        if eng.metrics:
+            eng.metrics.fenced.set(1)
+            eng.metrics.fences.inc(source=source)
+        eng.flight.record(
+            "engine.fenced", reason=reason, source=source, detail=detail
+        )
+        # A fence is a discrete fault, incident-worthy on first
+        # observation — same fan-out as every other incident (ring +
+        # flight window + counter), so /debug/incidents tells the story.
+        eng.anomaly.report(
+            "engine.fenced", 1.0, reason=reason, source=source
+        )
+        # Wake every waiter FIRST: streams cut and unary handlers 503
+        # before the cancel sweep below can dress a teardown up as a
+        # completion.
+        with self._cond:
+            self._cond.notify_all()
+        # Persist the warm prefix state while the process still can — a
+        # fence is often the last stop before a restart.  A chip-health
+        # fence skips the device-page reads (rows off a sick chip are
+        # not worth trusting); the host-RAM arena is still safe.
+        if self._snapshot_dir:
+            self.save_snapshot(
+                trigger=f"fence:{source}",
+                include_device=source != "chip_health",
+            )
+        # In-flight work is being failed over by the router: release
+        # the slots/pages rather than keep decoding for nobody (a hung
+        # loop applies this at whatever step boundary it next reaches).
+        with self._cond:
+            leftovers = [r for r in eng.slots if r is not None]
+            leftovers += list(eng.queue)
+        for req in leftovers:
+            eng.cancel(req)
+        with self._cond:
+            self._cond.notify_all()
+        return True
+
+    def unfence(self) -> bool:
+        """Release the fence: admission reopens, ``/healthz`` recovers,
+        the router's next poll promotes the replica back, and both
+        detectors re-arm (a STILL-hung step or still-sick chip re-fences
+        on their next check — unfencing a wedged replica tells the
+        operator immediately)."""
+        with self._fence_lock:
+            if not self._fence.is_set():
+                return False
+            self._fence.clear()
+            self.fence_reason = None
+            self.fence_source = None
+            self.fence_detail = None
+        eng = self.engine
+        if eng.metrics:
+            eng.metrics.fenced.set(0)
+        eng.flight.record("engine.unfenced")
+        if self.watchdog is not None:
+            self.watchdog.rearm()
+        if self.chip_health is not None:
+            self.chip_health.rearm()
+        with self._cond:
+            self._cond.notify_all()
+        return True
+
+    @property
+    def fenced(self) -> bool:
+        return self._fence.is_set()
+
+    def fence_state(self) -> dict:
+        """JSON-safe fence/watchdog/snapshot block of GET /debug/state."""
+        with self._fence_lock:
+            fenced = self._fence.is_set()
+            state = {
+                "fenced": fenced,
+                "reason": self.fence_reason,
+                "source": self.fence_source,
+                "detail": self.fence_detail,
+                "since_s": (
+                    round(time.monotonic() - self.fence_at, 3)
+                    if fenced
+                    else None
+                ),
+                "fences_total": self.fences,
+            }
+        state["watchdog"] = (
+            self.watchdog.snapshot() if self.watchdog is not None else None
+        )
+        state["chip_health"] = (
+            self.chip_health.snapshot()
+            if self.chip_health is not None
+            else None
+        )
+        state["snapshot"] = {
+            "dir": self._snapshot_dir or None,
+            "interval_s": self._snapshot_interval_s,
+            "last_save": self.last_snapshot_save,
+            "last_load": self.last_snapshot_load,
+        }
+        return state
+
+    # ----------------------------------------------------- warm snapshots
+
+    def _snapshot_path(self) -> str:
+        from .engine_snapshot import SNAPSHOT_NAME
+
+        return os.path.join(self._snapshot_dir, SNAPSHOT_NAME)
+
+    def save_snapshot(
+        self, trigger: str = "manual", include_device: bool = True
+    ) -> dict:
+        """Persist the KV host arena (+ retained device pages) to the
+        snapshot dir; one save at a time (periodic vs fence vs drain
+        collapse onto the lock, last writer wins the atomic rename)."""
+        if not self._snapshot_dir:
+            return {"ok": False, "reason": "disabled"}
+        from .engine_snapshot import save_arena_snapshot
+
+        with self._snap_lock:
+            result = save_arena_snapshot(
+                self.engine,
+                self._snapshot_path(),
+                include_device=include_device,
+                trigger=trigger,
+            )
+            self.last_snapshot_save = result
+        return result
+
+    def load_snapshot(self) -> dict:
+        """Rehydrate the KV host arena from the snapshot dir (call once
+        BEFORE start(): the first admissions then restore warm).  A
+        missing/corrupt snapshot degrades to a clean cold start."""
+        if not self._snapshot_dir:
+            return {"ok": False, "reason": "disabled"}
+        from .engine_snapshot import load_arena_snapshot
+
+        result = load_arena_snapshot(self.engine, self._snapshot_path())
+        self.last_snapshot_load = result
+        return result
+
+    def _snapshot_loop(self) -> None:
+        while not self._stop.wait(self._snapshot_interval_s):
+            if self._fence.is_set():
+                continue  # the fence path already saved
+            self.save_snapshot(trigger="periodic")
 
     # ----------------------------------------------------------- draining
 
@@ -813,6 +1148,10 @@ class EngineServer:
             cut_requests=cut,
             seconds=round(time.monotonic() - t0, 3),
         )
+        # The drain is the orderly half of a restart: persist the warm
+        # prefix state so the replacement pod's restores hit warm.
+        if self._snapshot_dir:
+            self.save_snapshot(trigger="drain")
         self._stop.set()
         self.drained.set()
         with self._cond:
@@ -820,6 +1159,10 @@ class EngineServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.chip_health is not None:
+            self.chip_health.stop()
         with self._cond:
             self._cond.notify_all()
         self._httpd.shutdown()
@@ -1052,9 +1395,83 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--failpoints",
         default="",
         help="arm chaos failpoints: 'name=mode[:arg][*count];...' with "
-        "modes error/delay/hang/flap (utils/failpoints.py; catalog in "
-        "docs/chaos.md).  Adds to any $TPU_FAILPOINTS arming; every "
-        "trigger lands in the flight recorder",
+        "modes error/delay/hang/flap/truncate (utils/failpoints.py; "
+        "catalog in docs/chaos.md).  Adds to any $TPU_FAILPOINTS arming; "
+        "every trigger lands in the flight recorder",
+    )
+    p.add_argument(
+        "--watchdog",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="hung-step watchdog (models/engine_watchdog.py, default "
+        "on): a host thread deadlines every dispatched engine step "
+        "against factor x the rolling step-time p99 (compile-aware "
+        "grace, so first-shape XLA compiles never false-trip); a breach "
+        "FENCES the replica — admission 503, /healthz fenced, router "
+        "demotion, in-flight streams cut for zero-drop failover",
+    )
+    p.add_argument(
+        "--watchdog-min-deadline",
+        type=float,
+        default=5.0,
+        help="floor (seconds) of the hung-step deadline: the watchdog "
+        "never fences a step younger than this however fast the "
+        "baseline runs",
+    )
+    p.add_argument(
+        "--watchdog-grace",
+        type=float,
+        default=120.0,
+        help="deadline (seconds) for GRACE steps — warmup, fresh XLA "
+        "compiles, prefill/admission work; size it above the worst "
+        "cold-compile the model can hit",
+    )
+    p.add_argument(
+        "--chip-health-url",
+        default="",
+        help="plugin daemon device-health surface to watch (e.g. "
+        "http://127.0.0.1:9400/debug/devices — the DaemonSet's "
+        "--metrics-port on the node): a chip of this replica's mesh "
+        "going Unhealthy or leaving the inventory fences the replica; "
+        "after repeated poll failures the feed falls back to direct "
+        "/dev/accel* presence probes of TPU_VISIBLE_CHIPS (empty: "
+        "devfs probes only, or off entirely when off-cluster)",
+    )
+    p.add_argument(
+        "--chip-health-interval",
+        type=float,
+        default=1.0,
+        help="chip-health poll cadence in seconds",
+    )
+    p.add_argument(
+        "--snapshot-dir",
+        default="",
+        help="crash-safe warm restart (models/engine_snapshot.py): "
+        "persist the content-addressed KV host arena here on "
+        "fence/drain/SIGTERM and every --snapshot-interval seconds "
+        "(atomic rename, versioned header, per-page checksums), and "
+        "rehydrate it at startup so a restarted replica's prefix "
+        "restores hit warm; a corrupted/truncated snapshot degrades to "
+        "a clean cold start.  The deploy yamls mount an emptyDir here; "
+        "empty = off",
+    )
+    p.add_argument(
+        "--snapshot-interval",
+        type=float,
+        default=60.0,
+        help="seconds between periodic KV-arena snapshots (0 disables "
+        "the timer; fence/drain/SIGTERM saves still run)",
+    )
+    p.add_argument(
+        "--admin-endpoints",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="serve POST /debug/fence and /debug/unfence "
+        "(operator-forced fencing for rollouts — same code path as the "
+        "watchdog); set 0 on untrusted networks: the server binds "
+        "0.0.0.0 and a fence cancels in-flight work",
     )
     p.add_argument(
         "--checkpoint-dir",
@@ -1230,10 +1647,47 @@ def main(argv: Optional[list[str]] = None) -> None:
         mesh=mesh,
         **spec_kw,
     )
+    watchdog = None
+    if args.watchdog:
+        watchdog = StepWatchdog(
+            lambda info: None,  # EngineServer binds the fence path
+            min_deadline_s=args.watchdog_min_deadline,
+            grace_deadline_s=args.watchdog_grace,
+        )
+    chip_feed = None
+    chip_paths = visible_chip_paths()
+    if args.chip_health_url or chip_paths:
+        chip_feed = ChipHealthFeed(
+            lambda info: None,  # EngineServer binds the fence path
+            url=args.chip_health_url,
+            device_paths=chip_paths,
+            poll_interval_s=args.chip_health_interval,
+            flight=box,
+        )
+        print(
+            "chip-health feed: "
+            + (args.chip_health_url or "devfs")
+            + f" over {chip_paths or 'daemon inventory'}",
+            file=sys.stderr,
+        )
     server = EngineServer(
         engine, port=args.http_port, registry=registry,
         enable_trace=args.debug_trace,
-    ).start()
+        enable_admin=bool(args.admin_endpoints),
+        watchdog=watchdog,
+        chip_health=chip_feed,
+        snapshot_dir=args.snapshot_dir,
+        snapshot_interval_s=args.snapshot_interval,
+    )
+    if args.snapshot_dir:
+        # Rehydrate BEFORE serving: the first admissions restore warm.
+        restored = server.load_snapshot()
+        print(
+            f"kv snapshot restore: {restored}",
+            file=sys.stderr,
+            flush=True,
+        )
+    server.start()
 
     # A pod delete sends SIGTERM: drain gracefully — stop admitting,
     # finish in-flight decodes inside --drain-grace, THEN stop the loop —

@@ -56,6 +56,12 @@ class ReplicaState:
         self.breaker = breaker
         self.reachable = True  # optimistic until a poll says otherwise
         self.draining = False
+        # Replica self-fencing (summary ``fenced``): a sick replica —
+        # hung step, unhealthy chip, operator fence — is treated exactly
+        # like a draining one (no new assignments, in-flight streams
+        # fail over through the ordinary zero-drop path) until its
+        # summary clears.
+        self.fenced = False
         self.queue_depth = 0
         self.active_slots = 0
         self.last_poll = 0.0  # time.monotonic of last successful poll
@@ -66,6 +72,7 @@ class ReplicaState:
         return {
             "reachable": self.reachable,
             "draining": self.draining,
+            "fenced": self.fenced,
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
             "breaker": self.breaker.snapshot(),
@@ -124,15 +131,22 @@ class RoutingPolicy:
         tags anything after it ``failover``.
         """
         ring_order = self.ring.order(self.key_of(prompt))
+
+        def _out(st: ReplicaState) -> bool:
+            # Draining and fenced replicas take NO new assignments —
+            # not even as a stale-poll hedge (a fenced replica answers
+            # 503 by contract; dialing it just burns a retry token).
+            return st.draining or st.fenced
+
         eligible = [
             n
             for n in ring_order
-            if not self.replicas[n].draining and self.replicas[n].reachable
+            if not _out(self.replicas[n]) and self.replicas[n].reachable
         ]
         stale = [
             n
             for n in ring_order
-            if not self.replicas[n].draining and not self.replicas[n].reachable
+            if not _out(self.replicas[n]) and not self.replicas[n].reachable
         ]
         if self.mode == "random":
             with self._rng_lock:
@@ -169,7 +183,7 @@ class RoutingPolicy:
         depths = [
             st.queue_depth
             for st in self.replicas.values()
-            if st.reachable and not st.draining
+            if st.reachable and not st.draining and not st.fenced
         ]
         if not depths:
             return 0.0

@@ -126,6 +126,13 @@ class RouterMetrics:
             "1 while the replica reports draining (no new assignments)",
             ("replica",),
         )
+        self.replica_fenced = registry.gauge(
+            "tpu_router_replica_fenced",
+            "1 while the replica reports fenced (self-fenced on a hung "
+            "step / sick chip / operator fence: no new assignments, "
+            "in-flight streams fail over)",
+            ("replica",),
+        )
         self.breaker_state = registry.gauge(
             "tpu_router_breaker_state",
             "Breaker state per replica (0 closed, 1 open, 2 half-open)",
@@ -153,6 +160,7 @@ class RouterMetrics:
             self.replica_up,
             self.replica_queue_depth,
             self.replica_draining,
+            self.replica_fenced,
             self.breaker_state,
         ):
             gauge.remove(replica=name)
@@ -426,6 +434,7 @@ class RouterServer:
             self.replicas[name] = ReplicaState(name, breaker)
             self.ring.add(name)
         self.metrics.replica_up.set(1, replica=name)
+        self.metrics.replica_fenced.set(0, replica=name)
         self.metrics.breaker_state.set(STATE_VALUE["closed"], replica=name)
         self._record("router.replica_added", replica=name)
 
@@ -489,6 +498,9 @@ class RouterServer:
             draining = bool(payload.get("draining", False))
             if draining != st.draining:
                 self._mark_draining(name, draining)
+            fenced = bool(payload.get("fenced", False))
+            if fenced != st.fenced:
+                self._mark_fenced(name, fenced)
             st.last_poll = time.monotonic()
             self.metrics.replica_queue_depth.set(
                 st.queue_depth, replica=name
@@ -502,6 +514,21 @@ class RouterServer:
         self.metrics.replica_draining.set(1 if draining else 0, replica=name)
         self._record(
             "router.drain_begin" if draining else "router.drain_end",
+            replica=name,
+        )
+
+    def _mark_fenced(self, name: str, fenced: bool) -> None:
+        """A replica self-fenced (hung-step watchdog, chip-health feed,
+        or operator POST /debug/fence): demote it exactly like a
+        draining one — no new assignments; its cut streams fail over
+        through the ordinary zero-drop path — until the summary clears."""
+        st = self.replicas.get(name)
+        if st is None or st.fenced == fenced:
+            return
+        st.fenced = fenced
+        self.metrics.replica_fenced.set(1 if fenced else 0, replica=name)
+        self._record(
+            "router.replica_fenced" if fenced else "router.replica_unfenced",
             replica=name,
         )
 

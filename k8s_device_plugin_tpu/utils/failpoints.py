@@ -41,6 +41,13 @@ Fault modes:
     ``fire()`` returns a :class:`FailpointHit` whose ``value``
     alternates every ``period`` triggers (default 1) — the transient
     probe-failure shape the health debounce exists for.
+``truncate[:fraction]``
+    ``fire()`` returns a hit the CALL SITE interprets as "corrupt your
+    output": the snapshot writer tears the file to ``fraction`` of its
+    bytes (default 0.5), the snapshot reader reads only that prefix —
+    the disk-corruption shape the warm-restart degradation contract is
+    scored against.  Sites that do not understand truncation ignore the
+    hit (non-error hits are advisory by design).
 
 Spec grammar (``--failpoints`` on both CLIs, ``TPU_FAILPOINTS`` env)::
 
@@ -65,7 +72,7 @@ log = logging.getLogger("tpu.failpoints")
 
 ENV = "TPU_FAILPOINTS"
 
-MODES = ("error", "delay", "hang", "flap")
+MODES = ("error", "delay", "hang", "flap", "truncate")
 
 # Hard ceiling on hang-mode blocking: chaos must stay recoverable.
 MAX_HANG_S = 30.0
@@ -80,20 +87,24 @@ class FailpointError(RuntimeError):
 class FailpointHit:
     """What ``fire()`` returns when an armed (non-error) failpoint
     triggered: which one, in which mode, the per-arm trigger ordinal,
-    and — for ``flap`` — whether the fault is currently ACTIVE."""
+    for ``flap`` whether the fault is currently ACTIVE, and the arm's
+    raw ``arg`` (``truncate`` call sites read their fraction off it)."""
 
-    __slots__ = ("name", "mode", "n", "value")
+    __slots__ = ("name", "mode", "n", "value", "arg")
 
-    def __init__(self, name: str, mode: str, n: int, value: bool):
+    def __init__(
+        self, name: str, mode: str, n: int, value: bool, arg=None
+    ):
         self.name = name
         self.mode = mode
         self.n = n
         self.value = value
+        self.arg = arg
 
     def __repr__(self) -> str:  # debugging/log friendliness
         return (
             f"FailpointHit(name={self.name!r}, mode={self.mode!r}, "
-            f"n={self.n}, value={self.value})"
+            f"n={self.n}, value={self.value}, arg={self.arg!r})"
         )
 
 
@@ -176,6 +187,19 @@ def parse_spec(spec: str) -> list[tuple[str, str, Optional[str], Optional[int]]]
             if period < 1:
                 raise ValueError(
                     f"failpoint {name!r}: flap period must be >= 1"
+                )
+        if mode == "truncate" and arg is not None:
+            try:
+                fraction = float(arg)
+            except ValueError:
+                raise ValueError(
+                    f"failpoint {name!r}: truncate fraction {arg!r} is not "
+                    "a number"
+                ) from None
+            if not 0.0 <= fraction < 1.0:
+                raise ValueError(
+                    f"failpoint {name!r}: truncate fraction must be in "
+                    f"[0, 1), got {fraction}"
                 )
         out.append((name, mode, arg, count))
     return out
@@ -350,15 +374,22 @@ class FailpointRegistry:
             )
         if fp.mode == "delay":
             time.sleep(float(fp.arg))
-            return FailpointHit(name, "delay", n, True)
+            return FailpointHit(name, "delay", n, True, fp.arg)
         if fp.mode == "hang":
             limit = min(float(fp.arg), MAX_HANG_S) if fp.arg else MAX_HANG_S
             fp.unhang.wait(timeout=limit)
-            return FailpointHit(name, "hang", n, True)
+            return FailpointHit(name, "hang", n, True, fp.arg)
+        if fp.mode == "truncate":
+            # Advisory: the call site tears its own output (snapshot
+            # writer/reader — docs/chaos.md catalog); sites that do not
+            # understand truncation ignore the hit.
+            return FailpointHit(name, "truncate", n, True, fp.arg)
         # flap: fault value alternates every `period` triggers, starting
         # ACTIVE (the first probe after arming sees the fault).
         period = int(fp.arg) if fp.arg else 1
-        return FailpointHit(name, "flap", n, ((n - 1) // period) % 2 == 0)
+        return FailpointHit(
+            name, "flap", n, ((n - 1) // period) % 2 == 0, fp.arg
+        )
 
 
 # Process-wide registry: the production call sites (plugin, engine,

@@ -380,6 +380,8 @@ class FakeReplica:
         self.prefill_delay_s = prefill_delay_s
         self._draining = threading.Event()
         self._shedding = threading.Event()  # overload-shed mode (X-Shed)
+        self._fenced = threading.Event()  # self-fenced (summary `fenced`)
+        self.fence_reason = "operator"
         self.shed_kind = "overload"
         self.retry_after = "1"
         self.killed = threading.Event()
@@ -388,6 +390,7 @@ class FakeReplica:
         self.generate_requests = 0  # every /generate that got past drain
         self.drain_rejects = 0  # 503s answered while draining
         self.shed_rejects = 0  # 503+X-Shed answered while shedding
+        self.fence_rejects = 0  # 503s answered while fenced
         self.active_streams = 0
         self.seen_trace_ids: list = []
         self.seen_deadlines: list = []  # X-Request-Deadline header values
@@ -412,6 +415,24 @@ class FakeReplica:
                     self.send_error(404)
                     return
                 trace_id = self.headers.get("X-Request-Id") or ""
+                if replica._fenced.is_set():
+                    # The EngineServer fence contract: plain 503 +
+                    # Retry-After, no X-Shed — the router must stop
+                    # assigning and retry elsewhere.
+                    with replica._lock:
+                        replica.fence_rejects += 1
+                    body = json.dumps(
+                        {"error": "replica is fenced",
+                         "reason": replica.fence_reason,
+                         "trace_id": trace_id}
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", replica.retry_after)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if replica._draining.is_set():
                     with replica._lock:
                         replica.drain_rejects += 1
@@ -516,10 +537,16 @@ class FakeReplica:
                         "queue_depth": active,  # the fake has no queue
                         "active_slots": active,
                         "draining": replica._draining.is_set(),
+                        "fenced": replica._fenced.is_set(),
                         "loop_alive": True,
                     })
                 elif path == "/healthz":
-                    if replica._draining.is_set():
+                    if replica._fenced.is_set():
+                        self._json(503, {
+                            "status": "fenced",
+                            "reason": replica.fence_reason,
+                        })
+                    elif replica._draining.is_set():
                         self._json(503, {"status": "draining"})
                     else:
                         self._json(200, {"status": "ok"})
@@ -571,6 +598,24 @@ class FakeReplica:
 
     def undrain(self) -> None:
         self._draining.clear()
+
+    # --- the EngineServer fence contract ---
+    def begin_fence(
+        self, reason: str = "operator", retry_after: str = "1"
+    ) -> None:
+        """Replica self-fenced (watchdog trip / sick chip / operator):
+        new /generate answers a plain 503 + Retry-After (no X-Shed),
+        /healthz answers fenced, and the ?summary=1 poll grows
+        ``fenced: true`` — the router must stop assigning and let
+        in-flight streams fail over.  In-flight FAKE streams keep
+        running (the real server cuts them; tests that need the cut use
+        kill())."""
+        self.fence_reason = reason
+        self.retry_after = retry_after
+        self._fenced.set()
+
+    def unfence(self) -> None:
+        self._fenced.clear()
 
     # --- the EngineServer overload-shed contract ---
     def begin_shed(

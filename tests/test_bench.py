@@ -430,3 +430,70 @@ def test_bench_diff_parses_overload_block(tmp_path):
     (tmp_path / "d.json").write_text(json.dumps(loaded))
     d = bench_diff.load_record(str(tmp_path / "d.json"))
     assert "PAGE-LEAK" in bench_diff.ledger_row(a, d)
+
+
+def test_bench_diff_parses_restart_block(tmp_path):
+    """Records grew a RESTART block (ISSUE 10, benchmark.py
+    _run_restart_phase): cold vs warm post-restart TTFT p99 and the
+    restored-page count must surface in the normalized record, the
+    field diff, and the ledger row — and the row must scream
+    COLD-REGRESSED when the warm restart is SLOWER than a cold one
+    (speedup < 1) and NO-RESTORE when the snapshot stopped
+    rehydrating (0 pages restored)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 9,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 10
+    loaded["parsed"]["restart"] = {
+        "sessions": 4, "prefix_tokens": 48,
+        "snapshot_bytes": 120000, "snapshot_entries": 3,
+        "entries_loaded": 3,
+        "cold": {"ttft_p50_ms": 30.0, "ttft_p99_ms": 42.0,
+                 "prefix_hits": 0},
+        "warm": {"ttft_p50_ms": 12.0, "ttft_p99_ms": 20.0,
+                 "prefix_hits": 8, "restored_pages": 12},
+        "warm_speedup": 2.1,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["restart_cold_ttft_p99_ms"] == 42.0
+    assert b["restart_warm_ttft_p99_ms"] == 20.0
+    assert b["restart_restored_pages"] == 12
+    assert b["restart_warm_speedup"] == 2.1
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "restart_warm_ttft_p99_ms" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "restart warm p99 20.0ms vs cold 42.0ms" in row
+    assert "12 pages restored" in row
+    assert "COLD-REGRESSED" not in row and "NO-RESTORE" not in row
+    # Warm slower than cold: the one outcome worse than no snapshot.
+    loaded["parsed"]["restart"]["warm_speedup"] = 0.8
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "COLD-REGRESSED" in bench_diff.ledger_row(a, c)
+    # Zero restored pages: the snapshot silently stopped rehydrating.
+    loaded["parsed"]["restart"]["warm_speedup"] = 2.1
+    loaded["parsed"]["restart"]["warm"]["restored_pages"] = 0
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "NO-RESTORE" in bench_diff.ledger_row(a, d)
+    # A skipped phase rides in parsed untouched, never in the row.
+    loaded["parsed"]["restart"] = {"skipped": "prompt too short"}
+    (tmp_path / "e.json").write_text(json.dumps(loaded))
+    e = bench_diff.load_record(str(tmp_path / "e.json"))
+    assert "restart_warm_ttft_p99_ms" not in e
+    assert "restart warm p99" not in bench_diff.ledger_row(a, e)

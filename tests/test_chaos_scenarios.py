@@ -1109,3 +1109,445 @@ def test_chaos_overload_storm_mixed_priorities(chaos_server, tmp_path):
         assert slo["pass"], slo
     finally:
         engine.overload = None
+
+
+# ======================================================================
+# Scenarios 9-11: replica self-fencing + crash-safe warm restart
+# ======================================================================
+
+
+@pytest.fixture(scope="module")
+def fenced_pair():
+    """Two IDENTICAL tiny serving replicas (same params seed, KV tiers
+    on, hung-step watchdog armed) — identical weights make greedy
+    failover continuations bit-identical across replicas, so the
+    zero-drop contract is checkable token-for-token.  Yields a mutable
+    dict so the warm-restart scenario can swap in the server it
+    rebuilt; teardown stops whatever is current."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_device_plugin_tpu.models.engine import (
+        EngineMetrics,
+        ServingEngine,
+    )
+    from k8s_device_plugin_tpu.models.engine_watchdog import StepWatchdog
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+    from k8s_device_plugin_tpu.models.transformer import (
+        GPTConfig,
+        PagedConfig,
+        TransformerLM,
+    )
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+    from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    paged = PagedConfig(page_size=4, num_pages=64, max_pages_per_seq=16)
+    pair = {"cfg": cfg, "params": params, "paged": paged}
+    for tag in ("a", "b"):
+        registry = MetricsRegistry()
+        box = FlightRecorder(capacity=8192, name=f"replica-{tag}")
+        engine = ServingEngine(
+            cfg, params, paged, max_slots=4,
+            metrics=EngineMetrics(registry), flight=box,
+            kv_retain=True, kv_host_cache_mb=16,
+        )
+        wd = StepWatchdog(
+            lambda info: None,  # EngineServer binds the fence path
+            min_deadline_s=0.5, grace_deadline_s=45.0,
+            warmup=4, poll_interval_s=0.05,
+        )
+        server = EngineServer(
+            engine, host="127.0.0.1", port=0, registry=registry,
+            watchdog=wd, request_timeout_s=120,
+        ).start()
+        pair[f"engine_{tag}"] = engine
+        pair[f"server_{tag}"] = server
+        pair[f"registry_{tag}"] = registry
+        # Warm the prefill shapes the scenarios hit — the 8-token
+        # session prompt plus the longer prompt+emitted resubmission
+        # buckets a mid-stream failover lands (batch 1 and 2) — so no
+        # scenario measurement eats a cold compile.
+        for plen in (8, 12, 24, 40):
+            for group in (1, 2):
+                import threading as _threading
+
+                threads = [
+                    _threading.Thread(
+                        target=_replica_post,
+                        args=(server.port, [7 + g] * plen, 2),
+                    )
+                    for g in range(group)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        engine.kvcache_clear()
+    yield pair
+    from k8s_device_plugin_tpu.utils import failpoints
+
+    failpoints.disarm_all()
+    for tag in ("a", "b"):
+        try:
+            pair[f"server_{tag}"].stop()
+        except OSError:
+            pass
+
+
+def _replica_post(port, prompt, max_new, timeout=120):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(
+            {"prompt": list(prompt), "max_new_tokens": max_new}
+        ).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _sse_stream(port, payload, out, timeout=120):
+    """Read one SSE /generate stream into ``out`` (events list + flags)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:
+                line = line.strip()
+                if line.startswith(b"data:"):
+                    out["events"].append(json.loads(line[5:]))
+    except OSError as e:
+        out["error"] = str(e)
+    finally:
+        out["done"] = True
+
+
+def test_chaos_readback_hang_watchdog_fence_zero_drop(fenced_pair, tmp_path):
+    """A wedged device readback (engine.readback hang failpoint) on the
+    replica serving a session: the hung-step watchdog must fence it
+    within the deadline, the router must demote it (summary ``fenced``)
+    and fail the cut streams over — with ZERO client-visible drops and
+    bit-identical tokens (same weights on both replicas).  The clean
+    replica is the precision control: any fence it raises is a false
+    positive."""
+    import threading
+
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils import failpoints
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    chaos_report = _chaos_report()
+    server_a, server_b = fenced_pair["server_a"], fenced_pair["server_b"]
+    engine_a, engine_b = fenced_pair["engine_a"], fenced_pair["engine_b"]
+    rbox = FlightRecorder(capacity=4096, name="router")
+    router = RouterServer(
+        [f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"],
+        host="127.0.0.1", port=0, flight=rbox,
+        poll_interval_s=0.15, hedge=False, upstream_timeout_s=120.0,
+        request_timeout_s=120.0,
+    ).start()
+    try:
+        # A session prompt whose ring home is replica A.
+        a_name = f"127.0.0.1:{server_a.port}"
+        prompt = None
+        for salt in range(400):
+            cand = [(salt + 3) % 90 + 2] * 8
+            if router.ring.order(router.policy.key_of(cand))[0] == a_name:
+                prompt = cand
+                break
+        assert prompt is not None
+        max_new = 32
+        # Oracle: the undisturbed greedy stream, computed on the CLEAN
+        # replica (identical weights), tiers cleared afterwards.
+        oracle = _replica_post(server_b.port, prompt, max_new)["tokens"]
+        engine_b.kvcache_clear()
+
+        # The hang clears when the replica fences (a fault pinned to
+        # that replica): disarm INSIDE the fence path, before the cut
+        # streams fail over — the clean replica must never fire it.
+        orig_fence = server_a.begin_fence
+
+        def fence_and_clear(*args, **kwargs):
+            failpoints.disarm_all()
+            return orig_fence(*args, **kwargs)
+
+        server_a.begin_fence = fence_and_clear
+        streams = [
+            {"events": [], "done": False} for _ in range(2)
+        ]
+        threads = [
+            threading.Thread(
+                target=_sse_stream,
+                args=(
+                    router.port,
+                    {"prompt": prompt, "max_new_tokens": max_new},
+                    out,
+                ),
+                daemon=True,
+            )
+            for out in streams
+        ]
+        for t in threads:
+            t.start()
+        assert wait_until(
+            lambda: all(
+                len(s["events"]) >= 4 for s in streams
+            ),
+            timeout=60,
+        ), "streams never reached steady decode"
+        t0 = time.time()
+        failpoints.arm("engine.readback", "hang", arg="25")
+        assert wait_until(lambda: server_a.fenced, timeout=15), (
+            "watchdog never fenced the hung replica"
+        )
+        fence_detect_s = time.time() - t0
+        for t in threads:
+            t.join(timeout=120)
+        injected = [{
+            "cls": "engine_hang", "replica": a_name,
+            "t0": t0, "t1": time.time(),
+        }]
+        detected = []
+        for name, eng in ((a_name, engine_a),
+                          (f"127.0.0.1:{server_b.port}", engine_b)):
+            for e in eng.flight.window(kinds=["engine.fenced"]):
+                detected.append(
+                    {"cls": "engine_hang", "replica": name, "ts": e["ts"]}
+                )
+        score = chaos_report.score_detections(injected, detected, grace_s=5.0)
+        hang = score["per_class"]["engine_hang"]
+
+        # Zero client-visible drops, bit-identical through the failover.
+        drops = 0
+        for s in streams:
+            tokens = [e["token"] for e in s["events"] if "token" in e]
+            dones = [e for e in s["events"] if e.get("done")]
+            if not dones or tokens != oracle:
+                drops += 1
+        # The router saw the fence via the summary poll too.
+        assert wait_until(
+            lambda: bool(rbox.window(kinds=["router.replica_fenced"])),
+            timeout=5,
+        )
+        failovers = len(rbox.window(kinds=["router.failover"]))
+        slo = {
+            "targets": {"fence_detect_s": 5.0, "dropped_streams": 0},
+            "measured": {
+                "fence_detect_s": round(fence_detect_s, 3),
+                "dropped_streams": drops,
+                "failovers": failovers,
+            },
+            "pass": fence_detect_s <= 5.0 and drops == 0,
+        }
+        result = {
+            "scenario": "readback_hang_watchdog_fence",
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": (
+                hang["precision"] == 1.0 and hang["recall"] == 1.0
+                and drops == 0
+            ),
+        }
+        _publish(result)
+        assert hang["recall"] == 1.0, score
+        assert hang["precision"] == 1.0, score  # clean replica stayed quiet
+        assert drops == 0, [s["events"][-1:] for s in streams]
+        assert failovers >= 1, "streams completed without failing over?"
+        assert slo["pass"], slo
+    finally:
+        server_a.begin_fence = orig_fence
+        failpoints.disarm_all()
+        router.stop()
+        server_a.unfence()
+        assert wait_until(
+            lambda: not any(s is not None for s in engine_a.slots), timeout=30
+        )
+        engine_a.kvcache_clear()
+        engine_b.kvcache_clear()
+
+
+def test_chaos_chip_unplug_mid_decode_fence(fenced_pair, tmp_path):
+    """A chip yanked mid-decode: the chip-health feed (devfs presence
+    probe — the daemon-less fallback path) must fence the replica; the
+    stream on it is cut, /healthz flips to fenced.  A second feed over
+    a HEALTHY devfs on the control replica must stay quiet (precision).
+    Deterministic: the test drives check_once() itself."""
+    import threading
+
+    from k8s_device_plugin_tpu.models.engine_watchdog import ChipHealthFeed
+
+    chaos_report = _chaos_report()
+    server_a, server_b = fenced_pair["server_a"], fenced_pair["server_b"]
+    engine_a, engine_b = fenced_pair["engine_a"], fenced_pair["engine_b"]
+    a_name = f"127.0.0.1:{server_a.port}"
+    b_name = f"127.0.0.1:{server_b.port}"
+    devs = {}
+    for tag in ("a", "b"):
+        d = tmp_path / tag / "dev"
+        d.mkdir(parents=True)
+        (d / "accel0").write_text("")
+        devs[tag] = str(d / "accel0")
+    feed_a = ChipHealthFeed(lambda f: None, device_paths=[devs["a"]])
+    feed_a.on_unhealthy = server_a._chip_fence
+    feed_b = ChipHealthFeed(lambda f: None, device_paths=[devs["b"]])
+    feed_b.on_unhealthy = server_b._chip_fence
+    try:
+        out = {"events": [], "done": False}
+        t = threading.Thread(
+            target=_sse_stream,
+            args=(server_a.port, {"prompt": [11] * 8,
+                                  "max_new_tokens": 32}, out),
+            daemon=True,
+        )
+        t.start()
+        assert wait_until(lambda: len(out["events"]) >= 3, timeout=60)
+        assert feed_a.check_once() is None  # healthy while present
+        t0 = time.time()
+        os.unlink(devs["a"])  # the unplug
+        injected = [{
+            "cls": "chip_unplug_fence", "replica": a_name,
+            "t0": t0, "t1": t0 + 5.0,
+        }]
+        fault = feed_a.check_once()
+        assert fault is not None and fault["kind"] == "unplugged"
+        assert feed_b.check_once() is None  # control stays healthy
+        assert server_a.fenced and not server_b.fenced
+        assert wait_until(lambda: out["done"], timeout=30)
+        assert not any(e.get("done") for e in out["events"]), (
+            "a chip-fenced stream must be CUT for failover, not completed"
+        )
+        detected = []
+        for name, eng in ((a_name, engine_a), (b_name, engine_b)):
+            for e in eng.flight.window(kinds=["engine.fenced"]):
+                if e.get("source") == "chip_health":
+                    detected.append({
+                        "cls": "chip_unplug_fence", "replica": name,
+                        "ts": e["ts"],
+                    })
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        cls = score["per_class"]["chip_unplug_fence"]
+        result = {
+            "scenario": "chip_unplug_mid_decode_fence",
+            "injected": injected, "detected": detected, "score": score,
+            "slo": {
+                "targets": {"fence_on_unplug": True},
+                "measured": {"fenced": True, "fault": fault},
+                "pass": True,
+            },
+            "pass": cls["precision"] == 1.0 and cls["recall"] == 1.0,
+        }
+        _publish(result)
+        assert cls["precision"] == 1.0 and cls["recall"] == 1.0, score
+    finally:
+        server_a.unfence()
+        server_b.unfence()
+        assert wait_until(
+            lambda: not any(s is not None for s in engine_a.slots), timeout=30
+        )
+        engine_a.kvcache_clear()
+        engine_b.kvcache_clear()
+
+
+def test_chaos_kill_warm_restart_restores_prefix(fenced_pair, tmp_path):
+    """Kill -> warm restart: a drained (SIGTERM-shaped) replica persists
+    its KV arena; the restarted replica rehydrates it and same-prefix
+    traffic RESTORES instead of recomputing — bit-identical tokens,
+    host-tier hits > 0.  A corrupted snapshot must degrade to a clean
+    cold start (correct tokens, zero hits).  Runs LAST: it rebuilds
+    replica A's server around the same compiled engine."""
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+
+    chaos_report = _chaos_report()
+    server_a = fenced_pair["server_a"]
+    engine_a = fenced_pair["engine_a"]
+    registry = fenced_pair["registry_a"]
+    snapdir = str(tmp_path / "snap")
+    server_a._snapshot_dir = snapdir
+    prefix = [5, 6, 7, 8, 9, 10, 11, 12]  # two full pages: registrable
+    sessions = [prefix + [40 + i] * 4 for i in range(3)]
+    before = {
+        tuple(p): _replica_post(server_a.port, p, 8)["tokens"]
+        for p in sessions
+    }
+
+    # SIGTERM shape: drain (in-flight none), which saves the snapshot.
+    t_kill = time.time()
+    server_a.begin_drain(grace_s=10.0)
+    assert server_a.drained.wait(30), "drain never completed"
+    assert server_a.last_snapshot_save and server_a.last_snapshot_save["ok"]
+    server_a.stop()
+
+    # The death: all serving state gone (tiers, arena); same compiled
+    # engine object stands in for the restarted process.
+    engine_a.kvcache_clear()
+    restarted = EngineServer(
+        engine_a, host="127.0.0.1", port=0, registry=registry,
+        snapshot_dir=snapdir, request_timeout_s=120,
+    )
+    loaded = restarted.load_snapshot()
+    assert loaded["ok"] and loaded["restored"] >= 1, loaded
+    restarted.start()
+    fenced_pair["server_a"] = restarted  # teardown stops the live one
+
+    host0, restores0 = engine_a.kv_host_hits, engine_a.kv_restores
+    after = {
+        tuple(p): _replica_post(restarted.port, p, 8)["tokens"]
+        for p in sessions
+    }
+    restored_hits = engine_a.kv_host_hits - host0
+    restored_pages = engine_a.kv_restores - restores0
+    assert after == before, "warm restart must replay bit-identically"
+    assert restored_hits > 0, "restart never hit the rehydrated arena"
+
+    injected = [{"cls": "warm_restart", "t0": t_kill, "t1": time.time()}]
+    detected = [
+        {"cls": "warm_restart", "ts": e["ts"]}
+        for e in engine_a.flight.window(kinds=["engine.snapshot.loaded"])
+        if e["ts"] >= t_kill
+    ]
+    score = chaos_report.score_detections(injected, detected, grace_s=5.0)
+    cls = score["per_class"]["warm_restart"]
+
+    # Corruption: tear the snapshot, restart again -> clean cold start.
+    path = os.path.join(snapdir, "kv_arena.snapshot")
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[: len(data) // 3])
+    engine_a.kvcache_clear()
+    bad = restarted.load_snapshot()
+    assert not bad["ok"] and len(engine_a._kv_arena) == 0
+    host0 = engine_a.kv_host_hits
+    cold = _replica_post(restarted.port, sessions[0], 8)["tokens"]
+    assert cold == before[tuple(sessions[0])], "cold start must be correct"
+    assert engine_a.kv_host_hits == host0, "poisoned-cache leak"
+
+    result = {
+        "scenario": "kill_warm_restart_prefix_restore",
+        "injected": injected, "detected": detected, "score": score,
+        "slo": {
+            "targets": {"restored_prefix_hits_min": 1},
+            "measured": {
+                "restored_hits": restored_hits,
+                "restored_pages": restored_pages,
+                "snapshot_bytes": server_a.last_snapshot_save.get("bytes"),
+                "entries_loaded": loaded["restored"],
+                "corrupt_degrades_clean": True,
+            },
+            "pass": restored_hits >= 1,
+        },
+        "pass": cls["recall"] == 1.0 and restored_hits >= 1,
+    }
+    _publish(result)
+    assert cls["recall"] == 1.0, score

@@ -141,6 +141,27 @@ def test_flap_mode_alternates_with_period():
     ]
 
 
+def test_truncate_mode_is_advisory_with_arg():
+    """Truncate returns a hit carrying the arm's fraction — the call
+    site (the snapshot writer/reader) tears its own output; sites that
+    do not understand truncation simply ignore the hit."""
+    reg = FailpointRegistry("t")
+    reg.arm("p", "truncate", arg="0.25", count=1)
+    hit = reg.fire("p")
+    assert hit.mode == "truncate" and hit.value is True and hit.arg == "0.25"
+    assert reg.fire("p") is None  # budget spent
+
+
+def test_truncate_spec_grammar():
+    assert parse_spec("engine.snapshot.save=truncate:0.5*1") == [
+        ("engine.snapshot.save", "truncate", "0.5", 1)
+    ]
+    assert parse_spec("p=truncate") == [("p", "truncate", None, None)]
+    for bad in ("p=truncate:1.5", "p=truncate:-0.1", "p=truncate:half"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+
 def test_rearm_replaces():
     reg = FailpointRegistry("t")
     reg.arm("p", "error")

@@ -738,21 +738,23 @@ def test_metrics_lint_clean_on_live_engine_server(served):
 
 def test_debug_state_summary_mode(served):
     """/debug/state grew the router-poll surface: top-level queue_depth/
-    active_slots/draining ride the full snapshot, and ?summary=1 returns
-    ONLY those four scalars — no engine-lock snapshot, no span ring —
+    active_slots/draining/fenced ride the full snapshot, and ?summary=1
+    returns ONLY those scalars — no engine-lock snapshot, no span ring —
     so a K-replica poll fan-in costs the fleet ~nothing."""
     _, _, server = served
     full = _get_json(server.port, "/debug/state")
     assert full["queue_depth"] == 0
     assert full["active_slots"] == 0
     assert full["draining"] is False
+    assert full["fenced"] is False
     assert full["loop_alive"] is True
-    assert "engine" in full and "spans" in full
+    assert "engine" in full and "spans" in full and "fence" in full
     summary = _get_json(server.port, "/debug/state?summary=1")
     assert summary == {
         "queue_depth": 0,
         "active_slots": 0,
         "draining": False,
+        "fenced": False,
         "loop_alive": True,
     }
 
@@ -898,6 +900,166 @@ def test_request_timeout_cancels_and_frees_slot(shared_engine):
         assert len(eng.free_pages) == eng.paged.num_pages - 1
     finally:
         failpoints.disarm_all()
+        server.stop()
+        if eng._inflight_guard is not None:
+            eng._inflight_guard._owner = None  # hand back to pytest thread
+
+
+# --------------------------------------------------------- replica fencing
+
+
+def test_fence_endpoints_healthz_summary_and_admission(served):
+    """Operator-forced fencing (POST /debug/fence — the rollout lever,
+    same code path as the watchdog): /healthz flips to fenced, the
+    router's summary poll grows ``fenced``, admission answers a plain
+    503 + Retry-After (no X-Shed: take me out of rotation), and
+    /debug/state carries the fence block.  Unfence restores all of it."""
+    cfg, params, server = served
+    try:
+        out = _post_path(server.port, "/debug/fence", {"reason": "rollout"})
+        assert out == {"fenced": True, "reason": "rollout", "changed": True}
+        # Idempotent: a second fence reports unchanged.
+        out = _post_path(server.port, "/debug/fence", {})
+        assert out["fenced"] and not out["changed"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get_json(server.port, "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "fenced"
+        summary = _get_json(server.port, "/debug/state?summary=1")
+        assert summary["fenced"] is True
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": [3, 141, 59], "max_new_tokens": 6})
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After")
+        assert e.value.headers.get("X-Shed") is None, (
+            "a fence is not an overload shed: the router must demote, "
+            "not merely back off"
+        )
+        state = _get_json(server.port, "/debug/state")
+        fence = state["fence"]
+        assert fence["fenced"] and fence["reason"] == "rollout"
+        assert fence["source"] == "operator" and fence["fences_total"] >= 1
+        # The fence is an incident and a flight event, not just a flag.
+        events = server.engine.flight.window(kinds=["engine.fenced"])
+        assert events and events[-1]["reason"] == "rollout"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30
+        ).read().decode()
+        assert "tpu_engine_fenced 1" in body
+        assert 'tpu_engine_fences_total{source="operator"}' in body
+    finally:
+        out = _post_path(server.port, "/debug/unfence", {})
+    assert out == {"fenced": False, "changed": True}
+    assert _get_json(server.port, "/healthz")["status"] == "ok"
+    assert _get_json(server.port, "/debug/state?summary=1")["fenced"] is False
+    # Same prompt/length as test_generate_matches_oracle: the oracle
+    # program is already compiled — serving-resumed proof at zero cost.
+    prompt = [3, 141, 59]
+    got = _post(server.port, {"prompt": prompt, "max_new_tokens": 6})
+    assert got["tokens"] == _oracle(cfg, params, prompt, 6)
+
+
+@pytest.mark.slow
+def test_watchdog_fence_cuts_stream_no_done_event(shared_engine):
+    """The hung-step fence end to end on a live server: a readback hang
+    (the `engine.readback` hang failpoint — the wedged-DMA shape) trips
+    the watchdog, the replica fences, and the in-flight SSE stream is
+    CUT with no done/error event (the shape the router's zero-drop
+    failover resubmits).  Unfence re-arms: the replica serves again.
+
+    Slow-marked (tier-1 runs ~10s from its 870s hard timeout): the same
+    contract is scored with measured precision/recall by the
+    readback-hang chaos scenario; tier-1 keeps the fast fence-endpoint
+    coverage above and the fake-clock watchdog units."""
+    from k8s_device_plugin_tpu.models.engine_watchdog import StepWatchdog
+    from k8s_device_plugin_tpu.utils import failpoints
+
+    cfg, params, eng = shared_engine
+    if eng._inflight_guard is not None:
+        eng._inflight_guard._owner = None  # loop thread takes ownership
+    wd = StepWatchdog(
+        lambda info: None,  # EngineServer binds the fence path
+        min_deadline_s=0.3,
+        grace_deadline_s=20.0,
+        warmup=2,
+        poll_interval_s=0.05,
+    )
+    server = EngineServer(
+        eng, host="127.0.0.1", port=0, watchdog=wd, request_timeout_s=30
+    ).start()
+    lines: list[dict] = []
+    stream_done = threading.Event()
+
+    def _stream():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/generate",
+            data=json.dumps(
+                {"prompt": [3, 141, 59], "max_new_tokens": 20,
+                 "stream": True}
+            ).encode(),
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if line.startswith(b"data:"):
+                        lines.append(json.loads(line[5:]))
+        except OSError:
+            pass
+        finally:
+            stream_done.set()
+
+    try:
+        # Baseline: two quick unary requests past the watchdog warmup.
+        for _ in range(2):
+            _post(server.port, {"prompt": [3, 141, 59], "max_new_tokens": 3})
+        t = threading.Thread(target=_stream, daemon=True)
+        t.start()
+        # Let the stream reach steady decode (past the activation grace
+        # step), THEN wedge the readback: the hang lands on a
+        # tight-deadline step.
+        deadline = time.monotonic() + 10
+        while len(lines) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(lines) >= 2, "stream never started"
+        failpoints.arm("engine.readback", "hang", arg="10")
+        fence_deadline = time.monotonic() + 8
+        fenced = False
+        while time.monotonic() < fence_deadline:
+            if _get_json(server.port, "/debug/state?summary=1")["fenced"]:
+                fenced = True
+                break
+            time.sleep(0.05)
+        assert fenced, "watchdog never fenced the hung step"
+        assert stream_done.wait(5), "fence did not cut the stream"
+        assert not any("done" in e or "error" in e for e in lines), (
+            "a fenced stream must be CUT, not completed: the router's "
+            "failover keys off the broken stream"
+        )
+        trip = wd.snapshot()["last_trip"]
+        assert trip and trip["kind"] == "hung_step"
+        failpoints.disarm_all()  # release the hung step
+        # Unfence: detectors re-arm, serving resumes.
+        out = _post_path(server.port, "/debug/unfence", {})
+        assert out["changed"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                if _get_json(server.port, "/healthz")["status"] == "ok":
+                    break
+            except urllib.error.HTTPError:
+                pass
+            time.sleep(0.05)
+        got = _post(
+            server.port, {"prompt": [3, 141, 59], "max_new_tokens": 3},
+            timeout=30,
+        )
+        assert len(got["tokens"]) == 3
+        assert not wd.tripped, "unfence must re-arm the watchdog"
+    finally:
+        failpoints.disarm_all()
+        eng.watchdog = None
         server.stop()
         if eng._inflight_guard is not None:
             eng._inflight_guard._owner = None  # hand back to pytest thread

@@ -827,3 +827,111 @@ def test_stream_deadline_eventually_504s_and_shed_stream_retries():
         assert events[-1].get("done") is True
     finally:
         _teardown(replicas, router)
+
+
+# ======================================================================
+# Replica self-fencing (summary `fenced` — ISSUE 10)
+# ======================================================================
+
+
+def test_policy_excludes_fenced_like_draining():
+    """A fenced replica takes NO new assignments — not even as the
+    stale-poll hedge an unreachable one gets (a fenced replica answers
+    503 by contract; dialing it only burns a retry token)."""
+    policy, states = _policy(["a:1", "b:1", "c:1"])
+    prompt = [5] * 32
+    home = policy.candidates(prompt)[0][0]
+    states[home].fenced = True
+    order, _ = policy.candidates(prompt)
+    assert home not in order
+    # Fenced beats unreachable-hedging too.
+    states[home].reachable = False
+    order, _ = policy.candidates(prompt)
+    assert home not in order
+    states[home].fenced = False
+    states[home].reachable = True
+    assert home in policy.candidates(prompt)[0]
+
+
+def test_poll_marks_fenced_and_unfenced_with_flight_events():
+    """The router's summary poll picks up ``fenced`` like ``draining``:
+    router.replica_fenced flight event + per-replica gauge + no new
+    assignments while fenced; the summary clearing promotes the replica
+    back (router.replica_unfenced)."""
+    replicas, router, flight = _fleet(2)
+    try:
+        a, b = replicas
+        prompt = _home_prompt(router, a.name)
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        assert out["tokens"] == fake_generate(prompt, 4)
+        assert a.generate_requests == 1 and b.generate_requests == 0
+
+        a.begin_fence(reason="hung_step")
+        assert wait_until(lambda: router.replicas[a.name].fenced)
+        events = flight.window(kinds=["router.replica_fenced"])
+        assert events and events[-1]["replica"] == a.name
+        assert router.metrics.replica_fenced.value(replica=a.name) == 1
+        # The fenced home gets NOTHING; its ring neighbor serves.
+        for _ in range(3):
+            out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+            assert out["tokens"] == fake_generate(prompt, 4)
+        assert a.generate_requests == 1, "fenced replica was dialed"
+        assert b.generate_requests == 3
+        snap = router.snapshot()
+        assert snap["replicas"][a.name]["fenced"] is True
+
+        a.unfence()
+        assert wait_until(lambda: not router.replicas[a.name].fenced)
+        assert flight.window(kinds=["router.replica_unfenced"])
+        assert router.metrics.replica_fenced.value(replica=a.name) == 0
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        assert a.generate_requests == 2, "unfenced home must serve again"
+    finally:
+        _teardown(replicas, router)
+
+
+def test_fenced_503_dial_fails_over_before_poll_notices():
+    """A fence landing BETWEEN polls: the dial's plain 503 (no X-Shed)
+    must fail the request over to the next ring replica immediately —
+    the client never sees the fence."""
+    replicas, router, flight = _fleet(
+        2, router_kwargs={"poll_interval_s": 30.0}  # poll will NOT save us
+    )
+    try:
+        a, b = replicas
+        prompt = _home_prompt(router, a.name)
+        a.begin_fence(reason="chip_unplugged")
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        assert out["tokens"] == fake_generate(prompt, 4)
+        assert a.fence_rejects == 1 and b.generate_requests == 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_fenced_replica_in_flight_stream_finishes():
+    """Fencing stops NEW assignments; a stream already running on the
+    replica keeps flowing (the real server only cuts streams it cannot
+    finish — the FakeReplica models the finishable case)."""
+    replicas, router, flight = _fleet(2, token_delay_s=0.03)
+    try:
+        a, b = replicas
+        prompt = _home_prompt(router, a.name)
+        import threading
+
+        result: dict = {}
+
+        def _run():
+            result["events"], result["tokens"] = _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": 12}
+            )
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        assert wait_until(lambda: a.active_streams == 1)
+        a.begin_fence()
+        assert wait_until(lambda: router.replicas[a.name].fenced)
+        t.join(timeout=10)
+        assert result["tokens"] == fake_generate(prompt, 12)
+        assert any(e.get("done") for e in result["events"])
+    finally:
+        _teardown(replicas, router)

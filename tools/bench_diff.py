@@ -133,6 +133,26 @@ def load_record(path: str) -> dict:
                 "hi_ttft_p99_storm_ms"
             )
             rec["overload_pool_exact"] = overload.get("pool_exact")
+        # Restart block (RESTART serving rows, benchmark.py
+        # _run_restart_phase): cold vs warm post-restart TTFT p99
+        # through the KV-arena snapshot, plus how many pages the warm
+        # path actually restored.  The regression tells: restored pages
+        # dropping to 0 (the snapshot stopped rehydrating) or the warm
+        # p99 exceeding the cold one (speedup < 1 — the row screams
+        # COLD-REGRESSED, because a restore path slower than a cold
+        # start is worse than not having one).
+        restart = parsed.get("restart")
+        if isinstance(restart, dict) and not restart.get("skipped"):
+            rec["restart_cold_ttft_p99_ms"] = (restart.get("cold") or {}).get(
+                "ttft_p99_ms"
+            )
+            rec["restart_warm_ttft_p99_ms"] = (restart.get("warm") or {}).get(
+                "ttft_p99_ms"
+            )
+            rec["restart_restored_pages"] = (restart.get("warm") or {}).get(
+                "restored_pages"
+            )
+            rec["restart_warm_speedup"] = restart.get("warm_speedup")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -170,6 +190,8 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "overload_goodput_ratio", "overload_sheds",
         "overload_hi_ttft_ratio", "overload_hi_ttft_storm_ms",
         "overload_pool_exact",
+        "restart_cold_ttft_p99_ms", "restart_warm_ttft_p99_ms",
+        "restart_restored_pages", "restart_warm_speedup",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -240,6 +262,24 @@ def ledger_row(a: dict, b: dict) -> str:
                 + ("" if b.get("chaos_slo_pass", True) else ", SLO-FAIL")
                 + ")"
                 if b.get("chaos_scenarios") is not None
+                else ""
+            )
+            + (
+                f"; restart warm p99 {b['restart_warm_ttft_p99_ms']}ms "
+                f"vs cold {b.get('restart_cold_ttft_p99_ms')}ms "
+                f"({b.get('restart_restored_pages')} pages restored"
+                + (
+                    ", COLD-REGRESSED"
+                    if (b.get("restart_warm_speedup") or 1.0) < 1.0
+                    else ""
+                )
+                + (
+                    ", NO-RESTORE"
+                    if b.get("restart_restored_pages") == 0
+                    else ""
+                )
+                + ")"
+                if b.get("restart_warm_ttft_p99_ms") is not None
                 else ""
             )
             + (
