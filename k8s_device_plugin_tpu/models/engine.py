@@ -327,8 +327,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
             jnp.zeros((max_slots, paged.max_pages_per_seq), jnp.int32)
         )
         # Page 0 is the idle-slot scratch target — never allocated.
-        self.free_pages: deque[int] = deque(range(1, paged.num_pages))
-        self.slots: list[Optional[Request]] = [None] * max_slots
+        self.free_pages: deque[int] = deque(range(1, paged.num_pages))  # guarded by: _lock
+        self.slots: list[Optional[Request]] = [None] * max_slots  # guarded by: _lock
         self._slot_pages: list[list[int]] = [[] for _ in range(max_slots)]
         self._slot_last: list[int] = [0] * max_slots  # last emitted token
         self._slot_len: list[int] = [0] * max_slots  # consumed positions
@@ -369,7 +369,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         # match refuses them (see _match_prefix) until _activate removes
         # them post-graft.
         self._pending_pages: set[int] = set()
-        self.queue: deque[Request] = deque()
+        self.queue: deque[Request] = deque()  # guarded by: _lock
         # submit() is documented callable from other threads (the serving
         # topology: an RPC handler enqueues while the owner thread loops
         # step(), and MetricsServer scrapes concurrently) — the queue and

@@ -144,11 +144,11 @@ class KVCacheMixin:
                 f"kv_host_cache_mb must be >= 0, got {kv_host_cache_mb}"
             )
         self._kv_retain = bool(kv_retain)
-        self._kv_arena = HostKVArena(int(kv_host_cache_mb * 1024 * 1024))
+        self._kv_arena = HostKVArena(int(kv_host_cache_mb * 1024 * 1024))  # guarded by: _lock
         # Retained tier: page id -> None, insertion order = LRU order
         # (move_to_end on retain refreshes recency).  Only refcount-zero,
         # trie-linked pages ever live here.
-        self._kv_retained: "OrderedDict[int, None]" = OrderedDict()
+        self._kv_retained: "OrderedDict[int, None]" = OrderedDict()  # guarded by: _lock
         # Host-visible counters (exported via metrics when wired, and
         # through kvcache_state / the perf ledger).
         self.kv_retained_hits = 0
@@ -163,7 +163,7 @@ class KVCacheMixin:
 
     # ------------------------------------------------------------- tier 1
 
-    def _kv_retain_page(self, page: int) -> bool:
+    def _kv_retain_page(self, page: int) -> bool:  # caller holds: _lock
         """Refcount just hit zero: keep the page (trie links intact) when
         it is reachable — i.e. registered in the trie.  Unregistered
         pages (generation tails, orphaned by a dead parent) hold nothing
@@ -175,7 +175,7 @@ class KVCacheMixin:
         self._kv_retained.move_to_end(page)
         return True
 
-    def _kv_revive(self, page: int) -> None:
+    def _kv_revive(self, page: int) -> None:  # caller holds: _lock
         """A retained page was matched and re-referenced (0 -> 1): pin it
         out of the reclaimable set.  Caller holds the lock."""
         if page in self._kv_retained:
@@ -205,7 +205,7 @@ class KVCacheMixin:
                 return page
         return fallback
 
-    def _kv_reclaim_page(self, page: int) -> None:
+    def _kv_reclaim_page(self, page: int) -> None:  # caller holds: _lock
         """Demote one retained page: offload its rows to the host arena
         (tier 2, content-keyed) when enabled, then run the SAME teardown
         a free runs — every trie link touching the page dies, so a
@@ -324,7 +324,7 @@ class KVCacheMixin:
                 return node, tokens
         return None
 
-    def _kv_offload_page(self, page: int) -> bool:
+    def _kv_offload_page(self, page: int) -> bool:  # caller holds: _lock
         """Copy one retained page's rows into the host arena keyed by its
         cumulative prefix; True when stored.  Caller holds the lock."""
         if not self._kv_arena.enabled:
@@ -435,7 +435,7 @@ class KVCacheMixin:
                 self.metrics.kvcache_evictions.inc(evicted, tier="host")
             return ("snap", req.rid) in self._kv_arena
 
-    def _kv_drop_snapshot(self, rid: int) -> None:
+    def _kv_drop_snapshot(self, rid: int) -> None:  # caller holds: _lock
         self._kv_arena.pop(("snap", rid))
 
     def _kv_try_restore_resume(self, slot: int, req: Any) -> bool:

@@ -106,6 +106,7 @@ rest of the models/ stack which benchmarks on synthetic ids):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -118,6 +119,8 @@ from ..utils.spans import SpanRecorder, sanitize_trace_id
 from .engine import ServingEngine
 from .engine_overload import SHED_EXPIRED, SHED_INFEASIBLE, ShedError
 from .engine_watchdog import ChipHealthFeed, StepWatchdog, visible_chip_paths
+
+log = logging.getLogger("tpu.serving")
 
 
 class EngineServer:
@@ -538,8 +541,16 @@ class EngineServer:
                             # Always unwound, or the global profiler stays
                             # started and bricks every later capture.
                             jax.profiler.stop_trace()
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # A failed unwind is exactly the bricked
+                            # state the comment above warns about —
+                            # swallowing it silently would make every
+                            # later capture fail with no cause on
+                            # record.
+                            log.warning(
+                                "jax.profiler.stop_trace failed; later "
+                                "captures may be bricked: %s", e,
+                            )
                     server._trace_lock.release()
                 self._reply(200, {"trace_dir": tdir, "seconds": seconds})
 

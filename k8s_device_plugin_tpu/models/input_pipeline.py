@@ -78,7 +78,7 @@ def prefetch_to_device(
         if close is not None:
             try:
                 close()
-            except Exception:
+            except Exception:  # codelint: ignore[naked-except] best-effort generator teardown; the worker is already exiting
                 pass
 
     def worker() -> None:

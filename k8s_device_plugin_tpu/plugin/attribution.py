@@ -83,7 +83,7 @@ class AllocationLedger:
         self._clock = clock
         self._lock = threading.Lock()
         # device_id -> {"ts": grant time, "confirmed": bool, "owner": tuple|None}
-        self._grants: dict[str, dict] = {}
+        self._grants: dict[str, dict] = {}  # guarded by: _lock
         self.granted_total = 0
         self.released_total = 0
 

@@ -67,10 +67,10 @@ class CircuitBreaker:
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0  # consecutive, closed state only
-        self._opened_at = 0.0
-        self._probe_in_flight = False
+        self._state = CLOSED  # guarded by: _lock
+        self._failures = 0  # consecutive, closed state only; guarded by: _lock
+        self._opened_at = 0.0  # guarded by: _lock
+        self._probe_in_flight = False  # guarded by: _lock
 
     # ---------------------------------------------------------- queries
 
@@ -97,7 +97,7 @@ class CircuitBreaker:
 
     # ------------------------------------------------------- transitions
 
-    def _transition(self, new: str) -> Optional[tuple[str, str]]:
+    def _transition(self, new: str) -> Optional[tuple[str, str]]:  # caller holds: _lock
         """Lock-held state change; returns (old, new) for the callback."""
         old = self._state
         if old == new:

@@ -45,8 +45,14 @@ FAILOVER = "failover"
 class ReplicaState:
     """One replica's router-side view: address, poll-derived load/drain
     state, and its circuit breaker.  Mutable fields are plain scalars
-    updated by the poll loop and read racily by dispatch (GIL-atomic;
-    a one-poll-stale read is by design)."""
+    read racily by dispatch (GIL-atomic; a one-poll-stale read is by
+    design).  The poll-derived fields are owner-thread-only by contract
+    (annotated ``guarded by: owner-thread``): the router's poll loop
+    mutates them off-lock, and any other thread — the request/stream
+    paths marking a replica draining or fenced on failover — must hold
+    the router lock, which serializes against the owner.
+    ``RouterServer(racecheck=True)`` arms a racecheck.OwnerGuard that
+    raises at any off-contract toucher (tests/test_router.py pins it)."""
 
     def __init__(self, name: str, breaker: CircuitBreaker):
         self.name = name  # "host:port" — the ring node AND dial target
@@ -54,17 +60,17 @@ class ReplicaState:
         self.host = host
         self.port = int(port)
         self.breaker = breaker
-        self.reachable = True  # optimistic until a poll says otherwise
-        self.draining = False
+        self.reachable = True  # optimistic until a poll says otherwise; guarded by: owner-thread
+        self.draining = False  # guarded by: owner-thread
         # Replica self-fencing (summary ``fenced``): a sick replica —
         # hung step, unhealthy chip, operator fence — is treated exactly
         # like a draining one (no new assignments, in-flight streams
         # fail over through the ordinary zero-drop path) until its
         # summary clears.
-        self.fenced = False
-        self.queue_depth = 0
-        self.active_slots = 0
-        self.last_poll = 0.0  # time.monotonic of last successful poll
+        self.fenced = False  # guarded by: owner-thread
+        self.queue_depth = 0  # guarded by: owner-thread
+        self.active_slots = 0  # guarded by: owner-thread
+        self.last_poll = 0.0  # last successful poll (monotonic); guarded by: owner-thread
         self.dispatches = 0
         self.failures = 0
 

@@ -202,7 +202,7 @@ class _Upstream:
     def close(self) -> None:
         try:
             self.conn.close()
-        except Exception:
+        except Exception:  # codelint: ignore[naked-except] best-effort close of a possibly-dead socket; per-close logs would drown failover
             pass
 
 
@@ -240,12 +240,35 @@ class RouterServer:
         policy_mode: str = "affinity",
         seed: int = 0,
         replicas_dns: Optional[str] = None,
+        racecheck: bool = False,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
         self.flight = flight
-        self._lock = threading.Lock()  # ring/replica-set membership
+        # Ring/replica-set membership AND the license to touch replica
+        # poll state off the poll thread (see _poll_guard below).
+        # Reentrant so OwnerGuard's _is_owned introspection works.
+        self._lock = threading.RLock()
+        # Poll-state owner discipline (utils/racecheck.py): the poll
+        # thread owns ReplicaState's poll-derived fields (reachable /
+        # queue_depth / active_slots / draining / fenced / last_poll —
+        # annotated `guarded by: owner-thread` in policy.py) off-lock;
+        # request/stream threads marking a replica draining or fenced on
+        # the failover path must hold self._lock, which serializes them
+        # against the owner without stealing ownership
+        # (steal_on_lock=False — a transient request thread becoming
+        # owner would false-trip the long-lived poll loop).  Opt-in like
+        # the engine's racecheck: the contract is free in production,
+        # CHECKED in the suites that run with racecheck=True.
+        self._poll_guard = None
+        if racecheck:
+            from ..utils.racecheck import OwnerGuard
+
+            self._poll_guard = OwnerGuard(
+                lock=self._lock, name="replica_poll", steal_on_lock=False
+            )
         self._stop = threading.Event()
+        self._first_poll = threading.Event()
         self._draining = threading.Event()
         self.drained = threading.Event()
         self._active = 0  # in-flight client requests (drain watches this)
@@ -465,6 +488,11 @@ class RouterServer:
     # -------------------------------------------------------- poll loop
 
     def _poll_once(self) -> None:
+        if self._poll_guard is not None:
+            # Poll state is owner-thread-only: the first off-lock caller
+            # (the poll thread) owns it; any other thread polling
+            # off-lock is a contract violation racecheck raises on.
+            self._poll_guard.check("poll_once")
         for name, st in list(self.replicas.items()):
             if self._stop.is_set():
                 return
@@ -508,9 +536,19 @@ class RouterServer:
 
     def _mark_draining(self, name: str, draining: bool) -> None:
         st = self.replicas.get(name)
-        if st is None or st.draining == draining:
+        if st is None:
             return
-        st.draining = draining
+        # Called from the poll thread (summary says draining) AND from
+        # request/stream threads (a 503 without X-Shed): the lock is the
+        # cross-thread license to touch poll state — the OwnerGuard
+        # contract's "other side" (see __init__).  Instruments fire
+        # outside the lock: leaf locks only ever nest under this one.
+        with self._lock:
+            if self._poll_guard is not None:
+                self._poll_guard.check("mark_draining")
+            if st.draining == draining:
+                return
+            st.draining = draining
         self.metrics.replica_draining.set(1 if draining else 0, replica=name)
         self._record(
             "router.drain_begin" if draining else "router.drain_end",
@@ -523,9 +561,14 @@ class RouterServer:
         draining one — no new assignments; its cut streams fail over
         through the ordinary zero-drop path — until the summary clears."""
         st = self.replicas.get(name)
-        if st is None or st.fenced == fenced:
+        if st is None:
             return
-        st.fenced = fenced
+        with self._lock:  # same cross-thread license as _mark_draining
+            if self._poll_guard is not None:
+                self._poll_guard.check("mark_fenced")
+            if st.fenced == fenced:
+                return
+            st.fenced = fenced
         self.metrics.replica_fenced.set(1 if fenced else 0, replica=name)
         self._record(
             "router.replica_fenced" if fenced else "router.replica_unfenced",
@@ -557,8 +600,12 @@ class RouterServer:
             self.remove_replica(name)
 
     def _poll_loop(self) -> None:
-        # Wait FIRST: start() already ran one synchronous poll, so the
-        # loop's job is the steady cadence, not an immediate re-poll.
+        # The FIRST poll runs here too (not in start()): the poll thread
+        # is the single off-lock owner of replica poll state, and
+        # start() blocks on _first_poll instead — same no-cold-blind-
+        # spot contract, one owner thread.
+        self._poll_once()
+        self._first_poll.set()
         while not self._stop.wait(self._poll_interval):
             self._refresh_dns()
             self._poll_once()
@@ -1278,11 +1325,15 @@ class RouterServer:
         }
 
     def start(self) -> "RouterServer":
-        self._poll_once()  # first poll before serving: no cold blind spot
         self._poll_thread = threading.Thread(
             target=self._poll_loop, name="router-poll", daemon=True
         )
         self._poll_thread.start()
+        # First poll before serving: no cold blind spot.  It runs ON the
+        # poll thread (the poll-state owner); start() just waits for it.
+        self._first_poll.wait(
+            timeout=self._poll_timeout * (len(self.replicas) + 1) + 2.0
+        )
         self._http_thread = threading.Thread(
             # 50ms shutdown poll (vs the 0.5s default): drains and test
             # teardowns should not stall on the accept loop.

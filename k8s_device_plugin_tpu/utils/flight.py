@@ -78,7 +78,7 @@ class FlightRecorder:
         self.name = name
         self.capacity = capacity
         self._lock = threading.RLock()
-        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._ring: deque[dict] = deque(maxlen=capacity)  # guarded by: _lock
         self.recorded = 0
         self.dropped = 0
         self._dropped_by_kind: dict[str, int] = {}

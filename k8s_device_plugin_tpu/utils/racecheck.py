@@ -85,17 +85,30 @@ class OwnerGuard:
     :class:`LockDisciplineError` at the faulty call site.  A lock-held
     check re-binds ownership to the calling thread (holding the lock IS
     the license to take over — e.g. the stress suites drain on the main
-    thread after stopping the server loop)."""
+    thread after stopping the server loop).
 
-    def __init__(self, lock, name: str = "owned"):
+    ``steal_on_lock=False`` keeps the lock-held license but WITHOUT the
+    ownership rebind: a lock-holding thread may touch the state (it is
+    serialized against the owner, who also takes the lock for its own
+    mutations under this mode's contract) yet does not become the new
+    off-lock owner.  This is the router poll-loop shape: the poll thread
+    owns the per-replica poll state off-lock, while request/stream
+    threads marking a replica draining/fenced on failover must hold the
+    router lock — a transient request thread must not steal ownership
+    from the long-lived poll loop (its later off-lock poll would then
+    false-trip while the request thread is still alive)."""
+
+    def __init__(self, lock, name: str = "owned", steal_on_lock: bool = True):
         self._lock = lock
         self._name = name
+        self._steal_on_lock = steal_on_lock
         self._owner: Optional[threading.Thread] = None
 
     def check(self, op: str) -> None:
         me = threading.current_thread()
         if _owned(self._lock):
-            self._owner = me
+            if self._steal_on_lock:
+                self._owner = me
             return
         if self._owner is None or not self._owner.is_alive():
             # First toucher (or the previous owner thread exited — a

@@ -45,6 +45,7 @@ else:
 PLUGIN_TIER_FILES = {
     "test_attribution.py",
     "test_cli.py",
+    "test_codelint.py",
     "test_discovery.py",
     "test_envs.py",
     "test_health.py",
@@ -91,6 +92,18 @@ def pytest_collection_modifyitems(config, items):
                 "marker (module-level `pytestmark = pytest.mark.slow`) so "
                 "tier-1 deselects them — the 870s budget has no headroom "
                 "for fleet simulations"
+            )
+        if base == "test_codelint.py" and not any(
+            m.name == "plugin" for m in item.iter_markers()
+        ):
+            # The static-analyzer suite is jax-free AST work and MUST
+            # stay in the fast plugin tier: it is the whole-repo
+            # contract gate (tools/codelint), and `-m 'plugin and not
+            # slow'` is where builder sessions expect it to run.
+            raise _pytest.UsageError(
+                f"{item.nodeid}: test_codelint.py must carry the "
+                "`plugin` marker (PLUGIN_TIER_FILES keeps it in the "
+                "fast jax-free tier)"
             )
 
 

@@ -935,3 +935,59 @@ def test_fenced_replica_in_flight_stream_finishes():
         assert any(e.get("done") for e in result["events"])
     finally:
         _teardown(replicas, router)
+
+
+def test_racecheck_owner_guard_on_poll_state():
+    """RouterServer(racecheck=True) arms the poll-state OwnerGuard
+    (utils/racecheck.py): the poll thread owns ReplicaState's
+    poll-derived fields off-lock; any OTHER thread polling off-lock
+    raises at the faulty call site, while the failover-path mutators
+    (_mark_draining / _mark_fenced) stay legal from request threads
+    because they take the router lock — and, with steal_on_lock=False,
+    taking it does NOT steal ownership from the long-lived poll loop."""
+    import threading
+
+    from k8s_device_plugin_tpu.utils.racecheck import LockDisciplineError
+
+    replicas, router, _ = _fleet(2, router_kwargs={"racecheck": True})
+    try:
+        victim = replicas[0].name
+        # The poll thread has polled at least once (start() waits on the
+        # first poll), so it owns the poll state.
+        assert router._poll_guard._owner is router._poll_thread
+
+        # A foreign thread (this one) polling OFF-LOCK is the exact
+        # contract violation the guard exists for.
+        with pytest.raises(LockDisciplineError):
+            router._poll_once()
+
+        # The stream-failover handoff from a request-shaped foreign
+        # thread is LEGAL: _mark_draining/_mark_fenced take the router
+        # lock (the cross-thread license)...
+        errors: list = []
+
+        def failover_path():
+            try:
+                router._mark_draining(victim, True)
+                router._mark_fenced(victim, True)
+                router._mark_fenced(victim, False)
+                router._mark_draining(victim, False)
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        t = threading.Thread(target=failover_path, name="fake-request")
+        t.start()
+        t.join(timeout=5)
+        assert not errors, errors
+
+        # ...and did not steal ownership: the poll loop keeps polling
+        # violation-free after the request thread's marks (a stolen
+        # owner would false-trip the next poll tick).
+        assert router._poll_guard._owner is router._poll_thread
+        before = router.replicas[victim].last_poll
+        assert wait_until(
+            lambda: router.replicas[victim].last_poll > before, timeout=3
+        )
+        assert router._poll_thread.is_alive()
+    finally:
+        _teardown(replicas, router)

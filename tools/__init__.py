@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.codelint` works from the repo
+# root; the scripts in here still run fine as plain `python tools/x.py`.

@@ -1,0 +1,62 @@
+"""Codebase-contract static analyzer for the TPU device-plugin repo.
+
+The system is a fleet of cooperating threaded daemons (engine step loop,
+watchdog, snapshot thread, router poll loop, plugin health sweeps) whose
+operational catalogs — flight-event kinds, metric names, failpoint
+sites, CLI flags, `/debug` endpoints — are documented by hand in
+docs/operations.md, docs/chaos.md, docs/routing.md, and README.md.
+`tools/metrics_lint.py` lints the *runtime* exposition and
+`utils/racecheck.py` checks lock discipline *dynamically*; this package
+is the static third leg: pure-AST passes that catch deadlocks,
+stalls-under-lock, contract-annotation violations, and doc drift at
+analysis time, before a chaos scenario has to find them at runtime.
+
+Passes (each in ``tools/codelint/passes/``):
+
+``lock-order``
+    Extracts the static lock-acquisition graph (``with self._lock:``
+    blocks plus resolvable intraprocedural call edges) and flags cycles
+    (deadlock candidates) and nested acquisitions not on the reviewed
+    allowlist in :mod:`tools.codelint.config`.
+``blocking-under-lock``
+    Flags calls that can block indefinitely — ``time.sleep``,
+    socket/HTTP dials, subprocess waits, ``jax.block_until_ready`` /
+    device readback, unbounded ``Queue.get`` / ``Condition.wait`` — that
+    sit lexically inside a held-lock region.
+``guarded-by``
+    Verifies the ``# guarded by: _lock`` attribute-annotation
+    convention: every annotated structure's *mutations* must happen
+    under the named lock (reads stay unguarded, mirroring
+    ``racecheck.GuardedDeque``'s policy).
+``catalog-drift``
+    Cross-checks code against the documented catalogs in both
+    directions: flight-event kinds vs docs/operations.md rows, metric
+    registrations vs the metric tables, failpoint sites vs the
+    docs/chaos.md catalog, argparse flags vs the README/docs flag
+    documentation, and `/debug/*` routes vs the endpoint tables.
+``naked-except``
+    Flags bare/overbroad ``except`` handlers that swallow exceptions
+    silently (no re-raise, no log line, no flight event) in daemon
+    code.
+
+Usage (CI and local; exits non-zero on any unbaselined finding)::
+
+    python -m tools.codelint                  # all passes, human table
+    python -m tools.codelint --json -         # machine-readable
+    python -m tools.codelint --pass lock-order --pass catalog-drift
+    python -m tools.codelint --all --url http://127.0.0.1:9100/metrics
+    python -m tools.codelint --write-baseline # refresh the baseline
+
+Findings carry stable keys (never line numbers) so the committed
+baseline (``tools/codelint/baseline.json``) does not churn on
+reformatting; a baseline entry whose finding disappeared FAILS the run
+("remove stale suppression") so the baseline can only shrink honestly.
+Inline escape hatch: ``# codelint: ignore[pass-name] reason`` on (or one
+line above) the offending line.
+
+Stdlib-only, jax-free by construction: tier-1 runs the whole-repo lint
+(tests/test_codelint.py) in the fast plugin tier.
+"""
+
+from .model import Finding, Baseline, apply_baseline  # noqa: F401
+from .runner import run_passes, PASSES  # noqa: F401

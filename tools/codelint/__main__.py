@@ -1,0 +1,163 @@
+"""CLI: ``python -m tools.codelint`` — run the contract passes, print a
+human table (or JSON), exit non-zero on any unbaselined finding or
+stale suppression.
+
+``--all`` additionally runs the RUNTIME exposition lint
+(tools/metrics_lint.py) against any ``--url`` endpoints — one command
+covers both the static contracts and the live /metrics surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import config as cfg
+from .model import Baseline
+from .runner import PASSES, run_passes
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="codelint",
+        description="codebase-contract static analyzer "
+        "(lock discipline, blocking-under-lock, guarded-by, "
+        "catalog drift, naked excepts)",
+    )
+    p.add_argument(
+        "--root",
+        default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repo root (default: inferred from this file)",
+    )
+    p.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=sorted(PASSES),
+        help="run only this pass (repeatable; default: all five)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline JSON path (default: %(default)s)",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline to exactly the current findings "
+        "(review the diff before committing — the baseline is the "
+        "reviewed deferral list, not a mute button)",
+    )
+    p.add_argument(
+        "--json",
+        metavar="PATH",
+        help="write machine-readable results to PATH ('-' for stdout)",
+    )
+    p.add_argument(
+        "--all",
+        action="store_true",
+        help="also run the runtime exposition lint "
+        "(tools/metrics_lint.py) against each --url",
+    )
+    p.add_argument(
+        "--url",
+        action="append",
+        default=[],
+        help="live /metrics URL for the runtime exposition lint "
+        "(with --all; repeatable)",
+    )
+    args = p.parse_args(argv)
+
+    baseline = Baseline.load(args.baseline)
+    result = run_passes(
+        args.root, passes=args.passes, cfg=cfg, baseline=baseline
+    )
+
+    if args.write_baseline:
+        from .model import BaselineEntry
+
+        baseline.entries = [
+            BaselineEntry(key=f.key, note="baselined by --write-baseline")
+            for f in result["findings"]
+        ] + [
+            e
+            for e in baseline.entries
+            if e.key in {s.key for s in result["suppressed"]}
+        ]
+        baseline.save(args.baseline)
+        print(
+            f"baseline rewritten: {len(baseline.entries)} suppression(s) "
+            f"-> {args.baseline}"
+        )
+        return 0
+
+    exposition_errors: list = []
+    if args.all and args.url:
+        from .. import metrics_lint
+
+        for url in args.url:
+            try:
+                exposition_errors.extend(
+                    f"{url}: {e}" for e in metrics_lint.lint_url(url)
+                )
+            except OSError as e:
+                exposition_errors.append(f"{url}: scrape failed: {e}")
+
+    if args.json:
+        payload = {
+            "schema": "tpu-codelint/v1",
+            "ok": result["ok"] and not exposition_errors,
+            "elapsed_s": result["elapsed_s"],
+            "passes": result["passes"],
+            "findings": [f.to_json() for f in result["findings"]],
+            "suppressed": [f.key for f in result["suppressed"]],
+            "stale_suppressions": result["stale"],
+            "inline_ignored": result["inline_ignored"],
+            "exposition_errors": exposition_errors,
+        }
+        if args.json == "-":
+            json.dump(payload, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json, "w") as f:
+                json.dump(payload, f, indent=2)
+
+    failed = False
+    for f in result["findings"]:
+        failed = True
+        where = f"{f.file}:{f.line}" if f.file else "(repo)"
+        print(f"{f.pass_name}: {where}: {f.message}", file=sys.stderr)
+    for key in result["stale"]:
+        failed = True
+        print(
+            f"baseline: stale entry {key!r}: the finding no longer "
+            "occurs — remove stale suppression from "
+            f"{args.baseline}",
+            file=sys.stderr,
+        )
+    for err in exposition_errors:
+        failed = True
+        print(f"exposition: {err}", file=sys.stderr)
+    if not failed:
+        n = len(result["suppressed"])
+        print(
+            f"codelint: clean — {len(result['passes'])} pass(es) in "
+            f"{result['elapsed_s']}s"
+            + (f" ({n} baselined)" if n else "")
+            + (
+                f", {result['inline_ignored']} inline-ignored"
+                if result["inline_ignored"]
+                else ""
+            )
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Finding / baseline / suppression model shared by every codelint pass.
+
+A :class:`Finding` is one contract violation.  Its ``key`` is the stable
+identity used for baselining and inline suppression: it names the pass,
+a short finding code, and a location that deliberately EXCLUDES line
+numbers (file + symbol or file + subject), so reformatting a file never
+churns the baseline.  Line numbers ride along for humans only.
+
+Baseline semantics (the only two ways a finding may be silenced):
+
+- **Committed baseline** (``tools/codelint/baseline.json``): a reviewed
+  list of finding keys with a mandatory ``note`` saying why each is
+  deferred.  A baseline entry whose finding no longer occurs is STALE
+  and fails the run — the baseline can only shrink honestly, never
+  accrete dead suppressions.
+- **Inline pragma**: ``# codelint: ignore[pass-name] reason`` on the
+  finding's line or the line directly above it.  Scoped to one pass on
+  one line; anything broader belongs in the baseline where it is
+  reviewed.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+IGNORE_RE = re.compile(r"codelint:\s*ignore\[([a-z0-9-]+)\]")
+
+
+@dataclass
+class Finding:
+    """One contract violation surfaced by a pass."""
+
+    pass_name: str  # e.g. "lock-order"
+    code: str  # short kebab-case finding class, e.g. "nested-unallowed"
+    key: str  # stable identity (no line numbers) for baseline matching
+    file: str  # repo-relative path ("" for cross-file findings)
+    line: int  # 1-based; 0 when the finding has no single line
+    message: str
+
+    def to_json(self) -> dict:
+        return {
+            "pass": self.pass_name,
+            "code": self.code,
+            "key": self.key,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class BaselineEntry:
+    key: str
+    note: str = ""
+
+
+@dataclass
+class Baseline:
+    """The committed suppression list, with honest-shrinkage checking."""
+
+    entries: list[BaselineEntry] = field(default_factory=list)
+    path: Optional[str] = None
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except FileNotFoundError:
+            return cls(entries=[], path=path)
+        entries = [
+            BaselineEntry(key=e["key"], note=e.get("note", ""))
+            for e in raw.get("suppressions", [])
+        ]
+        return cls(entries=entries, path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        target = path or self.path
+        assert target, "baseline has no path"
+        payload = {
+            "schema": "tpu-codelint-baseline/v1",
+            "suppressions": [
+                {"key": e.key, "note": e.note}
+                for e in sorted(self.entries, key=lambda e: e.key)
+            ],
+        }
+        with open(target, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+
+    def keys(self) -> set:
+        return {e.key for e in self.entries}
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: Baseline
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Split findings into (active, suppressed) and report stale keys.
+
+    ``active`` are unbaselined findings (fail the run); ``suppressed``
+    matched a baseline entry; ``stale`` are baseline keys with no
+    matching finding — the "remove stale suppression" error class, which
+    ALSO fails the run.
+    """
+    allowed = baseline.keys()
+    active = [f for f in findings if f.key not in allowed]
+    suppressed = [f for f in findings if f.key in allowed]
+    present = {f.key for f in findings}
+    stale = sorted(allowed - present)
+    return active, suppressed, stale
+
+
+def inline_ignored(finding: Finding, comments: dict[int, str]) -> bool:
+    """True when the finding's line (or the line above) carries a
+    ``# codelint: ignore[pass-name]`` pragma for this pass."""
+    if not finding.line:
+        return False
+    for line in (finding.line, finding.line - 1):
+        comment = comments.get(line, "")
+        m = IGNORE_RE.search(comment)
+        if m and m.group(1) == finding.pass_name:
+            return True
+    return False

@@ -1,0 +1,2 @@
+"""The codelint passes.  Each module exposes ``NAME`` and
+``run(repo, cfg) -> list[Finding]``; the runner registers them."""

@@ -1,0 +1,57 @@
+"""Shared held-lock-region machinery for the lock passes.
+
+A "lock region" is the lexical body of a ``with <lock>:`` item whose
+context expression resolves to an indexed lock (walker.lock_for_with_item).
+Both lock passes walk the same regions; this module extracts them once
+per function."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..walker import Module, Repo, LockId
+
+
+@dataclass
+class LockRegion:
+    lock: LockId
+    with_node: ast.With
+    mod: Module
+    cls: Optional[str]
+    fn: ast.AST
+
+
+def lock_regions(
+    repo: Repo, mod: Module, cls: Optional[str], fn: ast.AST
+) -> Iterator[LockRegion]:
+    """Every held-lock region in one function (nested regions yield
+    separately; the body of an inner ``with`` belongs to both).  Nested
+    function definitions are NOT descended into — they run later,
+    usually on another thread, and are visited as their own units."""
+    stack: list = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lock = repo.lock_for_with_item(mod, cls, item.context_expr)
+                if lock is not None:
+                    yield LockRegion(lock, node, mod, cls, fn)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def region_calls(region: LockRegion) -> Iterator[ast.Call]:
+    """Calls lexically inside the region body, excluding those inside a
+    nested function definition (a closure defined under the lock runs
+    later, usually on another thread, not under the lock)."""
+    stack: list = list(region.with_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,121 @@
+"""Pass: blocking-under-lock — indefinite blocking inside a held-lock
+region.
+
+The exact bug class the hung-step watchdog and the replica fence exist
+to mitigate at RUNTIME: a thread that sleeps, dials a socket, waits on
+a subprocess, or blocks on a device readback while holding a lock
+starves every other thread that needs it — the scraper thread stalls
+the /metrics endpoint, the admission path stalls `/healthz`, the poll
+loop stalls failover.  This pass catches it at ANALYSIS time: any call
+from the blocking tables in config (BLOCKING_DOTTED, BLOCKING_METHODS,
+and the timeout-dependent BLOCKING_NEED_TIMEOUT set) lexically inside a
+``with <lock>:`` body is a finding.
+
+Timeout semantics: ``cond.wait(timeout)`` / ``q.get(timeout=...)`` /
+``t.join(timeout)`` are bounded and pass; the unbounded no-timeout
+forms are findings.  ``Queue.get`` is distinguished from ``dict.get``
+by arity (``dict.get`` always takes a key), ``Thread.join`` from
+``str.join`` the same way.  A ``Condition.wait`` on the condition being
+held releases the lock while waiting, but the UNBOUNDED form is still
+flagged — a daemon that can wait forever wedges its own shutdown path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..model import Finding
+from ..walker import Repo, _attr_chain
+from ._regions import lock_regions, region_calls
+
+NAME = "blocking-under-lock"
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return True
+    return False
+
+
+def _classify(call: ast.Call, mod, cfg) -> str:
+    """Return a human label for a blocking call, or "" when benign."""
+    chain = _attr_chain(call.func)
+    if not chain:
+        return ""
+    dotted = ".".join(chain)
+    # `from time import sleep` style: resolve the bare name through the
+    # module's import map.
+    if len(chain) == 1 and chain[0] in mod.imports:
+        dotted = mod.imports[chain[0]]
+    if len(chain) >= 2 and chain[0] in mod.imports:
+        dotted = ".".join([mod.imports[chain[0]], *chain[1:]])
+        # strip any package prefix: "urllib.request.urlopen" stays
+        # matchable whether imported absolutely or via alias
+    for known in cfg.BLOCKING_DOTTED:
+        if dotted == known or dotted.endswith("." + known):
+            return known
+    if dotted in cfg.BLOCKING_DOTTED:
+        return dotted
+    method = chain[-1]
+    if len(chain) >= 2 and method in cfg.BLOCKING_METHODS:
+        return f".{method}()"
+    if len(chain) >= 2 and method in cfg.BLOCKING_NEED_TIMEOUT:
+        if _has_timeout(call):
+            return ""
+        if method == "wait" and not call.args:
+            # Condition/Event/proc wait() with no timeout: unbounded.
+            return ".wait() without timeout"
+        if method == "wait_for" and len(call.args) < 2:
+            # Condition.wait_for(predicate) with no timeout: unbounded.
+            return ".wait_for() without timeout"
+        if method == "get" and not call.args:
+            # Queue.get() no-arg form (dict.get always takes a key).
+            blockkw = next(
+                (k for k in call.keywords if k.arg == "block"), None
+            )
+            if blockkw is not None and (
+                isinstance(blockkw.value, ast.Constant)
+                and blockkw.value.value is False
+            ):
+                return ""
+            return ".get() without timeout"
+        if method == "join" and not call.args and not call.keywords:
+            return ".join() without timeout"
+    return ""
+
+
+def run(repo: Repo, cfg) -> list:
+    findings: list = []
+    seen: set = set()
+    for mod, cls, fn in repo.functions():
+        for region in lock_regions(repo, mod, cls, fn):
+            for call in region_calls(region):
+                label = _classify(call, mod, cfg)
+                if not label:
+                    continue
+                owner = f"{cls}.{fn.name}" if cls else fn.name
+                key = (
+                    f"{NAME}:{mod.rel}:{owner}:{label}:"
+                    f"under:{region.lock.qual}"
+                )
+                if key in seen:
+                    continue  # one finding per (site-kind, function)
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        NAME,
+                        "blocking",
+                        key,
+                        mod.rel,
+                        call.lineno,
+                        f"{label} called while holding "
+                        f"{region.lock} in {owner} — a stall here "
+                        "starves every thread contending on the lock; "
+                        "move the blocking call outside the region or "
+                        "bound it with a timeout",
+                    )
+                )
+    return findings

@@ -1,0 +1,450 @@
+"""Pass: catalog-drift — code vs documented operational catalogs, both
+directions.
+
+Five catalogs, each with a single documented home (config points at
+them) that PRs 6-10 kept in sync by hand:
+
+- **Flight-event kinds** (``flight.record("kind", ...)`` and
+  ``self._record(...)`` wrappers) vs the docs/operations.md flight
+  catalog tables (header ``| Kind | Source | ... |``).
+- **Metric names** (``registry.counter/gauge/histogram/summary``
+  registrations) vs the docs/operations.md metric tables (header
+  ``| Name | Type | ... |``).
+- **Failpoint sites** (``failpoints.fire(...)`` / ``fire_scoped``) vs
+  the docs/chaos.md failpoint catalog (header ``| Failpoint | ... |``).
+- **CLI flags** (every ``add_argument`` option on the serving/plugin/
+  router/benchmark CLIs) vs the README/docs flag documentation; ghost
+  flags are checked against README with the tools/ CLIs included in the
+  universe so `tools/chaos_report.py --run` mentions aren't false
+  ghosts.
+- **``/debug/*`` endpoints** (route string literals in comparison/
+  dict-key/subscript-route position) vs the README + operations.md
+  endpoint tables (header ``| Endpoint | ... |``).
+
+Undocumented code entries and documented ghost entries are BOTH
+findings: the catalogs are operator-facing contracts, and a stale row
+is an operator chasing an endpoint that does not exist.
+
+Dynamic event kinds (``self._record(f"router.breaker_{new}", ...)``)
+become prefix wildcards: the code side is satisfied when at least one
+documented kind matches the prefix, and documented kinds matching a
+code wildcard are not ghosts.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Optional
+
+from ..model import Finding
+from ..walker import Repo, Module, _attr_chain
+
+NAME = "catalog-drift"
+
+KIND_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)*$")
+METRIC_RE = re.compile(r"^tpu_[a-z0-9_]+$")
+BACKTICK_RE = re.compile(r"`([^`]+)`")
+FLAG_RE = re.compile(r"--[a-z0-9][a-z0-9-]*")
+ROUTE_RE = re.compile(r"/debug/[\w/.-]+")
+
+
+# ---------------------------------------------------------------- tables
+
+
+def _tables(text: str):
+    """Yield (header_cells, [(lineno, row_cells), ...]) per markdown
+    table."""
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        if lines[i].lstrip().startswith("|"):
+            block = []
+            while i < len(lines) and lines[i].lstrip().startswith("|"):
+                block.append((i + 1, lines[i]))
+                i += 1
+            if len(block) >= 2:
+                header = _cells(block[0][1])
+                rows = [
+                    (ln, _cells(raw))
+                    for ln, raw in block[2:]  # skip the |---| separator
+                ]
+                yield header, rows
+        else:
+            i += 1
+
+
+def _cells(row: str) -> list:
+    parts = row.strip().strip("|").split("|")
+    return [p.strip() for p in parts]
+
+
+def _doc_text(root: str, rel: str) -> str:
+    try:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return f.read()
+    except FileNotFoundError:
+        return ""
+
+
+def _first_line_of(text: str, token: str) -> int:
+    for i, line in enumerate(text.splitlines(), 1):
+        if token in line:
+            return i
+    return 0
+
+
+def _catalog_tokens(
+    root: str, docs: list, header0: str, header1: Optional[str], token_re
+) -> dict:
+    """token -> (doc_rel, line) from the first cell of matching tables."""
+    out: dict = {}
+    for rel in docs:
+        text = _doc_text(root, rel)
+        for header, rows in _tables(text):
+            if not header or header[0] != header0:
+                continue
+            if header1 is not None and (
+                len(header) < 2 or header[1] != header1
+            ):
+                continue
+            for lineno, cells in rows:
+                if not cells:
+                    continue
+                for tick in BACKTICK_RE.findall(cells[0]):
+                    for token in re.split(r"\s*/\s*|\s+", tick.strip()):
+                        if token_re.match(token):
+                            out.setdefault(token, (rel, lineno))
+    return out
+
+
+# ------------------------------------------------------------- code side
+
+
+def _const_str(mod: Module, node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in mod.constants:
+        return mod.constants[node.id]
+    return None
+
+
+def _event_kinds(repo: Repo):
+    """exact: kind -> (rel, line); wildcards: prefix -> (rel, line)."""
+    exact: dict = {}
+    wild: dict = {}
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in ("record", "_record"):
+                continue
+            arg = node.args[0]
+            values = []
+            if isinstance(arg, ast.IfExp):
+                values = [_const_str(mod, arg.body), _const_str(mod, arg.orelse)]
+            else:
+                values = [_const_str(mod, arg)]
+            for value in values:
+                if value is not None and KIND_RE.match(value):
+                    exact.setdefault(value, (mod.rel, node.lineno))
+            if isinstance(arg, ast.JoinedStr) and arg.values:
+                head = arg.values[0]
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ):
+                    prefix = head.value
+                    if prefix and KIND_RE.match(prefix.rstrip("._")):
+                        wild.setdefault(prefix, (mod.rel, node.lineno))
+    return exact, wild
+
+
+def _metric_names(repo: Repo) -> dict:
+    out: dict = {}
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr
+                in ("counter", "gauge", "histogram", "summary")
+                and node.args
+            ):
+                value = _const_str(mod, node.args[0])
+                if value is not None and METRIC_RE.match(value):
+                    out.setdefault(value, (mod.rel, node.lineno))
+    return out
+
+
+def _failpoint_names(repo: Repo) -> dict:
+    out: dict = {}
+    for mod in repo.modules:
+        if mod.rel.endswith("utils/failpoints.py"):
+            continue  # the registry's own plumbing, not call sites
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in ("fire", "fire_scoped"):
+                continue
+            value = _const_str(mod, node.args[0])
+            if value is not None and KIND_RE.match(value):
+                out.setdefault(value, (mod.rel, node.lineno))
+    return out
+
+
+def _argparse_flags(mod: Module) -> dict:
+    out: dict = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            for arg in node.args:
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("--")
+                ):
+                    out.setdefault(arg.value, (mod.rel, node.lineno))
+    return out
+
+
+def _routes(repo: Repo) -> dict:
+    """/debug/* string literals in route-defining position: comparison
+    operands (incl. membership tuples), dict keys, subscript stores."""
+    out: dict = {}
+
+    def note(mod, node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            path = node.value.split("?")[0]
+            if path.startswith("/debug/"):
+                out.setdefault(path, (mod.rel, node.lineno))
+
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Compare):
+                note(mod, node.left)
+                for comp in node.comparators:
+                    note(mod, comp)
+                    if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                        for elt in comp.elts:
+                            note(mod, elt)
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        note(mod, key)
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        note(mod, target.slice)
+    return out
+
+
+# ------------------------------------------------------------------ run
+
+
+def run(repo: Repo, cfg) -> list:
+    findings: list = []
+    root = repo.root
+
+    def finding(code: str, subject: str, rel: str, line: int, msg: str):
+        findings.append(
+            Finding(NAME, code, f"{NAME}:{code}:{subject}", rel, line, msg)
+        )
+
+    # ---- flight events
+    doc_kinds = _catalog_tokens(
+        root, cfg.EVENT_CATALOG_DOCS, "Kind", "Source", KIND_RE
+    )
+    code_kinds, code_wild = _event_kinds(repo)
+    for kind, (rel, line) in sorted(code_kinds.items()):
+        if kind not in doc_kinds:
+            finding(
+                "event-undocumented",
+                kind,
+                rel,
+                line,
+                f"flight-event kind {kind!r} is recorded here but has no "
+                f"row in the {'/'.join(cfg.EVENT_CATALOG_DOCS)} flight "
+                "catalog",
+            )
+    for prefix, (rel, line) in sorted(code_wild.items()):
+        if not any(k.startswith(prefix) for k in doc_kinds):
+            finding(
+                "event-undocumented",
+                f"{prefix}*",
+                rel,
+                line,
+                f"dynamic flight-event kind {prefix}* has no matching "
+                "rows in the flight catalog",
+            )
+    for kind, (rel, line) in sorted(doc_kinds.items()):
+        if kind not in code_kinds and not any(
+            kind.startswith(p) for p in code_wild
+        ):
+            finding(
+                "event-ghost",
+                kind,
+                rel,
+                line,
+                f"documented flight-event kind {kind!r} is never "
+                "recorded anywhere in the package",
+            )
+
+    # ---- metrics
+    doc_metrics = _catalog_tokens(
+        root, cfg.METRIC_CATALOG_DOCS, "Name", "Type", METRIC_RE
+    )
+    code_metrics = _metric_names(repo)
+    for name, (rel, line) in sorted(code_metrics.items()):
+        if name not in doc_metrics:
+            finding(
+                "metric-undocumented",
+                name,
+                rel,
+                line,
+                f"metric {name!r} is registered here but has no row in "
+                f"the {'/'.join(cfg.METRIC_CATALOG_DOCS)} metric tables",
+            )
+    for name, (rel, line) in sorted(doc_metrics.items()):
+        if name not in code_metrics:
+            finding(
+                "metric-ghost",
+                name,
+                rel,
+                line,
+                f"documented metric {name!r} is never registered in the "
+                "package",
+            )
+
+    # ---- failpoints
+    doc_fps = _catalog_tokens(
+        root, cfg.FAILPOINT_CATALOG_DOCS, "Failpoint", None, KIND_RE
+    )
+    code_fps = _failpoint_names(repo)
+    for name, (rel, line) in sorted(code_fps.items()):
+        if name not in doc_fps:
+            finding(
+                "failpoint-undocumented",
+                name,
+                rel,
+                line,
+                f"failpoint site {name!r} fires here but has no row in "
+                f"the {'/'.join(cfg.FAILPOINT_CATALOG_DOCS)} catalog",
+            )
+    for name, (rel, line) in sorted(doc_fps.items()):
+        if name not in code_fps:
+            finding(
+                "failpoint-ghost",
+                name,
+                rel,
+                line,
+                f"documented failpoint {name!r} has no fire() site in "
+                "the package",
+            )
+
+    # ---- CLI flags
+    coverage_docs: list = []
+    for pattern in cfg.FLAG_COVERAGE_DOCS:
+        if any(c in pattern for c in "*?["):
+            coverage_docs.extend(sorted(glob.glob(os.path.join(root, pattern))))
+        else:
+            coverage_docs.append(os.path.join(root, pattern))
+    doc_flag_text = "\n".join(
+        _doc_text(root, os.path.relpath(p, root)) for p in coverage_docs
+    )
+    documented_flags = set(FLAG_RE.findall(doc_flag_text))
+    universe: set = set()
+    for rel in cfg.CLI_MODULES:
+        mod = repo.by_rel.get(rel)
+        if mod is None:
+            continue
+        flags = _argparse_flags(mod)
+        universe |= set(flags)
+        for flag, (frel, line) in sorted(flags.items()):
+            if flag not in documented_flags:
+                finding(
+                    "flag-undocumented",
+                    f"{rel}:{flag}",
+                    frel,
+                    line,
+                    f"CLI flag {flag} ({rel}) appears nowhere in "
+                    "README.md or docs/ — document it or fold it away",
+                )
+    # tools/ CLIs widen the ghost universe only.
+    for extra_root in cfg.FLAG_UNIVERSE_EXTRA_ROOTS:
+        target = os.path.join(root, extra_root)
+        paths = (
+            [target]
+            if target.endswith(".py")
+            else sorted(glob.glob(os.path.join(target, "*.py")))
+        )
+        for path in paths:
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, str
+                        ):
+                            universe.add(arg.value)
+    for rel in cfg.FLAG_GHOST_DOCS:
+        text = _doc_text(root, rel)
+        for flag in sorted(set(FLAG_RE.findall(text))):
+            if flag not in universe:
+                finding(
+                    "flag-ghost",
+                    flag,
+                    rel,
+                    _first_line_of(text, flag),
+                    f"documented flag {flag} is defined by no CLI in "
+                    "the repo",
+                )
+
+    # ---- /debug endpoints
+    doc_routes: dict = {}
+    for rel in cfg.ENDPOINT_CATALOG_DOCS:
+        text = _doc_text(root, rel)
+        for header, rows in _tables(text):
+            if not header or header[0] != "Endpoint":
+                continue
+            for lineno, cells in rows:
+                if not cells:
+                    continue
+                for tick in BACKTICK_RE.findall(cells[0]):
+                    for route in ROUTE_RE.findall(tick.split("?")[0]):
+                        doc_routes.setdefault(route, (rel, lineno))
+    code_routes = _routes(repo)
+    for route, (rel, line) in sorted(code_routes.items()):
+        if route not in doc_routes:
+            finding(
+                "endpoint-undocumented",
+                route,
+                rel,
+                line,
+                f"route {route!r} is served here but has no row in the "
+                f"{'/'.join(cfg.ENDPOINT_CATALOG_DOCS)} endpoint tables",
+            )
+    for route, (rel, line) in sorted(doc_routes.items()):
+        if route not in code_routes:
+            finding(
+                "endpoint-ghost",
+                route,
+                rel,
+                line,
+                f"documented endpoint {route!r} is served nowhere in "
+                "the package",
+            )
+    return findings

@@ -1,0 +1,178 @@
+"""Pass: guarded-by — verify the ``# guarded by: <lock>`` annotation
+convention on shared mutable structures.
+
+The convention: an attribute assignment line carries the annotation::
+
+    self._ring: deque = deque(maxlen=capacity)  # guarded by: _lock
+
+and from then on every MUTATION of ``self._ring`` inside the class —
+assignment/augmented-assignment/del, subscript store, or a call to a
+mutating container method (config.MUTATOR_METHODS) — must sit lexically
+inside ``with self._lock:``.  Reads stay unguarded on purpose: the
+engine's contract allows lock-free reads of approximate state (gauge
+snapshots), mirroring racecheck.GuardedDeque's runtime policy.  The
+static pass and the runtime guards are two layers over ONE convention:
+annotate it here, wrap it there.
+
+Exemptions, all explicit:
+
+- ``__init__`` (construction precedes sharing);
+- methods whose ``def`` line carries ``# caller holds: <lock>`` —
+  helpers whose contract pushes the lock to the call site (the call
+  sites are checked where they hold the lock lexically);
+- annotations naming a RUNTIME guard (config.RUNTIME_GUARDS, e.g.
+  ``owner-thread``): single-owner handoffs that a static lexical check
+  cannot express; utils/racecheck.OwnerGuard enforces them in the
+  racecheck-enabled suites.
+
+An annotation naming a lock the class does not define is itself a
+finding (``unknown-lock``) — a typo'd contract is worse than none.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..model import Finding
+from ..walker import Repo, Module
+
+NAME = "guarded-by"
+
+_CALLER_HOLDS_RE = re.compile(r"caller holds:\s*([A-Za-z_]\w*)")
+
+
+def _under_lock(mod: Module, node: ast.AST, lock_attr: str) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock_attr>:``?"""
+    cur = node
+    while cur in mod.parents:
+        cur = mod.parents[cur]
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and expr.attr == lock_attr
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                ):
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def run(repo: Repo, cfg) -> list:
+    findings: list = []
+    for mod in repo.modules:
+        for cls in mod.classes.values():
+            if not cls.guards:
+                continue
+            for attr, guard in cls.guards.items():
+                runtime = guard.lock in cfg.RUNTIME_GUARDS
+                known = guard.lock in cls.lock_attrs or (
+                    # Mixin pattern: the lock is constructed by the
+                    # derived class (ServingEngine owns the engine lock
+                    # the KVCache/Admission mixins guard against).
+                    repo.derived_lock_owner(cls.name, guard.lock)
+                    is not None
+                )
+                if not runtime and not known:
+                    findings.append(
+                        Finding(
+                            NAME,
+                            "unknown-lock",
+                            f"{NAME}:unknown-lock:{mod.rel}:{cls.name}."
+                            f"{attr}",
+                            mod.rel,
+                            guard.line,
+                            f"{cls.name}.{attr} is annotated 'guarded "
+                            f"by: {guard.lock}' but {cls.name} defines "
+                            "no such threading.Lock/RLock/Condition "
+                            "attribute",
+                        )
+                    )
+                    continue
+                if runtime:
+                    continue  # enforced by racecheck at runtime
+                findings.extend(
+                    _check_attr(mod, cls, attr, guard.lock, cfg)
+                )
+    return findings
+
+
+def _check_attr(mod: Module, cls, attr: str, lock: str, cfg) -> list:
+    findings: list = []
+    for mname, fn in cls.methods.items():
+        if mname == "__init__" or mname.startswith("_init"):
+            # Construction precedes sharing; the engine mixins extend
+            # __init__ through `_init_*` helpers called before the
+            # instance escapes its constructor.
+            continue
+        held_by_contract = _CALLER_HOLDS_RE.search(
+            mod.comment_on(fn.lineno)
+        )
+        if held_by_contract and held_by_contract.group(1) == lock:
+            continue
+        for node in ast.walk(fn):
+            site = _mutation_site(node, attr, cfg)
+            if site is None:
+                continue
+            if _under_lock(mod, node, lock):
+                continue
+            op, line = site
+            findings.append(
+                Finding(
+                    NAME,
+                    "unguarded-mutation",
+                    f"{NAME}:{mod.rel}:{cls.name}.{mname}:{attr}:{op}",
+                    mod.rel,
+                    line,
+                    f"{cls.name}.{attr} is 'guarded by: {lock}' but "
+                    f"{mname}() mutates it ({op}) outside 'with "
+                    f"self.{lock}'",
+                )
+            )
+    return findings
+
+
+def _mutation_site(node: ast.AST, attr: str, cfg):
+    """(op, line) when ``node`` mutates ``self.<attr>``, else None."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in targets:
+            if _self_attr(t) == attr:
+                return "rebind", node.lineno
+            if (
+                isinstance(t, ast.Subscript)
+                and _self_attr(t.value) == attr
+            ):
+                return "setitem", node.lineno
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if _self_attr(t) == attr or (
+                isinstance(t, ast.Subscript) and _self_attr(t.value) == attr
+            ):
+                return "del", node.lineno
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if (
+            node.func.attr in cfg.MUTATOR_METHODS
+            and _self_attr(node.func.value) == attr
+        ):
+            return f".{node.func.attr}()", node.lineno
+    return None

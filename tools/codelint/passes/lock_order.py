@@ -1,0 +1,196 @@
+"""Pass: lock-order — static lock-acquisition graph, cycles, and
+unreviewed nested acquisitions.
+
+Builds the repo's static lock graph: a node per indexed lock
+(``self.X = threading.Lock()`` / module-level), an edge ``L -> M`` when
+code acquires M while lexically holding L — directly (nested ``with``)
+or through a resolvable call chain (``self.m()``, module functions,
+imported repo modules, and the duck-typed receivers hinted in
+config.ATTR_TYPES).  Three finding classes:
+
+``self-deadlock``
+    A non-reentrant lock (plain ``Lock``/``Condition``) re-acquired on
+    a path that already holds it — deadlocks unconditionally the first
+    time the path executes.  Re-acquiring an ``RLock`` is fine (the
+    reentrancy is the point) and produces nothing.
+``cycle``
+    L -> ... -> L in the edge graph: a static deadlock candidate.  Two
+    threads taking the participating locks in opposite orders can
+    deadlock; there is no legal allowlisting of a cycle.
+``nested-unallowed``
+    An edge not in config.LOCK_ORDER_ALLOW.  Nesting is sometimes
+    right (leaf instruments under a daemon lock) but must be REVIEWED:
+    add the (outer, inner) pair to the allowlist with the rationale,
+    or restructure to release the outer lock first.
+
+Call resolution is conservative: an unresolvable call contributes no
+edges, so every reported edge corresponds to a real syntactic path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..model import Finding
+from ..walker import Repo, LockId
+from ._regions import lock_regions
+
+NAME = "lock-order"
+
+
+def _direct_acquires(repo: Repo, mod, cls, fn) -> set:
+    return {region.lock for region in lock_regions(repo, mod, cls, fn)}
+
+
+def _calls_in(fn: ast.AST) -> list:
+    """Calls in a function body, not descending into nested defs."""
+    out, stack = [], list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def run(repo: Repo, cfg) -> list:
+    attr_types = cfg.ATTR_TYPES
+    units = list(repo.functions())
+
+    # Transitive lock-acquisition set per function unit (fixpoint over
+    # the resolvable call graph).
+    unit_key = {id(fn): (mod, cls, fn) for mod, cls, fn in units}
+    acquires: dict[int, set] = {
+        id(fn): _direct_acquires(repo, mod, cls, fn) for mod, cls, fn in units
+    }
+    callees: dict[int, list] = {}
+    for mod, cls, fn in units:
+        edges = []
+        for call in _calls_in(fn):
+            resolved = repo.resolve_call(mod, cls, call, attr_types)
+            if resolved is not None and id(resolved[2]) in acquires:
+                edges.append(id(resolved[2]))
+        callees[id(fn)] = edges
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in callees.items():
+            for callee in outs:
+                extra = acquires[callee] - acquires[key]
+                if extra:
+                    acquires[key] |= extra
+                    changed = True
+
+    # Edge extraction: while holding region.lock, a direct nested with
+    # or a call whose transitive acquires are nonempty adds edges.
+    edges: dict[tuple, tuple] = {}  # (outer,inner) -> (mod,line,detail)
+    reacquires: dict[str, tuple] = {}  # lock -> (mod,line,detail)
+
+    def add_edge(outer: LockId, inner: LockId, mod, line: int, detail: str):
+        if outer == inner:
+            # Re-acquiring a lock already held: harmless on an RLock
+            # (the reentrancy is the point), a guaranteed SELF-DEADLOCK
+            # on a plain Lock/Condition the moment the path executes.
+            if repo.lock_kind(outer) != "RLock":
+                reacquires.setdefault(str(outer), (mod.rel, line, detail))
+            return
+        key = (str(outer), str(inner))
+        edges.setdefault(key, (mod.rel, line, detail))
+
+    for mod, cls, fn in units:
+        for region in lock_regions(repo, mod, cls, fn):
+            stack = list(region.with_node.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        inner = repo.lock_for_with_item(
+                            mod, cls, item.context_expr
+                        )
+                        if inner is not None:
+                            add_edge(
+                                region.lock, inner, mod, node.lineno,
+                                "nested with",
+                            )
+                if isinstance(node, ast.Call):
+                    resolved = repo.resolve_call(mod, cls, node, attr_types)
+                    if resolved is not None:
+                        callee_id = id(resolved[2])
+                        for inner in acquires.get(callee_id, ()):
+                            r_mod, r_cls, r_fn = resolved
+                            add_edge(
+                                region.lock, inner, mod, node.lineno,
+                                f"via call to {r_cls + '.' if r_cls else ''}"
+                                f"{r_fn.name}",
+                            )
+                stack.extend(ast.iter_child_nodes(node))
+
+    findings: list = []
+    for lock, (rel, line, detail) in sorted(reacquires.items()):
+        findings.append(
+            Finding(
+                NAME,
+                "self-deadlock",
+                f"{NAME}:self-deadlock:{lock}",
+                rel,
+                line,
+                f"non-reentrant lock {lock} is re-acquired while "
+                f"already held ({detail}) — a plain Lock/Condition "
+                "self-deadlocks here; make it an RLock or hoist the "
+                "inner acquisition out",
+            )
+        )
+    # Cycles: report each unordered pair once, plus longer cycles via a
+    # DFS over the edge graph.
+    graph: dict[str, set] = {}
+    for (outer, inner) in edges:
+        graph.setdefault(outer, set()).add(inner)
+    reported_cycles: set = set()
+    for outer, inners in sorted(graph.items()):
+        for inner in sorted(inners):
+            if outer in graph.get(inner, ()):  # 2-cycle
+                pair = tuple(sorted((outer, inner)))
+                if pair in reported_cycles:
+                    continue
+                reported_cycles.add(pair)
+                rel, line, detail = edges[(outer, inner)]
+                findings.append(
+                    Finding(
+                        NAME,
+                        "cycle",
+                        f"{NAME}:cycle:{pair[0]}<->{pair[1]}",
+                        rel,
+                        line,
+                        f"lock cycle: {outer} and {inner} are each "
+                        f"acquired while the other is held ({detail}) — "
+                        "static deadlock candidate",
+                    )
+                )
+    allow = cfg.LOCK_ORDER_ALLOW
+    for (outer, inner), (rel, line, detail) in sorted(edges.items()):
+        if tuple(sorted((outer, inner))) in reported_cycles:
+            continue
+        if (outer, inner) in allow:
+            continue
+        findings.append(
+            Finding(
+                NAME,
+                "nested-unallowed",
+                f"{NAME}:nested:{outer}->{inner}",
+                rel,
+                line,
+                f"nested lock acquisition not on the reviewed allowlist: "
+                f"{inner} taken while holding {outer} ({detail}) — add "
+                "the ordered pair to tools/codelint/config.py "
+                "LOCK_ORDER_ALLOW with rationale, or release the outer "
+                "lock first",
+            )
+        )
+    return findings

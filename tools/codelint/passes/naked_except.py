@@ -1,0 +1,131 @@
+"""Pass: naked-except — silent exception swallowing in daemon code.
+
+A daemon loop that catches everything and does NOTHING is how a fleet
+loses its forensic record: the fault happened, nothing logged it, no
+flight event marks the timeline, and the loop spins on as if healthy.
+This pass flags ``except:`` / ``except Exception:`` /
+``except BaseException:`` handlers that swallow silently — the body
+neither re-raises, nor logs, nor records a flight event, nor does any
+real fallback work (a handler that assigns a fallback value or calls a
+cleanup path has HANDLED the exception; one that is only ``pass`` /
+``continue`` / bare ``return`` has hidden it).
+
+Narrow excepts (``except OSError:``) are never flagged: catching a
+specific exception silently is a (reviewable) judgment call; catching
+EVERYTHING silently is a bug class.  Intentional sites take the inline
+pragma with a reason::
+
+    except Exception:  # codelint: ignore[naked-except] best-effort close
+        pass
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..model import Finding
+from ..walker import Repo, _attr_chain
+
+NAME = "naked-except"
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGERS = {"log", "logger", "logging", "warnings"}
+_LOG_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "warn",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name) and handler.type.id in _BROAD:
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD
+            for e in handler.type.elts
+        )
+    return False
+
+
+def _acknowledges(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, log, flight-record, or do real
+    fallback work?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] == "record" or chain[-1] == "_record":
+                return True  # flight event
+            if chain[0] in _LOGGERS and chain[-1] in _LOG_METHODS | {"warn"}:
+                return True
+            if chain[-1] == "print":  # CLI surfaces report via stderr
+                return True
+    # Real fallback work: anything beyond pass/continue/break/bare
+    # return/constant expression counts as handling.
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None
+            or (
+                isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is None
+            )
+        ):
+            # Bare `return` hides the exception; `return <fallback>` is
+            # a handled degradation.
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue
+        return True
+    return False
+
+
+def run(repo: Repo, cfg) -> list:
+    findings: list = []
+    for mod in repo.modules:
+        counters: dict = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _acknowledges(node):
+                continue
+            # Stable key: file + enclosing function + ordinal within it.
+            fn = node
+            while fn in mod.parents and not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                fn = mod.parents[fn]
+            owner = (
+                fn.name
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else "<module>"
+            )
+            ordinal = counters.get((mod.rel, owner), 0)
+            counters[(mod.rel, owner)] = ordinal + 1
+            suffix = f"#{ordinal}" if ordinal else ""
+            what = (
+                "bare except"
+                if node.type is None
+                else f"except {ast.unparse(node.type)}"
+            )
+            findings.append(
+                Finding(
+                    NAME,
+                    "swallow",
+                    f"{NAME}:{mod.rel}:{owner}{suffix}",
+                    mod.rel,
+                    node.lineno,
+                    f"{what} in {owner}() swallows silently — add a "
+                    "flight event, a log line, or a re-raise (or narrow "
+                    "the exception type); intentional best-effort sites "
+                    "take '# codelint: ignore[naked-except] <reason>'",
+                )
+            )
+    return findings

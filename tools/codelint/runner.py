@@ -1,0 +1,78 @@
+"""Pass registry + orchestration: parse the repo once, run the selected
+passes, apply inline pragmas, baseline, and staleness checking."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import config as default_config
+from .model import Baseline, Finding, apply_baseline, inline_ignored
+from .walker import Repo
+from .passes import (
+    blocking_under_lock,
+    catalog_drift,
+    guarded_by,
+    lock_order,
+    naked_except,
+)
+
+PASSES = {
+    lock_order.NAME: lock_order.run,
+    blocking_under_lock.NAME: blocking_under_lock.run,
+    guarded_by.NAME: guarded_by.run,
+    catalog_drift.NAME: catalog_drift.run,
+    naked_except.NAME: naked_except.run,
+}
+
+
+def run_passes(
+    root: str,
+    passes: Optional[list] = None,
+    cfg=default_config,
+    baseline: Optional[Baseline] = None,
+    repo: Optional[Repo] = None,
+) -> dict:
+    """Run the selected passes (all by default) over ``root``.
+
+    Returns a result dict: ``findings`` (active, unbaselined),
+    ``suppressed`` (matched baseline), ``inline_ignored`` count,
+    ``stale`` baseline keys, ``elapsed_s``, and ``ok`` (True only when
+    there are no active findings AND no stale suppressions).  Pass a
+    pre-built ``repo`` to share one parse across runs (the tier-1 suite
+    does — parsing is most of the wall time).
+    """
+    t0 = time.monotonic()
+    repo = repo if repo is not None else Repo(root, cfg.SCAN_ROOTS)
+    names = passes or list(PASSES)
+    raw: list[Finding] = []
+    for name in names:
+        if name not in PASSES:
+            raise ValueError(
+                f"unknown pass {name!r} (have: {', '.join(sorted(PASSES))})"
+            )
+        raw.extend(PASSES[name](repo, cfg))
+
+    # Inline pragmas: dropped before baselining (scoped, visible in the
+    # source at the site — they need no central entry).
+    kept: list[Finding] = []
+    ignored = 0
+    for f in raw:
+        mod = repo.by_rel.get(f.file)
+        if mod is not None and inline_ignored(f, mod.comments):
+            ignored += 1
+        else:
+            kept.append(f)
+
+    baseline = baseline if baseline is not None else Baseline()
+    active, suppressed, stale = apply_baseline(kept, baseline)
+    active.sort(key=lambda f: (f.pass_name, f.file, f.line, f.key))
+    return {
+        "findings": active,
+        "suppressed": suppressed,
+        "inline_ignored": ignored,
+        "stale": stale,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+        "passes": names,
+        "ok": not active and not stale,
+    }

@@ -1,0 +1,394 @@
+"""Shared file-walker and AST index for the codelint passes.
+
+Parses every ``*.py`` under the target roots ONCE into :class:`Module`
+records (AST + comment map + class/lock/import indexes) and exposes the
+cross-module lookups the passes share: lock identities, intraprocedural
+call resolution, and per-function transitive lock-acquisition sets.
+
+Everything here is name-based static analysis, deliberately
+conservative: a call we cannot resolve contributes no edges (a lint must
+prefer silence to noise), and the repo-specific escape hatches —
+duck-typed attribute types, allowlisted lock orders — live in
+:mod:`tools.codelint.config` where they are reviewed, not inferred.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _attr_chain(node: ast.AST) -> Optional[list[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for anything non-trivial."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+@dataclass
+class LockId:
+    """Stable identity of one lock: its defining file plus its qualified
+    attribute name (``Class.attr`` or a module-level name)."""
+
+    rel: str
+    qual: str  # "ServingEngine._lock" / "_registry_lock"
+
+    def __str__(self) -> str:
+        return f"{self.rel}:{self.qual}"
+
+    def __hash__(self):
+        return hash((self.rel, self.qual))
+
+    def __eq__(self, other):
+        return (self.rel, self.qual) == (other.rel, other.qual)
+
+
+@dataclass
+class GuardAnnotation:
+    """One ``# guarded by: <lock>`` annotation on an attribute."""
+
+    attr: str
+    lock: str  # lock attr name, or an "owner-thread"-style marker
+    line: int
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)  # name -> ast.FunctionDef
+    lock_attrs: set = field(default_factory=set)  # self.X = threading.Lock()
+    lock_kinds: dict = field(default_factory=dict)  # attr -> Lock/RLock/Condition
+    guards: dict = field(default_factory=dict)  # attr -> GuardAnnotation
+
+
+class Module:
+    """One parsed source file plus the indexes the passes need."""
+
+    def __init__(self, root: str, path: str):
+        self.path = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.rel)
+        self.comments = self._comment_map()
+        self.parents: dict = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.imports: dict[str, str] = {}  # local alias -> dotted module
+        self.constants: dict[str, str] = {}  # module-level str constants
+        self.module_locks: set = set()  # module-level lock names
+        self.module_lock_kinds: dict[str, str] = {}
+        self._index()
+
+    # ------------------------------------------------------------ indexes
+
+    def _comment_map(self) -> dict[int, str]:
+        comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        return comments
+
+    def _index(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name):
+                    kind = self._lock_ctor_kind(node.value)
+                    if kind is not None:
+                        self.module_locks.add(target.id)
+                        self.module_lock_kinds[target.id] = kind
+                    elif isinstance(node.value, ast.Constant) and isinstance(
+                        node.value.value, str
+                    ):
+                        self.constants[target.id] = node.value.value
+
+    def _index_import(self, node) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                self.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name
+                )
+        else:
+            mod = node.module or ""
+            if node.level:  # relative: resolve against this file's package
+                pkg_parts = self.rel.split("/")[:-1]
+                pkg_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(pkg_parts)
+                mod = f"{base}.{mod}" if mod else base
+            for alias in node.names:
+                self.imports[alias.asname or alias.name] = (
+                    f"{mod}.{alias.name}" if mod else alias.name
+                )
+
+    @staticmethod
+    def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+        """"Lock"/"RLock"/"Condition" when ``value`` constructs one."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _attr_chain(value.func)
+        if chain and chain[-1] in _LOCK_CTORS and (
+            len(chain) == 1 or chain[-2] == "threading"
+        ):
+            return chain[-1]
+        return None
+
+    @classmethod
+    def _is_lock_ctor(cls, value: ast.AST) -> bool:
+        return cls._lock_ctor_kind(value) is not None
+
+    def _index_class(self, node: ast.ClassDef) -> None:
+        info = ClassInfo(name=node.name, node=node)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        target = sub.targets[0]
+                        if (
+                            isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                        ):
+                            kind = self._lock_ctor_kind(sub.value)
+                            if kind is not None:
+                                info.lock_attrs.add(target.attr)
+                                info.lock_kinds[target.attr] = kind
+                            self._maybe_guard(info, target.attr, sub.lineno)
+                    elif isinstance(sub, ast.AnnAssign):
+                        target = sub.target
+                        if (
+                            isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                        ):
+                            kind = (
+                                self._lock_ctor_kind(sub.value)
+                                if sub.value is not None
+                                else None
+                            )
+                            if kind is not None:
+                                info.lock_attrs.add(target.attr)
+                                info.lock_kinds[target.attr] = kind
+                            self._maybe_guard(info, target.attr, sub.lineno)
+        self.classes[node.name] = info
+
+    _GUARD_RE = re.compile(r"guarded by:\s*([A-Za-z_][\w-]*(?:\([^)]*\))?)")
+
+    def _maybe_guard(self, info: ClassInfo, attr: str, line: int) -> None:
+        comment = self.comments.get(line, "")
+        m = self._GUARD_RE.search(comment)
+        if m and attr not in info.guards:
+            info.guards[attr] = GuardAnnotation(
+                attr=attr, lock=m.group(1), line=line
+            )
+
+    # ----------------------------------------------------------- helpers
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+
+class Repo:
+    """Every parsed module under the scan roots, plus cross-module
+    lookups (dotted module name -> Module) and the function index the
+    lock passes resolve calls through."""
+
+    def __init__(self, root: str, scan_roots: list[str]):
+        self.root = root
+        self.modules: list[Module] = []
+        self.by_rel: dict[str, Module] = {}
+        self.by_dotted: dict[str, Module] = {}
+        self._derived_owner_cache: dict = {}
+        for scan in scan_roots:
+            base = os.path.join(root, scan)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [
+                    d
+                    for d in sorted(dirnames)
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        self._load(os.path.join(dirpath, name))
+
+    def _load(self, path: str) -> None:
+        mod = Module(self.root, path)
+        self.modules.append(mod)
+        self.by_rel[mod.rel] = mod
+        dotted = mod.rel[:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        self.by_dotted[dotted] = mod
+
+    def lock_kind(self, lock: "LockId") -> Optional[str]:
+        """"Lock"/"RLock"/"Condition" for an indexed lock identity."""
+        mod = self.by_rel.get(lock.rel)
+        if mod is None:
+            return None
+        if "." in lock.qual:
+            cls_name, attr = lock.qual.split(".", 1)
+            info = mod.classes.get(cls_name)
+            return info.lock_kinds.get(attr) if info else None
+        return mod.module_lock_kinds.get(lock.qual)
+
+    def derived_lock_owner(
+        self, cls_name: str, attr: str
+    ) -> Optional["tuple[Module, ClassInfo]"]:
+        """A mixin's ``with self._lock`` resolves through the derived
+        class that actually constructs the lock (the engine pattern:
+        ``ServingEngine(AdmissionMixin, ...)`` owns ``_lock``, the
+        mixins' methods run with ``self`` being the derived instance).
+        Returns the unique derived class defining ``attr`` as a lock,
+        or None when there is none — or more than one (ambiguity must
+        not invent edges).  Memoized: the lock passes ask for the same
+        (mixin, attr) pairs thousands of times across one run."""
+        cached = self._derived_owner_cache.get((cls_name, attr), "miss")
+        if cached != "miss":
+            return cached
+        owners = []
+        for mod in self.modules:
+            for info in mod.classes.values():
+                base_names = {
+                    b.id
+                    for b in info.node.bases
+                    if isinstance(b, ast.Name)
+                } | {
+                    b.attr
+                    for b in info.node.bases
+                    if isinstance(b, ast.Attribute)
+                }
+                if cls_name in base_names and attr in info.lock_attrs:
+                    owners.append((mod, info))
+        result = owners[0] if len(owners) == 1 else None
+        self._derived_owner_cache[(cls_name, attr)] = result
+        return result
+
+    # ------------------------------------------------- function iteration
+
+    def functions(self) -> Iterator[tuple[Module, Optional[str], ast.AST]]:
+        """Yield (module, class_name_or_None, function_node) for every
+        function/method in the repo, including nested ones (a nested
+        function is attributed to its enclosing class if any)."""
+        for mod in self.modules:
+            seen: set = set()
+            for cls in mod.classes.values():
+                for fn in cls.methods.values():
+                    seen.add(id(fn))
+                    yield mod, cls.name, fn
+                    for sub in ast.walk(fn):
+                        if (
+                            isinstance(
+                                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                            )
+                            and id(sub) not in seen
+                        ):
+                            seen.add(id(sub))
+                            yield mod, cls.name, sub
+            for fn in mod.functions.values():
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                yield mod, None, fn
+                for sub in ast.walk(fn):
+                    if (
+                        isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and id(sub) not in seen
+                    ):
+                        seen.add(id(sub))
+                        yield mod, None, sub
+
+    # -------------------------------------------------- lock identities
+
+    def lock_for_with_item(
+        self, mod: Module, cls: Optional[str], expr: ast.AST
+    ) -> Optional[LockId]:
+        """The lock a ``with <expr>:`` item acquires, if <expr> names
+        one we indexed: ``self.X`` (class lock attr) or a module-level
+        lock name."""
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        if chain[0] == "self" and len(chain) == 2 and cls:
+            info = mod.classes.get(cls)
+            if info and chain[1] in info.lock_attrs:
+                return LockId(mod.rel, f"{cls}.{chain[1]}")
+            # Mixin pattern: the lock lives on the (unique) derived
+            # class — identity canonicalizes there so AdmissionMixin's
+            # `with self._lock` IS ServingEngine._lock.
+            owner = self.derived_lock_owner(cls, chain[1])
+            if owner is not None:
+                o_mod, o_info = owner
+                return LockId(o_mod.rel, f"{o_info.name}.{chain[1]}")
+        if len(chain) == 1 and chain[0] in mod.module_locks:
+            return LockId(mod.rel, chain[0])
+        return None
+
+    def resolve_call(
+        self,
+        mod: Module,
+        cls: Optional[str],
+        call: ast.Call,
+        attr_types: dict,
+    ) -> Optional[tuple[Module, Optional[str], ast.AST]]:
+        """Resolve a call to a (module, class, function) unit when the
+        receiver is statically knowable: ``self.m()``, ``f()``,
+        ``imported_module.f()``, or a duck-typed attribute listed in
+        ``attr_types`` (config): ``self.flight.record()`` ->
+        FlightRecorder.record."""
+        chain = _attr_chain(call.func)
+        if not chain:
+            return None
+        # self.m() -> same-class method
+        if chain[0] == "self" and len(chain) == 2 and cls:
+            info = mod.classes.get(cls)
+            if info and chain[1] in info.methods:
+                return mod, cls, info.methods[chain[1]]
+        # f() -> module function
+        if len(chain) == 1 and chain[0] in mod.functions:
+            return mod, None, mod.functions[chain[0]]
+        # alias.f() -> imported repo module's function (or class ctor: skip)
+        if len(chain) == 2 and chain[0] in mod.imports:
+            target = self.by_dotted.get(mod.imports[chain[0]])
+            if target and chain[1] in target.functions:
+                return target, None, target.functions[chain[1]]
+        # duck-typed receiver: self.X.m() / X.m() with X in attr_types
+        if len(chain) >= 2 and chain[-2] != "self":
+            recv = chain[-2]
+            hint = attr_types.get(recv)
+            if hint:
+                target_rel, target_cls = hint
+                target = self.by_rel.get(target_rel)
+                if target:
+                    info = target.classes.get(target_cls)
+                    if info and chain[-1] in info.methods:
+                        return target, target_cls, info.methods[chain[-1]]
+        return None

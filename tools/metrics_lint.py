@@ -27,6 +27,14 @@ Usage (CI or live debugging; exits nonzero on any finding):
 
 The tier-1 suite scrapes live MetricsServer and EngineServer instances
 through :func:`lint`.
+
+``--from-codelint`` hands the whole invocation to the unified
+contract-lint entry point: ``python tools/metrics_lint.py
+--from-codelint URL...`` ≡ ``python -m tools.codelint --all --url
+URL...`` — the static passes (lock discipline, catalog drift, …) run
+first and THEN each URL gets this module's runtime exposition lint, one
+command, one exit code.  The codelint side imports :func:`lint_url`
+directly, so both entry points share one linter.
 """
 
 from __future__ import annotations
@@ -202,14 +210,43 @@ def main(argv=None) -> int:
         prog="metrics-lint",
         description="strictly lint Prometheus text exposition endpoints",
     )
-    p.add_argument("urls", nargs="+", help="one or more /metrics URLs")
+    p.add_argument(
+        "urls",
+        nargs="*",
+        help="one or more /metrics URLs (optional with --from-codelint: "
+        "the static passes still run)",
+    )
     p.add_argument(
         "--cardinality-budget",
         type=int,
         default=DEFAULT_CARDINALITY_BUDGET,
         help="max series per metric family (default %(default)s)",
     )
+    p.add_argument(
+        "--from-codelint",
+        action="store_true",
+        help="run the unified contract lint instead: the tools/codelint "
+        "static passes first, then this exposition lint against every "
+        "URL (equivalent to `python -m tools.codelint --all --url ...`)",
+    )
     args = p.parse_args(argv)
+    if args.from_codelint:
+        # Script invocation (`python tools/metrics_lint.py`) puts tools/
+        # itself on sys.path, not the repo root — fix up so the package
+        # import works from either entry style.
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        from tools.codelint.__main__ import main as codelint_main
+
+        codelint_args = ["--all"]
+        for url in args.urls:
+            codelint_args += ["--url", url]
+        return codelint_main(codelint_args)
+    if not args.urls:
+        p.error("need at least one /metrics URL (or --from-codelint)")
     failed = False
     for url in args.urls:
         try:
