@@ -1062,6 +1062,53 @@ def run_serving(args) -> None:
         )
     )
 
+    # --- Tracing overhead phase (TRACE row) ------------------------------
+    # The always-on span layer must stay ~free: the SAME jobs decode
+    # through the SAME compiled programs with the recorder detached,
+    # then attached (host-side toggle — no new compiles), and the
+    # per-token cost difference is the measured tracing overhead.
+    # tools/bench_diff.py screams TRACE-OVERHEAD past 2%.
+    trace_spans0 = len(spans.snapshot()) + spans.dropped
+    eng.spans = None
+    t0 = time.perf_counter()
+    off_done = eng.run(jobs)
+    trace_off_dt = time.perf_counter() - t0
+    off_tokens = sum(len(r.tokens) for r in off_done)
+    eng.spans = spans
+    t0 = time.perf_counter()
+    on_done = eng.run(jobs)
+    trace_on_dt = time.perf_counter() - t0
+    on_tokens = sum(len(r.tokens) for r in on_done)
+    trace_off_tps = off_tokens / trace_off_dt if trace_off_dt else 0.0
+    trace_on_tps = on_tokens / trace_on_dt if trace_on_dt else 0.0
+    trace_overhead = (
+        (trace_off_tps / trace_on_tps) - 1.0 if trace_on_tps else 0.0
+    )
+    trace_spans_recorded = (
+        len(spans.snapshot()) + spans.dropped - trace_spans0
+    )
+    # Rides GET /debug/profile (and the profile JSON block below): the
+    # live answer to "what does tracing cost on this replica".
+    eng.profiler.note_trace_overhead(trace_overhead)
+    trace_block = {
+        "overhead": round(trace_overhead, 4),
+        "off_tokens_per_sec": round(trace_off_tps, 2),
+        "on_tokens_per_sec": round(trace_on_tps, 2),
+        "spans_recorded": trace_spans_recorded,
+    }
+    log(
+        "perf-ledger row: | Tracing overhead (b%d) | spans off %.2f → on "
+        "%.2f tokens/sec (overhead %+.2f%%; %d spans) | - | `benchmark.py "
+        "--model serving` | update on bench round |"
+        % (
+            args.slots,
+            trace_off_tps,
+            trace_on_tps,
+            trace_overhead * 100.0,
+            trace_spans_recorded,
+        )
+    )
+
     # --- Tensor-parallel phase (MULTICHIP row) ---------------------------
     # Same jobs through a tp=N engine built the CLI-facing way
     # (mesh_from_allocation + the sharded ctor), timed against the tp=1
@@ -1176,6 +1223,7 @@ def run_serving(args) -> None:
                 "overload": overload_block,
                 "restart": restart_block,
                 "router": router_block,
+                "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {
                     "steps": prof["steps"],
@@ -1183,6 +1231,9 @@ def run_serving(args) -> None:
                     "step_ms_p99": prof["step_ms"]["p99"],
                     "phase_ms_p50": phase_p50,
                     "occupancy": prof["occupancy"],
+                    # The tracing phase noted it on the profiler, so the
+                    # live GET /debug/profile carries the same number.
+                    "trace_overhead": trace_block["overhead"],
                     "incidents": eng.anomaly.snapshot()["incidents_total"],
                 },
             }

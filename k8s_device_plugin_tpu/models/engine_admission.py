@@ -46,6 +46,9 @@ class AdmissionMixin:
         stop: Optional[list] = None,
         logit_bias: Optional[dict] = None,
         trace_id: Optional[str] = None,
+        trace_parent: str = "",
+        trace_hop: int = 0,
+        trace_attempt: int = 0,
         priority: int = 1,
         tenant: str = "",
         deadline_s: Optional[float] = None,
@@ -128,6 +131,10 @@ class AdmissionMixin:
                 # send an id — generated ids tie SSE events, spans, and
                 # log lines of one request together.
                 trace_id=trace_id or new_trace_id(),
+                # Cross-process parent (router attempt span) from the
+                # X-Trace-Context hop header, when one arrived.
+                trace_parent=str(trace_parent or ""),
+                trace_hop=int(trace_hop), trace_attempt=int(trace_attempt),
                 rid=self._next_rid, submitted_at=now,
             )
             if self.spans:
@@ -920,23 +927,31 @@ class AdmissionMixin:
                     parent_id=req.root_span,
                     attrs={"rid": req.rid, "tokens": len(req.tokens)},
                 )
+                root_attrs = {
+                    "rid": req.rid,
+                    "prompt_tokens": len(req.prompt),
+                    "new_tokens": len(req.tokens),
+                    "outcome": f"shed:{req.shed}"
+                    if req.shed
+                    else (
+                        "cancelled"
+                        if req.cancelled
+                        else ("stopped" if req.stopped else "completed")
+                    ),
+                }
+                if req.trace_parent:
+                    # Cross-process link (X-Trace-Context): the router
+                    # attempt span this tree roots under — the join key
+                    # tools/trace_assemble.py resolves fleet-wide.
+                    root_attrs["parent"] = req.trace_parent
+                    root_attrs["hop"] = req.trace_hop
+                    root_attrs["attempt"] = req.trace_attempt
                 self.spans.record_span(
                     "request",
                     req.trace_id,
                     start_monotonic=req.submitted_at,
                     end_monotonic=req.finished_at,
                     span_id=req.root_span,
-                    attrs={
-                        "rid": req.rid,
-                        "prompt_tokens": len(req.prompt),
-                        "new_tokens": len(req.tokens),
-                        "outcome": f"shed:{req.shed}"
-                        if req.shed
-                        else (
-                            "cancelled"
-                            if req.cancelled
-                            else ("stopped" if req.stopped else "completed")
-                        ),
-                    },
+                    attrs=root_attrs,
                 )
             self._clear_slot(slot)

@@ -100,9 +100,19 @@ class EngineProfiler:
         self._phase_totals = {p: 0.0 for p in PHASES}
         self._mem_fn = "unprobed"  # "unprobed" -> callable | None
         self._last_mem: Optional[int] = None
+        # Measured spans-enabled per-step overhead fraction (the
+        # benchmark's A/B over the same jobs, spans off vs on); None
+        # until a bench round noted one.  Rides the snapshot so
+        # GET /debug/profile answers "what does tracing cost here".
+        self._trace_overhead: Optional[float] = None
 
     def timer(self) -> StepTimer:
         return StepTimer()
+
+    def note_trace_overhead(self, overhead: float) -> None:
+        """Record the measured spans-on vs spans-off per-step overhead
+        fraction (benchmark.py --model serving's tracing phase)."""
+        self._trace_overhead = float(overhead)
 
     # -------------------------------------------------------------- memory
 
@@ -255,6 +265,7 @@ class EngineProfiler:
             "steps": steps,
             "tokens": tokens,
             "window": n,
+            "trace_overhead": self._trace_overhead,
             "step_ms": {
                 "mean": round((sum(walls) / n * 1e3) if n else 0.0, 4),
                 "p50": round(_percentile(walls, 0.5) * 1e3, 4),

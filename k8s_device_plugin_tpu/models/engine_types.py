@@ -363,6 +363,15 @@ class Request:
     # Reserved root-span id (spans recorder): the queue/prefill/decode
     # child spans parent on it across threads; 0 when tracing is off.
     root_span: int = 0
+    # Cross-process parent link (X-Trace-Context, utils/spans.py): the
+    # 16-hex span id of the router attempt that carried this request,
+    # plus which hop/attempt of the request's journey that dial was.
+    # The request root span records them as attrs so
+    # tools/trace_assemble.py can root this replica's tree under the
+    # router's — "" means no upstream context (a direct client).
+    trace_parent: str = ""
+    trace_hop: int = 0
+    trace_attempt: int = 0
     # monotonic submit time (engine-internal: queue-wait observation).
     submitted_at: float = 0.0
     # monotonic lifecycle stamps (0.0 until reached): slot assignment,

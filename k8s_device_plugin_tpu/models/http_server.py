@@ -66,6 +66,15 @@ rest of the models/ stack which benchmarks on synthetic ids):
          ``?summary=1`` returns ONLY those (no engine lock, no spans) —
          the shape the router's per-second poll loop reads.
 
+    GET /debug/spans -> 200 JSON span ring alone ({"spans", "dropped",
+         "capacity"}); ``?rid=<trace id>`` returns ONLY that request's
+         tree — the trace assembler's live-mode surface
+         (tools/trace_assemble.py).  A router dial carries
+         ``X-Trace-Context`` (trace id, parent attempt span, hop and
+         attempt index, W3C-traceparent-shaped); a valid context is
+         adopted — its trace id wins over ``X-Request-Id`` and the
+         request root span records the ``parent``/``hop``/``attempt``
+         attrs that root this replica's tree under the router's.
     GET /debug/profile -> 200 JSON per-step profiler snapshot
          (models/engine_profiler.py): per-phase breakdown
          (schedule/prefill/dispatch/readback/sample/host_gap/spec_verify
@@ -113,9 +122,15 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+import urllib.parse
+
 from ..utils import flight as flight_mod
 from ..utils.metrics import MetricsRegistry, write_exposition
-from ..utils.spans import SpanRecorder, sanitize_trace_id
+from ..utils.spans import (
+    SpanRecorder,
+    parse_trace_context,
+    sanitize_trace_id,
+)
 from .engine import ServingEngine
 from .engine_overload import SHED_EXPIRED, SHED_INFEASIBLE, ShedError
 from .engine_watchdog import ChipHealthFeed, StepWatchdog, visible_chip_paths
@@ -262,8 +277,22 @@ class EngineServer:
                 # adopted verbatim; anything else (including no header)
                 # gets a generated id.  Either way the SAME id is echoed
                 # on the response header, the JSON body, every SSE
-                # event, and every span the request produces.
-                trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
+                # event, and every span the request produces.  A router
+                # dial additionally carries X-Trace-Context (hop
+                # context, utils/spans.py): its trace id wins, and its
+                # attempt span id roots this replica's span tree under
+                # the router's — the fleet-timeline link.  A malformed
+                # context simply doesn't link (fall back to the plain
+                # X-Request-Id contract); it can never reject a request.
+                hop_ctx = parse_trace_context(
+                    self.headers.get("X-Trace-Context")
+                )
+                if hop_ctx is not None:
+                    trace_id = hop_ctx.trace_id
+                else:
+                    trace_id = sanitize_trace_id(
+                        self.headers.get("X-Request-Id")
+                    )
                 if server._fence.is_set():
                     # Fenced: this replica may be decoding on a sick
                     # chip or wedged mid-step — a plain 503 (no X-Shed)
@@ -380,7 +409,12 @@ class EngineServer:
                     # the prefix trie dedupes the prompt pages, so extra
                     # choices cost generation pages only (and each slot
                     # draws its own sampling rows — independent samples).
-                    # All n choices share the request's trace id.
+                    # All n choices share the request's trace id (and
+                    # upstream hop context, when a router sent one).
+                    if hop_ctx is not None:
+                        kwargs["trace_parent"] = hop_ctx.parent_span
+                        kwargs["trace_hop"] = hop_ctx.hop
+                        kwargs["trace_attempt"] = hop_ctx.attempt
                     reqs = [
                         server.engine.submit(
                             prompt, max_new, trace_id=trace_id, **kwargs
@@ -814,6 +848,20 @@ class EngineServer:
                         state["spans_dropped"] = rec.dropped
                         state["span_capacity"] = rec.capacity
                     self._reply(200, state)
+                elif path == "/debug/spans":
+                    # The span ring alone (also rides /debug/state);
+                    # ?rid=<trace id> filters to ONE request's tree so
+                    # the trace assembler's live mode doesn't pull the
+                    # whole ring per request.  404s without a recorder.
+                    rec = server.engine.spans
+                    if rec is None:
+                        self.send_error(404)
+                        return
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    rid = (query.get("rid") or [None])[0]
+                    self._reply(200, rec.dump(trace_id=rid))
                 elif path == "/debug/profile":
                     # Per-step phase breakdown over the rolling window —
                     # aggregates only, no request-identifying content, so
@@ -1646,7 +1694,12 @@ def main(argv: Optional[list[str]] = None) -> None:
         paged,
         max_slots=args.slots,
         metrics=EngineMetrics(registry),
-        spans=SpanRecorder(capacity=args.span_ring),
+        # Registered alongside the flight box: SIGUSR2/atexit dumps
+        # then carry the span trees tools/trace_assemble.py joins into
+        # fleet timelines even after the pod is gone.
+        spans=flight_mod.register_spans(
+            SpanRecorder(capacity=args.span_ring, name="engine")
+        ),
         flight=box,
         prefill_chunk=args.prefill_chunk,
         decode_block=_resolve_decode_block(args.decode_block, args.spec_gamma),
@@ -1724,8 +1777,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         pass  # not on the main thread (embedded/test use)
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
-        "/debug/state /debug/profile /debug/kvcache /debug/admission "
-        "/debug/incidents /debug/flight)",
+        "/debug/state /debug/spans /debug/profile /debug/kvcache "
+        "/debug/admission /debug/incidents /debug/flight)",
         file=sys.stderr,
         flush=True,
     )

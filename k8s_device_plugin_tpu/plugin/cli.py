@@ -225,11 +225,12 @@ def main(argv: list[str] | None = None) -> int:
         "/debug/incidents": monitor.snapshot,
         "/debug/flight": box.snapshot,
         "/debug/failpoints": failpoints.snapshot,
-        "/debug/spans": lambda: {
-            "spans": spans.snapshot(),
-            "dropped": spans.dropped,
-            "capacity": spans.capacity,
-        },
+        # ?rid=<trace id> filters to one request's tree (the trace
+        # assembler's live mode; MetricsServer hands query-declaring
+        # callables the parsed query dict).
+        "/debug/spans": lambda query: spans.dump(
+            trace_id=(query.get("rid") or [None])[0]
+        ),
     }
     if args.resources:
         # Multi-resource mode builds one plugin per resource inside the

@@ -37,9 +37,20 @@ engine decoded it":
 
 Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
 (503 until a replica is reachable; ``draining`` during shutdown),
-``GET /metrics`` (Prometheus), ``GET /debug/router`` (full snapshot).
-Every fault-handling decision is a flight event (``router.*``) so a
-chaos run can join injected replica kills against what the router saw.
+``GET /metrics`` (Prometheus), ``GET /debug/router`` (full snapshot),
+``GET /debug/spans`` (the router's request-span ring; ``?rid=`` filters
+one trace).  Every fault-handling decision is a flight event
+(``router.*``, per-request ones carrying ``rid``) so a chaos run can
+join injected replica kills against what the router saw.
+
+Distributed tracing (ISSUE 12): the router records its own span tree
+per request — a ``router.request`` root, ``router.route`` selection
+children, and one ``router.attempt`` child per upstream leg — and
+stamps each leg's span id into the dial's ``X-Trace-Context`` header
+(utils/spans.py hop context), so the replica's span tree roots under
+exactly the leg that carried it.  ``tools/trace_assemble.py`` joins the
+rings into one per-request fleet timeline; the chaos kill scenario
+scores that assembly's completeness.
 
 Chaos seam: each upstream dial fires the ``router.replica_conn``
 failpoint scoped per replica (``router.replica_conn.<host:port>``) —
@@ -56,6 +67,7 @@ import random
 import socket
 import threading
 import time
+import urllib.parse
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -64,7 +76,12 @@ import http.client
 
 from ..utils import failpoints
 from ..utils.metrics import MetricsRegistry, write_exposition
-from ..utils.spans import sanitize_trace_id
+from ..utils.spans import (
+    TRACE_CONTEXT_HEADER,
+    SpanRecorder,
+    format_trace_context,
+    sanitize_trace_id,
+)
 from .breaker import STATE_VALUE, CircuitBreaker, RetryBudget
 from .policy import FAILOVER, ReplicaState, RoutingPolicy
 from .ring import HashRing
@@ -188,6 +205,50 @@ class _Rolling:
         return ordered[idx]
 
 
+class _ReqTrace:
+    """Per-request span bookkeeping threaded through the proxy paths.
+
+    The root span id is reserved at arrival (recorded when the request
+    resolves — the engine's cross-thread pattern); every upstream leg —
+    first attempt, retry, hedge leg, failover resubmission — draws a
+    DISTINCT (attempt index, span id) pair through :meth:`begin_attempt`
+    (hedge legs run on spawned threads, hence the lock), and that pair
+    rides the dial's ``X-Trace-Context`` header so the replica's span
+    tree roots under exactly the leg that carried it."""
+
+    __slots__ = ("rec", "trace_id", "root", "t0", "attrs", "_lock",
+                 "n_attempts")
+
+    # The router→replica dial is hop 1 of the request's journey
+    # (client→router is hop 0 and needs no header: the router IS the
+    # entry point).
+    HOP = 1
+
+    def __init__(self, rec: SpanRecorder, trace_id: str):
+        self.rec = rec
+        self.trace_id = trace_id
+        self.root = rec.reserve_id()
+        self.t0 = time.monotonic()
+        self.attrs: dict = {}
+        self._lock = threading.Lock()
+        self.n_attempts = 0
+
+    def begin_attempt(self) -> tuple[int, int]:
+        """(attempt index, reserved span id) for one upstream leg."""
+        with self._lock:
+            idx = self.n_attempts
+            self.n_attempts += 1
+        return idx, self.rec.reserve_id()
+
+    def header(self, span_id: int, attempt: int) -> str:
+        return format_trace_context(
+            self.trace_id, span_id, self.HOP, attempt
+        )
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+
 class _Upstream:
     """One dialed upstream attempt: the connection (closable for
     cancel/cleanup) and its response."""
@@ -219,6 +280,7 @@ class RouterServer:
         port: int = 8100,
         registry: Optional[MetricsRegistry] = None,
         flight=None,
+        spans: Optional[SpanRecorder] = None,
         *,
         prefix_block_tokens: int = 16,
         prefix_max_blocks: int = 4,
@@ -245,6 +307,15 @@ class RouterServer:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
         self.flight = flight
+        # Router-side request spans (utils/spans.py, always on — the
+        # recorder is a lock + deque append per span): route selection,
+        # per-attempt dial/TTFB, SSE relay, failover.  Served at
+        # GET /debug/spans and embedded in SIGUSR2/atexit flight dumps;
+        # tools/trace_assemble.py joins these against the replicas'
+        # rings into one fleet timeline per request.
+        self.spans = spans if spans is not None else SpanRecorder(
+            capacity=2048, name="router"
+        )
         # Ring/replica-set membership AND the license to touch replica
         # poll state off the poll thread (see _poll_guard below).
         # Reentrant so OwnerGuard's _is_owned introspection works.
@@ -370,18 +441,31 @@ class RouterServer:
                     return
                 with server._active_lock:
                     server._active += 1
+                # Root span reserved NOW; attempt legs parent on it and
+                # the finally records it with the request's outcome —
+                # the router half of the fleet timeline.
+                tr = _ReqTrace(server.spans, trace_id)
+                tr.set(stream=bool(body.get("stream")))
                 try:
                     if body.get("stream"):
                         server._proxy_stream(
-                            self, body, prompt, trace_id, deadline_s
+                            self, body, prompt, trace_id, deadline_s, tr
                         )
                     else:
                         server._proxy_unary(
-                            self, body, prompt, trace_id, deadline_s
+                            self, body, prompt, trace_id, deadline_s, tr
                         )
                 finally:
                     with server._active_lock:
                         server._active -= 1
+                    tr.set(attempts=tr.n_attempts)
+                    server.spans.record_span(
+                        "router.request",
+                        trace_id,
+                        start_monotonic=tr.t0,
+                        span_id=tr.root,
+                        attrs=tr.attrs,
+                    )
 
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0]
@@ -406,6 +490,15 @@ class RouterServer:
                     write_exposition(self, server.registry)
                 elif path == "/debug/router":
                     self._reply(200, server.snapshot())
+                elif path == "/debug/spans":
+                    # ?rid=<trace id>: one request's tree only — the
+                    # trace assembler's live mode pulls per-request,
+                    # not whole rings.
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    rid = (query.get("rid") or [None])[0]
+                    self._reply(200, server.spans.dump(trace_id=rid))
                 else:
                     self.send_error(404)
 
@@ -643,6 +736,7 @@ class RouterServer:
         trace_id: str,
         stream: bool,
         deadline: Optional[float] = None,
+        hop_header: Optional[str] = None,
     ) -> _Upstream:
         """One upstream POST /generate.  Fires the per-replica
         ``router.replica_conn`` failpoint first (the chaos seam: an
@@ -650,7 +744,9 @@ class RouterServer:
         request carries a deadline, the REMAINING budget is re-computed
         at dial time and stamped as ``X-Request-Deadline`` — each hop
         subtracts the time it already spent, so the replica's expiry
-        sweep judges the same clock the client does.  Raises
+        sweep judges the same clock the client does.  ``hop_header``
+        is this leg's ``X-Trace-Context`` (distinct per attempt) — the
+        replica roots its span tree under it.  Raises
         ``_CONN_ERRORS`` / ``FailpointError`` on transport failure."""
         failpoints.fire_scoped(FAILPOINT_CONN, name, replica=name)
         st = self.replicas[name]
@@ -660,6 +756,8 @@ class RouterServer:
             "Content-Type": "application/json",
             "X-Request-Id": trace_id,
         }
+        if hop_header is not None:
+            headers[TRACE_CONTEXT_HEADER] = hop_header
         if deadline is not None:
             headers["X-Request-Deadline"] = (
                 f"{max(deadline - time.monotonic(), 0.0):.3f}"
@@ -679,6 +777,62 @@ class RouterServer:
             conn.close()
             raise
         return _Upstream(name, conn, resp)
+
+    def _span_route(
+        self, tr: Optional[_ReqTrace], t0: float, picked, exclude: set
+    ) -> None:
+        """One ``router.route`` span per candidate selection: the
+        placement decision, breaker-gated skips (the exclude set), and
+        the retry-budget level at decision time — the
+        breaker/budget-decision record of the timeline."""
+        if tr is None:
+            return
+        attrs: dict = {
+            "excluded": len(exclude),
+            "budget": round(self.budget.available(), 1),
+        }
+        if picked is None:
+            attrs["outcome"] = "none_dialable"
+        else:
+            attrs["replica"], attrs["placement"] = picked
+        self.spans.record_span(
+            "router.route",
+            tr.trace_id,
+            start_monotonic=t0,
+            parent_id=tr.root,
+            attrs=attrs,
+        )
+
+    def _span_attempt(
+        self,
+        tr: Optional[_ReqTrace],
+        span_id: int,
+        t0: float,
+        replica: str,
+        attempt: int,
+        kind: str,
+        **attrs,
+    ) -> None:
+        """Record one upstream leg's ``router.attempt`` span under the
+        span id its ``X-Trace-Context`` carried — the cross-process
+        anchor the replica's tree parents on.  ``kind`` is
+        primary/retry/hedge/failover."""
+        if tr is None:
+            return
+        self.spans.record_span(
+            "router.attempt",
+            tr.trace_id,
+            start_monotonic=t0,
+            span_id=span_id,
+            parent_id=tr.root,
+            attrs={
+                "replica": replica,
+                "attempt": attempt,
+                "hop": _ReqTrace.HOP,
+                "kind": kind,
+                **attrs,
+            },
+        )
 
     def _next_candidate(
         self, prompt, exclude: set, attempt_index: int
@@ -739,7 +893,7 @@ class RouterServer:
     # ------------------------------------------------------------ unary
 
     def _proxy_unary(
-        self, handler, body, prompt, trace_id, deadline_s=None
+        self, handler, body, prompt, trace_id, deadline_s=None, tr=None
     ) -> None:
         t0 = time.monotonic()
         # The client's deadline bounds the whole attempt budget: every
@@ -762,9 +916,12 @@ class RouterServer:
                 # Even the emptiest replica's queue forecast outruns the
                 # remaining budget: fail fast, never enqueue.
                 self.metrics.requests.inc(outcome="deadline")
+                if tr:
+                    tr.set(outcome="deadline")
                 self._record(
                     "router.deadline_exceeded",
                     where="forecast",
+                    rid=trace_id,
                     remaining_s=round(deadline - time.monotonic(), 3),
                 )
                 handler._reply(
@@ -776,7 +933,9 @@ class RouterServer:
                     trace_id,
                 )
                 return
+            route_t0 = time.monotonic()
             picked = self._next_candidate(prompt, exclude, attempt)
+            self._span_route(tr, route_t0, picked, exclude)
             if picked is None:
                 if exclude:
                     # Everything failed (or shed) once: start over — but
@@ -807,22 +966,33 @@ class RouterServer:
             if attempt > 0:
                 if not self.budget.try_spend():
                     self._record(
-                        "router.retry_budget_exhausted", replica=name
+                        "router.retry_budget_exhausted",
+                        replica=name,
+                        rid=trace_id,
                     )
                     break
                 self.metrics.retries.inc()
-                self._record("router.retry", replica=name, attempt=attempt)
+                self._record(
+                    "router.retry",
+                    replica=name,
+                    attempt=attempt,
+                    rid=trace_id,
+                )
             st = self.replicas[name]
             try:
                 result = self._dial_with_hedge(
                     name, body, prompt, trace_id, exclude, deadline=
                     deadline if deadline_s is not None else None,
+                    tr=tr, kind="retry" if attempt > 0 else "primary",
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
                 st.breaker.record_failure()
                 self._record(
-                    "router.dispatch_error", replica=name, error=str(e)
+                    "router.dispatch_error",
+                    replica=name,
+                    error=str(e),
+                    rid=trace_id,
                 )
                 exclude.add(name)
                 attempt += 1
@@ -843,6 +1013,7 @@ class RouterServer:
                         replica=up.name,
                         shed=headers.get("X-Shed"),
                         retry_after=ra,
+                        rid=trace_id,
                     )
                 exclude.add(up.name)
                 # A polite 503 is not a breaker failure and not a retry:
@@ -857,6 +1028,7 @@ class RouterServer:
                     "router.dispatch_error",
                     replica=up.name,
                     status=up.resp.status,
+                    rid=trace_id,
                 )
                 exclude.add(up.name)
                 attempt += 1
@@ -878,6 +1050,12 @@ class RouterServer:
                 self.metrics.requests.inc(outcome="ok")
             else:
                 self.metrics.requests.inc(outcome="error")
+            if tr:
+                tr.set(
+                    outcome="ok" if kind == "ok" else "error",
+                    replica=up.name,
+                    placement=winner_placement or placement,
+                )
             handler.send_response(up.resp.status)
             for key, value in headers.items():
                 if key.lower() != "x-request-id":
@@ -892,7 +1070,11 @@ class RouterServer:
             return
         if deadline_s is not None and time.monotonic() >= deadline:
             self.metrics.requests.inc(outcome="deadline")
-            self._record("router.deadline_exceeded", where="retry_loop")
+            if tr:
+                tr.set(outcome="deadline")
+            self._record(
+                "router.deadline_exceeded", where="retry_loop", rid=trace_id
+            )
             handler._reply(
                 504,
                 {"error": "deadline exceeded", "trace_id": trace_id},
@@ -900,6 +1082,8 @@ class RouterServer:
             )
             return
         self.metrics.requests.inc(outcome="timeout")
+        if tr:
+            tr.set(outcome="timeout")
         handler._reply(
             503,
             {"error": "no replica available", "trace_id": trace_id},
@@ -908,7 +1092,8 @@ class RouterServer:
         )
 
     def _dial_with_hedge(
-        self, name, body, prompt, trace_id, exclude, deadline=None
+        self, name, body, prompt, trace_id, exclude, deadline=None,
+        tr=None, kind="primary",
     ) -> tuple[_Upstream, Optional[str]]:
         """Dial ``name``; when hedging is on and no response lands
         within the rolling TTFT p99, race a second dispatch along the
@@ -917,17 +1102,41 @@ class RouterServer:
         the primary's error when every leg fails.  With a client
         deadline, the hedge only fires while enough budget remains for
         the second leg to actually answer — a hedge that cannot beat
-        the deadline is a wasted retry token."""
+        the deadline is a wasted retry token.  Every leg — primary AND
+        hedge — draws its own attempt index + span id from ``tr``, so
+        the two race legs are distinct, separately-linked children in
+        the assembled timeline."""
         results: queue_mod.Queue = queue_mod.Queue()
 
-        def leg(leg_name: str):
+        def leg(leg_name: str, leg_kind: str):
+            attempt_idx, span_id = (
+                tr.begin_attempt() if tr else (0, 0)
+            )
+            leg_t0 = time.monotonic()
             try:
-                results.put((leg_name, self._dial(leg_name, body, trace_id, False, deadline), None))
+                up = self._dial(
+                    leg_name, body, trace_id, False, deadline,
+                    hop_header=tr.header(span_id, attempt_idx)
+                    if tr
+                    else None,
+                )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
+                self._span_attempt(
+                    tr, span_id, leg_t0, leg_name, attempt_idx, leg_kind,
+                    outcome="conn_error", error=type(e).__name__,
+                )
                 results.put((leg_name, None, e))
+                return
+            # Unary: the response headers are in — dial + TTFB is the
+            # leg's span; the body relay happens on the handler thread.
+            self._span_attempt(
+                tr, span_id, leg_t0, leg_name, attempt_idx, leg_kind,
+                status=up.resp.status,
+            )
+            results.put((leg_name, up, None))
 
         threading.Thread(
-            target=leg, args=(name,), name="router-dial", daemon=True
+            target=leg, args=(name, kind), name="router-dial", daemon=True
         ).start()
         in_flight = 1
         hedged_name = None
@@ -953,20 +1162,25 @@ class RouterServer:
                         # win: spend nothing.
                         hedged_name = ""
                         continue
+                    route_t0 = time.monotonic()
                     picked = self._next_candidate(
                         prompt, exclude | {name}, 1
                     )
                     if picked is not None and self.budget.try_spend():
+                        self._span_route(
+                            tr, route_t0, picked, exclude | {name}
+                        )
                         hedged_name = picked[0]
                         self._record(
                             "router.hedge",
                             replica=hedged_name,
                             primary=name,
                             after_s=round(hedge_after, 3),
+                            rid=trace_id,
                         )
                         threading.Thread(
                             target=leg,
-                            args=(hedged_name,),
+                            args=(hedged_name, "hedge"),
                             name="router-hedge",
                             daemon=True,
                         ).start()
@@ -993,7 +1207,10 @@ class RouterServer:
             if hedged_name and leg_name == hedged_name:
                 self.metrics.hedges.inc(result="won")
                 self._record(
-                    "router.hedge_won", replica=leg_name, primary=name
+                    "router.hedge_won",
+                    replica=leg_name,
+                    primary=name,
+                    rid=trace_id,
                 )
                 return up, FAILOVER
             if hedged_name and leg_name == name:
@@ -1025,7 +1242,7 @@ class RouterServer:
     # ----------------------------------------------------------- stream
 
     def _proxy_stream(
-        self, handler, body, prompt, trace_id, deadline_s=None
+        self, handler, body, prompt, trace_id, deadline_s=None, tr=None
     ) -> None:
         """SSE passthrough with zero-drop mid-stream failover.
 
@@ -1068,17 +1285,24 @@ class RouterServer:
             if time.monotonic() >= deadline:
                 if deadline_s is not None:
                     self.metrics.requests.inc(outcome="deadline")
+                    if tr:
+                        tr.set(outcome="deadline")
                     self._record(
                         "router.deadline_exceeded",
                         where="stream",
                         emitted=len(emitted),
+                        rid=trace_id,
                     )
                     client_error("deadline exceeded")
                     return
                 self.metrics.requests.inc(outcome="timeout")
+                if tr:
+                    tr.set(outcome="timeout")
                 client_error("generation timed out")
                 return
+            route_t0 = time.monotonic()
             picked = self._next_candidate(prompt, exclude, attempt)
+            self._span_route(tr, route_t0, picked, exclude)
             if picked is None:
                 if exclude:
                     # Same Retry-After floor as the unary restart: a
@@ -1111,30 +1335,64 @@ class RouterServer:
             if attempt > 0:
                 if not self.budget.try_spend():
                     self._record(
-                        "router.retry_budget_exhausted", replica=name
+                        "router.retry_budget_exhausted",
+                        replica=name,
+                        rid=trace_id,
                     )
                     self.metrics.requests.inc(outcome="error")
+                    if tr:
+                        tr.set(outcome="error")
                     client_error("retry budget exhausted")
                     return
                 if not emitted:
                     self.metrics.retries.inc()
                     self._record(
-                        "router.retry", replica=name, attempt=attempt
+                        "router.retry",
+                        replica=name,
+                        attempt=attempt,
+                        rid=trace_id,
                     )
             attempt += 1
             st = self.replicas[name]
             upstream_body = dict(body)
             upstream_body["prompt"] = prompt + emitted
             upstream_body["max_new_tokens"] = max_new - len(emitted)
+            # One leg = one attempt span; its id rides the dial's
+            # X-Trace-Context so the replica's tree roots under it.
+            # Every leg after a mid-stream death is a failover
+            # resubmission (even one that died before emitting — the
+            # resubmitted prompt is just the original); the leg whose
+            # relay dies records outcome "died", which is exactly what
+            # tpu_router_failovers_total meters — the assembler's
+            # attempt-count cross-check.
+            leg_kind = (
+                "failover"
+                if failovers
+                else ("retry" if attempt > 1 else "primary")
+            )
+            attempt_idx, leg_span = (
+                tr.begin_attempt() if tr else (0, 0)
+            )
+            leg_t0 = time.monotonic()
             try:
                 up = self._dial(
-                    name, upstream_body, trace_id, True, upstream_deadline
+                    name, upstream_body, trace_id, True, upstream_deadline,
+                    hop_header=tr.header(leg_span, attempt_idx)
+                    if tr
+                    else None,
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
                 st.breaker.record_failure()
+                self._span_attempt(
+                    tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
+                    outcome="conn_error", error=type(e).__name__,
+                )
                 self._record(
-                    "router.dispatch_error", replica=name, error=str(e)
+                    "router.dispatch_error",
+                    replica=name,
+                    error=str(e),
+                    rid=trace_id,
                 )
                 exclude.add(name)
                 continue
@@ -1144,6 +1402,10 @@ class RouterServer:
                 retry_after = float(ra) if ra else retry_after
                 up.close()
                 shed = up_headers.get("X-Shed")
+                self._span_attempt(
+                    tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
+                    status=503, outcome="shed" if shed else "draining",
+                )
                 if shed:
                     # Overload shed: healthy replica, keep in rotation.
                     self._record(
@@ -1151,6 +1413,7 @@ class RouterServer:
                         replica=name,
                         shed=shed,
                         retry_after=ra,
+                        rid=trace_id,
                     )
                 else:
                     self._mark_draining(name, True)
@@ -1158,9 +1421,15 @@ class RouterServer:
                 continue
             if up.resp.status != 200:
                 data = up.resp.read()
+                self._span_attempt(
+                    tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
+                    status=up.resp.status, outcome="error",
+                )
                 if headers_sent:
                     up.close()
                     self.metrics.requests.inc(outcome="error")
+                    if tr:
+                        tr.set(outcome="error")
                     client_error(f"replica HTTP {up.resp.status}")
                     return
                 handler.send_response(up.resp.status)
@@ -1174,6 +1443,8 @@ class RouterServer:
                     pass
                 up.close()
                 self.metrics.requests.inc(outcome="error")
+                if tr:
+                    tr.set(outcome="error")
                 return
             st.dispatches += 1
             if not headers_sent:
@@ -1184,7 +1455,20 @@ class RouterServer:
                 handler.end_headers()
                 headers_sent = True
                 self.metrics.placements.inc(placement=placement)
+                if tr:
+                    tr.set(placement=placement)
             done = False
+            leg_tokens = 0  # tokens relayed by THIS leg (attempt attrs)
+
+            def end_leg(outcome: str) -> None:
+                # The leg's attempt span covers dial → relay end: TTFB
+                # and SSE relay in one timed child, the relayed-token
+                # count in its attrs.
+                self._span_attempt(
+                    tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
+                    status=200, outcome=outcome, tokens=leg_tokens,
+                )
+
             try:
                 for event in self._iter_sse(up.resp):
                     if event is None:  # heartbeat comment
@@ -1193,6 +1477,7 @@ class RouterServer:
                             handler.wfile.flush()
                         except OSError:
                             up.close()
+                            end_leg("client_gone")
                             return  # client vanished; upstream cancels
                         continue
                     if "token" in event:
@@ -1206,10 +1491,12 @@ class RouterServer:
                         out["index"] = len(emitted)
                         out["trace_id"] = trace_id
                         emitted.append(event["token"])
+                        leg_tokens += 1
                         try:
                             self._sse(handler, out)
                         except OSError:
                             up.close()
+                            end_leg("client_gone")
                             return
                         continue
                     if event.get("done"):
@@ -1236,24 +1523,33 @@ class RouterServer:
                         except OSError:
                             pass
                         up.close()
+                        end_leg("relay_error")
                         self.metrics.requests.inc(outcome="error")
+                        if tr:
+                            tr.set(outcome="error")
                         return
             except (*_CONN_ERRORS, ValueError):
                 pass  # transport death mid-stream; handled below
             up.close()
             if done:
+                end_leg("done")
                 st.breaker.record_success()
                 elapsed = time.monotonic() - t0
                 self.metrics.request_seconds.observe(elapsed)
                 self.metrics.requests.inc(outcome="ok")
+                if tr:
+                    tr.set(outcome="ok", failovers=failovers)
                 return
             # Transport error or EOF before `done`: either way the
             # replica died mid-stream.  Fail the stream over.
+            end_leg("died")
             st.failures += 1
             st.breaker.record_failure()
             failovers += 1
             if failovers > self._max_failovers:
                 self.metrics.requests.inc(outcome="error")
+                if tr:
+                    tr.set(outcome="error", failovers=failovers)
                 client_error("failover budget exhausted")
                 return
             self.metrics.failovers.inc()
@@ -1262,6 +1558,7 @@ class RouterServer:
                 replica=name,
                 emitted=len(emitted),
                 remaining=max_new - len(emitted),
+                rid=trace_id,
             )
             if len(emitted) >= max_new:
                 # Nothing left to generate: the death landed after the
@@ -1276,6 +1573,8 @@ class RouterServer:
                 except OSError:
                     pass
                 self.metrics.requests.inc(outcome="ok")
+                if tr:
+                    tr.set(outcome="ok", failovers=failovers)
                 return
             exclude.add(name)
 
@@ -1459,6 +1758,16 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--drain-grace", type=float, default=10.0)
     p.add_argument("--flight-ring", type=int, default=2048)
     p.add_argument(
+        "--span-ring",
+        type=int,
+        default=2048,
+        help="capacity of the router's request-span ring (route "
+        "selection, per-attempt dial/TTFB, SSE relay, failover legs) "
+        "served at GET /debug/spans and embedded in flight dumps — "
+        "tools/trace_assemble.py joins it with the replicas' rings "
+        "into per-request fleet timelines",
+    )
+    p.add_argument(
         "--dump-dir", default=flight_mod.default_dump_dir() or ""
     )
     p.add_argument("--failpoints", default="")
@@ -1469,6 +1778,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     box = flight_mod.register(
         flight_mod.FlightRecorder(capacity=args.flight_ring, name="router")
     )
+    # The span ring rides the same SIGUSR2/atexit dumps the flight
+    # recorder does: a dead router still leaves the per-request
+    # timelines tools/trace_assemble.py needs on disk.
+    spans = flight_mod.register_spans(
+        SpanRecorder(capacity=args.span_ring, name="router")
+    )
     flight_mod.install_dump_handlers(args.dump_dir or None)
     failpoints.set_flight(box)
     failpoints.arm_from_env()
@@ -1478,6 +1793,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         replicas,
         port=args.http_port,
         flight=box,
+        spans=spans,
         prefix_block_tokens=args.prefix_block_tokens,
         prefix_max_blocks=args.prefix_blocks,
         vnodes=args.vnodes,
@@ -1515,7 +1831,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         pass
     print(
         f"routing on :{server.port} over {len(server.replicas)} replicas "
-        "(POST /generate, GET /healthz /metrics /debug/router)",
+        "(POST /generate, GET /healthz /metrics /debug/router /debug/spans)",
         file=sys.stderr,
         flush=True,
     )

@@ -160,6 +160,12 @@ class FlightRecorder:
 # __init__: tests construct hundreds of throwaway recorders and a global
 # dump must not grow with them.
 _registry: list[FlightRecorder] = []
+# Span rings (utils/spans.SpanRecorder) dumped ALONGSIDE the flight
+# recorders: a post-mortem dump then carries both halves of the
+# request story — the typed-event journal AND the span trees the trace
+# assembler (tools/trace_assemble.py) joins across processes.  Same
+# explicit-registration rule.
+_span_registry: list = []
 _registry_lock = threading.Lock()
 
 
@@ -182,6 +188,28 @@ def registered() -> list[FlightRecorder]:
         return list(_registry)
 
 
+def register_spans(recorder):
+    """Add a span ring (utils/spans.SpanRecorder) to the process-wide
+    dump set (idempotent): SIGUSR2/atexit dumps then embed its spans
+    under ``spans.<recorder.name>`` — the offline input to
+    ``tools/trace_assemble.py``."""
+    with _registry_lock:
+        if recorder not in _span_registry:
+            _span_registry.append(recorder)
+    return recorder
+
+
+def unregister_spans(recorder) -> None:
+    with _registry_lock:
+        if recorder in _span_registry:
+            _span_registry.remove(recorder)
+
+
+def registered_spans() -> list:
+    with _registry_lock:
+        return list(_span_registry)
+
+
 def default_dump_dir(environ=None) -> Optional[str]:
     """The configured dump directory (``TPU_PLUGIN_DUMP_DIR``) or None."""
     environ = os.environ if environ is None else environ
@@ -192,16 +220,23 @@ def dump_all(
     dump_dir: Optional[str] = None,
     reason: str = "manual",
     recorders=None,
+    span_recorders=None,
 ) -> Optional[str]:
     """Write every registered (or explicitly passed) recorder to one JSON
     file under ``dump_dir`` (env default, tempdir fallback); returns the
-    path, or None when there was nothing to dump.  Never raises — the
-    callers are signal handlers and atexit hooks, where an exception
-    would replace the forensic record with a traceback."""
+    path, or None when there was nothing to dump.  Registered span rings
+    ride along under ``spans`` (the trace assembler's offline input).
+    Never raises — the callers are signal handlers and atexit hooks,
+    where an exception would replace the forensic record with a
+    traceback."""
     recs = list(recorders) if recorders is not None else registered()
-    if not recs:
+    span_recs = (
+        list(span_recorders)
+        if span_recorders is not None
+        else registered_spans()
+    )
+    if not recs and not span_recs:
         return None
-    directory = dump_dir or default_dump_dir() or tempfile.gettempdir()
     payload = {
         "schema": "tpu-flight-dump/v1",
         "reason": reason,
@@ -210,6 +245,9 @@ def dump_all(
         "ts": round(time.time(), 3),
         "recorders": {r.name: r.snapshot() for r in recs},
     }
+    if span_recs:
+        payload["spans"] = {r.name: r.dump() for r in span_recs}
+    directory = dump_dir or default_dump_dir() or tempfile.gettempdir()
     path = os.path.join(
         directory,
         f"tpu-flight-{os.getpid()}-{reason}-{int(time.time())}.json",

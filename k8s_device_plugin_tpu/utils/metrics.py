@@ -388,11 +388,14 @@ class MetricsServer:
     callable) ⇒ 200 "ok", False ⇒ 503 — so a liveness probe reflects the
     daemon's actual state, not just this HTTP thread's.
 
-    ``debug`` maps extra GET paths (e.g. ``/debug/devices``) to no-arg
+    ``debug`` maps extra GET paths (e.g. ``/debug/devices``) to
     callables returning a JSON-serializable snapshot — the plugin-side
     introspection companion to the serving engine's ``/debug/state``.
-    A snapshot callable that raises answers 500 with the error, never
-    kills the metrics thread.
+    A callable declaring at least one positional parameter receives the
+    parsed query dict (``{name: [values]}``; e.g. the span endpoint's
+    ``?rid=`` filter); a no-arg callable is called bare.  A snapshot
+    callable that raises answers 500 with the error, never kills the
+    metrics thread.
     """
 
     def __init__(
@@ -403,11 +406,22 @@ class MetricsServer:
         health=None,
         debug=None,
     ):
+        import inspect as _inspect
         import json as _json
+        import urllib.parse as _urlparse
 
         registry_ref = registry
         health_ref = health
         debug_ref = dict(debug or {})
+        # Decided once at construction, not per request: which debug
+        # callables want the query dict (any positional parameter).
+        wants_query = set()
+        for _path, _fn in debug_ref.items():
+            try:
+                if _inspect.signature(_fn).parameters:
+                    wants_query.add(_path)
+            except (TypeError, ValueError):
+                pass  # builtins without signatures: call bare
 
         class Handler(BaseHTTPRequestHandler):
             def _json_reply(self, code: int, obj) -> None:
@@ -422,7 +436,13 @@ class MetricsServer:
                 path = self.path.split("?")[0]
                 if path in debug_ref:
                     try:
-                        snap = debug_ref[path]()
+                        if path in wants_query:
+                            query = _urlparse.parse_qs(
+                                _urlparse.urlparse(self.path).query
+                            )
+                            snap = debug_ref[path](query)
+                        else:
+                            snap = debug_ref[path]()
                     except Exception as e:  # snapshot bug must not kill scrapes
                         self._json_reply(500, {"error": str(e)})
                         return

@@ -35,10 +35,11 @@ from __future__ import annotations
 import contextvars
 import logging
 import os
+import re
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import NamedTuple, Optional
 
 log = logging.getLogger("tpu.spans")
 
@@ -76,6 +77,98 @@ def sanitize_trace_id(raw: object) -> str:
         ):
             return rid
     return new_trace_id()
+
+
+# --------------------------------------------------------- hop context
+#
+# Cross-process span propagation (fleet-wide tracing): the router stamps
+# one ``X-Trace-Context`` header on EVERY upstream dial — first attempt,
+# each retry, each hedge leg, and the failover resubmission all carry a
+# DISTINCT attempt span id — and the replica roots its per-request span
+# tree under that id instead of floating free.  The format is
+# W3C-traceparent-shaped (version-traceid-parentid-tail) but keeps the
+# repo's trace-id contract (any printable id the sanitize gate accepts,
+# dashes included — parsing splits from the RIGHT so a dashed trace id
+# survives) and replaces the W3C flags byte with ``<hop><attempt>``
+# (two hex bytes): which edge of the request's journey this dial is,
+# and which attempt along that edge.
+
+TRACE_CONTEXT_HEADER = "X-Trace-Context"
+_CTX_VERSION = "00"
+_SPAN_HEX_RE = re.compile(r"^[0-9a-f]{16}$")
+_BYTE_HEX_RE = re.compile(r"^[0-9a-f]{2}$")
+
+
+class HopContext(NamedTuple):
+    """One parsed ``X-Trace-Context``: the sender's trace id, the span
+    id of the sending attempt (16 lowercase hex — the cross-process
+    parent link the assembler joins on), and the hop/attempt indexes
+    (0-255 each; the wire clamps)."""
+
+    trace_id: str
+    parent_span: str
+    hop: int
+    attempt: int
+
+
+def format_span_id(span_id: int) -> str:
+    """A span id as the 16-hex wire form ``X-Trace-Context`` carries
+    (process-local ints; the pair (process, id) is globally unique and
+    the assembler scopes the join per source)."""
+    return f"{int(span_id) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+def format_trace_context(
+    trace_id: str, parent_span_id: int, hop: int, attempt: int
+) -> str:
+    """The ``X-Trace-Context`` value for one outbound dial.  Hop and
+    attempt clamp into [0, 255] (a request surviving 255 attempts has
+    bigger problems than a saturated counter)."""
+    hop = min(max(int(hop), 0), 255)
+    attempt = min(max(int(attempt), 0), 255)
+    return (
+        f"{_CTX_VERSION}-{trace_id}-{format_span_id(parent_span_id)}"
+        f"-{hop:02x}{attempt:02x}"
+    )
+
+
+def parse_trace_context(raw: object) -> Optional[HopContext]:
+    """Parse a client/router-supplied ``X-Trace-Context``; None on ANY
+    malformation (wrong version, bad hex, hostile trace id) — the
+    receiver then falls back to the plain ``X-Request-Id`` contract.
+    Parsing never raises and never mints ids: a header that fails here
+    simply doesn't link, it cannot corrupt the span ring."""
+    if not isinstance(raw, str):
+        return None
+    value = raw.strip()
+    # Longest legal header: "00-" + 128-char id + "-" + 16 hex + "-" + 4.
+    if not (8 < len(value) <= _MAX_TRACE_ID_LEN + 25):
+        return None
+    if not value.startswith(_CTX_VERSION + "-"):
+        return None
+    # Split from the RIGHT: the trace id may itself contain dashes
+    # (sanitize_trace_id admits any printable id), so only the two
+    # fixed-width trailing fields are separator-addressed.
+    body = value[len(_CTX_VERSION) + 1:]
+    parts = body.rsplit("-", 2)
+    if len(parts) != 3:
+        return None
+    trace_id, parent_span, tail = parts
+    # The embedded trace id must pass the SAME gate a bare X-Request-Id
+    # does — compare against the sanitizer instead of re-implementing it
+    # (sanitize returns the input verbatim iff it was acceptable).
+    if not trace_id or sanitize_trace_id(trace_id) != trace_id:
+        return None
+    if not _SPAN_HEX_RE.match(parent_span):
+        return None
+    if len(tail) != 4:
+        return None
+    hop_hex, attempt_hex = tail[:2], tail[2:]
+    if not (_BYTE_HEX_RE.match(hop_hex) and _BYTE_HEX_RE.match(attempt_hex)):
+        return None
+    return HopContext(
+        trace_id, parent_span, int(hop_hex, 16), int(attempt_hex, 16)
+    )
 
 
 # The active span's id and trace id for same-thread nesting.  Module-level
@@ -142,11 +235,17 @@ class SpanRecorder:
     span as one structured event through the ``tpu.spans`` logger.
     """
 
-    def __init__(self, capacity: int = 512, emit: bool = False):
+    def __init__(
+        self, capacity: int = 512, emit: bool = False, name: str = "spans"
+    ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self.emit = emit
+        # Keys this recorder in multi-recorder flight dumps and the
+        # trace assembler's source labels (a serving pod has an
+        # "engine" ring; the router daemon a "router" ring).
+        self.name = name
         self._lock = threading.Lock()
         self._ring: deque[dict] = deque(maxlen=capacity)
         self._next_id = 1
@@ -214,6 +313,21 @@ class SpanRecorder:
         """Recent spans, oldest first (JSON-safe copies)."""
         with self._lock:
             return [dict(e) for e in self._ring]
+
+    def dump(self, trace_id: Optional[str] = None) -> dict:
+        """The ``GET /debug/spans`` body (also what flight dumps embed):
+        the ring plus its truncation accounting, optionally filtered to
+        ONE request's tree so the assembler's live mode doesn't pull
+        whole rings."""
+        spans = self.snapshot()
+        if trace_id is not None:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        return {
+            "name": self.name,
+            "spans": spans,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+        }
 
     def clear(self) -> None:
         with self._lock:

@@ -58,6 +58,7 @@ PLUGIN_TIER_FILES = {
     "test_spans.py",
     "test_stress.py",
     "test_topology.py",
+    "test_trace_assemble.py",
     "test_watcher.py",
 }
 

@@ -21,6 +21,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import grpc
 
 from k8s_device_plugin_tpu.kubelet import constants
+from k8s_device_plugin_tpu.utils.spans import (
+    SpanRecorder,
+    parse_trace_context,
+    sanitize_trace_id,
+)
 from k8s_device_plugin_tpu.kubelet.api import (
     DevicePluginStub,
     add_pod_resources_servicer,
@@ -394,6 +399,14 @@ class FakeReplica:
         self.active_streams = 0
         self.seen_trace_ids: list = []
         self.seen_deadlines: list = []  # X-Request-Deadline header values
+        self.seen_trace_context: list = []  # raw X-Trace-Context values
+        # Replica-side span ring, like EngineServer's: one "request"
+        # span per handled /generate, rooted under the router attempt
+        # its X-Trace-Context named — recorded even when the stream is
+        # CUT by kill() (the finally runs), so a chaos scenario can
+        # assemble the victim's half of the timeline from the
+        # in-process recorder after the sockets are gone.
+        self.spans = SpanRecorder(capacity=512, name="replica")
         replica = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -414,7 +427,16 @@ class FakeReplica:
                 if self.path.split("?")[0] != "/generate":
                     self.send_error(404)
                     return
-                trace_id = self.headers.get("X-Request-Id") or ""
+                # The EngineServer hop-context contract: a valid
+                # X-Trace-Context wins (its trace id + the parent
+                # attempt span the request tree roots under); anything
+                # else falls back to the plain X-Request-Id.
+                raw_ctx = self.headers.get("X-Trace-Context")
+                hop_ctx = parse_trace_context(raw_ctx)
+                if hop_ctx is not None:
+                    trace_id = hop_ctx.trace_id
+                else:
+                    trace_id = self.headers.get("X-Request-Id") or ""
                 if replica._fenced.is_set():
                     # The EngineServer fence contract: plain 503 +
                     # Retry-After, no X-Shed — the router must stop
@@ -474,7 +496,24 @@ class FakeReplica:
                     replica.seen_deadlines.append(
                         self.headers.get("X-Request-Deadline")
                     )
+                    replica.seen_trace_context.append(raw_ctx)
                 rid = replica.generate_requests
+                span_tid = sanitize_trace_id(trace_id)
+                root_span = replica.spans.reserve_id()
+                t0 = time.monotonic()
+
+                def record_request(outcome: str, n_tokens: int) -> None:
+                    attrs = {"rid": rid, "outcome": outcome,
+                             "new_tokens": n_tokens}
+                    if hop_ctx is not None:
+                        attrs["parent"] = hop_ctx.parent_span
+                        attrs["hop"] = hop_ctx.hop
+                        attrs["attempt"] = hop_ctx.attempt
+                    replica.spans.record_span(
+                        "request", span_tid, start_monotonic=t0,
+                        span_id=root_span, attrs=attrs,
+                    )
+
                 if replica.prefill_delay_s:
                     time.sleep(replica.prefill_delay_s)
                 if not stream:
@@ -494,7 +533,11 @@ class FakeReplica:
                     self.send_header("X-Request-Id", trace_id)
                     self.send_header("Content-Length", str(len(out)))
                     self.end_headers()
-                    self.wfile.write(out)
+                    try:
+                        self.wfile.write(out)
+                        record_request("completed", len(tokens))
+                    except OSError:  # hedge loser / kill(): cut reply
+                        record_request("cut", len(tokens))
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
@@ -503,9 +546,9 @@ class FakeReplica:
                 self.end_headers()
                 with replica._lock:
                     replica.active_streams += 1
+                tokens = []
                 try:
                     seq = list(prompt)
-                    tokens = []
                     for i in range(max_new):
                         if replica.token_delay_s:
                             time.sleep(replica.token_delay_s)
@@ -522,8 +565,13 @@ class FakeReplica:
                            "trace_id": trace_id}
                     self.wfile.write(f"data: {json.dumps(fin)}\n\n".encode())
                     self.wfile.flush()
+                    record_request("completed", len(tokens))
                 except OSError:
-                    pass  # client (the router) went away / kill()
+                    # Client (the router) went away / kill(): the CUT
+                    # stream still records its span — what the real
+                    # engine's cancel teardown does — so the victim's
+                    # half of a failover timeline assembles.
+                    record_request("cut", len(tokens))
                 finally:
                     with replica._lock:
                         replica.active_streams -= 1
@@ -540,6 +588,14 @@ class FakeReplica:
                         "fenced": replica._fenced.is_set(),
                         "loop_alive": True,
                     })
+                elif path == "/debug/spans":
+                    # The EngineServer contract incl. the ?rid= filter
+                    # (the trace assembler's live mode).
+                    import urllib.parse as _up
+
+                    query = _up.parse_qs(_up.urlparse(self.path).query)
+                    rid = (query.get("rid") or [None])[0]
+                    self._json(200, replica.spans.dump(trace_id=rid))
                 elif path == "/healthz":
                     if replica._fenced.is_set():
                         self._json(503, {
@@ -578,6 +634,8 @@ class FakeReplica:
         return f"127.0.0.1:{self.port}"
 
     def start(self) -> "FakeReplica":
+        # Source label for trace assembly: one ring per replica name.
+        self.spans.name = f"replica-{self.name}"
         self._thread = threading.Thread(
             # 50ms shutdown poll: tests tear fleets down constantly and
             # the default 0.5s poll would dominate the suite's wall clock.

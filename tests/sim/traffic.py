@@ -212,13 +212,17 @@ class RouterStreamOutcome:
 
     __slots__ = (
         "prompt", "max_new", "tokens", "completed", "dropped", "cancelled",
-        "reason", "ttft_s", "session",
+        "reason", "ttft_s", "session", "rid",
     )
 
-    def __init__(self, prompt, max_new, session):
+    def __init__(self, prompt, max_new, session, rid=""):
         self.prompt = prompt
         self.max_new = max_new
         self.session = session
+        # Client-chosen X-Request-Id: the grep/join key tying this
+        # stream's verdict to router + replica spans and flight events
+        # (the trace-completeness scorer joins on it).
+        self.rid = rid
         self.tokens: list = []
         self.completed = False
         self.dropped = False
@@ -342,17 +346,20 @@ class RouterTraffic:
 
     def _stream_one(
         self, prompt, n_new: int, session: int, cancel: bool,
-        timeout_s: float,
+        timeout_s: float, rid: str = "",
     ) -> RouterStreamOutcome:
         import http.client
         import json as json_mod
 
-        outcome = RouterStreamOutcome(prompt, n_new, session)
+        outcome = RouterStreamOutcome(prompt, n_new, session, rid=rid)
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=timeout_s
         )
         t0 = time.monotonic()
         try:
+            headers = {"Content-Type": "application/json"}
+            if rid:
+                headers["X-Request-Id"] = rid
             conn.request(
                 "POST",
                 "/generate",
@@ -360,7 +367,7 @@ class RouterTraffic:
                     {"prompt": prompt, "max_new_tokens": n_new,
                      "stream": True}
                 ).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             )
             resp = conn.getresponse()
             if resp.status != 200:
@@ -443,7 +450,8 @@ class RouterTraffic:
                     index[0] += 1
                 prompt, n_new, session, cancel = requests[i]
                 outcome = self._stream_one(
-                    prompt, n_new, session, cancel, timeout_s
+                    prompt, n_new, session, cancel, timeout_s,
+                    rid=f"traffic-{self.seed}-{i}",
                 )
                 with lock:
                     report.outcomes.append(outcome)

@@ -497,3 +497,53 @@ def test_bench_diff_parses_restart_block(tmp_path):
     e = bench_diff.load_record(str(tmp_path / "e.json"))
     assert "restart_warm_ttft_p99_ms" not in e
     assert "restart warm p99" not in bench_diff.ledger_row(a, e)
+
+
+def test_bench_diff_parses_trace_block(tmp_path):
+    """Records grew a TRACE block (ISSUE 12, benchmark.py's tracing
+    phase): the measured spans-on vs spans-off overhead fraction must
+    surface in the normalized record, the field diff, and the ledger
+    row — and the row must scream TRACE-OVERHEAD when the always-on
+    span layer stops being ~free (overhead > 2%)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 11,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 12
+    loaded["parsed"]["trace"] = {
+        "overhead": 0.004,
+        "off_tokens_per_sec": 101.0,
+        "on_tokens_per_sec": 100.6,
+        "spans_recorded": 64,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["trace_overhead"] == 0.004
+    assert b["trace_spans"] == 64
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "trace_overhead" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "trace overhead 0.004" in row
+    assert "64 spans" in row
+    assert "TRACE-OVERHEAD" not in row
+    # Overhead past ~2%: the row screams.
+    loaded["parsed"]["trace"]["overhead"] = 0.031
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "TRACE-OVERHEAD" in bench_diff.ledger_row(a, c)
+    # A record without the block: no trace fields, no row segment.
+    assert "trace_overhead" not in a
+    assert "trace overhead" not in bench_diff.ledger_row(a, a)

@@ -640,6 +640,7 @@ def test_chaos_router_replica_kill_mid_decode(tmp_path):
             seed=11, sessions=5, prefix_len=32,
             expected_fn=fake_generate,
         )
+        traffic_t0 = time.time()
         thread, holder = traffic.run_in_thread(
             72, concurrency=6, max_new=(8, 14), timeout_s=60.0
         )
@@ -689,18 +690,72 @@ def test_chaos_router_replica_kill_mid_decode(tmp_path):
             _url.urlopen(req, timeout=15).read()
             if router.replicas[victim_name].breaker.state == "closed":
                 break
-        detected = _router_kill_detections(flight)
+        # --- Trace completeness (ISSUE 12): every injected request must
+        # assemble into ONE fleet timeline — router root, every attempt
+        # a distinct linked child, the killed replica's cut tree under
+        # the primary leg and the survivor's under the failover leg —
+        # with zero orphans/gaps/broken links and a failover-attempt
+        # count matching what the router's flight metered per request.
+        # Scored through the SAME join as incident detection.
+        from collections import Counter
+
+        from tools import trace_assemble as ta
+
+        t_end = time.time()
+        sources = ta._as_source("router", router.spans.dump())
+        for r in replicas:  # incl. the killed victim: its in-process
+            # ring survives the socket kill (the post-mortem dump shape)
+            sources += ta._as_source(r.spans.name, r.spans.dump())
+        timelines = ta.assemble(sources)
+        failover_by_rid = Counter(
+            e.get("rid")
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "router.failover"
+        )
+        report_for_trace = holder[0]
+        traffic_rids = [o.rid for o in report_for_trace.outcomes]
+        injected += [
+            {"cls": "trace_complete", "rid": rid,
+             "t0": traffic_t0, "t1": t_end}
+            for rid in traffic_rids
+        ]
+        trace_detections = []
+        failover_attempts_total = 0
+        for t in timelines:
+            if not t["trace_id"].startswith("traffic-"):
+                continue  # breaker-recovery probes, not injected traffic
+            # A leg whose relay died is exactly one metered failover
+            # (tpu_router_failovers_total increments per death that
+            # resubmits) — the attempt-count cross-check.
+            n_died = sum(
+                1 for a in t["attempts"] if a["outcome"] == "died"
+            )
+            failover_attempts_total += n_died
+            if not t["complete"]:
+                continue
+            if n_died != failover_by_rid.get(t["trace_id"], 0):
+                continue  # attempt count disagrees with router metering
+            trace_detections.append(
+                {"cls": "trace_complete", "rid": t["trace_id"],
+                 "ts": min(max(t["end"], traffic_t0), t_end)}
+            )
+        detected = _router_kill_detections(flight) + trace_detections
         score = chaos_report.score_detections(injected, detected, grace_s=2.0)
         kill = score["per_class"]["replica_kill"]
+        trace_score = score["per_class"]["trace_complete"]
         breaker_state = router.replicas[victim_name].breaker.state
         slo = {
-            "targets": {"dropped_streams": 0},
+            "targets": {"dropped_streams": 0, "trace_completeness": 1.0},
             "measured": {
                 "dropped_streams": report.dropped,
                 "in_flight_at_kill": in_flight_at_kill,
                 "failovers": router.metrics.failovers.value(),
                 "breaker_state_after_recovery": breaker_state,
                 "traffic": report.as_dict(),
+                "trace_timelines": len(traffic_rids),
+                "trace_precision": trace_score["precision"],
+                "trace_recall": trace_score["recall"],
+                "trace_failover_attempts": failover_attempts_total,
             },
             "pass": report.dropped == 0,
         }
@@ -710,6 +765,8 @@ def test_chaos_router_replica_kill_mid_decode(tmp_path):
             "score": score, "slo": slo,
             "pass": (
                 kill["precision"] == 1.0 and kill["recall"] == 1.0
+                and trace_score["precision"] == 1.0
+                and trace_score["recall"] == 1.0
                 and report.dropped == 0
             ),
         }
@@ -728,7 +785,20 @@ def test_chaos_router_replica_kill_mid_decode(tmp_path):
         assert kill["recall"] == 1.0, score
         assert kill["precision"] == 1.0, score
         clean = {r.name for r in replicas[:3]} - {victim_name}
-        assert not [d for d in detected if d["replica"] in clean], detected
+        assert not [
+            d for d in detected
+            if d["cls"] == "replica_kill" and d["replica"] in clean
+        ], detected
+        # Trace completeness (the ISSUE 12 acceptance bar): ONE complete
+        # timeline per injected request — zero orphans/gaps/broken
+        # links, failover attempts matching the router's own metering —
+        # at precision/recall 1.0, and the assembled failover legs sum
+        # to exactly the failovers the router counted.
+        assert trace_score["precision"] == 1.0, score
+        assert trace_score["recall"] == 1.0, score
+        assert (
+            failover_attempts_total == router.metrics.failovers.value()
+        ), (failover_attempts_total, router.metrics.failovers.value())
     finally:
         _teardown_router(replicas, router)
 

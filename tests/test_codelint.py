@@ -282,6 +282,7 @@ def _drift_cfg_overrides():
     return dict(
         EVENT_CATALOG_DOCS=["docs/ops.md"],
         METRIC_CATALOG_DOCS=["docs/ops.md"],
+        SPAN_CATALOG_DOCS=["docs/ops.md"],
         FAILPOINT_CATALOG_DOCS=["docs/chaos.md"],
         ENDPOINT_CATALOG_DOCS=["docs/ops.md"],
         FLAG_COVERAGE_DOCS=["docs/ops.md"],
@@ -345,6 +346,48 @@ def test_catalog_drift_dynamic_kind_matches_prefix(tmp_path):
     # The wildcard satisfies the code side AND shields the documented
     # states from ghost status.
     assert result["ok"], [f.message for f in result["findings"]]
+
+
+def test_catalog_drift_span_names_both_directions(tmp_path):
+    """Span operations recorded in code must appear in the `| Span |
+    Source |` catalog and vice versa; a Name argument resolves through
+    assignments (the timed_rpc f-string default becomes a prefix
+    wildcard, so documented `rpc.<Method>` rows are not ghosts)."""
+    source = """
+        class Engine:
+            def __init__(self, spans):
+                self.spans = spans
+
+            def work(self, f):
+                with self.spans.span("engine.step"):
+                    pass
+                self.spans.record_span("documented.op", "tid",
+                                       start_monotonic=0.0)
+                self.spans.record_span("undocumented.op", "tid",
+                                       start_monotonic=0.0)
+                span_name = None or f"rpc.{f.__name__}"
+                self.spans.record_span(span_name, "daemon",
+                                       start_monotonic=0.0)
+        """
+    docs = {
+        "docs/ops.md": """
+            | Span | Source | Covers |
+            |------|--------|--------|
+            | `engine.step` / `documented.op` | engine | work |
+            | `rpc.Allocate` | daemon | one RPC |
+            | `ghost.op` | nowhere | never recorded |
+            """,
+    }
+    root = _fixture_repo(tmp_path, source, docs)
+    result = _run(root, ["catalog-drift"], **_drift_cfg_overrides())
+    by_key = {f.key: f for f in result["findings"]}
+    codes = {f.code for f in result["findings"]}
+    assert codes == {"span-undocumented", "span-ghost"}, by_key
+    assert any("undocumented.op" in k for k in by_key), by_key
+    assert any("ghost.op" in k for k in by_key), by_key
+    # The wildcard satisfied rpc.Allocate; engine.step/documented.op are
+    # covered — exactly the two findings above, nothing else.
+    assert len(result["findings"]) == 2
 
 
 def test_catalog_drift_undocumented_flag_and_endpoint(tmp_path):

@@ -1063,3 +1063,87 @@ def test_watchdog_fence_cuts_stream_no_done_event(shared_engine):
         server.stop()
         if eng._inflight_guard is not None:
             eng._inflight_guard._owner = None  # hand back to pytest thread
+
+
+# ======================================================================
+# Hop-context adoption + /debug/spans (fleet tracing, ISSUE 12)
+# ======================================================================
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def test_trace_context_header_adopted_and_tree_rooted(served):
+    """A router-stamped X-Trace-Context wins over X-Request-Id: its
+    trace id rides the response, and the request root span records the
+    parent/hop/attempt attrs the fleet assembler joins on."""
+    from k8s_device_plugin_tpu.utils.spans import (
+        format_span_id,
+        format_trace_context,
+    )
+
+    cfg, params, server = served
+    header = format_trace_context("ctx-adopt-1", 42, 1, 2)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": [3, 141, 59], "max_new_tokens": 5}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-Id": "should-lose",
+            "X-Trace-Context": header,
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        got = json.loads(resp.read())
+        assert resp.headers["X-Request-Id"] == "ctx-adopt-1"
+    assert got["trace_id"] == "ctx-adopt-1"
+    assert got["tokens"] == _oracle(cfg, params, [3, 141, 59], 5)
+    spans = _get_json(server.port, "/debug/spans?rid=ctx-adopt-1")["spans"]
+    root = next(s for s in spans if s["name"] == "request")
+    assert root["attrs"]["parent"] == format_span_id(42)
+    assert root["attrs"]["hop"] == 1
+    assert root["attrs"]["attempt"] == 2
+    # The ordinary per-request children still parent on the root.
+    children = {
+        s["name"] for s in spans if s.get("parent_id") == root["span_id"]
+    }
+    assert {"queue", "prefill", "decode"} <= children
+
+
+def test_malformed_trace_context_falls_back_to_request_id(served):
+    _, _, server = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": [3, 141, 59], "max_new_tokens": 2}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-Id": "fallback-7",
+            "X-Trace-Context": "not-a-context",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        got = json.loads(resp.read())
+    assert got["trace_id"] == "fallback-7"
+    spans = _get_json(server.port, "/debug/spans?rid=fallback-7")["spans"]
+    root = next(s for s in spans if s["name"] == "request")
+    # No upstream context: no cross-process link attrs.
+    assert "parent" not in root["attrs"]
+
+
+def test_debug_spans_endpoint_shape_and_rid_filter(served):
+    _, _, server = served
+    _post(server.port, {"prompt": [3, 141, 59], "max_new_tokens": 2})
+    full = _get_json(server.port, "/debug/spans")
+    assert set(full) == {"name", "spans", "dropped", "capacity"}
+    assert full["spans"], "ring should not be empty after traffic"
+    tids = {s["trace_id"] for s in full["spans"]}
+    assert len(tids) > 1, "expect several traces in the module fixture ring"
+    some = next(iter(tids - {"engine"}))
+    only = _get_json(server.port, f"/debug/spans?rid={some}")
+    assert only["spans"] and {s["trace_id"] for s in only["spans"]} == {some}

@@ -991,3 +991,261 @@ def test_racecheck_owner_guard_on_poll_state():
         assert router._poll_thread.is_alive()
     finally:
         _teardown(replicas, router)
+
+
+# ======================================================================
+# Fleet-wide distributed tracing (ISSUE 12): hop-context propagation,
+# per-attempt spans, /debug/spans, timeline assembly
+# ======================================================================
+
+
+def _post_with_headers(port, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _load_trace_assemble():
+    from tools import trace_assemble
+
+    return trace_assemble
+
+
+def test_trace_context_propagates_and_roots_replica_tree():
+    """Every dial carries X-Trace-Context; the replica adopts its trace
+    id and roots its request span under the router's attempt span — the
+    cross-process link the assembler joins on."""
+    from k8s_device_plugin_tpu.utils.spans import parse_trace_context
+
+    replicas, router, _ = _fleet(2)
+    try:
+        prompt = [41, 42, 43, 44]
+        got = _post_with_headers(
+            router.port,
+            {"prompt": prompt, "max_new_tokens": 3},
+            headers={"X-Request-Id": "propagate-1"},
+        )
+        assert got["trace_id"] == "propagate-1"
+        served = next(r for r in replicas if r.seen_trace_context)
+        ctx = parse_trace_context(served.seen_trace_context[-1])
+        assert ctx is not None, served.seen_trace_context
+        assert ctx.trace_id == "propagate-1"
+        assert ctx.hop == 1 and ctx.attempt == 0
+        # The parent span id resolves to a recorded router.attempt span.
+        router_spans = router.spans.dump(trace_id="propagate-1")["spans"]
+        by_name = {}
+        for s in router_spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert set(by_name) == {
+            "router.request", "router.route", "router.attempt"
+        }
+        attempt = by_name["router.attempt"][0]
+        assert attempt["span_id"] == int(ctx.parent_span, 16)
+        assert attempt["parent_id"] == by_name["router.request"][0]["span_id"]
+        assert attempt["attrs"]["kind"] == "primary"
+        assert attempt["attrs"]["status"] == 200
+        assert by_name["router.request"][0]["attrs"]["outcome"] == "ok"
+        # Replica side: the request span carries the parent link attrs.
+        # (The handler thread records it just after writing the reply —
+        # the client can observe the response first, so wait.)
+        assert wait_until(
+            lambda: served.spans.dump(trace_id="propagate-1")["spans"],
+            timeout=5,
+        )
+        rep_spans = served.spans.dump(trace_id="propagate-1")["spans"]
+        root = next(s for s in rep_spans if s["name"] == "request")
+        assert root["attrs"]["parent"] == ctx.parent_span
+        assert root["attrs"]["hop"] == 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_router_debug_spans_endpoint_and_rid_filter():
+    replicas, router, _ = _fleet(2)
+    try:
+        for rid in ("spans-a", "spans-b"):
+            _post_with_headers(
+                router.port,
+                {"prompt": [7, 8, 9], "max_new_tokens": 2},
+                headers={"X-Request-Id": rid},
+            )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/spans", timeout=10
+        ) as resp:
+            full = json.loads(resp.read())
+        assert full["name"] == "router" and full["capacity"] > 0
+        assert {s["trace_id"] for s in full["spans"]} == {"spans-a", "spans-b"}
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/spans?rid=spans-b",
+            timeout=10,
+        ) as resp:
+            only = json.loads(resp.read())
+        assert only["spans"] and all(
+            s["trace_id"] == "spans-b" for s in only["spans"]
+        )
+    finally:
+        _teardown(replicas, router)
+
+
+def test_hedge_legs_are_distinct_linked_child_spans():
+    """A hedged unary request produces TWO attempt spans — distinct
+    span ids, distinct attempt indexes, kinds primary/hedge — both
+    children of the one request root, and each replica saw its own
+    X-Trace-Context naming its own leg."""
+    from k8s_device_plugin_tpu.utils.spans import parse_trace_context
+
+    fast = FakeReplica().start()
+    slow = FakeReplica(prefill_delay_s=1.5).start()
+    router = RouterServer(
+        [fast.name, slow.name],
+        host="127.0.0.1",
+        port=0,
+        poll_interval_s=0.1,
+        hedge=True,
+        hedge_min_s=0.1,
+        backoff_base_s=0.02,
+    ).start()
+    try:
+        prompt = _home_prompt(router, slow.name)
+        got = _post_with_headers(
+            router.port,
+            {"prompt": prompt, "max_new_tokens": 4},
+            headers={"X-Request-Id": "hedged-1"},
+        )
+        assert got["tokens"] == fake_generate(prompt, 4)
+        # The losing primary leg records its span when its stalled dial
+        # finally resolves (the drain thread closes it) — AFTER the
+        # client already has the hedge's answer.
+        assert wait_until(
+            lambda: len(
+                [
+                    s
+                    for s in router.spans.dump(trace_id="hedged-1")["spans"]
+                    if s["name"] == "router.attempt"
+                ]
+            )
+            == 2,
+            timeout=5,
+        )
+        spans = router.spans.dump(trace_id="hedged-1")["spans"]
+        attempts = [s for s in spans if s["name"] == "router.attempt"]
+        assert len(attempts) == 2, attempts
+        root = next(s for s in spans if s["name"] == "router.request")
+        assert {a["parent_id"] for a in attempts} == {root["span_id"]}
+        assert {a["span_id"] for a in attempts} != {root["span_id"]}
+        assert len({a["span_id"] for a in attempts}) == 2
+        assert {a["attrs"]["attempt"] for a in attempts} == {0, 1}
+        assert {a["attrs"]["kind"] for a in attempts} == {"primary", "hedge"}
+        # Each replica's received context names ITS leg.
+        ctxs = {}
+        for r, leg in ((slow, "primary"), (fast, "hedge")):
+            ctx = parse_trace_context(r.seen_trace_context[-1])
+            assert ctx is not None and ctx.trace_id == "hedged-1"
+            ctxs[leg] = ctx
+        assert ctxs["primary"].parent_span != ctxs["hedge"].parent_span
+        by_kind = {a["attrs"]["kind"]: a for a in attempts}
+        for leg, ctx in ctxs.items():
+            assert by_kind[leg]["span_id"] == int(ctx.parent_span, 16)
+    finally:
+        _teardown([fast, slow], router)
+
+
+def test_killed_stream_assembles_one_timeline_zero_gaps():
+    """THE assembly contract on the failover path: kill the replica
+    mid-stream, let the stream complete elsewhere, then join router +
+    replica span dumps — ONE timeline, two attempts (primary/failover,
+    distinct linked span ids), zero orphans/gaps/broken links, and the
+    failover-attempt count matches the router's failover metric."""
+    ta = _load_trace_assemble()
+    replicas, router, _ = _fleet(
+        2, token_delay_s=0.02, router_kwargs=dict(breaker_failures=1)
+    )
+    try:
+        victim = replicas[0]
+        prompt = _home_prompt(router, victim.name)
+        failovers0 = router.metrics.failovers.value()
+        import http.client as http_client
+
+        conn = http_client.HTTPConnection(
+            "127.0.0.1", router.port, timeout=30
+        )
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompt": prompt, "max_new_tokens": 10,
+                        "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "killed-1"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = []
+        killed = False
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            event = json.loads(line[5:].strip())
+            events.append(event)
+            if len(events) == 3 and not killed:
+                victim.kill()
+                killed = True
+            if event.get("done"):
+                break
+        conn.close()
+        assert events and events[-1].get("done")
+        assert events[-1]["tokens"] == fake_generate(prompt, 10)
+        assert router.metrics.failovers.value() == failovers0 + 1
+        # Both replica handler threads record their request spans just
+        # AFTER the client observes the stream end (the victim's when
+        # its next write hits the reset socket): wait for the rings.
+        assert wait_until(
+            lambda: all(
+                r.spans.dump(trace_id="killed-1")["spans"]
+                for r in replicas
+            ),
+            timeout=5,
+        )
+        # Assemble: router ring fetched LIVE (?rid= narrows server-side),
+        # the dead victim's ring read from its in-process recorder (the
+        # post-mortem dump shape), the survivor's over HTTP.
+        sources = ta.fetch_url(
+            f"http://127.0.0.1:{router.port}/debug/spans", rid="killed-1"
+        )
+        sources += ta._as_source("victim", victim.spans.dump())
+        sources += ta.fetch_url(
+            f"http://127.0.0.1:{replicas[1].port}/debug/spans",
+            rid="killed-1",
+        )
+        timelines = ta.assemble(sources, trace_id="killed-1")
+        assert len(timelines) == 1
+        t = timelines[0]
+        assert t["complete"], ta.render_text(t)
+        assert not t["orphans"] and not t["gaps"] and not t["broken_links"]
+        kinds = [a["kind"] for a in t["attempts"]]
+        assert kinds == ["primary", "failover"], kinds
+        assert len({a["span_id"] for a in t["attempts"]}) == 2
+        # Attempt count matches what the router metered: 1 first dial +
+        # 1 failover.
+        n_failover_attempts = sum(
+            1 for a in t["attempts"] if a["kind"] == "failover"
+        )
+        assert n_failover_attempts == router.metrics.failovers.value()
+        # The victim's half shows the cut; the survivor's the finish.
+        assert t["attempts"][0]["replica_trees"][0]["attrs"]["outcome"] == "cut"
+        assert (
+            t["attempts"][1]["replica_trees"][0]["attrs"]["outcome"]
+            == "completed"
+        )
+        # Completeness detection feeds chaos scoring.
+        det = ta.completeness_detections(timelines, {"killed-1": 2})
+        assert len(det) == 1 and det[0]["rid"] == "killed-1"
+    finally:
+        _teardown(replicas, router)

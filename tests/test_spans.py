@@ -148,3 +148,126 @@ def test_emit_flows_through_json_formatter():
 def test_capacity_must_be_positive():
     with pytest.raises(ValueError):
         SpanRecorder(capacity=0)
+
+
+# ======================================================================
+# Hop-context header (fleet-wide propagation, ISSUE 12)
+# ======================================================================
+
+
+def test_hop_context_round_trips():
+    from k8s_device_plugin_tpu.utils.spans import (
+        format_trace_context,
+        parse_trace_context,
+    )
+
+    header = format_trace_context("abc-123", 77, hop=1, attempt=3)
+    ctx = parse_trace_context(header)
+    assert ctx is not None
+    assert ctx.trace_id == "abc-123"
+    assert ctx.parent_span == f"{77:016x}"
+    assert ctx.hop == 1
+    assert ctx.attempt == 3
+
+
+def test_hop_context_survives_dashed_and_weird_trace_ids():
+    from k8s_device_plugin_tpu.utils.spans import (
+        format_trace_context,
+        parse_trace_context,
+    )
+
+    # Any id sanitize_trace_id accepts must survive the header round
+    # trip — including dashes (the wire splits from the right) and a
+    # trailing dash.
+    for tid in ("a-b-c-d", "req/2024#7", "x" * 128, "ends-with-",
+                "00-looks-like-header"):
+        assert sanitize_trace_id(tid) == tid  # precondition
+        ctx = parse_trace_context(format_trace_context(tid, 1, 0, 0))
+        assert ctx is not None and ctx.trace_id == tid, tid
+
+
+def test_hop_context_clamps_hop_and_attempt():
+    from k8s_device_plugin_tpu.utils.spans import (
+        format_trace_context,
+        parse_trace_context,
+    )
+
+    ctx = parse_trace_context(format_trace_context("t", 5, 999, -3))
+    assert ctx == ("t", f"{5:016x}", 255, 0)
+
+
+def test_hop_context_rejects_malformed_input():
+    from k8s_device_plugin_tpu.utils.spans import (
+        format_trace_context,
+        parse_trace_context,
+    )
+
+    good = format_trace_context("tid", 9, 1, 0)
+    assert parse_trace_context(good) is not None
+    bad = [
+        None, 42, b"bytes", "", " ", "00", "00-", "garbage",
+        "01-" + good[3:],                      # wrong version
+        "00-tid-deadbeef-0100",                # short parent hex
+        "00-tid-" + "g" * 16 + "-0100",        # non-hex parent
+        "00-tid-" + "0" * 16 + "-01",          # short tail
+        "00-tid-" + "0" * 16 + "-01000",       # long tail
+        "00-tid-" + "0" * 16 + "-zz00",        # non-hex hop
+        "00-" + "0" * 16 + "-0100",            # missing trace id field
+        '00-has"quote-' + "0" * 16 + "-0100",  # hostile embedded id
+        "00-has\nnl-" + "0" * 16 + "-0100",
+        "00-" + "x" * 300 + "-" + "0" * 16 + "-0100",  # oversized
+        "00-tid-" + "A" * 16 + "-0100",        # hex case is fixed
+    ]
+    for raw in bad:
+        assert parse_trace_context(raw) is None, raw
+    # Fuzz-ish: deterministic pseudo-random garbage never parses into a
+    # context whose trace id the sanitizer would reject.
+    import random as _random
+
+    rng = _random.Random(1234)
+    alphabet = "0-abcdef\"\\\nXYZ "
+    for _ in range(500):
+        raw = "".join(
+            rng.choice(alphabet) for _ in range(rng.randrange(0, 60))
+        )
+        ctx = parse_trace_context(raw)
+        if ctx is not None:
+            assert sanitize_trace_id(ctx.trace_id) == ctx.trace_id
+
+
+def test_span_dump_filters_by_trace_id():
+    rec = SpanRecorder(capacity=8, name="unit")
+    t0 = time.monotonic()
+    rec.record_span("a", "t1", start_monotonic=t0)
+    rec.record_span("b", "t2", start_monotonic=t0)
+    rec.record_span("c", "t1", start_monotonic=t0)
+    full = rec.dump()
+    assert full["name"] == "unit" and len(full["spans"]) == 3
+    assert full["capacity"] == 8 and full["dropped"] == 0
+    only = rec.dump(trace_id="t1")
+    assert [s["name"] for s in only["spans"]] == ["a", "c"]
+
+
+def test_flight_dump_carries_registered_span_rings(tmp_path):
+    from k8s_device_plugin_tpu.utils import flight as flight_mod
+
+    rec = SpanRecorder(capacity=4, name="unit-ring")
+    rec.record_span("hop", "t9", start_monotonic=time.monotonic())
+    box = flight_mod.FlightRecorder(capacity=4, name="unit-box")
+    box.record("unit.event")
+    path = flight_mod.dump_all(
+        str(tmp_path), reason="test", recorders=[box], span_recorders=[rec]
+    )
+    assert path is not None
+    payload = json.loads(open(path).read())
+    assert payload["recorders"]["unit-box"]["recorded"] == 1
+    ring = payload["spans"]["unit-ring"]
+    assert [s["name"] for s in ring["spans"]] == ["hop"]
+    assert ring["capacity"] == 4
+    # The registry path: register/unregister round trip.
+    flight_mod.register_spans(rec)
+    try:
+        assert rec in flight_mod.registered_spans()
+    finally:
+        flight_mod.unregister_spans(rec)
+    assert rec not in flight_mod.registered_spans()

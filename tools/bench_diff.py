@@ -153,6 +153,15 @@ def load_record(path: str) -> dict:
                 "restored_pages"
             )
             rec["restart_warm_speedup"] = restart.get("warm_speedup")
+        # Trace block (TRACE serving rows, benchmark.py's tracing
+        # phase): measured spans-on vs spans-off per-token overhead
+        # over the same jobs.  The regression tell: overhead creeping
+        # past ~2% — the always-on span layer stopped being free and
+        # the row screams TRACE-OVERHEAD.
+        trace = parsed.get("trace")
+        if isinstance(trace, dict):
+            rec["trace_overhead"] = trace.get("overhead")
+            rec["trace_spans"] = trace.get("spans_recorded")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -192,6 +201,7 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "overload_pool_exact",
         "restart_cold_ttft_p99_ms", "restart_warm_ttft_p99_ms",
         "restart_restored_pages", "restart_warm_speedup",
+        "trace_overhead", "trace_spans",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -280,6 +290,18 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("restart_warm_ttft_p99_ms") is not None
+                else ""
+            )
+            + (
+                f"; trace overhead {b['trace_overhead']} "
+                f"({b.get('trace_spans')} spans"
+                + (
+                    ", TRACE-OVERHEAD"
+                    if (b.get("trace_overhead") or 0.0) > 0.02
+                    else ""
+                )
+                + ")"
+                if b.get("trace_overhead") is not None
                 else ""
             )
             + (

@@ -146,6 +146,11 @@ RUNTIME_GUARDS: set = {"owner-thread"}
 # Doc files (repo-relative) holding each machine-checked catalog.
 EVENT_CATALOG_DOCS = ["docs/operations.md"]
 METRIC_CATALOG_DOCS = ["docs/operations.md"]
+# Span operation names (utils/spans.py recorders) vs the operations.md
+# "Distributed tracing" span-name catalog (header `| Span | Source |`),
+# both directions with f-string prefix wildcards — the names the trace
+# assembler and operators grep by must stay real.
+SPAN_CATALOG_DOCS = ["docs/operations.md"]
 FAILPOINT_CATALOG_DOCS = ["docs/chaos.md"]
 ENDPOINT_CATALOG_DOCS = ["README.md", "docs/operations.md"]
 # Flags: coverage is satisfied by a backticked `--flag` anywhere in the

@@ -10,6 +10,10 @@ them) that PRs 6-10 kept in sync by hand:
 - **Metric names** (``registry.counter/gauge/histogram/summary``
   registrations) vs the docs/operations.md metric tables (header
   ``| Name | Type | ... |``).
+- **Span operation names** (``SpanRecorder.span()`` / ``record_span()``
+  call sites) vs the docs/operations.md span-name catalog (header
+  ``| Span | Source | ... |``) — the names the trace assembler joins
+  and operators grep by.
 - **Failpoint sites** (``failpoints.fire(...)`` / ``fire_scoped``) vs
   the docs/chaos.md failpoint catalog (header ``| Failpoint | ... |``).
 - **CLI flags** (every ``add_argument`` option on the serving/plugin/
@@ -45,6 +49,9 @@ from ..walker import Repo, Module, _attr_chain
 NAME = "catalog-drift"
 
 KIND_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)*$")
+# Span names admit CamelCase segments after the first dot: timed_rpc
+# names daemon spans rpc.<grpc method> (rpc.Allocate).
+SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-zA-Z0-9_]+)*$")
 METRIC_RE = re.compile(r"^tpu_[a-z0-9_]+$")
 BACKTICK_RE = re.compile(r"`([^`]+)`")
 FLAG_RE = re.compile(r"--[a-z0-9][a-z0-9-]*")
@@ -159,6 +166,79 @@ def _event_kinds(repo: Repo):
                     prefix = head.value
                     if prefix and KIND_RE.match(prefix.rstrip("._")):
                         wild.setdefault(prefix, (mod.rel, node.lineno))
+    return exact, wild
+
+
+def _span_names(repo: Repo):
+    """Span operation names recorded via ``SpanRecorder.span()`` /
+    ``record_span()``: exact names + f-string prefix wildcards, same
+    semantics as the flight-event side.  A ``Name`` first arg resolves
+    through the module's assignments (the ``timed_rpc`` shape:
+    ``span_name = name or f"rpc.{f.__name__}"`` becomes the ``rpc.``
+    wildcard).  utils/spans.py itself is the recorder's plumbing, not a
+    call site."""
+    exact: dict = {}
+    wild: dict = {}
+    for mod in repo.modules:
+        if mod.rel.endswith("utils/spans.py"):
+            continue
+        # Any assignment in the module whose value is (or contains, for
+        # BoolOp defaults) a string constant or f-string: the span-name
+        # candidates a Name argument can resolve to.
+        assigned: dict = {}
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            value = node.value
+            candidates = (
+                value.values if isinstance(value, ast.BoolOp) else [value]
+            )
+            vals = []
+            for cand in candidates:
+                if isinstance(cand, ast.Constant) and isinstance(
+                    cand.value, str
+                ):
+                    vals.append(("const", cand.value))
+                elif isinstance(cand, ast.JoinedStr) and cand.values:
+                    head = cand.values[0]
+                    if isinstance(head, ast.Constant) and isinstance(
+                        head.value, str
+                    ):
+                        vals.append(("wild", head.value))
+            if vals:
+                assigned.setdefault(node.targets[0].id, []).extend(vals)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in ("span", "record_span"):
+                continue
+            arg = node.args[0]
+            candidates = []
+            const = _const_str(mod, arg)
+            if const is not None:
+                candidates.append(("const", const))
+            elif isinstance(arg, ast.JoinedStr) and arg.values:
+                head = arg.values[0]
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ):
+                    candidates.append(("wild", head.value))
+            elif isinstance(arg, ast.Name):
+                candidates.extend(assigned.get(arg.id, []))
+            for kind, value in candidates:
+                if kind == "const" and SPAN_RE.match(value):
+                    exact.setdefault(value, (mod.rel, node.lineno))
+                elif (
+                    kind == "wild"
+                    and value
+                    and SPAN_RE.match(value.rstrip("._"))
+                ):
+                    wild.setdefault(value, (mod.rel, node.lineno))
     return exact, wild
 
 
@@ -294,6 +374,50 @@ def run(repo: Repo, cfg) -> list:
                 line,
                 f"documented flight-event kind {kind!r} is never "
                 "recorded anywhere in the package",
+            )
+
+    # ---- span operation names (the operations.md span-name catalog:
+    # header `| Span | Source | ... |`) — same both-directions + prefix
+    # wildcard semantics as flight events.  The catalog is the contract
+    # tools/trace_assemble.py timelines and operators grep against.
+    doc_spans = _catalog_tokens(
+        root, getattr(cfg, "SPAN_CATALOG_DOCS", []), "Span", "Source",
+        SPAN_RE,
+    )
+    code_spans, code_span_wild = _span_names(repo)
+    for name, (rel, line) in sorted(code_spans.items()):
+        if name not in doc_spans:
+            finding(
+                "span-undocumented",
+                name,
+                rel,
+                line,
+                f"span operation {name!r} is recorded here but has no "
+                "row in the "
+                f"{'/'.join(getattr(cfg, 'SPAN_CATALOG_DOCS', []))} "
+                "span-name catalog",
+            )
+    for prefix, (rel, line) in sorted(code_span_wild.items()):
+        if not any(k.startswith(prefix) for k in doc_spans):
+            finding(
+                "span-undocumented",
+                f"{prefix}*",
+                rel,
+                line,
+                f"dynamic span operation {prefix}* has no matching rows "
+                "in the span-name catalog",
+            )
+    for name, (rel, line) in sorted(doc_spans.items()):
+        if name not in code_spans and not any(
+            name.startswith(p) for p in code_span_wild
+        ):
+            finding(
+                "span-ghost",
+                name,
+                rel,
+                line,
+                f"documented span operation {name!r} is never recorded "
+                "anywhere in the package",
             )
 
     # ---- metrics

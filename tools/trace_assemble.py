@@ -1,0 +1,492 @@
+#!/usr/bin/env python3
+"""Assemble per-request FLEET timelines from router + replica span dumps.
+
+Since the router (PR 8) a request's life crosses processes: router
+admission, hedged dials, retries, mid-stream failover, fences.  Each
+process records its own span tree (utils/spans.py) but the rings are
+per-process islands — the operator greps an ``X-Request-Id`` by hand
+across dumps.  This tool owns the join:
+
+- **Inputs**: any mix of flight-dump files (``tpu-flight-dump/v1``,
+  whose ``spans`` section carries every registered ring), bare
+  ``GET /debug/spans`` payloads, ``GET /debug/state`` payloads, or live
+  ``--url http://host:port/debug/spans`` endpoints (with ``--rid`` the
+  live fetch narrows to ``?rid=`` so it never pulls whole rings).
+- **Join**: the router stamps every upstream leg with an
+  ``X-Trace-Context`` carrying the leg's ``router.attempt`` span id;
+  the replica's ``request`` root records it as the ``parent`` attr.
+  Assembly resolves those links into ONE causally-ordered tree per
+  trace id: the router root, its route/attempt children, and under
+  each attempt the replica tree that served it.
+- **Skew normalization**: wall clocks differ per host.  Each hop's
+  offset is estimated as ``replica_root.start - attempt.start`` (the
+  dial ALWAYS precedes the replica's submit, so any negative residue
+  is pure clock skew) and the replica tree is displayed shifted so the
+  hop nests inside its attempt.  The printed ``skew`` therefore folds
+  true clock skew together with dial latency — times within one
+  process are exact, cross-process alignment is approximate (the
+  operations.md caveat).
+- **Verdicts** per timeline:
+  - **orphans** — replica trees with no router parent (no ``parent``
+    attr while router spans exist, or a ``parent`` that resolves to no
+    attempt): propagation broke on the way down.
+  - **gaps** — attempts the router metered as reaching a replica
+    (status 200) with NO replica-side tree: the dropped-request smell
+    (a replica that accepted work and left no record).
+  - **broken links** — spans whose in-process parent id resolves
+    nowhere (a ring that overflowed mid-request; the dump says so via
+    ``dropped``).
+
+``score`` mode emits trace-completeness detections shaped for
+``tools/chaos_report.score_detections`` — the chaos harness joins them
+against injected requests and reports completeness precision/recall
+exactly like incident scoring (docs/chaos.md).
+
+Usage:
+
+    python tools/trace_assemble.py dump1.json dump2.json [--rid TID]
+    python tools/trace_assemble.py --url http://r:8100/debug/spans \\
+        --url http://a:8000/debug/spans --rid TID
+    python tools/trace_assemble.py dumps/*.json --json timelines.json
+
+Stdlib only; jax-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_mod
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+# Trace ids that are process-scoped streams, never request timelines.
+_NON_REQUEST_TRACES = {"engine", "daemon"}
+
+# Router span names (the process that OWNS the timeline root).
+ROOT_SPAN = "router.request"
+ATTEMPT_SPAN = "router.attempt"
+REPLICA_ROOT_SPAN = "request"
+
+
+# ----------------------------------------------------------------- load
+
+
+def _as_source(name: str, payload) -> list[dict]:
+    """Normalize one loaded JSON payload into span sources:
+    ``[{"name", "spans", "dropped"}]``."""
+    if isinstance(payload, list):  # bare span list
+        return [{"name": name, "spans": payload, "dropped": 0}]
+    if not isinstance(payload, dict):
+        raise ValueError(f"{name}: not a span payload")
+    if payload.get("schema") == "tpu-flight-dump/v1":
+        out = []
+        for ring_name, ring in (payload.get("spans") or {}).items():
+            out.append(
+                {
+                    "name": f"{name}:{ring_name}",
+                    "spans": ring.get("spans", []),
+                    "dropped": ring.get("dropped", 0),
+                }
+            )
+        return out
+    if "spans" in payload:  # /debug/spans or /debug/state shape
+        return [
+            {
+                "name": str(payload.get("name") or name),
+                "spans": payload["spans"],
+                "dropped": payload.get(
+                    "dropped", payload.get("spans_dropped", 0)
+                ),
+            }
+        ]
+    raise ValueError(f"{name}: no spans found in payload")
+
+
+def load_file(path: str) -> list[dict]:
+    with open(path) as f:
+        return _as_source(path, json.load(f))
+
+
+def fetch_url(url: str, rid: str | None = None, timeout: float = 10.0):
+    """Live mode: GET a /debug/spans (or /debug/state) endpoint; with a
+    rid the fetch narrows server-side (``?rid=``) so a per-request
+    assembly never pulls a whole ring across the fleet."""
+    target = url
+    if rid is not None:
+        sep = "&" if "?" in url else "?"
+        target = f"{url}{sep}rid={urllib.parse.quote(rid)}"
+    with urllib.request.urlopen(target, timeout=timeout) as resp:
+        return _as_source(url, json.loads(resp.read()))
+
+
+# ------------------------------------------------------------- assembly
+
+
+def _span_end(span: dict) -> float:
+    return span["start"] + span.get("duration_ms", 0.0) / 1e3
+
+
+def _index(source: dict, trace_id: str):
+    """This source's spans for one trace: (by_id, roots, broken)."""
+    spans = [s for s in source["spans"] if s.get("trace_id") == trace_id]
+    by_id = {s["span_id"]: s for s in spans}
+    roots, broken = [], []
+    for s in spans:
+        parent = s.get("parent_id", 0)
+        if parent == 0:
+            roots.append(s)
+        elif parent not in by_id:
+            # In-process parent resolves nowhere: the ring rolled the
+            # parent out (or the process died between records).
+            broken.append(s)
+        # else: linked child; rendered under its parent.
+    return by_id, roots, broken
+
+
+def _children(by_id: dict):
+    kids: dict = {}
+    for s in by_id.values():
+        parent = s.get("parent_id", 0)
+        if parent and parent in by_id:
+            kids.setdefault(parent, []).append(s)
+    for lst in kids.values():
+        lst.sort(key=lambda s: s["start"])
+    return kids
+
+
+def _tree(span: dict, kids: dict, source: str, shift_s: float = 0.0) -> dict:
+    return {
+        "name": span["name"],
+        "source": source,
+        "span_id": span["span_id"],
+        "start": round(span["start"] - shift_s, 6),
+        "duration_ms": span.get("duration_ms", 0.0),
+        "attrs": span.get("attrs", {}),
+        "children": [
+            _tree(c, kids, source, shift_s)
+            for c in kids.get(span["span_id"], [])
+        ],
+    }
+
+
+def trace_ids(sources: list[dict]) -> list[str]:
+    """Every request trace id present in any source (engine/daemon
+    streams excluded), ordered by first appearance time."""
+    first_seen: dict = {}
+    for src in sources:
+        for s in src["spans"]:
+            tid = s.get("trace_id", "")
+            if not tid or tid in _NON_REQUEST_TRACES:
+                continue
+            if tid not in first_seen or s["start"] < first_seen[tid]:
+                first_seen[tid] = s["start"]
+    return sorted(first_seen, key=first_seen.get)
+
+
+def assemble_trace(sources: list[dict], trace_id: str) -> dict:
+    """One trace id -> one fleet timeline with verdicts."""
+    router_sources, replica_sources = [], []
+    for src in sources:
+        by_id, roots, broken = _index(src, trace_id)
+        if not by_id:
+            continue
+        entry = {
+            "src": src,
+            "by_id": by_id,
+            "roots": roots,
+            "broken": broken,
+            "kids": _children(by_id),
+        }
+        if any(s["name"].startswith("router.") for s in by_id.values()):
+            router_sources.append(entry)
+        else:
+            replica_sources.append(entry)
+
+    broken_links = [
+        {"source": e["src"]["name"], "span_id": s["span_id"],
+         "name": s["name"], "parent_id": s.get("parent_id", 0)}
+        for e in router_sources + replica_sources
+        for s in e["broken"]
+    ]
+
+    # Router side: the timeline root + its attempts, keyed by span id
+    # (the id the X-Trace-Context carried down, 16-hex on the wire).
+    root = None
+    root_entry = None
+    attempts: dict[int, dict] = {}
+    for e in router_sources:
+        for s in e["by_id"].values():
+            if s["name"] == ROOT_SPAN and root is None:
+                root, root_entry = s, e
+            elif s["name"] == ATTEMPT_SPAN:
+                attempts[s["span_id"]] = {
+                    "span": s,
+                    "source": e["src"]["name"],
+                    "replica_trees": [],
+                    "skew_s": None,
+                }
+
+    # Replica side: each "request" root either links to an attempt
+    # (attrs.parent = that attempt's span id in hex) or is an orphan.
+    orphans = []
+    standalone_trees = []
+    for e in replica_sources:
+        for s in e["roots"]:
+            if s["name"] != REPLICA_ROOT_SPAN:
+                continue
+            parent_hex = (s.get("attrs") or {}).get("parent")
+            attempt = None
+            if parent_hex is not None:
+                try:
+                    attempt = attempts.get(int(parent_hex, 16))
+                except (TypeError, ValueError):
+                    attempt = None
+            if attempt is not None:
+                # Skew: the dial strictly precedes the replica's
+                # submit, so (replica start - attempt start) folds
+                # clock skew + dial latency; rendering shifts the
+                # replica tree so the hop nests inside its attempt.
+                skew = s["start"] - attempt["span"]["start"]
+                attempt["skew_s"] = round(skew, 6)
+                attempt["replica_trees"].append(
+                    _tree(s, e["kids"], e["src"]["name"], shift_s=skew)
+                )
+            elif parent_hex is not None and (router_sources or attempts):
+                orphans.append(
+                    {"source": e["src"]["name"], "span_id": s["span_id"],
+                     "reason": f"parent {parent_hex} resolves to no "
+                               "router attempt"}
+                )
+            elif router_sources:
+                orphans.append(
+                    {"source": e["src"]["name"], "span_id": s["span_id"],
+                     "reason": "no hop context (request root carries no "
+                               "parent attr)"}
+                )
+            else:
+                # No router in the assembly at all: a replica-only
+                # timeline (direct client), not an orphan.
+                standalone_trees.append(_tree(s, e["kids"], e["src"]["name"]))
+
+    # Gaps: attempts the router metered as REACHING a replica (the
+    # upstream answered 200) that left no replica-side tree — the
+    # dropped-request smell.  Rejections (503 drain/shed, 4xx) and
+    # dial failures never touched engine admission: no tree expected.
+    gaps = []
+    ordered_attempts = sorted(
+        attempts.values(),
+        key=lambda a: (a["span"].get("attrs", {}).get("attempt", 0),
+                       a["span"]["start"]),
+    )
+    for a in ordered_attempts:
+        attrs = a["span"].get("attrs", {})
+        if attrs.get("status") == 200 and not a["replica_trees"]:
+            gaps.append(
+                {"span_id": a["span"]["span_id"],
+                 "attempt": attrs.get("attempt"),
+                 "replica": attrs.get("replica"),
+                 "outcome": attrs.get("outcome")}
+            )
+
+    timeline = {
+        "trace_id": trace_id,
+        "root": (
+            _tree(root, root_entry["kids"], root_entry["src"]["name"])
+            if root is not None
+            else None
+        ),
+        "attempts": [
+            {
+                "span_id": a["span"]["span_id"],
+                "attempt": a["span"].get("attrs", {}).get("attempt"),
+                "replica": a["span"].get("attrs", {}).get("replica"),
+                "kind": a["span"].get("attrs", {}).get("kind"),
+                "outcome": a["span"].get("attrs", {}).get("outcome"),
+                "status": a["span"].get("attrs", {}).get("status"),
+                "start": a["span"]["start"],
+                "duration_ms": a["span"].get("duration_ms", 0.0),
+                "skew_s": a["skew_s"],
+                "replica_trees": a["replica_trees"],
+            }
+            for a in ordered_attempts
+        ],
+        "standalone_trees": standalone_trees,
+        "orphans": orphans,
+        "gaps": gaps,
+        "broken_links": broken_links,
+        "end": max(
+            ([_span_end(root)] if root is not None else [])
+            + [_span_end(a["span"]) for a in ordered_attempts]
+            + [t["start"] + t["duration_ms"] / 1e3 for t in standalone_trees]
+            + [0.0]
+        ),
+    }
+    timeline["complete"] = bool(
+        root is not None
+        and timeline["attempts"]
+        and not orphans
+        and not gaps
+        and not broken_links
+    )
+    return timeline
+
+
+def assemble(sources: list[dict], trace_id: str | None = None) -> list[dict]:
+    """Every (or one) request trace across the sources -> timelines."""
+    tids = [trace_id] if trace_id is not None else trace_ids(sources)
+    return [assemble_trace(sources, tid) for tid in tids]
+
+
+# ------------------------------------------------------------- scoring
+
+
+def completeness_detections(
+    timelines: list[dict],
+    expected_attempts: dict | None = None,
+) -> list[dict]:
+    """Trace-completeness detections for chaos_report.score_detections:
+    one ``{"cls": "trace_complete", "rid", "ts"}`` per timeline that
+    assembled into ONE complete tree (zero orphans/gaps/broken links —
+    and, when the caller knows how many legs the router metered for
+    that request, a matching attempt count).  An incomplete trace emits
+    nothing and scores as a recall miss against its injected request."""
+    out = []
+    for t in timelines:
+        ok = t["complete"]
+        if expected_attempts is not None and t["trace_id"] in expected_attempts:
+            ok = ok and len(t["attempts"]) == expected_attempts[t["trace_id"]]
+        if ok:
+            out.append(
+                {"cls": "trace_complete", "rid": t["trace_id"], "ts": t["end"]}
+            )
+    return out
+
+
+# ------------------------------------------------------------ rendering
+
+
+def _fmt_attrs(attrs: dict, skip=("rid",)) -> str:
+    parts = [
+        f"{k}={v}" for k, v in attrs.items() if k not in skip
+    ]
+    return (" " + " ".join(parts)) if parts else ""
+
+
+def _render_node(node: dict, lines: list, depth: int) -> None:
+    pad = "  " * depth
+    lines.append(
+        f"{pad}{node['name']} {node['duration_ms']:.3f}ms "
+        f"[{node['source']}]{_fmt_attrs(node['attrs'])}"
+    )
+    for child in node["children"]:
+        _render_node(child, lines, depth + 1)
+
+
+def render_text(timeline: dict) -> str:
+    """Human-readable tree for one timeline ("one request, one
+    timeline" — the triage surface of the operations.md runbook)."""
+    t = timeline
+    verdict = "complete" if t["complete"] else "INCOMPLETE"
+    lines = [
+        f"trace {t['trace_id']} — {len(t['attempts'])} attempt(s), "
+        f"{len(t['orphans'])} orphan(s), {len(t['gaps'])} gap(s), "
+        f"{len(t['broken_links'])} broken link(s) — {verdict}"
+    ]
+    if t["root"] is not None:
+        _render_node(t["root"], lines, 1)
+    for a in t["attempts"]:
+        skew = (
+            f" skew {a['skew_s'] * 1e3:+.1f}ms"
+            if a["skew_s"] is not None
+            else ""
+        )
+        lines.append(
+            f"  attempt#{a['attempt']} [{a['kind']}] -> {a['replica']} "
+            f"{a['duration_ms']:.3f}ms status={a['status']} "
+            f"outcome={a['outcome']}{skew}"
+        )
+        for tree in a["replica_trees"]:
+            _render_node(tree, lines, 2)
+    for tree in t["standalone_trees"]:
+        _render_node(tree, lines, 1)
+    for o in t["orphans"]:
+        lines.append(f"  ORPHAN [{o['source']}] span {o['span_id']}: "
+                     f"{o['reason']}")
+    for g in t["gaps"]:
+        lines.append(
+            f"  GAP attempt#{g['attempt']} -> {g['replica']}: router "
+            f"metered status 200, no replica-side tree"
+        )
+    for b in t["broken_links"]:
+        lines.append(
+            f"  BROKEN LINK [{b['source']}] span {b['span_id']} "
+            f"({b['name']}): parent {b['parent_id']} resolves nowhere"
+        )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace-assemble",
+        description="join router + replica span dumps into per-request "
+        "fleet timelines; flag orphans/gaps/broken links",
+    )
+    p.add_argument(
+        "dumps",
+        nargs="*",
+        help="span dump files: flight dumps (tpu-flight-dump/v1), "
+        "/debug/spans payloads, or /debug/state payloads (globs ok)",
+    )
+    p.add_argument(
+        "--url",
+        action="append",
+        default=[],
+        help="live /debug/spans (or /debug/state) endpoint; repeatable "
+        "— one per fleet process.  With --rid the fetch narrows "
+        "server-side (?rid=)",
+    )
+    p.add_argument("--rid", default=None, help="assemble ONE trace id only")
+    p.add_argument(
+        "--json", default="", help="write the timelines as JSON here"
+    )
+    args = p.parse_args(argv)
+    sources: list[dict] = []
+    paths: list[str] = []
+    for pattern in args.dumps:
+        hits = sorted(glob_mod.glob(pattern))
+        paths.extend(hits if hits else [pattern])
+    try:
+        for path in paths:
+            sources.extend(load_file(path))
+        for url in args.url:
+            sources.extend(fetch_url(url, rid=args.rid))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace-assemble: {e}", file=sys.stderr)
+        return 2
+    if not sources:
+        print("trace-assemble: no span sources (pass dumps and/or --url)",
+              file=sys.stderr)
+        return 2
+    timelines = assemble(sources, trace_id=args.rid)
+    for t in timelines:
+        print(render_text(t))
+        print()
+    complete = sum(1 for t in timelines if t["complete"])
+    print(
+        f"{len(timelines)} timeline(s) from {len(sources)} source(s): "
+        f"{complete} complete, {len(timelines) - complete} incomplete"
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"timelines": timelines}, f, indent=2)
+        print(f"wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
