@@ -598,6 +598,198 @@ def _run_router_phase(args) -> dict | None:
     return block
 
 
+def _run_kernels_phase(args) -> dict | None:
+    """KERNELS perf phase: the split-K paged-attention kernel vs the
+    engine's gather fallback vs the old single-pass Pallas path, per
+    shape x KV format — the per-shape kernel perf ledger that
+    tools/bench_diff.py gates regressions against.
+
+    What the row claims and how it is measured:
+
+    - **kernel** — `ops.paged_attention` through its default routing
+      (compiled Mosaic split-K on TPU; the vectorized XLA
+      implementation of the same split math on CPU — the route the
+      engine's decode step actually takes), split degree from the
+      per-generation tuning table (ops/tuning.py).
+    - **gather** — the engine's fallback math verbatim
+      (models/transformer.py: materialize the [max_len] view,
+      dequantize it when quantized, masked grouped einsum).
+    - **single** — the pre-split-K kernel shape: `num_splits=1` forced
+      through the Pallas lane (the interpreter on CPU — exactly what
+      the r03–r05 smoke rows measured at 0.06–0.12x of gather; the
+      compiled 1-split kernel on TPU).
+
+    Every arm runs the SAME jitted-callable discipline (warm twice,
+    min-of-N timed executions, device_get sync), and the quantized
+    shapes share the bf16 shape's geometry so the `int8_vs_bf16` field
+    is a like-for-like fused-dequant claim.  Returns the JSON `kernels`
+    block (None when skipped via `--no-kernel`)."""
+    if not getattr(args, "kernel", True):
+        return None
+    from ..ops import tuning
+    from ..ops.paged_attention import paged_attention
+    from ..ops.quant import (
+        dequantize_kv,
+        dequantize_kv4,
+        quantize_kv,
+        quantize_kv4,
+    )
+
+    # (name, batch, heads, kv_heads, head_dim, page_size, pages, fill, fmt)
+    # — the CPU smoke set: one moderate GQA shape per format plus a
+    # longer MQA context where the split axis has real work.  fill < 1
+    # leaves a partial frontier page (the masked-tail case).
+    shapes = [
+        ("b4_gqa_f32", 4, 8, 4, 64, 16, 8, 0.75, "f32"),
+        ("b2_mqa_long_f32", 2, 16, 2, 64, 16, 32, 0.4, "f32"),
+        ("b4_gqa_bf16", 4, 8, 4, 64, 16, 8, 0.75, "bf16"),
+        ("b4_gqa_int8", 4, 8, 4, 64, 16, 8, 0.75, "int8"),
+        ("b4_gqa_int4", 4, 8, 4, 64, 16, 8, 0.75, "int4"),
+    ]
+
+    def _time(fn, operands, iters):
+        out = fn(*operands)  # compile
+        _sync(out)
+        _sync(fn(*operands))
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _sync(fn(*operands))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    def _gather_decode(q, kr, vr, lens, sk=None, sv=None, fmt="f32"):
+        # The engine's gather-path math verbatim: gathered [max_len]
+        # view (dequantized first when quantized), grouped einsum with
+        # the positional mask, f32 softmax.
+        batch, heads, head_dim = q.shape
+        kv_heads = kr.shape[2]
+        group = heads // kv_heads
+        if fmt == "int8":
+            kr = dequantize_kv(kr, sk, q.dtype)
+            vr = dequantize_kv(vr, sv, q.dtype)
+        elif fmt == "int4":
+            kr = dequantize_kv4(kr, sk, q.dtype)
+            vr = dequantize_kv4(vr, sv, q.dtype)
+        qg = q.reshape(batch, kv_heads, group, 1, head_dim)
+        s = jnp.einsum(
+            "bhgqd,bkhd->bhgqk", qg, kr, preferred_element_type=jnp.float32
+        ) * (head_dim ** -0.5)
+        mask = jnp.arange(kr.shape[1])[None, None, None, None, :] < (
+            lens[:, None, None, None, None]
+        )
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vr.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bhgqd", p, vr)
+        return out.reshape(batch, heads, head_dim)
+
+    generation = tuning.device_generation()
+    rows: dict[str, dict] = {}
+    for name, batch, heads, kv_heads, head_dim, ps, pages, fill, fmt in shapes:
+        dt = jnp.float32 if fmt == "f32" else jnp.bfloat16
+        import zlib
+
+        rng = jax.random.PRNGKey(zlib.crc32(name.encode()) % (1 << 31))
+        ks = jax.random.split(rng, 4)
+        n_pool = batch * pages + 1
+        q = jax.random.normal(ks[0], (batch, heads, head_dim), dt)
+        pool_k = jax.random.normal(ks[1], (n_pool, ps, kv_heads, head_dim), dt)
+        pool_v = jax.random.normal(ks[2], (n_pool, ps, kv_heads, head_dim), dt)
+        table = (
+            jnp.arange(batch * pages, dtype=jnp.int32).reshape(batch, pages)
+            + 1
+        )
+        max_len = pages * ps
+        lens = jnp.asarray(
+            [max(1, int(max_len * fill) - 3 * i) for i in range(batch)],
+            jnp.int32,
+        )
+        sk = sv = None
+        if fmt == "int8":
+            pool_k, sk = quantize_kv(pool_k)
+            pool_v, sv = quantize_kv(pool_v)
+        elif fmt == "int4":
+            pool_k, sk = quantize_kv4(pool_k)
+            pool_v, sv = quantize_kv4(pool_v)
+        splits = tuning.pick_num_splits(pages, generation)
+        quant_kw = {"scale_k": sk, "scale_v": sv} if sk is not None else {}
+        kernel_fn = jax.jit(
+            lambda q, k, v, t, ln, **kw: paged_attention(q, k, v, t, ln, **kw)
+        )
+        operands = (q, pool_k, pool_v, table, lens)
+        kernel_ms = _time(
+            lambda *o: kernel_fn(*o, **quant_kw), operands, iters=7
+        )
+
+        def gather_full(q, k, v, t, ln):
+            kr = k[t].reshape(batch, max_len, kv_heads, -1)
+            vr = v[t].reshape(batch, max_len, kv_heads, -1)
+            skr = sk[t].reshape(batch, max_len, kv_heads) if sk is not None else None
+            svr = sv[t].reshape(batch, max_len, kv_heads) if sv is not None else None
+            return _gather_decode(q, kr, vr, ln, skr, svr, fmt)
+
+        gather_ms = _time(jax.jit(gather_full), operands, iters=7)
+        # The old path is SLOW on CPU (the whole point of the row);
+        # two timed iterations bound the phase's wall clock.
+        single_fn = jax.jit(
+            lambda q, k, v, t, ln: paged_attention(
+                q, k, v, t, ln, num_splits=1, use_pallas=True, **quant_kw
+            )
+        )
+        try:
+            single_ms = _time(single_fn, operands, iters=2)
+        except Exception as e:  # pragma: no cover - env without Pallas
+            log(f"  kernels: single-pass lane unavailable ({e!r})")
+            single_ms = None
+        rows[name] = {
+            "fmt": fmt,
+            "batch": batch,
+            "heads": heads,
+            "kv_heads": kv_heads,
+            "head_dim": head_dim,
+            "page_size": ps,
+            "pages": pages,
+            "splits": splits,
+            "kernel_ms": round(kernel_ms, 4),
+            "gather_ms": round(gather_ms, 4),
+            "single_ms": round(single_ms, 4) if single_ms else None,
+            "kernel_vs_gather": round(gather_ms / kernel_ms, 3),
+            "single_vs_gather": (
+                round(gather_ms / single_ms, 3) if single_ms else None
+            ),
+        }
+        log(
+            "  kernels %-16s %-5s S=%d kernel %.3fms gather %.3fms "
+            "single %sms -> %.2fx gather"
+            % (
+                name, fmt, splits, kernel_ms, gather_ms,
+                f"{single_ms:.3f}" if single_ms else "-",
+                gather_ms / kernel_ms,
+            )
+        )
+    min_ratio = min(r["kernel_vs_gather"] for r in rows.values())
+    int8_vs_bf16 = None
+    if "b4_gqa_int8" in rows and "b4_gqa_bf16" in rows:
+        int8_vs_bf16 = round(
+            rows["b4_gqa_bf16"]["kernel_ms"] / rows["b4_gqa_int8"]["kernel_ms"],
+            3,
+        )
+    block = {
+        "generation": generation,
+        "shapes": rows,
+        "min_kernel_vs_gather": min_ratio,
+        "int8_vs_bf16": int8_vs_bf16,
+    }
+    log(
+        "perf-ledger row: | KERNELS split-K paged attention (%d shapes) | "
+        "kernel vs gather min %.2fx (int8 vs bf16 %sx; splits from "
+        "%s row) | - | `benchmark.py --model serving --kernel` | update "
+        "on bench round |"
+        % (len(rows), min_ratio, int8_vs_bf16, generation)
+    )
+    return block
+
+
 def _run_overload_phase(eng, args, baseline_tps: float) -> dict:
     """OVERLOAD perf phase: a 2x sustained overload storm with mixed
     priorities through the SAME compiled engine, with the overload
@@ -1173,6 +1365,8 @@ def run_serving(args) -> None:
                 "bit-identical" if tp_match else "DIVERGED",
             )
         )
+    # --- Kernels phase (KERNELS rows): split-K vs gather vs single-pass
+    kernels_block = _run_kernels_phase(args)
     # --- Overload phase (OVERLOAD row): 2x storm, mixed priorities -----
     overload_block = _run_overload_phase(eng, args, overlap_tps)
     # --- Restart phase (RESTART row): cold vs warm arena rehydration ---
@@ -1220,6 +1414,7 @@ def run_serving(args) -> None:
                     "resumes_recomputed": churn_recomputed,
                 },
                 "tp": tp_block,
+                "kernels": kernels_block,
                 "overload": overload_block,
                 "restart": restart_block,
                 "router": router_block,
@@ -1352,6 +1547,15 @@ def main(argv: list[str] | None = None) -> None:
         type=_positive_int,
         default=16,
         help="serving: synthetic requests pushed through the engine",
+    )
+    p.add_argument(
+        "--kernel",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="serving: run the KERNELS phase (split-K paged-attention "
+        "kernel vs the gather fallback vs the old single-pass lane, per "
+        "shape x KV format — the per-shape ledger tools/bench_diff.py "
+        "gates; --no-kernel skips it)",
     )
     p.add_argument(
         "--router-replicas",

@@ -440,6 +440,37 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         self.anomaly.configure(
             "engine.ttft_seconds", warmup=20, z_threshold=6.0, sustain=2
         )
+        # Split-K paged-attention kernel routing (ops/paged_attention.py,
+        # ops/tuning.py): resolve the config's tri-state ONCE, export it,
+        # and surface the two ctor-time fallback decisions an operator
+        # would otherwise discover in a profile — a kernel-on spec engine
+        # still gathers for its multi-token verify pass (single-token
+        # draft/decode steps keep the kernel), and a kernel-on engine on
+        # an unswept TPU generation runs the conservative fallback split
+        # row until a hardware round records a real one.
+        self.kernel_on = paged.kernel_enabled(cfg.quant_kv)
+        if metrics:
+            metrics.kernel_enabled.set(int(self.kernel_on))
+        if self.kernel_on:
+            from ..ops import tuning as _kernel_tuning
+
+            fallback = None
+            if spec_gamma > 0:
+                fallback = "spec_verify"
+            elif (
+                jax.default_backend() == "tpu"
+                and not _kernel_tuning.has_row()
+            ):
+                fallback = "untuned_generation"
+            if fallback is not None:
+                if metrics:
+                    metrics.kernel_fallbacks.inc(reason=fallback)
+                self.flight.record(
+                    "kernel.fallback",
+                    reason=fallback,
+                    generation=_kernel_tuning.device_generation(),
+                    splits=paged.kernel_num_splits,
+                )
         self.profiler = (
             profiler
             if profiler is not None
@@ -1314,6 +1345,8 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     "page_size": self.paged.page_size,
                     "num_pages": self.paged.num_pages,
                     "max_pages_per_seq": self.paged.max_pages_per_seq,
+                    "kernel": self.kernel_on,
+                    "kernel_splits": self.paged.kernel_num_splits,
                     "decode_block": self._decode_block,
                     "admission": "optimistic" if self._optimistic else "reserve",
                     "prefix_sharing": self.prefix_sharing,
@@ -1386,10 +1419,20 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--use-kernel",
         action=argparse.BooleanOptionalAction,
         default=None,
-        help="decode through the Pallas paged-attention kernel instead of "
-        "the gather path (ops/paged_attention.py); default auto — kernel "
-        "on TPU, gather on CPU and (until its Mosaic lowering is "
-        "hardware-proven) for --quant-kv pools",
+        help="decode through the split-K flash-decode paged-attention "
+        "kernel instead of the gather path (ops/paged_attention.py; "
+        "fused int8 dequant, per-generation split tables in "
+        "ops/tuning.py); default auto — gather everywhere until a "
+        "hardware round proves the split-K Mosaic lowering "
+        "(docs/kernels.md)",
+    )
+    p.add_argument(
+        "--kernel-splits",
+        type=_positive_int,
+        default=None,
+        help="pin the paged kernel's split-K degree (default: the "
+        "per-generation tuning table, ops/tuning.py — 1 on CPU smoke "
+        "and short contexts)",
     )
     p.add_argument(
         "--temperature",
@@ -1545,6 +1588,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.num_pages,
         args.max_pages_per_seq,
         use_kernel=args.use_kernel,
+        kernel_num_splits=args.kernel_splits,
     )
     spec_kw = {}
     if args.spec_gamma:

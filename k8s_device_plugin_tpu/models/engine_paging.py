@@ -95,7 +95,15 @@ class PagingMixin:
                 new_att["pool_value"] = (
                     att["pool_value"].at[cover].set(paged_rows(src["cached_value"]))
                 )
-                if "pool_key_scale" in att:  # int8 KV: scales ride along
+                if "pool_key_scale" in att:
+                    # int8 KV: the scale rows CACHE alongside the page
+                    # write — the dense prefill quantized once
+                    # (quantize_kv_pair) and its scale slabs scatter
+                    # here with the codes; nothing later (kernel,
+                    # gather, offload, restore) re-derives a scale.
+                    # Pool-byte accounting (_kv_rows_nbytes) counts the
+                    # two f32 scale pools with the codes — pinned in
+                    # tests/test_engine.py.
                     new_att["pool_key_scale"] = (
                         att["pool_key_scale"]
                         .at[cover]

@@ -149,6 +149,31 @@ class EngineMetrics:
             "tp mesh axis built from the plugin's allocation; 1 = "
             "single-chip).  Set once at engine construction",
         )
+        # Split-K paged-attention kernel routing (ops/paged_attention.py):
+        # whether this engine's decode steps read pages through the
+        # kernel, and ctor-time fallback decisions worth surfacing (the
+        # speculative verify pass riding gather, an untuned generation
+        # running the conservative split row).
+        self.kernel_enabled = registry.gauge(
+            "tpu_engine_kernel_enabled",
+            "1 when the paged decode reads the KV pool through the "
+            "split-K flash-decode kernel, 0 on the gather fallback "
+            "(PagedConfig.use_kernel; auto resolves to gather until a "
+            "hardware round records tuning rows).  Set once at engine "
+            "construction",
+        )
+        self.kernel_fallbacks = registry.counter(
+            "tpu_engine_kernel_fallbacks_total",
+            "Kernel-path fallback decisions at engine construction, by "
+            "reason (spec_verify: the multi-token speculative verify "
+            "pass rides the gather path by design while single-token "
+            "steps keep the kernel; untuned_generation: no reviewed "
+            "ops/tuning.py row for this chip — the kernel runs the "
+            "conservative fallback split row until a hardware round "
+            "records one).  Each pairs with a kernel.fallback flight "
+            "event",
+            ["reason"],
+        )
         self.page_utilization = registry.gauge(
             "tpu_engine_kv_page_utilization",
             "Allocated fraction of the allocatable KV page pool (0..1; "

@@ -1290,10 +1290,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--use-kernel",
         action=argparse.BooleanOptionalAction,
         default=None,
-        help="force the Pallas paged-attention kernel on/off (default: "
-        "gather everywhere — round-5 hardware measured XLA's gather "
-        "faster at moderate contexts; force on for long-context pools "
-        "where max-pages-per-seq far exceeds typical lengths)",
+        help="force the split-K flash-decode paged-attention kernel "
+        "on/off (default: gather everywhere until a hardware round "
+        "proves the split-K Mosaic lowering — docs/kernels.md; force on "
+        "for long-context pools where max-pages-per-seq far exceeds "
+        "typical lengths)",
+    )
+    p.add_argument(
+        "--kernel-splits",
+        type=_positive_int,
+        default=None,
+        help="pin the paged kernel's split-K degree (default: the "
+        "per-generation tuning table, ops/tuning.py)",
     )
     p.add_argument("--spec-gamma", type=int, default=0)
     p.add_argument(
@@ -1652,6 +1660,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.num_pages,
         args.max_pages_per_seq,
         use_kernel=args.use_kernel,
+        kernel_num_splits=args.kernel_splits,
     )
     mesh = None
     if args.tp > 1:

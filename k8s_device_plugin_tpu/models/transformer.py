@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.flash_attention import flash_attention, mha_reference
-from ..ops.quant import Int8DenseGeneral, dequantize_kv, quantize_kv
+from ..ops.quant import Int8DenseGeneral, dequantize_kv, quantize_kv_pair
 
 # Large-negative logit for top-k filtering: finite (softmax/categorical
 # stay NaN-free even if every logit in a row were filtered) yet far below
@@ -53,30 +53,37 @@ class PagedConfig:
     page_size: int = 16
     num_pages: int = 256
     max_pages_per_seq: int = 16
-    # Read pages through the Pallas paged-attention kernel
+    # Read pages through the split-K flash-decode paged-attention kernel
     # (ops/paged_attention.py: scalar-prefetched page table, O(len) HBM
-    # traffic) instead of materializing the gathered [max_len] view.
-    # Sliding windows mask inside the kernel (attention_window composes),
-    # and int8 KV pools (quant_kv) stream as int8 with their scale pools
-    # riding along — half the decode traffic.
-    # None = auto: the GATHER path everywhere.  Round-5 hardware (the
-    # first session with the r4 in-program-table engine, BASELINE.md)
-    # measured the kernel LOSING to XLA's gather+einsum both standalone
-    # (0.82-0.91x at len 512-2048, ps 16/32) and at the engine step
-    # (-56 ms/step at b8) — round 3's +19 ms/step kernel win predates the
-    # r4 rework that made the gather path cheap, and at these shapes the
-    # gather's over-read is small (max_pages*ps vs len: ~1.25x at the
-    # measured configs).  The kernel's O(len) traffic wins when
-    # max_len >> typical len (long-context pools); force it there with
-    # use_kernel=True (Mosaic-proven for bf16 AND int8 pools — round-5
-    # parity maxerr <= 5.9e-3 across GQA/window/d128).  The engine-level
-    # int8 kernel-vs-dequant-gather A/B (hw_sweep int8_ab) was cut off by
-    # the 09:37 UTC relay wedge; until it lands, auto stays gather for
-    # quant_kv too.  Explicit False forces gather.
+    # traffic, each row's page list partitioned across a split grid axis
+    # with an exact online-softmax combine) instead of materializing the
+    # gathered [max_len] view.  Sliding windows mask inside the kernel
+    # (attention_window composes), and int8 KV pools (quant_kv) stream
+    # as int8 with their scale pools riding along and dequantization
+    # fused onto the score matrix — no bf16 copy ever lands in HBM.
+    # On CPU the same split-K math runs as a vectorized XLA program
+    # (the interpreter is a parity lane, not a serving path), which is
+    # what moved the KERNELS smoke ledger from 0.06-0.12x of the gather
+    # path to >=1x (benchmark.py --kernel).
+    # None = auto: the GATHER path everywhere, still.  Round-5 hardware
+    # measured the OLD single-pass kernel losing to XLA's gather+einsum
+    # at moderate contexts (0.82-0.91x standalone, -56 ms/step at b8,
+    # BASELINE.md); the split-K rewrite changes that math's schedule but
+    # has not yet had a Mosaic hardware round, so auto stays gather
+    # until one records tuning rows (ops/tuning.py, docs/kernels.md
+    # "Fallback & parity contract").  Explicit True forces the kernel
+    # (all pool formats); explicit False forces gather.
     use_kernel: bool | None = None
+    # Split-K degree override: None = the per-generation tuning table
+    # (ops/tuning.py — degenerate 1-split on CPU and short contexts,
+    # where the combine stage is skipped entirely).
+    kernel_num_splits: Optional[int] = None
 
     def kernel_enabled(self, quant_kv: bool = False) -> bool:
-        """Resolve the tri-state ``use_kernel`` at trace time."""
+        """Resolve the tri-state ``use_kernel`` at trace time (auto =
+        gather until a hardware round proves the split-K Mosaic lowering
+        — the engine meters the resolution via tpu_engine_kernel_enabled
+        and `kernel.fallback` flight events, models/engine.py)."""
         if self.use_kernel is None:
             return False
         return self.use_kernel
@@ -378,8 +385,13 @@ class CausalSelfAttention(nn.Module):
                 psv = self.variable(
                     "cache", "pool_value_scale", jnp.zeros, sshape, jnp.float32
                 )
-                k_store, ks = quantize_kv(k)
-                v_store, vs = quantize_kv(v)
+                # ONE fused quantization pass per append: the K/V pair
+                # stacks through a single amax/round/clip, and the scale
+                # rows land in the scale pools alongside the page write —
+                # nothing downstream (graft, kernel, gather) ever
+                # re-derives a scale (ops/quant.py quantize_kv_pair;
+                # bit-identical to two quantize_kv calls).
+                k_store, v_store, ks, vs = quantize_kv_pair(k, v)
             else:
                 pk = self.variable("cache", "pool_key", jnp.zeros, pool_shape, k.dtype)
                 pv = self.variable("cache", "pool_value", jnp.zeros, pool_shape, v.dtype)
@@ -430,7 +442,9 @@ class CausalSelfAttention(nn.Module):
                 # window masks inside the kernel (and skips wholly-dead
                 # pages), mirroring the gather path's mask.  int8 pools
                 # (quant_kv) stream as int8 — half the traffic — with
-                # their scale pools riding along.
+                # their scale pools riding along and dequantization fused
+                # onto the score matrix.  The split degree comes from the
+                # per-generation tuning table unless pinned on the config.
                 attn = paged_attention(
                     q[:, 0],
                     pk.value,
@@ -440,6 +454,7 @@ class CausalSelfAttention(nn.Module):
                     window=cfg.attention_window,
                     scale_k=psk.value if cfg.quant_kv else None,
                     scale_v=psv.value if cfg.quant_kv else None,
+                    num_splits=pg.kernel_num_splits,
                 )[:, None]
             else:
                 # Gather each row's pages into its logical [max_len] view.
@@ -491,8 +506,8 @@ class CausalSelfAttention(nn.Module):
                 cvs = self.variable(
                     "cache", "cached_value_scale", jnp.zeros, sshape, jnp.float32
                 )
-                kq, ks = quantize_kv(k)
-                vq, vs = quantize_kv(v)
+                # Same fused K/V pair quantization as the paged append.
+                kq, vq, ks, vs = quantize_kv_pair(k, v)
                 ck.value = jax.lax.dynamic_update_slice(ck.value, kq, (0, cur, 0, 0))
                 cv.value = jax.lax.dynamic_update_slice(cv.value, vq, (0, cur, 0, 0))
                 cks.value = jax.lax.dynamic_update_slice(cks.value, ks, (0, cur, 0))

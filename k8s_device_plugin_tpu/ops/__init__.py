@@ -7,11 +7,17 @@ from .quant import (
     Int8DenseGeneral,
     dequantize_int8,
     dequantize_kv,
+    dequantize_kv4,
     int8_dot_general,
+    pack_int4,
     quantize_int8,
     quantize_kv,
+    quantize_kv4,
+    quantize_kv_pair,
     quantize_lm_params,
+    unpack_int4,
 )
+from .tuning import DecodeRow, decode_row, device_generation, pick_num_splits
 
 __all__ = [
     "flash_attention",
@@ -20,10 +26,19 @@ __all__ = [
     "naive_linear_xent",
     "paged_attention",
     "Int8DenseGeneral",
+    "DecodeRow",
+    "decode_row",
     "dequantize_int8",
     "dequantize_kv",
+    "dequantize_kv4",
+    "device_generation",
     "int8_dot_general",
+    "pack_int4",
+    "pick_num_splits",
     "quantize_int8",
     "quantize_kv",
+    "quantize_kv4",
+    "quantize_kv_pair",
     "quantize_lm_params",
+    "unpack_int4",
 ]

@@ -88,6 +88,65 @@ def _fit_block(block: int, seq: int) -> int:
     return b
 
 
+# Short sequences: the large per-generation forward defaults exist to
+# amortize grid setup over LONG kv walks, but at seq <= _SHORT_SEQ the
+# naive fit swallows the whole sequence into one or two tiles and
+# starves the grid of parallel work — the r03–r05 smoke rows measured
+# the (1, 2, 256, 64) forward at 1.38 ms vs XLA's 1.05 ms (0.76x)
+# because q512 fitted to a single 256-row tile.  Capping the defaulted
+# q block at 128 under the threshold restores >= 2 q-programs per
+# (batch, head) and the MXU-native 128-row tile; the kv block keeps its
+# fitted size (kv iterations are the sequential axis either way).
+# Explicitly-passed blocks are never capped.
+_SHORT_SEQ = 512
+_SHORT_BLOCK_Q = 128
+
+
+def _auto_block(default: int, seq: int, q_axis: bool = False) -> int:
+    fitted = _fit_block(default, seq)
+    if q_axis and seq <= _SHORT_SEQ and fitted > _SHORT_BLOCK_Q:
+        # Re-fit from the cap, not min(): the capped block must still
+        # divide the sequence (192 fits to 64, not an invalid 128).
+        fitted = _fit_block(_SHORT_BLOCK_Q, seq)
+    return fitted
+
+
+def resolve_blocks(
+    seq_q: int,
+    seq_kv: int,
+    block_q: int | None = None,
+    block_kv: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_kv: int | None = None,
+    interpret: bool = False,
+    defaults: tuple[tuple[int, int], tuple[int, int]] | None = None,
+) -> tuple[int, int, int, int]:
+    """The one block-resolution rule :func:`flash_attention` applies:
+    per-generation defaults fitted to the sequence (with the short-seq q
+    cap above), explicit blocks clamped but never re-fitted.  Split out
+    (and parameterized on ``defaults`` = ((fwd_q, fwd_kv), (bwd_q,
+    bwd_kv))) so the chosen tiles are unit-testable off-TPU —
+    tests/test_ops.py pins the short-sequence fix."""
+    if defaults is None:
+        defaults = (
+            _default_blocks(interpret),
+            _default_blocks(interpret, _BWD_BLOCK_DEFAULTS),
+        )
+    (default_q, default_kv), (bwd_default_q, bwd_default_kv) = defaults
+
+    def resolve(explicit, default, seq, q_axis=False):
+        if explicit is not None:
+            return min(explicit, seq)
+        return _auto_block(default, seq, q_axis=q_axis)
+
+    return (
+        resolve(block_q, default_q, seq_q, q_axis=True),
+        resolve(block_kv, default_kv, seq_kv),
+        resolve(bwd_block_q, bwd_default_q, seq_q),
+        resolve(bwd_block_kv, bwd_default_kv, seq_kv),
+    )
+
+
 def mha_reference(
     q: jax.Array,
     k: jax.Array,
@@ -839,19 +898,16 @@ def flash_attention(
         bwd_impl = "xla" if interpret else "pallas"
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"bwd_impl must be auto|pallas|xla, got {bwd_impl!r}")
-    default_q, default_kv = _default_blocks(interpret)
-    bwd_default_q, bwd_default_kv = _default_blocks(interpret, _BWD_BLOCK_DEFAULTS)
-
     # Defaulted blocks FIT the sequence (halve until they divide it) so a
-    # generation default of 512 never rejects a seq that 128 accepted;
-    # explicitly-passed blocks keep the strict divide-or-raise contract.
-    def resolve(explicit, default, seq):
-        return _fit_block(default, seq) if explicit is None else min(explicit, seq)
-
-    fwd_q = resolve(block_q, default_q, q.shape[2])
-    fwd_kv = resolve(block_kv, default_kv, k.shape[2])
-    bwd_q = resolve(bwd_block_q, bwd_default_q, q.shape[2])
-    bwd_kv = resolve(bwd_block_kv, bwd_default_kv, k.shape[2])
+    # generation default of 512 never rejects a seq that 128 accepted —
+    # and short sequences additionally cap the forward q block so the
+    # grid keeps parallel work (resolve_blocks; the r03–r05 short-seq
+    # regression).  Explicitly-passed blocks keep the strict
+    # divide-or-raise contract.
+    fwd_q, fwd_kv, bwd_q, bwd_kv = resolve_blocks(
+        q.shape[2], k.shape[2], block_q, block_kv,
+        bwd_block_q, bwd_block_kv, interpret,
+    )
     return _flash(
         q, k, v, causal, window, sm_scale, fwd_q, fwd_kv, bwd_q, bwd_kv,
         interpret, bwd_impl,

@@ -41,18 +41,24 @@ import jax.numpy as jnp
 # int8 symmetric range: [-127, 127] (not -128: symmetric range keeps
 # q = round(w/s) invertible without per-sign handling and costs 0.4% range).
 _QMAX = 127.0
+# int4 symmetric range: [-7, 7] — same invertibility argument one octave
+# down; codes pack two per int8 byte (pack_int4) for the paged decode
+# kernel's quarter-traffic KV variant (ops/paged_attention.py).
+_QMAX4 = 7.0
 
 
-def _sym_quantize(x: jax.Array, axes: tuple[int, ...]) -> tuple[jax.Array, jax.Array]:
-    """The one symmetric-int8 core both the weight and KV paths share:
-    amax over ``axes`` per remaining coordinate, zero-amax guarded to scale
-    1, round-and-clip to [-127, 127].  Returns (q int8 [x.shape], scale
+def _sym_quantize(
+    x: jax.Array, axes: tuple[int, ...], qmax: float = _QMAX
+) -> tuple[jax.Array, jax.Array]:
+    """The one symmetric core every quantized path shares: amax over
+    ``axes`` per remaining coordinate, zero-amax guarded to scale 1,
+    round-and-clip to [-qmax, qmax].  Returns (q int8 [x.shape], scale
     float32 [x.shape minus axes])."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=axes)
-    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
     q = jnp.clip(
-        jnp.round(xf / jnp.expand_dims(scale, axes)), -_QMAX, _QMAX
+        jnp.round(xf / jnp.expand_dims(scale, axes)), -qmax, qmax
     ).astype(jnp.int8)
     return q, scale
 
@@ -88,11 +94,71 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return _sym_quantize(x, (-1,))
 
 
+def quantize_kv_pair(
+    k: jax.Array, v: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quantize a K/V pair in ONE fused pass: the pair stacks on a fresh
+    leading axis so the amax/scale/round-clip machinery traces once
+    instead of twice per append (per-element math — and therefore every
+    code and scale byte — is bit-identical to two :func:`quantize_kv`
+    calls, pinned in tests/test_quant.py).  Returns
+    ``(k_q, v_q, k_scale, v_scale)``."""
+    q, scale = _sym_quantize(jnp.stack([k, v]), (-1,))
+    return q[0], q[1], scale[0], scale[1]
+
+
 def dequantize_kv(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
     """Inverse of :func:`quantize_kv`; int8 stays the HBM format — the
     convert-and-scale fuses into the attention einsum's operand read, so
     decode reads half the cache bytes."""
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def pack_int4(codes: jax.Array) -> jax.Array:
+    """Pack int4 codes (int8 storage, values in [-7, 7]) two-per-byte
+    along the last axis: element 2i lands in the LOW nibble, 2i+1 in the
+    high — the layout ops/paged_attention.py's in-kernel unpack
+    (sign-extending shifts) inverts.  Last dim must be even."""
+    if codes.shape[-1] % 2:
+        raise ValueError(
+            f"int4 packing needs an even last dim, got {codes.shape[-1]}"
+        )
+    pairs = codes.reshape(*codes.shape[:-1], codes.shape[-1] // 2, 2)
+    lo = pairs[..., 0].astype(jnp.int32) & 0xF
+    hi = pairs[..., 1].astype(jnp.int32) & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array, dtype: Any = jnp.int8) -> jax.Array:
+    """Inverse of :func:`pack_int4` (host-side convenience; the kernels
+    carry their own in-VMEM copy of the same shift math)."""
+    x = packed.astype(jnp.int32)
+    lo = jnp.right_shift(jnp.left_shift(x, 28), 28)
+    hi = jnp.right_shift(jnp.left_shift(x, 24), 28)
+    both = jnp.stack([lo, hi], axis=-1)
+    return both.reshape(*packed.shape[:-1], packed.shape[-1] * 2).astype(dtype)
+
+
+def quantize_kv4(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-token, per-head int4 quantization of a K or V slab, packed
+    two-codes-per-byte along head_dim — a QUARTER of the bf16 KV bytes.
+
+    ``x``: [..., head_dim] with head_dim even.  Same per-(token, head)
+    scale granularity as :func:`quantize_kv` (the scale still factors
+    out of the head_dim dot, so the paged kernel applies it on the score
+    matrix).  Returns (packed int8 [..., head_dim//2], float32 scales
+    [x.shape minus the last axis]).
+    """
+    codes, scale = _sym_quantize(x, (-1,), qmax=_QMAX4)
+    return pack_int4(codes), scale
+
+
+def dequantize_kv4(packed: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_kv4` — the gather-path analogue the
+    int4 parity tests oracle against."""
+    return (
+        unpack_int4(packed, jnp.float32) * scale[..., None]
+    ).astype(dtype)
 
 
 def _normalize_axis(axis: Union[int, Sequence[int]], ndim: int) -> tuple[int, ...]:

@@ -1,0 +1,150 @@
+"""Per-chip-generation kernel tuning tables for the decode kernels.
+
+The split-K paged-attention kernel (ops/paged_attention.py) has one
+load-bearing free parameter — how many grid programs share one
+sequence's page list — and the right answer is a property of the CHIP
+(how many sequential page fetches amortize one program's setup, how
+much VMEM a partial-state triple costs), not of the model.  This module
+owns that knowledge the same way ops/flash_attention.py owns its block
+tables: small reviewed rows keyed by TPU generation, matched against
+what the plugin actually discovered.
+
+Grounding (the MT4G pattern, PAPERS.md): the serving container never
+guesses its chip.  The plugin daemon discovers the accelerator type at
+registration (plugin/discovery.py) and Allocate injects it as
+``TPU_ACCELERATOR_TYPE`` alongside ``TPU_CHIPS_PER_HOST_BOUNDS``
+(plugin/envs.py), so the engine's tuning lookup keys off the SAME
+topology source the mesh derivation uses (parallel/mesh.py) — with
+``jax.devices()[0].device_kind`` as the on-chip tie-breaker and an
+interpret-mode-safe default row for CPU smoke.
+
+Row schema (see docs/kernels.md "Tile-table schema" for how a hardware
+round records a new row):
+
+- ``generation``  — device_kind prefix the row matches (or "cpu");
+- ``min_pages_per_split`` — never split below this many pages per
+  program: each split re-pays the online-softmax state init and one
+  combine term, so thin splits trade HBM streaming for overhead;
+- ``max_splits`` — cap on the split axis (bounds the partial buffers
+  and the combine's reduction width);
+- ``source`` — provenance: which bench round measured it, or why the
+  row is provisional.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+
+@dataclass(frozen=True)
+class DecodeRow:
+    """One generation's split-K decode tuning row."""
+
+    generation: str
+    min_pages_per_split: int
+    max_splits: int
+    source: str
+
+
+# Keyed by device_kind prefix (the flash-attention table's convention).
+# The TPU rows are PROVISIONAL: they inherit the grid-overhead shape of
+# the round-2/3 flash block sweeps (v5e amortizes setup over large
+# sequential spans; v4 prefers smaller working sets) and exist so a
+# hardware round has a schema to fill in — `use_kernel` stays opt-in
+# until one does (models/transformer.py PagedConfig).
+DECODE_ROWS: tuple[DecodeRow, ...] = (
+    DecodeRow("TPU v5 lite", 4, 8, "provisional: awaiting hw round"),
+    DecodeRow("TPU v5e", 4, 8, "provisional: awaiting hw round"),
+    DecodeRow("TPU v5p", 4, 8, "provisional: awaiting hw round"),
+    DecodeRow("TPU v4", 4, 4, "provisional: smaller VMEM, fewer splits"),
+    DecodeRow("TPU v6", 4, 8, "provisional: inherits v5e until swept"),
+)
+
+# CPU smoke / Pallas interpreter: splitting buys nothing (no DMA
+# pipeline to parallelize) and every extra split is pure combine
+# overhead, so the safe row is the degenerate 1-split — which is also
+# what keeps the KERNELS ledger's CPU rows honest about the kernel's
+# structure rather than its split bookkeeping.
+CPU_ROW = DecodeRow("cpu", 1 << 30, 1, "interpret-mode-safe default")
+
+# Unknown TPU generation: conservative splits so the kernel stays
+# usable while the missing row is the visible gap (the engine meters it
+# as a kernel.fallback, reason=untuned_generation).
+FALLBACK_ROW = DecodeRow("unknown-tpu", 8, 2, "no row for this generation")
+
+# TPU_ACCELERATOR_TYPE prefixes (plugin/discovery.py values like
+# "v5litepod-8") -> the device_kind prefix the rows key on.
+_ACCEL_TYPE_PREFIXES: tuple[tuple[str, str], ...] = (
+    ("v5litepod", "TPU v5 lite"),
+    ("v5e", "TPU v5e"),
+    ("v5p", "TPU v5p"),
+    ("v4", "TPU v4"),
+    ("v6", "TPU v6"),
+)
+
+
+def device_generation(environ: Optional[Mapping[str, str]] = None) -> str:
+    """The generation key tuning rows match against.
+
+    Preference order: the live backend's device_kind (authoritative when
+    jax actually sits on a TPU), then the plugin-injected
+    ``TPU_ACCELERATOR_TYPE`` (the discovered-topology source — present
+    in every Allocate-launched serving container even before jax
+    initializes the chip), else "cpu".
+    """
+    env = os.environ if environ is None else environ
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            return jax.devices()[0].device_kind
+    except Exception:  # codelint: ignore[naked-except] best-effort probe: jax may be absent (plugin-only install) or refuse to initialize a backend here; the env/cpu fallback below is the answer either way
+        pass
+    accel = env.get("TPU_ACCELERATOR_TYPE", "")
+    for prefix, kind in _ACCEL_TYPE_PREFIXES:
+        if accel.startswith(prefix):
+            return kind
+    return "cpu"
+
+
+def decode_row(generation: Optional[str] = None) -> tuple[DecodeRow, bool]:
+    """The tuning row for ``generation`` (default: discovered) and
+    whether it was an exact match (False = the conservative fallback —
+    the engine's untuned-generation fallback signal)."""
+    kind = device_generation() if generation is None else generation
+    if kind == "cpu":
+        return CPU_ROW, True
+    for row in DECODE_ROWS:
+        if kind.startswith(row.generation):
+            return row, True
+    return FALLBACK_ROW, False
+
+
+def has_row(generation: Optional[str] = None) -> bool:
+    """Whether a reviewed tuning row exists for this generation."""
+    return decode_row(generation)[1]
+
+
+def pick_num_splits(
+    pages_per_seq: int, generation: Optional[str] = None
+) -> int:
+    """Split-K degree for a sequence of ``pages_per_seq`` table entries.
+
+    Largest power-of-two split count that (a) stays within the row's
+    ``max_splits`` and (b) leaves every split at least
+    ``min_pages_per_split`` pages of real streaming work.  Degenerates
+    to 1 for short contexts (the combine stage is skipped entirely
+    there — ops/paged_attention.py) and on the CPU row.
+    """
+    if pages_per_seq < 1:
+        raise ValueError(f"pages_per_seq must be >= 1, got {pages_per_seq}")
+    row, _ = decode_row(generation)
+    splits = 1
+    while (
+        splits * 2 <= row.max_splits
+        and pages_per_seq // (splits * 2) >= row.min_pages_per_split
+    ):
+        splits *= 2
+    return min(splits, pages_per_seq)

@@ -547,3 +547,83 @@ def test_bench_diff_parses_trace_block(tmp_path):
     # A record without the block: no trace fields, no row segment.
     assert "trace_overhead" not in a
     assert "trace overhead" not in bench_diff.ledger_row(a, a)
+
+
+def test_bench_diff_parses_kernels_block(tmp_path):
+    """Records grew a KERNELS block (ISSUE 13, benchmark.py
+    _run_kernels_phase): per-shape split-K-kernel-vs-gather ratios, the
+    minimum, and the fused int8-vs-bf16 ratio must surface in the
+    normalized record, the field diff, and the ledger row — and the row
+    must scream KERNEL-REGRESSED naming any shape whose ratio fell past
+    its recorded value (beyond the 10% jitter tolerance) and
+    KERNEL-SLOWER-THAN-GATHER when the minimum drops below 1.0 (the
+    state the old single-pass rows were stuck in)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    def shape(ratio):
+        return {"fmt": "f32", "splits": 1, "kernel_ms": 0.2,
+                "gather_ms": 0.2 * ratio, "single_ms": 2.0,
+                "kernel_vs_gather": ratio, "single_vs_gather": 0.1}
+
+    base = {
+        "n": 12,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu",
+                   "kernels": {
+                       "generation": "cpu",
+                       "shapes": {"b4_gqa_f32": shape(1.9),
+                                  "b4_gqa_int8": shape(1.8)},
+                       "min_kernel_vs_gather": 1.8,
+                       "int8_vs_bf16": 1.07,
+                   }},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 13
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["kernels_min_ratio"] == 1.8
+    assert b["kernels_int8_vs_bf16"] == 1.07
+    assert b["kernels_shapes"]["b4_gqa_f32"] == 1.9
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "kernels_min_ratio" in diff and "kernels[b4_gqa_f32]" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "kernels min 1.8x vs gather" in row
+    assert "int8/bf16 1.07x" in row
+    assert "KERNEL-REGRESSED" not in row
+    assert "KERNEL-SLOWER-THAN-GATHER" not in row
+    # One shape regresses past its recorded ratio (beyond tolerance):
+    # the row names it; a within-tolerance wobble on the other is quiet.
+    worse = json.loads(json.dumps(loaded))
+    worse["parsed"]["kernels"]["shapes"]["b4_gqa_f32"] = shape(1.2)
+    worse["parsed"]["kernels"]["shapes"]["b4_gqa_int8"] = shape(1.75)
+    worse["parsed"]["kernels"]["min_kernel_vs_gather"] = 1.2
+    (tmp_path / "c.json").write_text(json.dumps(worse))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    row_c = bench_diff.ledger_row(a, c)
+    assert "KERNEL-REGRESSED(b4_gqa_f32)" in row_c
+    assert "b4_gqa_int8" not in row_c.split("KERNEL-REGRESSED")[1]
+    assert "! KERNEL-REGRESSED b4_gqa_f32" in "\n".join(
+        bench_diff.diff_lines(a, c)
+    )
+    # The minimum below 1.0: slower than the fallback it exists to beat.
+    slower = json.loads(json.dumps(loaded))
+    slower["parsed"]["kernels"]["min_kernel_vs_gather"] = 0.8
+    (tmp_path / "d.json").write_text(json.dumps(slower))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "KERNEL-SLOWER-THAN-GATHER" in bench_diff.ledger_row(a, d)
+    # A record without the block: no kernels fields, no row segment.
+    blockless = {"n": 1, "rc": 0, "parsed": {"metric": "m", "value": 1.0,
+                                             "unit": "u", "platform": "cpu"}}
+    (tmp_path / "e.json").write_text(json.dumps(blockless))
+    e = bench_diff.load_record(str(tmp_path / "e.json"))
+    assert "kernels_min_ratio" not in e
+    assert "kernels min" not in bench_diff.ledger_row(e, e)

@@ -477,6 +477,21 @@ def test_engine_with_int8_paged_kv(rng):
     for (prompt, n), req in zip(jobs, reqs):
         assert req.tokens == _oracle(cfg, params, prompt, n), prompt
     assert len(eng.free_pages) == paged.num_pages - 1
+    # Pool-byte accounting pin (ISSUE 13 satellite): the scale rows are
+    # CACHED alongside every page write — the decode append quantizes
+    # once (quantize_kv_pair) and the graft copies the dense prefill's
+    # scale slabs; nothing downstream re-derives a scale — so a
+    # quant_kv page's host-arena footprint is exactly the int8 K/V
+    # codes plus the two f32 scale rows, per layer, unchanged by the
+    # fused-quantization rework.
+    rows = eng._kv_read_page_rows(1)
+    assert set(rows["layer_0"]) == {
+        "pool_key", "pool_value", "pool_key_scale", "pool_value_scale"
+    }
+    ps, hk, hd = paged.page_size, cfg.kv_heads, cfg.head_dim
+    codes = 2 * ps * hk * hd  # int8: 1 byte each
+    scales = 2 * ps * hk * 4  # f32 scale rows riding the page
+    assert eng._kv_rows_nbytes(rows) == cfg.num_layers * (codes + scales)
 
 
 def test_engine_int8_kv_composes_with_window_and_spec(rng):
@@ -519,6 +534,7 @@ def test_kernel_with_int8_paged_kv(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+@pytest.mark.slow
 def test_kernel_int8_kv_composes_with_window(rng):
     """use_kernel + quant_kv + sliding window: int8 pages stream through
     the windowed kernel mask while reclamation re-points scrolled
@@ -559,6 +575,7 @@ def test_spec_engine_matches_dense_oracle(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+@pytest.mark.slow
 def test_spec_engine_composes_with_window_and_kernel(rng):
     """Speculation + sliding window + the paged kernel (single-token
     draft steps ride the kernel, the multi-token verify rides the gather
@@ -722,8 +739,11 @@ def test_engine_fuzz_random_schedules(rng):
     cfg = _cfg()
     params = _params(cfg, rng)
     npr = np.random.RandomState(7)
+    # One geometry trial: the second (pow2-ps) geometry is covered
+    # by every targeted test above, and the full randomized blanket
+    # (feature-matrix fuzz) rides --slow since ISSUE 13.
     for trial, (ps, n_pages, mpp, slots) in enumerate(
-        [(3, 12, 9, 2), (4, 9, 6, 3)]
+        [(3, 12, 9, 2)]
     ):
         paged = PagedConfig(page_size=ps, num_pages=n_pages, max_pages_per_seq=mpp)
         eng = ServingEngine(cfg, params, paged, max_slots=slots)
@@ -757,7 +777,10 @@ def test_chunked_prefill_matches_oracle(rng):
     paged = PagedConfig(page_size=4, num_pages=32, max_pages_per_seq=8)
     prompt = [3, 141, 59, 265, 35, 7, 7, 3, 1, 2, 9, 4]  # bucket 16
     want = _oracle(cfg, params, prompt, 6)
-    for chunk in (4, 16, 32):
+    # chunk=16 (at bucket) and chunk=32 (above) are the SAME
+    # single-chunk path for this 12-token/bucket-16 prompt — one
+    # arm covers both; below-bucket (4) is the real chunked path.
+    for chunk in (4, 32):
         eng = ServingEngine(
             cfg, params, paged, max_slots=2, prefill_chunk=chunk
         )
@@ -888,6 +911,7 @@ def _assert_tokens_match_or_quant_tie(
         )
 
 
+@pytest.mark.slow
 def test_engine_feature_matrix_fuzz(rng):
     """Randomized blanket over the COMPOSED feature matrix: window x
     kernel x quant_kv x (speculation | decode blocks) x admission x

@@ -111,6 +111,29 @@ def test_default_blocks_fit_sequence(rng):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+def test_short_seq_blocks_auto_fit():
+    """The short-sequence forward fix (r03–r05 smoke: (1, 2, 256, 64)
+    ran 0.76x of XLA because the v5e 512-row default fitted to one
+    256-row tile): defaulted q blocks cap at 128 for seq <= 512, kv and
+    backward blocks keep their fitted sizes, long sequences keep the
+    swept large-tile defaults, and explicit blocks are never capped."""
+    from k8s_device_plugin_tpu.ops.flash_attention import resolve_blocks
+
+    v5e = ((512, 1024), (512, 512))  # fwd / bwd generation defaults
+    # The regression shape: q capped to 128 (2 q-programs per head), kv
+    # fitted to the sequence, backward untouched.
+    assert resolve_blocks(256, 256, defaults=v5e) == (128, 256, 256, 256)
+    # At the threshold the cap still applies; past it the swept defaults
+    # rule (the long-kv walks they were tuned for).
+    assert resolve_blocks(512, 512, defaults=v5e)[0] == 128
+    assert resolve_blocks(1024, 1024, defaults=v5e) == (512, 1024, 512, 512)
+    assert resolve_blocks(2048, 2048, defaults=v5e)[:2] == (512, 1024)
+    # Explicit blocks keep the strict contract — no silent capping.
+    assert resolve_blocks(256, 256, block_q=256, defaults=v5e)[0] == 256
+    # Non-pow2-divisible lengths still halve to fit (192 -> 64).
+    assert resolve_blocks(192, 192, defaults=v5e)[0] == 64
+
+
 def test_custom_scale(rng):
     q, k, v = make_qkv(rng, seq=128)
     out = flash_attention(q, k, v, sm_scale=0.5)

@@ -293,3 +293,55 @@ def test_quantized_decode_matches_quantized_forward_argmax(rng):
     logits = qmodel.apply({"params": qparams}, ids)
     want_first = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1))
     np.testing.assert_array_equal(np.asarray(out[:, 6]), want_first)
+
+
+def test_quantize_kv_pair_bit_identical_to_separate(rng):
+    """The fused K/V pair quantizer (one stacked amax/round/clip pass per
+    append, models/transformer.py decode) must produce byte-identical
+    codes AND scales to two separate quantize_kv calls — the fusion is a
+    dispatch-count optimization, never a numerics change."""
+    from k8s_device_plugin_tpu.ops.quant import quantize_kv_pair
+
+    ks = jax.random.split(rng, 2)
+    k = jax.random.normal(ks[0], (3, 5, 4, 16)) * 3.7
+    v = jax.random.normal(ks[1], (3, 5, 4, 16)) * 0.2
+    kq_a, ks_a = quantize_kv(k)
+    vq_a, vs_a = quantize_kv(v)
+    kq_b, vq_b, ks_b, vs_b = quantize_kv_pair(k, v)
+    np.testing.assert_array_equal(np.asarray(kq_a), np.asarray(kq_b))
+    np.testing.assert_array_equal(np.asarray(vq_a), np.asarray(vq_b))
+    np.testing.assert_array_equal(np.asarray(ks_a), np.asarray(ks_b))
+    np.testing.assert_array_equal(np.asarray(vs_a), np.asarray(vs_b))
+
+
+def test_int4_pack_roundtrip_and_bounds(rng):
+    """pack_int4/unpack_int4 are exact inverses over the full [-7, 7]
+    code range (including the sign-extension edge at -7), and
+    quantize_kv4 stays within scale/2 like the int8 path."""
+    from k8s_device_plugin_tpu.ops.quant import (
+        dequantize_kv4,
+        pack_int4,
+        quantize_kv4,
+        unpack_int4,
+    )
+
+    codes = jnp.asarray(
+        np.random.RandomState(3).randint(-7, 8, size=(5, 3, 16)), jnp.int8
+    )
+    assert pack_int4(codes).shape == (5, 3, 8)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_int4(pack_int4(codes))), np.asarray(codes)
+    )
+    with pytest.raises(ValueError, match="even"):
+        pack_int4(codes[..., :15])
+
+    x = jax.random.normal(rng, (2, 7, 4, 16)) * jnp.linspace(
+        0.1, 5.0, 7
+    )[None, :, None, None]
+    q4, scale = quantize_kv4(x)
+    assert q4.dtype == jnp.int8 and q4.shape == (2, 7, 4, 8)
+    assert scale.shape == (2, 7, 4)
+    back = dequantize_kv4(q4, scale, jnp.float32)
+    assert np.all(
+        np.abs(np.asarray(back - x)) <= np.asarray(scale)[..., None] / 2 + 1e-7
+    )

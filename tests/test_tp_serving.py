@@ -174,6 +174,34 @@ def test_engine_ctor_places_state_and_reports_tp(shared_engine):
     assert "tpu_engine_tp_size 2" in registry.render()
 
 
+def test_kernel_engine_sharding_contract_survives_split_k(shared_engine):
+    """The split-K kernel rework (ISSUE 13) changes HOW pages are read,
+    not the cache layout: a use_kernel=True engine (with a pinned split
+    degree) built sharded must satisfy the same per-leaf contract — KV
+    pools partitioned on the kv-heads axis, table/chain replicated —
+    with every leaf covered (the kernel's page blocks then stream each
+    chip's own head shard; no new leaf escapes the lint).  Ctor-only:
+    no jit programs are built."""
+    from k8s_device_plugin_tpu.models.engine import ServingEngine
+    from k8s_device_plugin_tpu.models.transformer import PagedConfig
+
+    cfg, params, _ = shared_engine
+    paged = PagedConfig(
+        page_size=4, num_pages=16, max_pages_per_seq=8,
+        use_kernel=True, kernel_num_splits=2,
+    )
+    eng = ServingEngine(cfg, params, paged, max_slots=2, mesh=_mesh2())
+    assert eng.kernel_on
+    assert eng.assert_sharded() > 0
+    for pool in ("pool_key", "pool_value"):
+        leaf = eng.cache["layer_0"]["attn"][pool]
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        assert shard[2] * 2 == leaf.shape[2], pool
+    state = eng.debug_state()
+    assert state["config"]["kernel"] is True
+    assert state["config"]["kernel_splits"] == 2
+
+
 def test_engine_ctor_rejects_indivisible_kv_heads(shared_engine):
     from k8s_device_plugin_tpu.models.engine import ServingEngine
     from k8s_device_plugin_tpu.models.transformer import PagedConfig

@@ -162,6 +162,23 @@ def load_record(path: str) -> dict:
         if isinstance(trace, dict):
             rec["trace_overhead"] = trace.get("overhead")
             rec["trace_spans"] = trace.get("spans_recorded")
+        # Kernels block (KERNELS serving rows, benchmark.py
+        # _run_kernels_phase): per-shape split-K-kernel-vs-gather
+        # ratios plus the fused int8-vs-bf16 decode ratio.  The
+        # regression tells: any shape's ratio sagging more than 10%
+        # below its previously recorded value (KERNEL-REGRESSED names
+        # the shapes), or the minimum ratio dropping below 1.0 — a
+        # kernel slower than its own fallback (KERNEL-SLOWER-THAN-
+        # GATHER) is the exact state the old single-pass ledger rows
+        # were stuck in.
+        kernels = parsed.get("kernels")
+        if isinstance(kernels, dict):
+            rec["kernels_min_ratio"] = kernels.get("min_kernel_vs_gather")
+            rec["kernels_int8_vs_bf16"] = kernels.get("int8_vs_bf16")
+            rec["kernels_shapes"] = {
+                name: (shape or {}).get("kernel_vs_gather")
+                for name, shape in (kernels.get("shapes") or {}).items()
+            }
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -173,6 +190,25 @@ def load_record(path: str) -> dict:
                 "resumes_recomputed"
             )
     return rec
+
+
+# A shape "regresses past its recorded ratio" when the new record's
+# kernel-vs-gather falls more than this fraction below the old one
+# (timing jitter on min-of-N CPU smoke is a few percent; 10% is signal).
+KERNEL_REGRESS_TOLERANCE = 0.9
+
+
+def kernel_regressions(a: dict, b: dict) -> list[str]:
+    """Shapes present in BOTH records whose kernel-vs-gather ratio fell
+    past the recorded value (beyond tolerance), sorted for stable rows."""
+    old = a.get("kernels_shapes") or {}
+    new = b.get("kernels_shapes") or {}
+    out = []
+    for name in sorted(set(old) & set(new)):
+        va, vb = old[name], new[name]
+        if va and vb and vb < va * KERNEL_REGRESS_TOLERANCE:
+            out.append(name)
+    return out
 
 
 def _fmt_value(rec: dict) -> str:
@@ -191,6 +227,7 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "tpu_reference_value", "overlap_speedup", "overlap_discards",
         "tp_size", "tp_tokens_per_sec", "tp_speedup",
         "tp_scaling_efficiency", "tp_discards", "tp_tokens_match",
+        "kernels_min_ratio", "kernels_int8_vs_bf16",
         "kvcache_hits", "kvcache_restores", "kvcache_reclaims",
         "kvcache_restore_speedup", "kvcache_resumes_restored",
         "kvcache_resumes_recomputed",
@@ -212,6 +249,20 @@ def diff_lines(a: dict, b: dict) -> list[str]:
             continue
         marker = " " if va == vb else "*"
         lines.append(f"  {marker} {field}: {va!r} -> {vb!r}")
+    # Per-shape kernel ratios: one line per shape in either record, with
+    # the same changed-marker convention.
+    shapes_a = a.get("kernels_shapes") or {}
+    shapes_b = b.get("kernels_shapes") or {}
+    for name in sorted(set(shapes_a) | set(shapes_b)):
+        va, vb = shapes_a.get(name), shapes_b.get(name)
+        marker = " " if va == vb else "*"
+        lines.append(f"  {marker} kernels[{name}]: {va!r} -> {vb!r}")
+    for name in kernel_regressions(a, b):
+        lines.append(
+            f"  ! KERNEL-REGRESSED {name}: {shapes_a[name]!r} -> "
+            f"{shapes_b[name]!r} (past the {KERNEL_REGRESS_TOLERANCE:.0%} "
+            "tolerance of its recorded ratio)"
+        )
     if (
         isinstance(a.get("value"), (int, float))
         and isinstance(b.get("value"), (int, float))
@@ -264,6 +315,25 @@ def ledger_row(a: dict, b: dict) -> str:
                     else ""
                 )
                 if b.get("router_replicas") is not None
+                else ""
+            )
+            + (
+                f"; kernels min {b['kernels_min_ratio']}x vs gather "
+                f"(int8/bf16 {b.get('kernels_int8_vs_bf16')}x"
+                + (
+                    ", KERNEL-SLOWER-THAN-GATHER"
+                    if (b.get("kernels_min_ratio") or 1.0) < 1.0
+                    else ""
+                )
+                + (
+                    ", KERNEL-REGRESSED("
+                    + ",".join(kernel_regressions(a, b))
+                    + ")"
+                    if kernel_regressions(a, b)
+                    else ""
+                )
+                + ")"
+                if b.get("kernels_min_ratio") is not None
                 else ""
             )
             + (
