@@ -1061,6 +1061,141 @@ def _run_restart_phase(eng, args) -> dict:
     return block
 
 
+def _run_elastic_phase(eng, args) -> dict:
+    """ELASTIC perf phase: cold-join vs peer-warmed-join TTFT p99 over
+    shared-prefix sessions (ISSUE 14 — elastic fleet scale-up).
+
+    What the row claims and how it is measured:
+
+    - The "donor" is the SAME compiled engine after serving a
+      shared-prefix session set: its warm state is serialized through
+      ``engine_snapshot.encode_snapshot`` — byte-for-byte the stream a
+      real donor's ``GET /debug/snapshot`` sends a joining replica.
+    - A **cold join** is modeled by clearing every KV tier (exactly
+      what a fresh replica lacks) and serving the same sessions: every
+      prefix re-prefills.  Per-request TTFT from the request's own
+      submit/first-token stamps, requests serial so TTFT is prefill.
+    - A **peer-warmed join** clears the same tiers, then rehydrates the
+      donor's wire bytes through the same parse+verify+admit path
+      ``fetch_peer_snapshot`` uses (minus the socket; the socket path
+      itself is pinned in tier-1 and scored under chaos) — prefix
+      pages restore host→device instead of recomputing.  The restore
+      scatter compiles during the warmup pass so neither measured join
+      eats a compile.
+
+    The acceptance bar the diurnal-burst sim scores (warmed joiner's
+    first-minute TTFT p99 within ~1.2x of warm peers) shows up here as
+    ``warmed_speedup`` — a value below 1 means peer warm-up made the
+    join SLOWER than cold and the ledger row screams NO-WARMUP.
+    """
+    import io
+
+    from . import engine_snapshot as snap_mod
+
+    page = eng.paged.page_size
+    plen = args.prompt_len
+    pl = (plen // page) * page  # the shareable FULL-page prefix
+    if pl < page:
+        return {"skipped": f"prompt_len {plen} < one page ({page})"}
+    prefix = [(23 + j) % eng.cfg.vocab_size for j in range(pl)]
+    sessions = [
+        prefix + [(90 + 5 * s + j) % eng.cfg.vocab_size
+                  for j in range(plen - pl)]
+        for s in range(4)
+    ]
+    n_new = args.decode_tokens
+
+    def _ttfts(reqs):
+        return sorted(
+            r.first_token_at - r.submitted_at
+            for r in reqs
+            if r.first_token_at
+        )
+
+    def _q(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+    # Donor warmup: serve the sessions, spill the retained tier into
+    # the host arena (pool pressure's path), and compile the restore
+    # scatter before anything is timed.
+    eng.kvcache_clear()
+    for s in sessions:
+        eng.run([(s, n_new)])
+    with eng._lock:
+        eng._kv_reclaim(len(eng._kv_retained))
+    eng.run([(sessions[0], n_new)])  # restore-path compile
+
+    # The donor's wire stream: exactly what GET /debug/snapshot sends.
+    with eng._lock:
+        layout = snap_mod.snapshot_layout(eng)
+        fingerprint = snap_mod.params_fingerprint(eng.params)
+        entries = snap_mod.collect_entries(eng)
+    wire = b"".join(snap_mod.encode_snapshot(layout, fingerprint, entries))
+
+    # COLD join (the control): a fresh replica with no donor.
+    eng.kvcache_clear()
+    hits0 = eng.kv_host_hits
+    cold_reqs = [eng.run([(s, n_new)])[0] for s in sessions]
+    cold_hits = eng.kv_host_hits - hits0
+    cold = _ttfts(cold_reqs)
+
+    # PEER-WARMED join: same fresh replica, donor stream rehydrated
+    # through the fetch path's parse+verify+admit before first traffic.
+    eng.kvcache_clear()
+    _, parsed = snap_mod._parse_snapshot(
+        io.BytesIO(wire), layout, fingerprint
+    )
+    restored_entries = snap_mod._admit_entries(eng, parsed)
+    hits0, restores0 = eng.kv_host_hits, eng.kv_restores
+    warm_reqs = [eng.run([(s, n_new)])[0] for s in sessions]
+    warm_hits = eng.kv_host_hits - hits0
+    restored_pages = eng.kv_restores - restores0
+    warm = _ttfts(warm_reqs)
+    eng.kvcache_clear()
+
+    cold_p99, warm_p99 = _q(cold, 0.99), _q(warm, 0.99)
+    block = {
+        "sessions": len(sessions),
+        "prefix_tokens": pl,
+        "wire_bytes": len(wire),
+        "entries": len(entries),
+        "entries_restored": restored_entries,
+        "cold_join": {
+            "ttft_p50_ms": round(_q(cold, 0.5) * 1e3, 3),
+            "ttft_p99_ms": round(cold_p99 * 1e3, 3),
+            "prefix_hits": cold_hits,
+        },
+        "warmed_join": {
+            "ttft_p50_ms": round(_q(warm, 0.5) * 1e3, 3),
+            "ttft_p99_ms": round(warm_p99 * 1e3, 3),
+            "prefix_hits": warm_hits,
+            "restored_pages": restored_pages,
+        },
+        "warmed_speedup": (
+            round(cold_p99 / warm_p99, 3) if warm_p99 else None
+        ),
+    }
+    log(
+        "perf-ledger row: | ELASTIC cold vs peer-warmed join (b%d, %d "
+        "sessions) | join TTFT p99 cold %.3f → warmed %.3f ms (%.3fx; "
+        "%d entries / %d pages restored over %d wire bytes) | - | "
+        "`benchmark.py --model serving` | update on bench round |"
+        % (
+            eng.max_slots,
+            len(sessions),
+            block["cold_join"]["ttft_p99_ms"],
+            block["warmed_join"]["ttft_p99_ms"],
+            block["warmed_speedup"] or 0.0,
+            restored_entries,
+            restored_pages,
+            len(wire),
+        )
+    )
+    return block
+
+
 def run_serving(args) -> None:
     """Continuous-batching serving benchmark through the SAME telemetry
     operators scrape: the TTFT/ITL percentiles in the JSON line are read
@@ -1371,6 +1506,8 @@ def run_serving(args) -> None:
     overload_block = _run_overload_phase(eng, args, overlap_tps)
     # --- Restart phase (RESTART row): cold vs warm arena rehydration ---
     restart_block = _run_restart_phase(eng, args)
+    # --- Elastic phase (ELASTIC row): cold vs peer-warmed join ---------
+    elastic_block = _run_elastic_phase(eng, args)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
     print(
@@ -1417,6 +1554,7 @@ def run_serving(args) -> None:
                 "kernels": kernels_block,
                 "overload": overload_block,
                 "restart": restart_block,
+                "elastic": elastic_block,
                 "router": router_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,

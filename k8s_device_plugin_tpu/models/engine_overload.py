@@ -363,6 +363,17 @@ class OverloadController:
 
     # ------------------------------------------------------------- shedding
 
+    def wait_ewma_s(self) -> Optional[float]:
+        """The measured queue-wait EWMA (None before any admission) —
+        the host-side hot signal the router's summary poll exports for
+        proactive migration and scale planning (ISSUE 14)."""
+        return self._wait_ewma
+
+    def drain_rate_rps(self) -> Optional[float]:
+        """The measured request drain rate (None before two finishes) —
+        the second host-side signal the fleet planner reads."""
+        return self._drain_rate
+
     def projected_wait_s(self, queue_depth: int) -> Optional[float]:
         """Queue depth over the measured drain rate — the honest wait
         forecast Retry-After and submit-side shedding both read.  None

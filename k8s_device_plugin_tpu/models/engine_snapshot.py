@@ -30,11 +30,24 @@ restarted replica's prefix restores hit warm:
   and serving proceeds exactly as if no snapshot existed.  Never a
   poisoned cache.
 
+The same ``MAGIC | version | header | entries`` byte stream doubles as
+the **peer-transfer wire format** (ISSUE 14): a scaling-up replica
+streams a warm neighbor's ``GET /debug/snapshot`` and rehydrates
+through the same verification path, so a joiner enters the fleet with
+the donor's hot prefixes instead of stone-cold — and the SAME
+degradation contract holds: a donor dying mid-transfer, a torn stream,
+or an incompatible peer (layout/params fingerprints ride HTTP headers
+and refuse before any bytes land) all degrade to a clean cold start.
+
 Failpoint sites (docs/chaos.md): ``engine.snapshot.save`` (``error``
 aborts the save; ``truncate[:fraction]`` writes a torn file — the
-disk-corruption shape the load contract is scored against) and
+disk-corruption shape the load contract is scored against),
 ``engine.snapshot.load`` (``error`` = unreadable file, ``truncate``
-reads a prefix of the bytes).
+reads a prefix of the bytes), ``engine.snapshot.serve`` (donor side:
+``error`` refuses, ``truncate`` tears the stream mid-transfer — the
+donor-died-mid-send shape, ``hang`` stalls the transfer), and
+``engine.snapshot.fetch`` (joiner side: ``error`` = dial failure,
+``truncate`` reads a prefix of the peer's bytes).
 """
 
 from __future__ import annotations
@@ -45,7 +58,7 @@ import struct
 import tempfile
 import time
 import zlib
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
 
 import numpy as np
 
@@ -54,6 +67,14 @@ from ..utils import failpoints
 MAGIC = b"TPUKVSN1"
 VERSION = 1
 SNAPSHOT_NAME = "kv_arena.snapshot"
+
+# Peer-transfer negotiation headers (GET /debug/snapshot): the joiner
+# states what it can ingest; the donor refuses a mismatch with 409
+# BEFORE any snapshot bytes land (and stamps its own values on the
+# response either way).
+LAYOUT_HEADER = "X-Snapshot-Layout"
+PARAMS_HEADER = "X-Snapshot-Params"
+ENTRIES_HEADER = "X-Snapshot-Entries"
 
 # Per-leaf byte cap on the params fingerprint sample: enough to tell two
 # weight sets apart, cheap enough to run at every save/load.
@@ -106,6 +127,15 @@ def params_fingerprint(params: Any) -> str:
             sample = np.asarray(flat[:n])
             crc = zlib.crc32(np.ascontiguousarray(sample).tobytes(), crc)
     return f"{crc:08x}"
+
+
+def layout_fingerprint(layout: dict) -> str:
+    """Short stable fingerprint of a page-row layout — what the peer
+    negotiation headers carry (the full layout JSON still rides the
+    stream's header and is compared verbatim at parse; the header hash
+    only exists to refuse before bytes move)."""
+    blob = json.dumps(layout, sort_keys=True, separators=(",", ":")).encode()
+    return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -172,19 +202,14 @@ def collect_entries(engine, include_device: bool = True) -> dict[tuple, dict]:
     return entries
 
 
-def _write_snapshot(
-    path: str,
-    layout: dict,
-    fingerprint: str,
-    entries: dict[tuple, dict],
-    truncate_fraction: Optional[float] = None,
-) -> int:
-    """Write MAGIC | version | header | entries to a tempfile in
-    ``path``'s directory and atomically rename it over ``path``.
-    Returns the byte size.  ``truncate_fraction`` (the
-    ``engine.snapshot.save`` failpoint's ``truncate`` mode) tears the
-    file AFTER the rename — the on-disk corruption shape (atomic rename
-    already rules out torn writes)."""
+def encode_snapshot(
+    layout: dict, fingerprint: str, entries: dict[tuple, dict]
+) -> Iterator[bytes]:
+    """Yield the ``MAGIC | version | header | entries`` byte stream —
+    one chunk for the preamble, then one chunk per entry.  The disk
+    writer and the ``GET /debug/snapshot`` peer stream share this one
+    encoder, so the wire format IS the file format (bit-identical,
+    pinned in tier-1)."""
     header = json.dumps(
         {
             "version": VERSION,
@@ -194,28 +219,40 @@ def _write_snapshot(
             "created_unix": round(time.time(), 3),
         }
     ).encode()
+    yield MAGIC + struct.pack("<II", VERSION, len(header)) + header
+    for key, rows in entries.items():
+        _, root, tokens = key
+        blob = _entry_blob(rows, layout)
+        meta = json.dumps(
+            {
+                "root": int(root),
+                "tokens": [int(t) for t in tokens],
+                "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                "nbytes": len(blob),
+            }
+        ).encode()
+        yield struct.pack("<I", len(meta)) + meta + blob
+
+
+def _write_snapshot(
+    path: str,
+    layout: dict,
+    fingerprint: str,
+    entries: dict[tuple, dict],
+    truncate_fraction: Optional[float] = None,
+) -> int:
+    """Write the encoded stream to a tempfile in ``path``'s directory
+    and atomically rename it over ``path``.  Returns the byte size.
+    ``truncate_fraction`` (the ``engine.snapshot.save`` failpoint's
+    ``truncate`` mode) tears the file AFTER the rename — the on-disk
+    corruption shape (atomic rename already rules out torn writes)."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(prefix=".kv_arena.", dir=directory)
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(MAGIC)
-            f.write(struct.pack("<II", VERSION, len(header)))
-            f.write(header)
-            for key, rows in entries.items():
-                _, root, tokens = key
-                blob = _entry_blob(rows, layout)
-                meta = json.dumps(
-                    {
-                        "root": int(root),
-                        "tokens": [int(t) for t in tokens],
-                        "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
-                        "nbytes": len(blob),
-                    }
-                ).encode()
-                f.write(struct.pack("<I", len(meta)))
-                f.write(meta)
-                f.write(blob)
+            for chunk in encode_snapshot(layout, fingerprint, entries):
+                f.write(chunk)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -298,6 +335,18 @@ def _parse_snapshot(f, expected_layout, expected_fingerprint):
     return header, entries
 
 
+def _admit_entries(engine, entries) -> int:
+    """Re-enter parsed entries through ``HostKVArena.put`` (budget
+    honored) under the engine lock; the next same-prefix admission then
+    restores device-side instead of recomputing."""
+    restored = 0
+    with engine._lock:
+        for key, rows, nbytes in entries:
+            engine._kv_arena.put(key, {"rows": rows}, nbytes)
+            restored += 1
+    return restored
+
+
 # ----------------------------------------------------------- engine wiring
 
 
@@ -367,11 +416,7 @@ def load_arena_snapshot(engine, path: str) -> dict:
         expected_fp = params_fingerprint(engine.params)
     try:
         header, entries = read_snapshot(path, expected_layout, expected_fp)
-        restored = 0
-        with engine._lock:
-            for key, rows, nbytes in entries:
-                engine._kv_arena.put(key, {"rows": rows}, nbytes)
-                restored += 1
+        restored = _admit_entries(engine, entries)
     except (failpoints.FailpointError, SnapshotError, OSError, ValueError) as e:
         reason = str(e)
         outcome = (
@@ -401,3 +446,145 @@ def load_arena_snapshot(engine, path: str) -> dict:
     if engine.flight is not None:
         engine.flight.record("engine.snapshot.loaded", **result)
     return result
+
+
+# ------------------------------------------------------ peer warm join
+
+
+def fetch_peer_snapshot(engine, peer: str, timeout_s: float = 30.0) -> dict:
+    """Warm-join: stream ``peer``'s (``"host:port"``) live arena over
+    ``GET /debug/snapshot`` and rehydrate this engine's host arena from
+    it — call BEFORE first admission, exactly like
+    :func:`load_arena_snapshot`.
+
+    The joiner states its layout/params fingerprints as request headers
+    so an incompatible donor refuses (409) before any snapshot bytes
+    move; the body then parses through the SAME verification the disk
+    path uses (per-entry CRC, full layout compare, entry count), so a
+    donor dying mid-stream, a torn transfer, or a lying peer all land in
+    the one degradation contract: everything partially admitted is
+    dropped and the joiner cold-starts clean — never a poisoned arena.
+    Meters ``tpu_engine_snapshot_fetches_total{outcome}``; the
+    ``engine.snapshot.fetch`` failpoint injects dial failure (``error``)
+    or a truncated read (``truncate[:fraction]``)."""
+    import http.client
+    import io
+
+    if not engine._kv_arena.enabled:
+        if engine.metrics:
+            engine.metrics.snapshot_fetches.inc(outcome="disabled")
+        return {"ok": False, "reason": "arena_disabled", "restored": 0,
+                "peer": peer}
+    t0 = time.perf_counter()
+    with engine._lock:
+        expected_layout = snapshot_layout(engine)
+        expected_fp = params_fingerprint(engine.params)
+    host, _, port = peer.rpartition(":")
+    outcome = "corrupt"
+    try:
+        hit = failpoints.fire("engine.snapshot.fetch", peer=peer)
+        outcome = "unreachable"  # failures below here until parse starts
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=timeout_s
+        )
+        try:
+            conn.request(
+                "GET",
+                "/debug/snapshot",
+                headers={
+                    LAYOUT_HEADER: layout_fingerprint(expected_layout),
+                    PARAMS_HEADER: expected_fp,
+                },
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                outcome = "refused"
+                raise SnapshotError(
+                    f"peer refused snapshot: HTTP {resp.status}"
+                )
+            outcome = "corrupt"  # transport/parse failures from here on
+            reader = resp
+            if hit is not None and hit.mode == "truncate":
+                data = resp.read()
+                frac = float(hit.arg) if hit.arg else 0.5
+                reader = io.BytesIO(data[: int(len(data) * frac)])
+            header, entries = _parse_snapshot(
+                reader, expected_layout, expected_fp
+            )
+        finally:
+            conn.close()
+        restored = _admit_entries(engine, entries)
+    except (failpoints.FailpointError, SnapshotError, OSError, ValueError) as e:
+        reason = str(e)
+        if reason in ("layout_mismatch", "params_mismatch"):
+            outcome = reason
+        # Clean cold start, never a poisoned arena: at join time the
+        # arena holds exactly the partial admit (plus any disk restore
+        # the operator layered first — rebuilt by traffic, never worth
+        # trusting next to a torn transfer).
+        with engine._lock:
+            engine._kv_arena.clear()
+        if engine.metrics:
+            engine.metrics.snapshot_fetches.inc(outcome=outcome)
+        if engine.flight is not None:
+            engine.flight.record(
+                "engine.snapshot.fetch_failed",
+                peer=peer, reason=reason, outcome=outcome,
+            )
+        return {"ok": False, "reason": reason, "outcome": outcome,
+                "restored": 0, "peer": peer}
+    result = {
+        "ok": True,
+        "peer": peer,
+        "restored": restored,
+        "bytes": engine._kv_arena.bytes,
+        "ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+    if engine.metrics:
+        engine.metrics.snapshot_fetches.inc(outcome="ok")
+    if engine.flight is not None:
+        engine.flight.record("engine.snapshot.fetched", **result)
+    return result
+
+
+def donor_for(joiner: str, peers, vnodes: int = 64) -> Optional[str]:
+    """The warm-up donor: the peer owning the ring segments adjacent to
+    where ``joiner`` lands — i.e. the replica whose keyspace (and
+    therefore whose warm prefixes) the joiner inherits most of under
+    the router's consistent hashing (router/ring.py, same vnode scheme
+    and hash, so this answer matches the router's remapping exactly).
+    Deterministic; None when no other peer exists."""
+    from ..router.ring import HashRing, _hash64
+
+    candidates = sorted({p for p in peers if p and p != joiner})
+    if not candidates:
+        return None
+    ring = HashRing(candidates, vnodes=vnodes)
+    counts: dict[str, int] = {}
+    for i in range(vnodes):
+        owner = ring.lookup(_hash64(f"{joiner}#{i}".encode()))
+        if owner is not None:
+            counts[owner] = counts.get(owner, 0) + 1
+    # Deterministic tie-break: count first, then name order.
+    return max(sorted(counts), key=lambda n: counts[n])
+
+
+def fleet_members(router_url: str, timeout_s: float = 5.0) -> list[str]:
+    """The fleet membership as the router sees it (``GET /debug/fleet``,
+    falling back to ``/debug/router`` — both carry a ``replicas`` map).
+    The joiner resolves its warm-up donor from this view instead of
+    needing fleet config of its own."""
+    import urllib.request
+
+    base = router_url.rstrip("/")
+    if not base.startswith("http"):
+        base = f"http://{base}"
+    last_error: Optional[Exception] = None
+    for path in ("/debug/fleet", "/debug/router"):
+        try:
+            with urllib.request.urlopen(base + path, timeout=timeout_s) as r:
+                payload = json.loads(r.read() or b"{}")
+            return sorted((payload.get("replicas") or {}).keys())
+        except (OSError, ValueError) as e:
+            last_error = e
+    raise SnapshotError(f"fleet membership unavailable: {last_error}")

@@ -330,6 +330,30 @@ class EngineMetrics:
             "Size of the last successfully written KV-arena snapshot "
             "(size the snapshot volume from this plus headroom)",
         )
+        # Elastic warm scale-up (GET /debug/snapshot peer transfer):
+        # donor-side serves and joiner-side fetches.  A joiner fetch
+        # with anything but outcome=ok cold-started clean.
+        self.snapshot_serves = registry.counter(
+            "tpu_engine_snapshot_serves_total",
+            "Peer snapshot streams served at GET /debug/snapshot by "
+            "outcome (ok / refused / client_gone / error); refused = "
+            "the joiner's layout/params fingerprint headers mismatched "
+            "and no bytes moved",
+            ["outcome"],
+        )
+        self.snapshot_served_bytes = registry.counter(
+            "tpu_engine_snapshot_served_bytes",
+            "KV-arena snapshot bytes streamed to warm-joining peers "
+            "(donor-side transfer volume)",
+        )
+        self.snapshot_fetches = registry.counter(
+            "tpu_engine_snapshot_fetches_total",
+            "Peer snapshot fetches at warm join by outcome (ok / "
+            "unreachable / refused / corrupt / layout_mismatch / "
+            "params_mismatch / disabled); anything but ok degrades to "
+            "a clean cold start",
+            ["outcome"],
+        )
 
 
 @dataclasses.dataclass

@@ -62,9 +62,11 @@ rest of the models/ stack which benchmarks on synthetic ids):
          pool, speculation counters) plus the recent span ring
          (utils/spans.py) when the engine was built with a recorder —
          ids and lengths only, never token content.  Top-level
-         ``queue_depth`` / ``active_slots`` / ``draining`` ride along;
-         ``?summary=1`` returns ONLY those (no engine lock, no spans) —
-         the shape the router's per-second poll loop reads.
+         ``queue_depth`` / ``active_slots`` / ``draining`` / ``fenced``
+         plus the host-side overload signals ``queue_wait_ewma_s`` /
+         ``drain_rate_rps`` ride along; ``?summary=1`` returns ONLY
+         those (no engine lock, no spans) — the shape the router's
+         per-second poll loop (and its migration/scale planner) reads.
 
     GET /debug/spans -> 200 JSON span ring alone ({"spans", "dropped",
          "capacity"}); ``?rid=<trace id>`` returns ONLY that request's
@@ -81,6 +83,15 @@ rest of the models/ stack which benchmarks on synthetic ids):
          p50/p99 over the rolling window), batch occupancy, KV-page
          utilization, overlap hit/discard window counts, device-memory
          track.  Always on.
+    GET /debug/snapshot -> 200 application/octet-stream: the live KV
+         host arena (+ retained device pages) in the engine_snapshot
+         wire format — the donor half of elastic peer warm-up.  The
+         joiner's ``X-Snapshot-Layout``/``X-Snapshot-Params`` request
+         headers are fingerprint-checked first (409 on mismatch, before
+         any bytes land); ``Range`` requests answer 416 (whole-blob
+         only); the response carries both fingerprints plus
+         ``X-Snapshot-Entries``.  NOTE: KV rows ARE token-derived
+         content — same trust domain as the snapshot volume.
     GET /debug/kvcache -> 200 JSON KV-cache tiering snapshot
          (models/engine_kvcache.py): retained-tier size, host-arena
          bytes/entries vs budget, hit/evict/restore counters, and
@@ -775,6 +786,117 @@ class EngineServer:
                 self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
                 self.wfile.flush()
 
+            def _serve_snapshot(self) -> None:
+                """GET /debug/snapshot: stream the arena (+ retained
+                device pages) in the engine_snapshot wire format — the
+                donor half of peer warm-up.  A joiner's layout/params
+                fingerprint headers are checked FIRST (409 before any
+                bytes land), resumable fetches are refused (416 — the
+                blob is verified whole or not at all), and the
+                ``engine.snapshot.serve`` failpoint injects refusal
+                (``error``), a stalled transfer (``hang``), or a stream
+                torn mid-send (``truncate`` — the donor-died shape the
+                joiner's degradation contract is scored against).  A
+                fence skips device-page reads exactly like a fence-path
+                save (rows off a sick chip are not worth shipping)."""
+                from ..utils import failpoints
+                from . import engine_snapshot as snap_mod
+
+                eng = server.engine
+                metrics = eng.metrics
+                try:
+                    hit = failpoints.fire("engine.snapshot.serve")
+                except failpoints.FailpointError as e:
+                    if metrics:
+                        metrics.snapshot_serves.inc(outcome="error")
+                    self._reply(503, {"error": f"snapshot unavailable: {e}"})
+                    return
+                if self.headers.get("Range"):
+                    # Whole-blob only: a resumed partial fetch would
+                    # splice bytes from two different arena states —
+                    # the CRCs would catch it, but refusing up front is
+                    # cheaper than shipping a transfer doomed to parse
+                    # as corrupt.
+                    self._reply(
+                        416,
+                        {"error": "resumable fetch refused: snapshot "
+                                  "transfers are whole-blob only"},
+                    )
+                    return
+                with eng._lock:
+                    layout = snap_mod.snapshot_layout(eng)
+                    fingerprint = snap_mod.params_fingerprint(eng.params)
+                    entries = snap_mod.collect_entries(
+                        eng,
+                        include_device=not server._fence.is_set(),
+                    )
+                layout_fp = snap_mod.layout_fingerprint(layout)
+                want_layout = self.headers.get(snap_mod.LAYOUT_HEADER)
+                want_params = self.headers.get(snap_mod.PARAMS_HEADER)
+                if (want_layout and want_layout != layout_fp) or (
+                    want_params and want_params != fingerprint
+                ):
+                    # Incompatible peer: refuse BEFORE any bytes land.
+                    if metrics:
+                        metrics.snapshot_serves.inc(outcome="refused")
+                    eng.flight.record(
+                        "engine.snapshot.serve_refused",
+                        peer=self.client_address[0],
+                        layout_ok=(not want_layout
+                                   or want_layout == layout_fp),
+                        params_ok=(not want_params
+                                   or want_params == fingerprint),
+                    )
+                    self._reply(
+                        409,
+                        {
+                            "error": "snapshot layout/params mismatch",
+                            "layout": layout_fp,
+                            "params_fingerprint": fingerprint,
+                        },
+                    )
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header(snap_mod.LAYOUT_HEADER, layout_fp)
+                self.send_header(snap_mod.PARAMS_HEADER, fingerprint)
+                self.send_header(snap_mod.ENTRIES_HEADER, str(len(entries)))
+                # No Content-Length: close-delimited like the SSE path.
+                # The format is self-delimiting (entry count in the
+                # header, per-entry CRCs), so the joiner never needs the
+                # transport to tell it whether the stream was whole.
+                self.end_headers()
+                chunks = snap_mod.encode_snapshot(
+                    layout, fingerprint, entries
+                )
+                if hit is not None and hit.mode == "truncate":
+                    # Tear the stream mid-send: the donor-died-
+                    # mid-transfer byte shape, injected without killing
+                    # the process.
+                    data = b"".join(chunks)
+                    frac = float(hit.arg) if hit.arg else 0.5
+                    chunks = iter([data[: int(len(data) * frac)]])
+                sent = 0
+                outcome = "ok"
+                try:
+                    for chunk in chunks:
+                        self.wfile.write(chunk)
+                        sent += len(chunk)
+                    self.wfile.flush()
+                except OSError:
+                    outcome = "client_gone"  # joiner vanished mid-pull
+                if metrics:
+                    metrics.snapshot_serves.inc(outcome=outcome)
+                    metrics.snapshot_served_bytes.inc(sent)
+                eng.flight.record(
+                    "engine.snapshot.served",
+                    peer=self.client_address[0],
+                    entries=len(entries),
+                    bytes=sent,
+                    outcome=outcome,
+                    torn=bool(hit is not None and hit.mode == "truncate"),
+                )
+
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0]
                 if path == "/healthz":
@@ -814,6 +936,11 @@ class EngineServer:
                     # otherwise only visible as a /healthz 503).  Plain
                     # racy scalar reads — no engine lock, no span/profiler
                     # assembly.
+                    ov = server.engine.overload
+                    wait_ewma = ov.wait_ewma_s() if ov is not None else None
+                    drain_rate = (
+                        ov.drain_rate_rps() if ov is not None else None
+                    )
                     summary = {
                         "queue_depth": len(server.engine.queue),
                         "active_slots": sum(
@@ -825,6 +952,22 @@ class EngineServer:
                         # assignments; streams fail over).
                         "fenced": server._fence.is_set(),
                         "loop_alive": server._loop_alive,
+                        # Host-side overload signals (the Host-Side
+                        # Telemetry pattern): the router's migration
+                        # planner and /debug/fleet scale signal read
+                        # THESE — queue-wait EWMA and drain-rate
+                        # forecast, not device counters.  None without
+                        # an overload controller (or before traffic).
+                        "queue_wait_ewma_s": (
+                            round(wait_ewma, 4)
+                            if wait_ewma is not None
+                            else None
+                        ),
+                        "drain_rate_rps": (
+                            round(drain_rate, 3)
+                            if drain_rate is not None
+                            else None
+                        ),
                     }
                     if "summary=1" in (self.path.split("?", 1) + [""])[1]:
                         # ?summary=1: the summary ALONE — skips the
@@ -862,6 +1005,15 @@ class EngineServer:
                     )
                     rid = (query.get("rid") or [None])[0]
                     self._reply(200, rec.dump(trace_id=rid))
+                elif path == "/debug/snapshot":
+                    # Peer warm-up (ISSUE 14): stream the live arena (+
+                    # retained device pages) in the snapshot wire format
+                    # so a scaling-up replica joins warm instead of
+                    # stone-cold.  Token CONTENT does ride this surface
+                    # (KV rows are the payload) — same trust domain as
+                    # the snapshot volume, served only to peers that
+                    # already share the weights (fingerprint handshake).
+                    self._serve_snapshot()
                 elif path == "/debug/profile":
                     # Per-step phase breakdown over the rolling window —
                     # aggregates only, no request-identifying content, so
@@ -1130,6 +1282,18 @@ class EngineServer:
         from .engine_snapshot import save_arena_snapshot
 
         with self._snap_lock:
+            # Re-check the fence UNDER the save lock (the ISSUE 14
+            # bugfix): the periodic thread tests the fence BEFORE
+            # blocking here, so a fence that lands while its save is
+            # queued on the lock would otherwise let the stale periodic
+            # save run second and republish device-page rows the
+            # fence-path save (chip_health source) deliberately
+            # excluded — the fence's safe snapshot, overwritten by a
+            # pre-fence view of a now-suspect chip.  Operator/drain
+            # saves still run while fenced; only the stale periodic
+            # writer is turned away.
+            if trigger == "periodic" and self._fence.is_set():
+                return {"ok": False, "reason": "fenced", "trigger": trigger}
             result = save_arena_snapshot(
                 self.engine,
                 self._snapshot_path(),
@@ -1150,6 +1314,43 @@ class EngineServer:
         result = load_arena_snapshot(self.engine, self._snapshot_path())
         self.last_snapshot_load = result
         return result
+
+    def warm_from_peer(self, peer: str, timeout_s: float = 30.0) -> dict:
+        """Peer warm-up (ISSUE 14): stream ``peer``'s GET
+        /debug/snapshot into this engine's arena — call BEFORE start(),
+        like :meth:`load_snapshot`.  Any failure (peer gone mid-stream,
+        fingerprint refusal, corruption) degrades to a clean cold
+        start; the joiner serves either way."""
+        from .engine_snapshot import fetch_peer_snapshot
+
+        result = fetch_peer_snapshot(self.engine, peer, timeout_s=timeout_s)
+        self.last_snapshot_load = result
+        return result
+
+    def warm_from_fleet(self, router_url: str, self_name: str) -> dict:
+        """Resolve the warm-up donor from the router's membership view
+        (the neighbor owning the ring segments ``self_name`` is about
+        to inherit — engine_snapshot.donor_for) and fetch its snapshot.
+        An unreachable router or an empty fleet is an ordinary cold
+        join, not an error."""
+        from .engine_snapshot import (
+            SnapshotError,
+            donor_for,
+            fleet_members,
+        )
+
+        try:
+            members = fleet_members(router_url)
+        except SnapshotError as e:
+            result = {"ok": False, "reason": str(e), "restored": 0}
+            self.last_snapshot_load = result
+            return result
+        donor = donor_for(self_name, members)
+        if donor is None:
+            result = {"ok": False, "reason": "no_peer", "restored": 0}
+            self.last_snapshot_load = result
+            return result
+        return self.warm_from_peer(donor)
 
     def _snapshot_loop(self) -> None:
         while not self._stop.wait(self._snapshot_interval_s):
@@ -1531,6 +1732,33 @@ def main(argv: Optional[list[str]] = None) -> None:
         "the timer; fence/drain/SIGTERM saves still run)",
     )
     p.add_argument(
+        "--warm-from-peer",
+        default="",
+        help="peer warm-up (elastic scale-up): stream this replica's "
+        "host:port GET /debug/snapshot into the KV host arena BEFORE "
+        "serving, so a scaling-up replica joins with the donor's warm "
+        "prefixes instead of stone-cold; layout/params fingerprints are "
+        "checked before any bytes move, and any mid-transfer death or "
+        "corruption degrades to a clean cold start (empty = off)",
+    )
+    p.add_argument(
+        "--warm-from-fleet",
+        default="",
+        help="peer warm-up via the router: resolve the warm-up donor "
+        "from this router URL's /debug/fleet membership view (the "
+        "neighbor owning the ring segments this replica inherits) and "
+        "fetch its snapshot before serving; requires --warm-self (or "
+        "its hostname:port default) to name this replica as the ring "
+        "sees it (empty = off)",
+    )
+    p.add_argument(
+        "--warm-self",
+        default="",
+        help="this replica's host:port as the router's ring names it "
+        "(the donor-selection key for --warm-from-fleet); default "
+        "<hostname>:<http-port>",
+    )
+    p.add_argument(
         "--admin-endpoints",
         type=int,
         choices=[0, 1],
@@ -1760,6 +1988,19 @@ def main(argv: Optional[list[str]] = None) -> None:
             file=sys.stderr,
             flush=True,
         )
+    if args.warm_from_peer or args.warm_from_fleet:
+        # Peer warm-up BEFORE serving (elastic scale-up): a failure
+        # here is an ordinary cold join — log and serve anyway.
+        if args.warm_from_peer:
+            warmed = server.warm_from_peer(args.warm_from_peer)
+        else:
+            import socket as socket_mod
+
+            self_name = args.warm_self or (
+                f"{socket_mod.gethostname()}:{args.http_port}"
+            )
+            warmed = server.warm_from_fleet(args.warm_from_fleet, self_name)
+        print(f"peer warm-up: {warmed}", file=sys.stderr, flush=True)
     server.start()
 
     # A pod delete sends SIGTERM: drain gracefully — stop admitting,
@@ -1787,7 +2028,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     print(
         f"serving on :{server.port} (POST /generate, GET /healthz /metrics "
         "/debug/state /debug/spans /debug/profile /debug/kvcache "
-        "/debug/admission /debug/incidents /debug/flight)",
+        "/debug/snapshot /debug/admission /debug/incidents /debug/flight)",
         file=sys.stderr,
         flush=True,
     )

@@ -40,6 +40,10 @@ HOME = "home"
 OVERFLOW = "overflow"
 RANDOM = "random"
 FAILOVER = "failover"
+# Planned migration (router/migration.py): a live stream moved OFF a
+# sustained-hot replica by the planner — the proactive cousin of
+# `failover`, same zero-drop resubmission, different cause.
+MIGRATION = "migration"
 
 
 class ReplicaState:
@@ -70,6 +74,13 @@ class ReplicaState:
         self.fenced = False  # guarded by: owner-thread
         self.queue_depth = 0  # guarded by: owner-thread
         self.active_slots = 0  # guarded by: owner-thread
+        # Host-side overload signals off the summary poll (queue-wait
+        # EWMA + drain-rate forecast, engine_overload.py): what the
+        # migration planner and the /debug/fleet scale signal read.
+        # None until the replica exports them (no controller / no
+        # traffic yet) — planners treat None as "no opinion".
+        self.queue_wait_ewma_s = None  # guarded by: owner-thread
+        self.drain_rate_rps = None  # guarded by: owner-thread
         self.last_poll = 0.0  # last successful poll (monotonic); guarded by: owner-thread
         self.dispatches = 0
         self.failures = 0
@@ -81,6 +92,8 @@ class ReplicaState:
             "fenced": self.fenced,
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
+            "queue_wait_ewma_s": self.queue_wait_ewma_s,
+            "drain_rate_rps": self.drain_rate_rps,
             "breaker": self.breaker.snapshot(),
             "dispatches": self.dispatches,
             "failures": self.failures,

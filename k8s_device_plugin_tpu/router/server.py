@@ -35,13 +35,24 @@ engine decoded it":
   enqueued anywhere.  ``X-Request-Priority``/``X-Tenant-Id`` fold into
   the upstream body for the engine's priority admission.
 
+- **Planned migration** (ISSUE 14, ``--migrate``) — the proactive
+  cousin of failover: a `MigrationPlanner` (router/migration.py) watches
+  the host-side queue-wait EWMA / drain-rate signals each summary poll
+  already carries, and when a replica runs sustained-hot while a peer
+  runs cold, live streams of its hottest prefix-block sessions are
+  resubmitted to the cold target through the SAME zero-drop machinery —
+  paced by a migration budget, only at paced token boundaries (never
+  mid-token-burst), aborted if the target's breaker refuses.
+
 Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
 (503 until a replica is reachable; ``draining`` during shutdown),
 ``GET /metrics`` (Prometheus), ``GET /debug/router`` (full snapshot),
-``GET /debug/spans`` (the router's request-span ring; ``?rid=`` filters
-one trace).  Every fault-handling decision is a flight event
-(``router.*``, per-request ones carrying ``rid``) so a chaos run can
-join injected replica kills against what the router saw.
+``GET /debug/fleet`` (per-replica host-side signals + migration planner
+state + the scale-up/down recommendation ``tools/fleet_plan.py``
+renders), ``GET /debug/spans`` (the router's request-span ring;
+``?rid=`` filters one trace).  Every fault-handling decision is a
+flight event (``router.*``, per-request ones carrying ``rid``) so a
+chaos run can join injected replica kills against what the router saw.
 
 Distributed tracing (ISSUE 12): the router records its own span tree
 per request — a ``router.request`` root, ``router.route`` selection
@@ -83,7 +94,13 @@ from ..utils.spans import (
     sanitize_trace_id,
 )
 from .breaker import STATE_VALUE, CircuitBreaker, RetryBudget
-from .policy import FAILOVER, ReplicaState, RoutingPolicy
+from .migration import (
+    MigrationConfig,
+    MigrationPlanner,
+    replica_pressure,
+    scale_recommendation,
+)
+from .policy import FAILOVER, MIGRATION, ReplicaState, RoutingPolicy
 from .ring import HashRing
 
 FAILPOINT_CONN = "router.replica_conn"
@@ -107,8 +124,18 @@ class RouterMetrics:
         )
         self.placements = registry.counter(
             "tpu_router_placements_total",
-            "Dispatches by placement decision (home/overflow/random/failover)",
+            "Dispatches by placement decision (home/overflow/random/"
+            "failover/migration)",
             ("placement",),
+        )
+        self.migrations = registry.counter(
+            "tpu_router_migrations_total",
+            "Planned session migrations by outcome (planned: stream "
+            "flagged to move off a sustained-hot replica; done: the "
+            "move landed on its target; aborted: target "
+            "breaker/eligibility or dial refused — the stream stays "
+            "put or falls back to ordinary failover)",
+            ("outcome",),
         )
         self.retries = registry.counter(
             "tpu_router_retries_total",
@@ -249,6 +276,26 @@ class _ReqTrace:
         self.attrs.update(attrs)
 
 
+class _StreamCtl:
+    """One live proxied stream's migration handle (rid-keyed registry).
+
+    ``migrate_to`` is written by :meth:`RouterServer.plan_migration`
+    (under the streams lock) and read/cleared by the stream's own relay
+    thread at token-event boundaries — plain attribute store/load
+    (GIL-atomic); a one-event-stale read is by design.  ``replica`` /
+    ``emitted`` are relay-thread-only bookkeeping the planner reads
+    racily to rank candidates."""
+
+    __slots__ = ("rid", "prefix_key", "replica", "emitted", "migrate_to")
+
+    def __init__(self, rid: str, prefix_key: int):
+        self.rid = rid
+        self.prefix_key = prefix_key
+        self.replica = ""
+        self.emitted = 0
+        self.migrate_to: Optional[str] = None
+
+
 class _Upstream:
     """One dialed upstream attempt: the connection (closable for
     cancel/cleanup) and its response."""
@@ -303,6 +350,9 @@ class RouterServer:
         seed: int = 0,
         replicas_dns: Optional[str] = None,
         racecheck: bool = False,
+        migrate: bool = False,
+        migration: Optional[MigrationConfig] = None,
+        migration_burst_gap_s: float = 0.005,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -361,6 +411,16 @@ class RouterServer:
         self._ttft_rolling = _Rolling()
         self._rng = random.Random(seed)
         self._dns = replicas_dns
+        # Proactive planned migration (router/migration.py; library
+        # default OFF like the engine's overload controller — the CLI
+        # arms it).  The planner runs on the poll thread; live streams
+        # register a _StreamCtl here so a plan can flag them to move.
+        self.planner = (
+            MigrationPlanner(migration) if migrate else None
+        )
+        self._migration_burst_gap = migration_burst_gap_s
+        self._streams: dict[str, _StreamCtl] = {}  # guarded by: _streams_lock
+        self._streams_lock = threading.Lock()
         self.policy = RoutingPolicy(
             self.ring,
             self.replicas,
@@ -490,6 +550,13 @@ class RouterServer:
                     write_exposition(self, server.registry)
                 elif path == "/debug/router":
                     self._reply(200, server.snapshot())
+                elif path == "/debug/fleet":
+                    # Elastic-fleet surface: per-replica host-side
+                    # signals, migration planner state, and the
+                    # scale-up/down recommendation (tools/fleet_plan.py
+                    # renders this; a warm-joining replica reads the
+                    # membership keys to pick its snapshot donor).
+                    self._reply(200, server.fleet_state())
                 elif path == "/debug/spans":
                     # ?rid=<trace id>: one request's tree only — the
                     # trace assembler's live mode pulls per-request,
@@ -560,6 +627,8 @@ class RouterServer:
                 return
             self.ring.remove(name)
             del self.replicas[name]
+        if self.planner is not None:
+            self.planner.forget(name)
         self.metrics.drop_replica(name)
         self._record("router.replica_removed", replica=name)
 
@@ -616,6 +685,17 @@ class RouterServer:
                 self._record("router.replica_up", replica=name)
             st.queue_depth = int(payload.get("queue_depth", 0))
             st.active_slots = int(payload.get("active_slots", 0))
+            # Host-side overload signals (queue-wait EWMA + drain-rate
+            # forecast): what the migration planner and /debug/fleet
+            # scale signal read.  Absent on pre-overload replicas.
+            raw_wait = payload.get("queue_wait_ewma_s")
+            st.queue_wait_ewma_s = (
+                float(raw_wait) if raw_wait is not None else None
+            )
+            raw_drain = payload.get("drain_rate_rps")
+            st.drain_rate_rps = (
+                float(raw_drain) if raw_drain is not None else None
+            )
             draining = bool(payload.get("draining", False))
             if draining != st.draining:
                 self._mark_draining(name, draining)
@@ -626,6 +706,9 @@ class RouterServer:
             self.metrics.replica_queue_depth.set(
                 st.queue_depth, replica=name
             )
+        # Proactive migration rides the poll cadence: feed the planner
+        # this sweep's signals, then execute at most one plan verdict.
+        self._maybe_plan_migrations()
 
     def _mark_draining(self, name: str, draining: bool) -> None:
         st = self.replicas.get(name)
@@ -702,6 +785,150 @@ class RouterServer:
         while not self._stop.wait(self._poll_interval):
             self._refresh_dns()
             self._poll_once()
+
+    # ------------------------------------------------- planned migration
+
+    def _maybe_plan_migrations(self) -> None:
+        """Poll-thread tick: feed this sweep's host-side signals to the
+        planner and execute at most one plan verdict (flag streams; the
+        relay threads perform the actual zero-drop moves at their next
+        paced token boundary)."""
+        planner = self.planner
+        if planner is None:
+            return
+        for name, st in list(self.replicas.items()):
+            planner.observe(
+                name,
+                wait_ewma_s=st.queue_wait_ewma_s,
+                drain_rate_rps=st.drain_rate_rps,
+                queue_depth=st.queue_depth,
+                eligible=(
+                    st.reachable and not st.draining and not st.fenced
+                ),
+            )
+        verdict = planner.plan()
+        if verdict is None:
+            return
+        source, target, n_moves = verdict
+        self.plan_migration(source, target=target, max_moves=n_moves)
+
+    def plan_migration(
+        self,
+        replica: str,
+        target: Optional[str] = None,
+        max_moves: int = 1,
+    ) -> int:
+        """Plan moves of the hottest prefix-block sessions off
+        ``replica``: flag up to ``max_moves`` live streams to resubmit
+        (prompt + emitted tokens, same rid — the PR 8 failover shape,
+        but PLANNED) onto ``target`` (default: the coldest eligible
+        peer).  Hotness ranks by live streams sharing the prefix key
+        (the shard the KV tiers are sweating for), then by emitted
+        length.  Returns how many streams were flagged; the relay
+        threads execute at their next paced token boundary and abort if
+        the target's breaker refuses."""
+        if target is None:
+            target = self._coldest_peer(replica)
+        if target is None or target == replica:
+            return 0
+        with self._streams_lock:
+            cands = [
+                c
+                for c in self._streams.values()
+                if c.replica == replica and c.migrate_to is None
+            ]
+            by_key: dict[int, int] = {}
+            for c in cands:
+                by_key[c.prefix_key] = by_key.get(c.prefix_key, 0) + 1
+            cands.sort(
+                key=lambda c: (-by_key[c.prefix_key], -c.emitted, c.rid)
+            )
+            flagged = cands[: max(0, int(max_moves))]
+            for c in flagged:
+                c.migrate_to = target
+        # Instruments OUTSIDE the streams lock (leaf-lock discipline).
+        for c in flagged:
+            self.metrics.migrations.inc(outcome="planned")
+            self._record(
+                "router.migration_planned",
+                rid=c.rid,
+                replica=replica,
+                target=target,
+                emitted=c.emitted,
+            )
+        return len(flagged)
+
+    def _coldest_peer(self, source: str) -> Optional[str]:
+        """The least-pressured routable replica other than ``source``
+        (the default migration target when the caller names none)."""
+        best: Optional[tuple[float, str]] = None
+        for name, st in self.replicas.items():
+            if name == source or not st.reachable or st.draining or st.fenced:
+                continue
+            pressure = replica_pressure(
+                st.queue_wait_ewma_s, st.drain_rate_rps, st.queue_depth
+            )
+            if best is None or (pressure, name) < best:
+                best = (pressure, name)
+        return best[1] if best is not None else None
+
+    def _acquire_migration_target(self, target: str) -> bool:
+        """Planned-move admission: the target must be routable RIGHT
+        NOW and its breaker must grant the dial — a migration aborts
+        rather than dogpile a tripping or demoted target."""
+        st = self.replicas.get(target)
+        if st is None or st.draining or st.fenced or not st.reachable:
+            return False
+        return st.breaker.try_acquire()
+
+    def _migration_aborted(self, rid: str, target: str, reason: str) -> None:
+        self.metrics.migrations.inc(outcome="aborted")
+        self._record(
+            "router.migration_aborted", rid=rid, target=target, reason=reason
+        )
+
+    def fleet_state(self) -> dict:
+        """GET /debug/fleet: per-replica host-side signals, planner
+        state, and the fleet scale recommendation — what
+        ``tools/fleet_plan.py`` renders and an autoscaler would poll."""
+        signals = {}
+        for name, st in list(self.replicas.items()):
+            eligible = st.reachable and not st.draining and not st.fenced
+            signals[name] = {
+                "pressure_s": round(
+                    replica_pressure(
+                        st.queue_wait_ewma_s,
+                        st.drain_rate_rps,
+                        st.queue_depth,
+                    ),
+                    4,
+                ),
+                "queue_depth": st.queue_depth,
+                "active_slots": st.active_slots,
+                "queue_wait_ewma_s": st.queue_wait_ewma_s,
+                "drain_rate_rps": st.drain_rate_rps,
+                "eligible": eligible,
+                "reachable": st.reachable,
+                "draining": st.draining,
+                "fenced": st.fenced,
+            }
+        cfg = self.planner.cfg if self.planner is not None else MigrationConfig()
+        with self._streams_lock:
+            active_streams = len(self._streams)
+        return {
+            "replicas": signals,
+            "active_streams": active_streams,
+            "migration": (
+                self.planner.snapshot()
+                if self.planner is not None
+                else {"enabled": False}
+            ),
+            "recommendation": scale_recommendation(
+                signals,
+                hot_wait_s=cfg.hot_wait_s,
+                cold_wait_s=cfg.cold_wait_s,
+            ),
+        }
 
     # ------------------------------------------------------ dispatching
 
@@ -1244,7 +1471,26 @@ class RouterServer:
     def _proxy_stream(
         self, handler, body, prompt, trace_id, deadline_s=None, tr=None
     ) -> None:
-        """SSE passthrough with zero-drop mid-stream failover.
+        """SSE passthrough wrapper: register the stream's migration
+        handle (the planner flags it through this registry), relay, and
+        always unregister — a dead handler thread must never leave a
+        ghost stream for the planner to keep planning against."""
+        ctl = _StreamCtl(trace_id, self.policy.key_of(prompt))
+        with self._streams_lock:
+            self._streams[trace_id] = ctl
+        try:
+            self._relay_stream(
+                handler, body, prompt, trace_id, deadline_s, tr, ctl
+            )
+        finally:
+            with self._streams_lock:
+                self._streams.pop(trace_id, None)
+
+    def _relay_stream(
+        self, handler, body, prompt, trace_id, deadline_s, tr, ctl
+    ) -> None:
+        """SSE passthrough with zero-drop mid-stream failover AND
+        planned migration.
 
         Token events are re-emitted with a GLOBAL index (continuations
         restart at 0 upstream); the final done event carries every
@@ -1254,7 +1500,17 @@ class RouterServer:
         never breaks unless every replica is gone or the failover/retry
         budget is spent.  A client deadline bounds the whole attempt
         budget (dial, retry sleeps, failovers) and rides every upstream
-        dial as a re-stamped ``X-Request-Deadline``."""
+        dial as a re-stamped ``X-Request-Deadline``.
+
+        Planned migration (ISSUE 14) rides the same resubmission: when
+        the planner flags ``ctl.migrate_to``, the relay — at a PACED
+        token boundary only, never mid-token-burst — validates the
+        target (eligibility + breaker; abort otherwise), ends the
+        current leg cleanly (``migrated``, no breaker failure: the
+        source is healthy, just hot), and dials the target with
+        ``prompt + emitted`` under the same rid.  The source engine
+        sees a client disconnect and frees its slot/pages; the client
+        sees one uninterrupted stream."""
         max_new = int(body.get("max_new_tokens", 16))
         emitted: list = []
         headers_sent = False
@@ -1271,6 +1527,13 @@ class RouterServer:
         )
         upstream_deadline = deadline if deadline_s is not None else None
         first_token_at: Optional[float] = None
+        # Planned migration state: `migrate_target` carries a validated
+        # (breaker-acquired) target from the event boundary that ended
+        # the previous leg into the next loop iteration's dial;
+        # `last_token_t` feeds the paced-boundary gate (never move
+        # mid-token-burst).
+        migrate_target: Optional[str] = None
+        last_token_t: Optional[float] = None
 
         def client_error(message: str) -> None:
             if headers_sent:
@@ -1300,39 +1563,51 @@ class RouterServer:
                     tr.set(outcome="timeout")
                 client_error("generation timed out")
                 return
-            route_t0 = time.monotonic()
-            picked = self._next_candidate(prompt, exclude, attempt)
-            self._span_route(tr, route_t0, picked, exclude)
-            if picked is None:
-                if exclude:
-                    # Same Retry-After floor as the unary restart: a
-                    # fleet-wide overload shed must back the stream off,
-                    # not hammer-loop the ring.
-                    exclude.clear()
-                    if retry_after is not None:
-                        delay = self._backoff(sleeps, retry_after)
-                        sleeps += 1
-                        if (
-                            sleeps > 16
-                            or time.monotonic() + delay >= deadline
-                        ):
-                            self.metrics.requests.inc(outcome="error")
-                            client_error("no replica available")
-                            return
-                        time.sleep(delay)
-                        retry_after = None
+            migration_leg = False
+            if migrate_target is not None:
+                # Planned move: the target was validated (breaker slot
+                # acquired) at the token boundary that ended the old
+                # leg — dial it directly.  No candidate walk, and no
+                # retry-budget spend: planned moves are paced by the
+                # planner's own migration budget, never by the fault
+                # budget.
+                name, placement = migrate_target, MIGRATION
+                migrate_target = None
+                migration_leg = True
+            else:
+                route_t0 = time.monotonic()
+                picked = self._next_candidate(prompt, exclude, attempt)
+                self._span_route(tr, route_t0, picked, exclude)
+                if picked is None:
+                    if exclude:
+                        # Same Retry-After floor as the unary restart: a
+                        # fleet-wide overload shed must back the stream
+                        # off, not hammer-loop the ring.
+                        exclude.clear()
+                        if retry_after is not None:
+                            delay = self._backoff(sleeps, retry_after)
+                            sleeps += 1
+                            if (
+                                sleeps > 16
+                                or time.monotonic() + delay >= deadline
+                            ):
+                                self.metrics.requests.inc(outcome="error")
+                                client_error("no replica available")
+                                return
+                            time.sleep(delay)
+                            retry_after = None
+                        continue
+                    delay = self._backoff(sleeps, retry_after)
+                    sleeps += 1
+                    if sleeps > 16 or time.monotonic() + delay >= deadline:
+                        self.metrics.requests.inc(outcome="error")
+                        client_error("no replica available")
+                        return
+                    time.sleep(delay)
+                    retry_after = None
                     continue
-                delay = self._backoff(sleeps, retry_after)
-                sleeps += 1
-                if sleeps > 16 or time.monotonic() + delay >= deadline:
-                    self.metrics.requests.inc(outcome="error")
-                    client_error("no replica available")
-                    return
-                time.sleep(delay)
-                retry_after = None
-                continue
-            name, placement = picked
-            if attempt > 0:
+                name, placement = picked
+            if attempt > 0 and not migration_leg:
                 if not self.budget.try_spend():
                     self._record(
                         "router.retry_budget_exhausted",
@@ -1353,7 +1628,14 @@ class RouterServer:
                         rid=trace_id,
                     )
             attempt += 1
-            st = self.replicas[name]
+            st = self.replicas.get(name)
+            if st is None:
+                # Membership changed under the leg (DNS reconciliation
+                # removed it between selection and dial): skip it.
+                if migration_leg:
+                    self._migration_aborted(trace_id, name, "removed")
+                exclude.add(name)
+                continue
             upstream_body = dict(body)
             upstream_body["prompt"] = prompt + emitted
             upstream_body["max_new_tokens"] = max_new - len(emitted)
@@ -1366,7 +1648,9 @@ class RouterServer:
             # tpu_router_failovers_total meters — the assembler's
             # attempt-count cross-check.
             leg_kind = (
-                "failover"
+                "migration"
+                if migration_leg
+                else "failover"
                 if failovers
                 else ("retry" if attempt > 1 else "primary")
             )
@@ -1394,6 +1678,11 @@ class RouterServer:
                     error=str(e),
                     rid=trace_id,
                 )
+                if migration_leg:
+                    # The planned target refused the dial: the move
+                    # aborts and the ordinary machinery resubmits the
+                    # stream wherever the ring says — still zero-drop.
+                    self._migration_aborted(trace_id, name, "dial_error")
                 exclude.add(name)
                 continue
             if up.resp.status == 503:
@@ -1417,6 +1706,10 @@ class RouterServer:
                     )
                 else:
                     self._mark_draining(name, True)
+                if migration_leg:
+                    self._migration_aborted(
+                        trace_id, name, "shed" if shed else "draining"
+                    )
                 exclude.add(name)
                 continue
             if up.resp.status != 200:
@@ -1425,6 +1718,16 @@ class RouterServer:
                     tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
                     status=up.resp.status, outcome="error",
                 )
+                if migration_leg:
+                    # The stream was HEALTHY before the planned move —
+                    # a target verdict must never kill it.  Abort the
+                    # move and resubmit through the ordinary ring walk.
+                    up.close()
+                    self._migration_aborted(
+                        trace_id, name, f"http_{up.resp.status}"
+                    )
+                    exclude.add(name)
+                    continue
                 if headers_sent:
                     up.close()
                     self.metrics.requests.inc(outcome="error")
@@ -1447,6 +1750,18 @@ class RouterServer:
                     tr.set(outcome="error")
                 return
             st.dispatches += 1
+            ctl.replica = name  # the planner ranks streams by home
+            if migration_leg:
+                # The move landed: the target accepted the resubmission
+                # and the relay continues there.  (A later death on the
+                # target is ordinary failover, separately metered.)
+                self.metrics.migrations.inc(outcome="done")
+                self._record(
+                    "router.migration_done",
+                    rid=trace_id,
+                    target=name,
+                    emitted=len(emitted),
+                )
             if not headers_sent:
                 handler.send_response(200)
                 handler.send_header("Content-Type", "text/event-stream")
@@ -1481,23 +1796,52 @@ class RouterServer:
                             return  # client vanished; upstream cancels
                         continue
                     if "token" in event:
+                        token_t = time.monotonic()
                         if first_token_at is None:
-                            first_token_at = time.monotonic()
+                            first_token_at = token_t
                             self._ttft_rolling.add(first_token_at - t0)
                             self.metrics.ttft_seconds.observe(
                                 first_token_at - t0
                             )
+                        token_gap = (
+                            token_t - last_token_t
+                            if last_token_t is not None
+                            else None
+                        )
+                        last_token_t = token_t
                         out = dict(event)
                         out["index"] = len(emitted)
                         out["trace_id"] = trace_id
                         emitted.append(event["token"])
                         leg_tokens += 1
+                        ctl.emitted = len(emitted)
                         try:
                             self._sse(handler, out)
                         except OSError:
                             up.close()
                             end_leg("client_gone")
                             return
+                        # Planned migration fires ONLY at a paced token
+                        # boundary: a measured inter-token gap at/above
+                        # the burst threshold means single-token decode
+                        # cadence — never mid-token-burst (a blocked
+                        # decode round's tokens arrive back-to-back; a
+                        # deferred flag is simply re-checked at the
+                        # next token).
+                        want = ctl.migrate_to
+                        if (
+                            want is not None
+                            and token_gap is not None
+                            and token_gap >= self._migration_burst_gap
+                            and len(emitted) < max_new
+                        ):
+                            ctl.migrate_to = None
+                            if self._acquire_migration_target(want):
+                                migrate_target = want
+                                break  # end this leg at the boundary
+                            self._migration_aborted(
+                                trace_id, want, "target_ineligible"
+                            )
                         continue
                     if event.get("done"):
                         fin = dict(event)
@@ -1531,6 +1875,16 @@ class RouterServer:
             except (*_CONN_ERRORS, ValueError):
                 pass  # transport death mid-stream; handled below
             up.close()
+            if migrate_target is not None:
+                # Planned move: this leg ends CLEANLY — "migrated", not
+                # "died".  No breaker failure and no failover metric
+                # (the source is healthy, just hot); closing the
+                # upstream makes the source engine see a client
+                # disconnect and cancel, freeing its slot and pages.
+                # The loop re-dials `migrate_target` with
+                # prompt + emitted under the same rid.
+                end_leg("migrated")
+                continue
             if done:
                 end_leg("done")
                 st.breaker.record_success()
@@ -1747,6 +2101,54 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     p.add_argument("--hedge-min-s", type=float, default=0.25)
     p.add_argument("--max-failovers", type=int, default=3)
+    p.add_argument(
+        "--migrate",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="proactive planned migration (router/migration.py, default "
+        "on): when a replica's queue-wait EWMA runs sustained-hot while "
+        "a peer runs cold, live streams of its hottest prefix-block "
+        "sessions are resubmitted to the cold peer through the zero-drop "
+        "failover machinery — paced by a migration budget, never "
+        "mid-token-burst, aborted if the target's breaker refuses; 0 "
+        "leaves only reactive failover",
+    )
+    p.add_argument(
+        "--migrate-hot-wait",
+        type=float,
+        default=2.0,
+        help="queue-wait pressure (seconds) at/above which a replica "
+        "counts as hot for migration/scale planning",
+    )
+    p.add_argument(
+        "--migrate-cold-wait",
+        type=float,
+        default=0.5,
+        help="queue-wait pressure (seconds) at/below which a replica is "
+        "a cold migration target",
+    )
+    p.add_argument(
+        "--migrate-sustain",
+        type=int,
+        default=3,
+        help="consecutive hot summary polls before a replica counts as "
+        "SUSTAINED hot (one bursty poll never triggers a migration)",
+    )
+    p.add_argument(
+        "--migrate-budget",
+        type=float,
+        default=4.0,
+        help="migration token bucket: burst cap on planned moves "
+        "(each flagged stream spends one token)",
+    )
+    p.add_argument(
+        "--migrate-refill",
+        type=float,
+        default=1.0,
+        help="migration budget refill rate (moves per second) — the "
+        "sustained pacing knob",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -1809,6 +2211,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         request_timeout_s=args.request_timeout,
         policy_mode=args.policy,
         replicas_dns=args.replicas_dns or None,
+        migrate=bool(args.migrate),
+        migration=MigrationConfig(
+            hot_wait_s=args.migrate_hot_wait,
+            cold_wait_s=args.migrate_cold_wait,
+            sustain_polls=args.migrate_sustain,
+            budget=args.migrate_budget,
+            refill_per_s=args.migrate_refill,
+        ),
     ).start()
 
     import signal
@@ -1831,7 +2241,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         pass
     print(
         f"routing on :{server.port} over {len(server.replicas)} replicas "
-        "(POST /generate, GET /healthz /metrics /debug/router /debug/spans)",
+        "(POST /generate, GET /healthz /metrics /debug/router "
+        "/debug/fleet /debug/spans)",
         file=sys.stderr,
         flush=True,
     )

@@ -374,15 +374,55 @@ class FakeReplica:
     :func:`fake_generate`.  jax-free, compile-free.
     """
 
+    # Synthetic snapshot layout every FakeReplica shares: warm-prefix
+    # keys ride the REAL engine_snapshot wire format (one tiny row per
+    # prefix), so fake-fleet warm-join scenarios exercise the exact
+    # encode/parse/verify path the engines use.
+    SNAPSHOT_LAYOUT = {
+        "page_size": 16,
+        "layers": {
+            "fake_layer": {
+                "pool_key": {"shape": [1], "dtype": "float32"},
+            }
+        },
+    }
+    SNAPSHOT_PARAMS_FP = "fake-params-fp"
+
     def __init__(
         self,
         host: str = "127.0.0.1",
         port: int = 0,
         token_delay_s: float = 0.0,
         prefill_delay_s: float = 0.0,
+        cold_prefill_delay_s: float = 0.0,
+        prefix_tokens: int = 0,
+        snapshot_chunk_s: float = 0.0,
     ):
         self.token_delay_s = token_delay_s
         self.prefill_delay_s = prefill_delay_s
+        # Warm-prefix model (elastic scale-up scenarios): with
+        # ``prefix_tokens`` set, a prompt whose leading prefix-key is
+        # NOT in ``warm_prefixes`` pays ``cold_prefill_delay_s`` (the
+        # cold re-prefill) and then warms it — exactly the KV-tier
+        # behaviour peer warm-join exists to skip.
+        self.cold_prefill_delay_s = cold_prefill_delay_s
+        self.prefix_tokens = prefix_tokens
+        self.warm_prefixes: set = set()
+        self.cold_prefills = 0
+        self.warm_prefills = 0
+        # Host-side overload signals the summary poll exports (the
+        # router's migration planner / /debug/fleet read these); tests
+        # set them directly to shape hot/cold fleets.
+        self.wait_ewma_s = None
+        self.drain_rate_rps = None
+        # Snapshot donor knobs: ``snapshot_payload`` overrides the body
+        # served at GET /debug/snapshot (e.g. real-engine-layout bytes);
+        # ``snapshot_chunk_s`` trickles the stream so a kill() can land
+        # mid-transfer; served bytes are counted for assertions.
+        self.snapshot_payload: bytes | None = None
+        self.snapshot_chunk_s = snapshot_chunk_s
+        self.snapshot_serves = 0
+        self.snapshot_refusals = 0
         self._draining = threading.Event()
         self._shedding = threading.Event()  # overload-shed mode (X-Shed)
         self._fenced = threading.Event()  # self-fenced (summary `fenced`)
@@ -514,8 +554,21 @@ class FakeReplica:
                         span_id=root_span, attrs=attrs,
                     )
 
-                if replica.prefill_delay_s:
-                    time.sleep(replica.prefill_delay_s)
+                delay = replica.prefill_delay_s
+                if replica.prefix_tokens and len(prompt) >= replica.prefix_tokens:
+                    key = tuple(prompt[: replica.prefix_tokens])
+                    with replica._lock:
+                        if key in replica.warm_prefixes:
+                            replica.warm_prefills += 1
+                        else:
+                            # Cold prefix: pay the re-prefill, then the
+                            # "KV tiers" hold it warm (what a peer
+                            # warm-join pre-populates).
+                            delay = max(delay, replica.cold_prefill_delay_s)
+                            replica.cold_prefills += 1
+                            replica.warm_prefixes.add(key)
+                if delay:
+                    time.sleep(delay)
                 if not stream:
                     tokens = []
                     seq = list(prompt)
@@ -587,7 +640,14 @@ class FakeReplica:
                         "draining": replica._draining.is_set(),
                         "fenced": replica._fenced.is_set(),
                         "loop_alive": True,
+                        # Host-side overload signals (the EngineServer
+                        # summary contract): test-settable so scenarios
+                        # shape hot/cold fleets for the planner.
+                        "queue_wait_ewma_s": replica.wait_ewma_s,
+                        "drain_rate_rps": replica.drain_rate_rps,
                     })
+                elif path == "/debug/snapshot":
+                    self._serve_snapshot()
                 elif path == "/debug/spans":
                     # The EngineServer contract incl. the ?rid= filter
                     # (the trace assembler's live mode).
@@ -616,6 +676,59 @@ class FakeReplica:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _serve_snapshot(self) -> None:
+                """The EngineServer GET /debug/snapshot contract in
+                miniature: fingerprint headers refused with 409 before
+                any bytes, then the wire-format body (warm prefixes as
+                tiny entries, or an injected payload) streamed in
+                chunks — ``snapshot_chunk_s`` trickles it so kill()
+                lands mid-transfer."""
+                from k8s_device_plugin_tpu.models import (
+                    engine_snapshot as snap_mod,
+                )
+
+                want_layout = self.headers.get(snap_mod.LAYOUT_HEADER)
+                want_params = self.headers.get(snap_mod.PARAMS_HEADER)
+                layout_fp = snap_mod.layout_fingerprint(
+                    replica.SNAPSHOT_LAYOUT
+                )
+                if replica.snapshot_payload is None and (
+                    (want_layout and want_layout != layout_fp)
+                    or (
+                        want_params
+                        and want_params != replica.SNAPSHOT_PARAMS_FP
+                    )
+                ):
+                    with replica._lock:
+                        replica.snapshot_refusals += 1
+                    self._json(409, {"error": "snapshot mismatch"})
+                    return
+                data = (
+                    replica.snapshot_payload
+                    if replica.snapshot_payload is not None
+                    else replica.snapshot_bytes()
+                )
+                with replica._lock:
+                    replica.snapshot_serves += 1
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream"
+                )
+                self.send_header(snap_mod.LAYOUT_HEADER, want_layout or layout_fp)
+                self.send_header(
+                    snap_mod.PARAMS_HEADER,
+                    want_params or replica.SNAPSHOT_PARAMS_FP,
+                )
+                self.end_headers()
+                try:
+                    for i in range(0, len(data), 256):
+                        if replica.snapshot_chunk_s:
+                            time.sleep(replica.snapshot_chunk_s)
+                        self.wfile.write(data[i : i + 256])
+                    self.wfile.flush()
+                except OSError:
+                    pass  # joiner vanished / kill() mid-transfer
 
             def log_message(self, *args):
                 pass
@@ -690,6 +803,72 @@ class FakeReplica:
         self._shedding.clear()
 
     # --- chaos ---
+    def snapshot_bytes(self) -> bytes:
+        """This fake's warm prefixes encoded in the REAL
+        engine_snapshot wire format (one tiny row per prefix) — what
+        GET /debug/snapshot streams by default."""
+        import numpy as np
+
+        from k8s_device_plugin_tpu.models import engine_snapshot as snap_mod
+
+        with self._lock:
+            prefixes = sorted(self.warm_prefixes)
+        entries = {
+            ("prefix", -1, tuple(int(t) for t in key)): {
+                "fake_layer": {
+                    "pool_key": np.zeros((1,), dtype=np.float32)
+                }
+            }
+            for key in prefixes
+        }
+        return b"".join(
+            snap_mod.encode_snapshot(
+                self.SNAPSHOT_LAYOUT, self.SNAPSHOT_PARAMS_FP, entries
+            )
+        )
+
+    def warm_from_peer(self, peer: str, timeout_s: float = 10.0) -> dict:
+        """The joiner half in miniature: stream ``peer``'s snapshot,
+        verify it through the real parser, and adopt its warm prefixes.
+        ANY failure (peer killed mid-transfer, torn stream, refusal)
+        adopts NOTHING — the clean-cold-start contract."""
+        import http.client
+
+        from k8s_device_plugin_tpu.models import engine_snapshot as snap_mod
+
+        host, _, port = peer.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(
+                host, int(port), timeout=timeout_s
+            )
+            try:
+                conn.request(
+                    "GET",
+                    "/debug/snapshot",
+                    headers={
+                        snap_mod.LAYOUT_HEADER: snap_mod.layout_fingerprint(
+                            self.SNAPSHOT_LAYOUT
+                        ),
+                        snap_mod.PARAMS_HEADER: self.SNAPSHOT_PARAMS_FP,
+                    },
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise snap_mod.SnapshotError(
+                        f"peer refused: HTTP {resp.status}"
+                    )
+                _, entries = snap_mod._parse_snapshot(
+                    resp, self.SNAPSHOT_LAYOUT, self.SNAPSHOT_PARAMS_FP
+                )
+            finally:
+                conn.close()
+        except (snap_mod.SnapshotError, OSError, ValueError) as e:
+            return {"ok": False, "reason": str(e), "restored": 0}
+        with self._lock:
+            for key, _rows, _nbytes in entries:
+                self.warm_prefixes.add(key[2])
+        return {"ok": True, "restored": len(entries), "peer": peer}
+
     def kill(self) -> None:
         """Abrupt death: reset every live connection (streams cut
         mid-token) and stop serving — the replica-pod-OOM shape the

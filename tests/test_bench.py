@@ -499,6 +499,72 @@ def test_bench_diff_parses_restart_block(tmp_path):
     assert "restart warm p99" not in bench_diff.ledger_row(a, e)
 
 
+def test_bench_diff_parses_elastic_block(tmp_path):
+    """Records grew an ELASTIC block (ISSUE 14, benchmark.py
+    _run_elastic_phase): cold-join vs peer-warmed-join TTFT p99 and
+    the shipped-entry count must surface in the normalized record, the
+    field diff, and the ledger row — and the row must scream NO-WARMUP
+    when the warmed join is SLOWER than a cold one (warmed_speedup < 1)
+    and NO-TRANSFER when the peer stream stopped rehydrating (0
+    entries restored)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 13,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 14
+    loaded["parsed"]["elastic"] = {
+        "sessions": 4, "prefix_tokens": 48,
+        "wire_bytes": 98304, "entries": 3, "entries_restored": 3,
+        "cold_join": {"ttft_p50_ms": 31.0, "ttft_p99_ms": 44.0,
+                      "prefix_hits": 0},
+        "warmed_join": {"ttft_p50_ms": 13.0, "ttft_p99_ms": 21.0,
+                        "prefix_hits": 8, "restored_pages": 12},
+        "warmed_speedup": 2.1,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["elastic_cold_ttft_p99_ms"] == 44.0
+    assert b["elastic_warmed_ttft_p99_ms"] == 21.0
+    assert b["elastic_entries_restored"] == 3
+    assert b["elastic_warmed_speedup"] == 2.1
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "elastic_warmed_ttft_p99_ms" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "elastic warmed-join p99 21.0ms vs cold 44.0ms" in row
+    assert "3 entries shipped" in row
+    assert "NO-WARMUP" not in row and "NO-TRANSFER" not in row
+    # Warmed join slower than cold: peer warm-up is actively hurting.
+    loaded["parsed"]["elastic"]["warmed_speedup"] = 0.9
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "NO-WARMUP" in bench_diff.ledger_row(a, c)
+    # Zero entries over the wire: the transfer silently stopped.
+    loaded["parsed"]["elastic"]["warmed_speedup"] = 2.1
+    loaded["parsed"]["elastic"]["entries_restored"] = 0
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "NO-TRANSFER" in bench_diff.ledger_row(a, d)
+    # A skipped phase rides in parsed untouched, never in the row.
+    loaded["parsed"]["elastic"] = {"skipped": "prompt too short"}
+    (tmp_path / "e.json").write_text(json.dumps(loaded))
+    e = bench_diff.load_record(str(tmp_path / "e.json"))
+    assert "elastic_warmed_ttft_p99_ms" not in e
+    assert "elastic warmed-join" not in bench_diff.ledger_row(a, e)
+
+
 def test_bench_diff_parses_trace_block(tmp_path):
     """Records grew a TRACE block (ISSUE 12, benchmark.py's tracing
     phase): the measured spans-on vs spans-off overhead fraction must

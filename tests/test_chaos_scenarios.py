@@ -1621,3 +1621,534 @@ def test_chaos_kill_warm_restart_restores_prefix(fenced_pair, tmp_path):
     }
     _publish(result)
     assert cls["recall"] == 1.0, score
+
+
+# ======================================================================
+# Scenarios 12-14: elastic fleet — peer warm-up + planned migration
+# (ISSUE 14)
+# ======================================================================
+
+
+def test_chaos_snapshot_donor_death_mid_transfer(fenced_pair, tmp_path):
+    """Peer warm-up under donor failure: a joiner streaming a warm
+    donor's GET /debug/snapshot (1) succeeds when healthy — the control:
+    restored entries, warm bit-identical serving; (2) degrades to a
+    CLEAN cold start when the stream is torn mid-transfer
+    (engine.snapshot.serve truncate — the donor-died byte shape); and
+    (3) degrades the same way when the donor is literally KILLED
+    mid-transfer (a lying FakeReplica donor trickling real-layout bytes,
+    sockets reset mid-body).  Both faults are scored against the
+    joiner's own engine.snapshot.fetch_failed flight events at
+    precision/recall 1.0 — the healthy control fetch must stay silent."""
+    import threading
+
+    import numpy as np
+
+    from k8s_device_plugin_tpu.models import engine_snapshot as snap
+    from k8s_device_plugin_tpu.utils import failpoints
+    from tests.fakes import FakeReplica
+
+    chaos_report = _chaos_report()
+    server_a, server_b = fenced_pair["server_a"], fenced_pair["server_b"]
+    engine_a, engine_b = fenced_pair["engine_a"], fenced_pair["engine_b"]
+    a_name = f"127.0.0.1:{server_a.port}"
+    # Clean slate regardless of scenario order in the module fixture.
+    server_a.unfence(), server_b.unfence()
+    engine_a.kvcache_clear(), engine_b.kvcache_clear()
+    donor = None
+    try:
+        # Warm the donor: one shared-prefix session (compiled shape).
+        prompt = [9] * 8
+        oracle = _replica_post(server_a.port, prompt, 6)["tokens"]
+        assert len(engine_a._kv_retained) >= 1
+
+        # --- Control: healthy fetch, joiner serves warm bit-identically.
+        res = snap.fetch_peer_snapshot(engine_b, a_name)
+        assert res["ok"] and res["restored"] >= 1, res
+        host0 = engine_b.kv_host_hits
+        got = _replica_post(server_b.port, prompt, 6)["tokens"]
+        assert got == oracle, "peer-warmed join must be bit-identical"
+        assert engine_b.kv_host_hits > host0, "join never restored warm"
+
+        # --- Fault 1: stream torn mid-transfer (donor-died byte shape).
+        engine_b.kvcache_clear()
+        t0_torn = time.time()
+        failpoints.arm(
+            "engine.snapshot.serve", "truncate", arg="0.3", count=1
+        )
+        res = snap.fetch_peer_snapshot(engine_b, a_name)
+        t1_torn = time.time()
+        assert not res["ok"] and res["restored"] == 0
+        assert len(engine_b._kv_arena) == 0, "torn transfer must drop whole"
+
+        # --- Fault 2: donor KILLED mid-transfer.  A fake donor serves
+        # real-layout bytes (so only the kill, not a layout refusal, is
+        # in play), trickled so the kill deterministically lands
+        # mid-body; kill() resets the live socket.
+        with engine_b._lock:
+            layout = snap.snapshot_layout(engine_b)
+            fp = snap.params_fingerprint(engine_b.params)
+        rows = {
+            layer: {
+                pool: np.zeros(
+                    tuple(spec["shape"]),
+                    dtype=snap._resolve_dtype(spec["dtype"]),
+                )
+                for pool, spec in pools.items()
+            }
+            for layer, pools in layout["layers"].items()
+        }
+        entries = {
+            ("prefix", -1, tuple(range(4 * (i + 1)))): rows
+            for i in range(3)
+        }
+        payload = b"".join(snap.encode_snapshot(layout, fp, entries))
+        donor = FakeReplica(snapshot_chunk_s=0.03)
+        donor.snapshot_payload = payload
+        donor.start()
+        holder: dict = {}
+        t0_kill = time.time()
+        fetcher = threading.Thread(
+            target=lambda: holder.update(
+                res=snap.fetch_peer_snapshot(engine_b, donor.name)
+            ),
+            daemon=True,
+        )
+        fetcher.start()
+        time.sleep(0.15)  # mid-body: ~5 of ~{many} trickled chunks out
+        donor.kill()
+        fetcher.join(timeout=30)
+        t1_kill = time.time()
+        res = holder["res"]
+        assert not res["ok"] and res["restored"] == 0, res
+        assert len(engine_b._kv_arena) == 0, "killed donor must drop whole"
+
+        # Cold start is CLEAN: correct tokens, no warm hits claimed.
+        host0 = engine_b.kv_host_hits
+        got = _replica_post(server_b.port, prompt, 6)["tokens"]
+        assert got == oracle, "cold start must still be CORRECT"
+
+        # --- Score: the joiner's own fetch_failed events vs the two
+        # injected fault windows; the control fetch is the precision
+        # gate (any fetch_failed outside the windows is a FP).
+        injected = [
+            {"cls": "snapshot_fetch_fail", "t0": t0_torn, "t1": t1_torn},
+            {"cls": "snapshot_fetch_fail", "t0": t0_kill, "t1": t1_kill},
+        ]
+        detected = [
+            {"cls": "snapshot_fetch_fail", "ts": e["ts"],
+             "peer": e.get("peer")}
+            for e in engine_b.flight.window(
+                kinds=["engine.snapshot.fetch_failed"]
+            )
+        ]
+        score = chaos_report.score_detections(
+            injected, detected, grace_s=2.0
+        )
+        cls = score["per_class"]["snapshot_fetch_fail"]
+        result = {
+            "scenario": "snapshot_donor_death_mid_transfer",
+            "injected": injected,
+            "detected": detected,
+            "score": score,
+            "slo": {
+                "targets": {"poisoned_arenas": 0, "cold_start_correct": True},
+                "measured": {
+                    "control_restored": 1,
+                    "arena_after_faults": len(engine_b._kv_arena),
+                    "cold_tokens_correct": got == oracle,
+                    "donor_serves": donor.snapshot_serves,
+                },
+                "pass": got == oracle and len(engine_b._kv_arena) == 0,
+            },
+            "pass": cls["precision"] == 1.0 and cls["recall"] == 1.0,
+        }
+        _publish(result)
+        assert cls["recall"] == 1.0, score
+        assert cls["precision"] == 1.0, score
+    finally:
+        failpoints.disarm_all()
+        engine_a.kvcache_clear(), engine_b.kvcache_clear()
+        if donor is not None and not donor.killed.is_set():
+            donor.stop()
+
+
+def test_chaos_planned_migration_zero_drop(tmp_path):
+    """Proactive planned migration under live traffic: one of 3
+    replicas turns sustained-hot (its summary exports a hot queue-wait
+    EWMA) while peers run cold — the planner must move its live
+    sessions onto a cold peer with ZERO client-visible drops, every
+    stream bit-identical (the resubmission carries prompt + emitted),
+    and the planning decisions score precision/recall 1.0 against the
+    injected hot window with the two cold replicas as the precision
+    control (a move planned OFF a cold replica would be a false
+    positive)."""
+    from k8s_device_plugin_tpu.router.migration import MigrationConfig
+    from tests.fakes import fake_generate
+    from tests.sim.traffic import RouterTraffic
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(
+        3,
+        token_delay_s=0.04,
+        migrate=True,
+        migration=MigrationConfig(
+            hot_wait_s=0.5, cold_wait_s=0.2, sustain_polls=2,
+            budget=8.0, refill_per_s=4.0, cooldown_s=0.4,
+            max_moves_per_plan=2,
+        ),
+    )
+    try:
+        traffic = RouterTraffic(
+            "127.0.0.1", router.port,
+            seed=29, sessions=4, prefix_len=32,
+            expected_fn=fake_generate,
+        )
+        thread, holder = traffic.run_in_thread(
+            36, concurrency=6, max_new=(16, 24), timeout_s=60.0
+        )
+        from tests.sim.fleet import wait_until as _wait
+
+        assert _wait(
+            lambda: sum(r.active_streams for r in replicas) >= 3,
+            timeout=10,
+        ), "traffic never ramped"
+        # The injected ground truth: ONE replica runs sustained-hot.
+        hot = max(replicas, key=lambda r: r.active_streams)
+        t0 = time.time()
+        hot.wait_ewma_s = 5.0
+        for r in replicas:
+            if r is not hot:
+                r.wait_ewma_s = 0.05
+        assert _wait(
+            lambda: router.metrics.migrations.value(outcome="done") >= 1,
+            timeout=15,
+        ), router.fleet_state()
+        # Signals normalize mid-run: the planner must stop planning.
+        time.sleep(0.6)
+        hot.wait_ewma_s = 0.05
+        t1 = time.time()
+        thread.join(timeout=90)
+        report = holder[0]
+        assert report is not None, "traffic replay never finished"
+
+        injected = [{
+            "cls": "planned_migration", "replica": hot.name,
+            "t0": t0, "t1": t1,
+        }]
+        detected = [
+            {"cls": "planned_migration", "replica": e["replica"],
+             "ts": e["ts"]}
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "router.migration_planned"
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        mig = score["per_class"]["planned_migration"]
+        done = router.metrics.migrations.value(outcome="done")
+        result = {
+            "scenario": "planned_migration_zero_drop", "replicas": 3,
+            "injected": injected, "detected": detected, "score": score,
+            "slo": {
+                "targets": {"dropped_streams": 0, "migrations_done": ">=1"},
+                "measured": {
+                    "dropped_streams": report.dropped,
+                    "migrations_planned": router.metrics.migrations.value(
+                        outcome="planned"
+                    ),
+                    "migrations_done": done,
+                    "migrations_aborted": router.metrics.migrations.value(
+                        outcome="aborted"
+                    ),
+                    "failovers": router.metrics.failovers.value(),
+                    "traffic": report.as_dict(),
+                },
+                "pass": report.dropped == 0 and done >= 1,
+            },
+            "pass": (
+                mig["precision"] == 1.0 and mig["recall"] == 1.0
+                and report.dropped == 0
+            ),
+        }
+        _publish(result)
+        # THE contract: zero client-visible drops, every stream
+        # bit-identical (expected_fn marks a corrupted stream dropped).
+        assert report.dropped == 0, report.as_dict()
+        assert report.completed == report.submitted, report.as_dict()
+        assert done >= 1
+        # No faults were injected: a planned move is NOT a failover.
+        assert router.metrics.failovers.value() == 0
+        # Measured planner quality: plans only off the hot replica,
+        # only inside the hot window.
+        assert mig["recall"] == 1.0, score
+        assert mig["precision"] == 1.0, score
+        cold_names = {r.name for r in replicas} - {hot.name}
+        assert not [
+            d for d in detected if d["replica"] in cold_names
+        ], detected
+    finally:
+        _teardown_router(replicas, router)
+
+
+def _timed_stream(port, prompt, n_new, rid, results, timeout=60):
+    """One SSE stream through the router: (ttft_s, tokens, completed)
+    appended to ``results`` under ``rid``."""
+    import http.client
+
+    out = {"rid": rid, "ttft_s": None, "tokens": [], "completed": False}
+    t0 = time.monotonic()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request(
+            "POST", "/generate",
+            json.dumps(
+                {"prompt": prompt, "max_new_tokens": n_new, "stream": True}
+            ).encode(),
+            headers={"X-Request-Id": rid},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            results.append(out)
+            return
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            ev = json.loads(line[5:])
+            if "token" in ev:
+                if out["ttft_s"] is None:
+                    out["ttft_s"] = time.monotonic() - t0
+                out["tokens"].append(ev["token"])
+            if ev.get("done"):
+                out["tokens"] = list(ev.get("tokens", out["tokens"]))
+                out["completed"] = True
+                break
+            if "error" in ev:
+                break
+        conn.close()
+    except OSError:
+        pass
+    results.append(out)
+
+
+def test_chaos_diurnal_burst_peer_warmed_scale_up(tmp_path):
+    """The ISSUE 14 acceptance scenario: a diurnal burst doubles the
+    fleet (2 -> 4 replicas).  The scale signal (/debug/fleet) must read
+    scale_up while the warm peers run hot with no cold headroom; the
+    new replica that warm-joined (donor picked via donor_for from the
+    router's membership view, snapshot streamed in the real wire
+    format) must serve its first-minute traffic with TTFT p99 within
+    ~1.2x of the warm peers, while the cold-join control pays the cold
+    re-prefill; zero drops, every stream bit-identical."""
+    import threading
+
+    from k8s_device_plugin_tpu.models.engine_snapshot import (
+        donor_for,
+        fleet_members,
+    )
+    from k8s_device_plugin_tpu.router.ring import HashRing
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+    from tests.fakes import FakeReplica, fake_generate
+    from tests.sim.fleet import wait_until as _wait
+
+    mk = dict(
+        token_delay_s=0.02, prefix_tokens=32, cold_prefill_delay_s=0.35
+    )
+    # All four replicas exist up front (their names pin the ring), but
+    # the joiners stay OUT of the router until the burst.
+    warm_a, warm_b = FakeReplica(**mk).start(), FakeReplica(**mk).start()
+    cold_join, warm_join = FakeReplica(**mk).start(), FakeReplica(**mk).start()
+    flight = FlightRecorder(capacity=4096, name="elastic-router")
+    router = RouterServer(
+        [warm_a.name, warm_b.name],
+        host="127.0.0.1", port=0, flight=flight,
+        poll_interval_s=0.15, hedge=False,
+        upstream_timeout_s=60.0, request_timeout_s=60.0,
+    ).start()
+    try:
+        # Sessions crafted per FUTURE home: the 4-replica ring decides
+        # which sessions will remap onto each joiner, so every group
+        # (warm peers / warm joiner / cold joiner) measures >= 3
+        # sessions deterministically.
+        future = HashRing(
+            [warm_a.name, warm_b.name, cold_join.name, warm_join.name],
+            vnodes=router.ring.vnodes,
+        )
+        groups: dict[str, list] = {
+            warm_a.name: [], warm_b.name: [],
+            cold_join.name: [], warm_join.name: [],
+        }
+        salt = 0
+        while any(len(v) < 3 for v in groups.values()):
+            salt += 1
+            prompt = [(salt * 7 + j) % 500 + 2 for j in range(32)]
+            home = future.lookup(router.policy.key_of(prompt))
+            if len(groups[home]) < 3:
+                groups[home].append(prompt)
+        sessions = [p for v in groups.values() for p in v]
+
+        # ---- Phase 1 (pre-burst): the 2-replica fleet serves every
+        # session and warms its tiers.
+        results1: list = []
+        threads = [
+            threading.Thread(
+                target=_timed_stream,
+                args=(router.port, p, 8, f"warm-{i}", results1),
+                daemon=True,
+            )
+            for i, p in enumerate(sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r["completed"] for r in results1), results1
+        # Steady-state assumption a long-lived fleet earns: overflow,
+        # hedging, and failover history spread hot sessions across the
+        # warm peers — seed the union directly so the donor's snapshot
+        # covers the fleet's hot set.
+        union = warm_a.warm_prefixes | warm_b.warm_prefixes
+        warm_a.warm_prefixes |= union
+        warm_b.warm_prefixes |= union
+
+        # ---- The scale signal: both peers report sustained-hot with
+        # no cold headroom -> /debug/fleet must recommend scale_up.
+        warm_a.wait_ewma_s = warm_b.wait_ewma_s = 5.0
+        import urllib.request as _url
+
+        def _fleet():
+            return json.loads(
+                _url.urlopen(
+                    f"http://127.0.0.1:{router.port}/debug/fleet",
+                    timeout=5,
+                ).read()
+            )
+
+        assert _wait(
+            lambda: _fleet()["recommendation"]["action"] == "scale_up",
+            timeout=5,
+        ), _fleet()
+        rec_up = _fleet()["recommendation"]
+        assert rec_up["suggested_replicas"] > rec_up["replicas"]
+
+        # ---- The burst: replica count DOUBLES.  The warm joiner pulls
+        # its donor's snapshot (donor resolved from the router's own
+        # membership view) BEFORE taking traffic; the cold joiner is
+        # the control.
+        members = fleet_members(f"http://127.0.0.1:{router.port}")
+        assert set(members) == {warm_a.name, warm_b.name}
+        donor = donor_for(warm_join.name, members)
+        assert donor in members
+        res = warm_join.warm_from_peer(donor)
+        assert res["ok"] and res["restored"] == len(
+            {tuple(p) for p in sessions}
+        ), res
+        router.add_replica(cold_join.name)
+        router.add_replica(warm_join.name)
+        warm_a.wait_ewma_s = warm_b.wait_ewma_s = 0.1
+        assert len(router.replicas) == 4, "fleet must double"
+        assert _wait(
+            lambda: all(
+                st.reachable for st in router.replicas.values()
+            ),
+            timeout=5,
+        )
+
+        # ---- Phase 2 (first minute, compressed): every session streams
+        # 3x; the first round pays any cold prefill — exactly the
+        # first-minute TTFT the acceptance bar is about.
+        results2: list = []
+        for round_i in range(3):
+            threads = [
+                threading.Thread(
+                    target=_timed_stream,
+                    args=(
+                        router.port, p, 8,
+                        f"burst-{round_i}-{i}", results2,
+                    ),
+                    daemon=True,
+                )
+                for i, p in enumerate(sessions)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        assert all(r["completed"] for r in results2), [
+            r for r in results2 if not r["completed"]
+        ]
+        # Bit-identical everywhere (prompt is recoverable per rid).
+        rid_prompt = {
+            f"burst-{ri}-{i}": p
+            for ri in range(3)
+            for i, p in enumerate(sessions)
+        }
+        for r in results2:
+            assert r["tokens"] == fake_generate(rid_prompt[r["rid"]], 8), r
+
+        def _p99(ttfts):
+            ordered = sorted(ttfts)
+            assert ordered, "a measurement group served no streams"
+            return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+        by_home: dict[str, list] = {name: [] for name in groups}
+        for r in results2:
+            home = router.ring.order(
+                router.policy.key_of(rid_prompt[r["rid"]])
+            )[0]
+            by_home[home].append(r["ttft_s"])
+        peers_p99 = _p99(by_home[warm_a.name] + by_home[warm_b.name])
+        warm_p99 = _p99(by_home[warm_join.name])
+        cold_p99 = _p99(by_home[cold_join.name])
+        # The acceptance bar (~1.2x warm peers) with a small absolute
+        # floor for scheduler noise on a loaded CI box; the JSON result
+        # carries the exact figures either way.
+        bar = max(1.2 * peers_p99, peers_p99 + 0.05)
+        result = {
+            "scenario": "diurnal_burst_peer_warmed_scale_up",
+            "replicas": {"before": 2, "after": len(router.replicas)},
+            "recommendation_at_burst": rec_up,
+            "slo": {
+                "targets": {
+                    "warm_join_ttft_p99_vs_peers": "<= ~1.2x",
+                    "dropped_streams": 0,
+                },
+                "measured": {
+                    "peers_ttft_p99_s": round(peers_p99, 4),
+                    "warm_join_ttft_p99_s": round(warm_p99, 4),
+                    "cold_join_ttft_p99_s": round(cold_p99, 4),
+                    "warm_join_ratio": round(warm_p99 / peers_p99, 3),
+                    "cold_join_ratio": round(cold_p99 / peers_p99, 3),
+                    "warm_join_cold_prefills": warm_join.cold_prefills,
+                    "cold_join_cold_prefills": cold_join.cold_prefills,
+                    "snapshot_restored": res["restored"],
+                    "donor": donor,
+                },
+                "pass": warm_p99 <= bar,
+            },
+            "pass": warm_p99 <= bar and cold_join.cold_prefills >= 3,
+        }
+        _publish(result)
+        # The warm joiner inherited the donor's hot set: ZERO cold
+        # prefills, first-minute p99 inside the bar.
+        assert warm_join.cold_prefills == 0, (
+            "peer warm-up left the joiner cold"
+        )
+        assert warm_p99 <= bar, result["slo"]["measured"]
+        # The control proves the bar means something: the cold joiner
+        # paid the re-prefill on every remapped session.
+        assert cold_join.cold_prefills >= 3
+        assert cold_p99 >= 0.3, result["slo"]["measured"]
+        # After the burst absorbed, the fleet verdict relaxes.
+        assert _wait(
+            lambda: _fleet()["recommendation"]["action"] != "scale_up",
+            timeout=5,
+        ), _fleet()
+    finally:
+        router.stop()
+        for r in (warm_a, warm_b, cold_join, warm_join):
+            if not r.killed.is_set():
+                r.stop()

@@ -261,6 +261,11 @@ def test_engine_metrics(rng):
     assert "tpu_engine_tokens_total" in text and "tpu_engine_free_pages" in text
 
 
+# Composition blankets ride --slow (the PR 13 buy-back pattern): each
+# feature keeps its own targeted tier-1 pin, and the cross-product runs
+# in the slow tier — tier-1 sits within seconds of its 870s driver
+# timeout on the 1-core box, and these are its priciest redundancy.
+@pytest.mark.slow
 def test_engine_composes_with_gqa_window_and_quant(rng):
     """The serving engine must work for the model features decode supports:
     GQA (grouped cache), sliding-window masking, and int8 weights — each
@@ -494,6 +499,7 @@ def test_engine_with_int8_paged_kv(rng):
     assert eng._kv_rows_nbytes(rows) == cfg.num_layers * (codes + scales)
 
 
+@pytest.mark.slow  # composition blanket (see the buy-back note above)
 def test_engine_int8_kv_composes_with_window_and_spec(rng):
     """quant_kv + sliding window + speculation on one engine: the draft
     writes quantized approximate K/V, the verify overwrites quantized
@@ -853,6 +859,7 @@ def test_chunked_prefill_prefix_share_waits_for_graft(rng):
     )
 
 
+@pytest.mark.slow  # composition blanket (see the buy-back note above)
 def test_chunked_prefill_composes_with_spec_and_window(rng):
     from k8s_device_plugin_tpu.ops.quant import quantize_lm_params
 
@@ -1164,6 +1171,7 @@ def test_decode_block_eos_and_max_new_mid_block(rng):
     assert req2.tokens == _oracle(cfg, params, prompt, 5)
 
 
+@pytest.mark.slow  # composition blanket (see the buy-back note above)
 def test_decode_block_composes_with_window_kernel_and_pages(rng):
     """Blocks cross page boundaries (page_size=2 < T=4), stream through
     the paged kernel, and windowed reclamation still frees scrolled
@@ -1464,6 +1472,7 @@ def test_optimistic_preemption_preserves_prefix_sharing(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+@pytest.mark.slow  # composition blanket (see the buy-back note above)
 def test_optimistic_composes_with_blocks_and_window(rng):
     """Decode blocks grow their T-token frontier through the optimistic
     allocator, and windowed reclamation returns pages to the shared

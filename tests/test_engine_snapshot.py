@@ -14,6 +14,7 @@ poisoned cache.
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -257,3 +258,214 @@ def test_engine_snapshot_missing_and_disabled(tiered_engine, tmp_path):
     res = snap.load_arena_snapshot(eng, path)
     assert not res["ok"] and res["reason"] == "arena_disabled"
     eng._kv_arena.budget_bytes = 8 << 20
+
+
+# --------------------------------------------- peer warm join (ISSUE 14)
+
+
+def _served(eng):
+    """An EngineServer over the session engine for the snapshot-stream
+    surface (the drain-test ownership pattern: hand step ownership to
+    the server's loop thread; it dies at stop() and the main thread
+    inherits back)."""
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+
+    if eng._inflight_guard is not None:
+        eng._inflight_guard._owner = None
+    return EngineServer(eng, host="127.0.0.1", port=0).start()
+
+
+def test_snapshot_stream_serve_fetch_and_refusals(tiered_engine):
+    """GET /debug/snapshot: the wire stream parses through the same
+    verifier as the disk format and carries the negotiation headers; an
+    incompatible fingerprint is refused with 409 BEFORE any bytes; a
+    Range (resumable) fetch is refused whole-blob-only with 416; and
+    fetch_peer_snapshot round-trips the stream into the arena with the
+    warm prefix replaying bit-identically on the joiner side."""
+    import http.client
+    import io
+
+    cfg, params, eng = tiered_engine
+    prompt = [3, 141, 59, 7]
+    ref = _warm(eng, prompt)
+    server = _served(eng)
+    try:
+        with eng._lock:
+            layout = snap.snapshot_layout(eng)
+            fp = snap.params_fingerprint(eng.params)
+        lfp = snap.layout_fingerprint(layout)
+
+        def _get(headers):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=10
+            )
+            conn.request("GET", "/debug/snapshot", headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            out = (resp.status, dict(resp.getheaders()), body)
+            conn.close()
+            return out
+
+        status, headers, wire = _get(
+            {snap.LAYOUT_HEADER: lfp, snap.PARAMS_HEADER: fp}
+        )
+        assert status == 200
+        assert headers[snap.LAYOUT_HEADER] == lfp
+        assert headers[snap.PARAMS_HEADER] == fp
+        _, entries = snap._parse_snapshot(io.BytesIO(wire), layout, fp)
+        assert len(entries) == int(headers[snap.ENTRIES_HEADER]) >= 1
+        assert all(key[0] == "prefix" for key, _, _ in entries)
+
+        # Fingerprint refusal: 409, and NO snapshot bytes moved.
+        status, headers, body = _get({snap.PARAMS_HEADER: "deadbeef"})
+        assert status == 409
+        refused = json.loads(body)
+        assert refused["layout"] == lfp
+        assert refused["params_fingerprint"] == fp
+        status, _, _ = _get({snap.LAYOUT_HEADER: "00000000"})
+        assert status == 409
+
+        # Resumable fetch refused: whole blob or nothing.
+        status, _, body = _get({"Range": "bytes=100-"})
+        assert status == 416
+        assert b"whole-blob" in body
+
+        # The fetch path proper (into the same arena: puts are
+        # content-addressed, so the round trip is an exact overwrite).
+        res = snap.fetch_peer_snapshot(eng, f"127.0.0.1:{server.port}")
+        assert res["ok"] and res["restored"] == len(entries)
+        assert any(
+            e["kind"] == "engine.snapshot.fetched"
+            for e in eng.flight.window(kinds=["engine.snapshot.fetched"])
+        )
+        served = [
+            e for e in eng.flight.window(kinds=["engine.snapshot.served"])
+        ]
+        assert served and served[-1]["bytes"] == len(wire)
+    finally:
+        server.stop()
+
+    # The joiner: every tier cleared (a fresh replica), the downloaded
+    # wire rehydrated through the same admit path — the next
+    # same-prefix request restores host->device, bit-identical.
+    eng.kvcache_clear()
+    _, parsed = snap._parse_snapshot(io.BytesIO(wire), layout, fp)
+    assert snap._admit_entries(eng, parsed) == len(entries)
+    host0 = eng.kv_host_hits
+    warm = eng.run([(prompt, 6)])[0].tokens
+    assert warm == ref, "peer-warmed join must replay bit-identically"
+    assert eng.kv_host_hits > host0, "warmed join never hit the arena"
+
+
+def test_snapshot_peer_fetch_degrades_to_clean_cold(tiered_engine):
+    """The joiner degradation contract under every injected fault: a
+    donor stream torn mid-transfer (serve truncate — the donor-died
+    shape), a joiner-side truncated read, a fetch dial error, and an
+    unreachable peer ALL leave an empty arena and correct cold tokens;
+    disarmed, the same fetch succeeds."""
+    cfg, params, eng = tiered_engine
+    prompt = [3, 141, 59, 7]
+    ref = _warm(eng, prompt)
+    server = _served(eng)
+    peer = f"127.0.0.1:{server.port}"
+    try:
+        failpoints.arm("engine.snapshot.serve", "truncate", arg="0.5",
+                       count=1)
+        res = snap.fetch_peer_snapshot(eng, peer)
+        assert not res["ok"] and res["restored"] == 0
+        assert len(eng._kv_arena) == 0, "torn transfer must drop whole"
+        assert res["outcome"] == "corrupt"
+
+        failpoints.arm("engine.snapshot.fetch", "truncate", arg="0.4",
+                       count=1)
+        res = snap.fetch_peer_snapshot(eng, peer)
+        assert not res["ok"] and len(eng._kv_arena) == 0
+
+        failpoints.arm("engine.snapshot.fetch", "error", count=1)
+        res = snap.fetch_peer_snapshot(eng, peer)
+        assert not res["ok"] and len(eng._kv_arena) == 0
+        fails = eng.flight.window(kinds=["engine.snapshot.fetch_failed"])
+        assert len(fails) >= 3 and fails[-1]["peer"] == peer
+
+        # An unreachable peer is an ordinary cold join, not a crash.
+        res = snap.fetch_peer_snapshot(eng, "127.0.0.1:1")
+        assert not res["ok"] and res["outcome"] == "unreachable"
+
+        # Disarmed: the same donor serves a good stream (the retained
+        # tier survives the arena clears above).
+        res = snap.fetch_peer_snapshot(eng, peer)
+        assert res["ok"] and res["restored"] >= 1
+    finally:
+        failpoints.disarm_all()
+        server.stop()
+    # Cold-start correctness after the failures: exact tokens.
+    eng.kvcache_clear()
+    assert eng.run([(prompt, 6)])[0].tokens == ref
+
+
+def test_fence_and_periodic_save_serialize_on_one_lock(
+    tiered_engine, tmp_path
+):
+    """The ISSUE 14 bugfix pin: saves serialize on ONE save lock (two
+    concurrent saves cannot overlap — proven by a per-save injected
+    delay), and a stale periodic save that was queued behind a fence's
+    save must NOT republish over it (the fence-path save may have
+    deliberately excluded device rows off a sick chip)."""
+    import threading
+
+    from k8s_device_plugin_tpu.models.http_server import EngineServer
+
+    cfg, params, eng = tiered_engine
+    _warm(eng, [3, 141, 59, 7])
+    if eng._inflight_guard is not None:
+        eng._inflight_guard._owner = None
+    server = EngineServer(
+        eng, host="127.0.0.1", port=0,
+        snapshot_dir=str(tmp_path), snapshot_interval_s=0,
+    )
+    path = str(tmp_path / snap.SNAPSHOT_NAME)
+    try:
+        # One save lock: two concurrent saves, each slowed 0.15s by the
+        # save failpoint, must run back to back — and the surviving
+        # file parses clean (never torn by the race).
+        failpoints.arm("engine.snapshot.save", "delay", arg="0.15",
+                       count=2)
+        results: list = []
+        threads = [
+            threading.Thread(
+                target=lambda trig=t: results.append(
+                    server.save_snapshot(trigger=trig)
+                )
+            )
+            for t in ("periodic", "manual")
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.3, (
+            f"saves overlapped ({elapsed:.3f}s): the save lock is gone"
+        )
+        assert all(r["ok"] for r in results), results
+        snap.read_snapshot(path)  # parses whole: no tear
+
+        # The race the lock + re-check close: fence first (its save
+        # runs, device rows excluded for a chip fence), then the STALE
+        # periodic save that had already passed its outside-the-lock
+        # fence check tries to publish — and must be turned away.
+        assert server.begin_fence("sick chip", source="chip_health")
+        before = open(path, "rb").read()
+        res = server.save_snapshot(trigger="periodic")
+        assert not res["ok"] and res["reason"] == "fenced"
+        assert open(path, "rb").read() == before, (
+            "stale periodic save republished over the fence-path save"
+        )
+        # Orderly triggers (drain/SIGTERM/operator) still save while
+        # fenced — only the stale periodic writer is refused.
+        assert server.save_snapshot(trigger="drain")["ok"]
+        server.unfence()
+    finally:
+        failpoints.disarm_all()
+        server._httpd.server_close()

@@ -750,6 +750,14 @@ def test_debug_state_summary_mode(served):
     assert full["loop_alive"] is True
     assert "engine" in full and "spans" in full and "fence" in full
     summary = _get_json(server.port, "/debug/state?summary=1")
+    # The host-side overload signals (ISSUE 14) ride along; their
+    # values depend on traffic order within the module fixture, so the
+    # shape is pinned here and the populated-after-traffic behaviour in
+    # test_summary_carries_host_side_overload_signals.
+    assert "queue_wait_ewma_s" in summary
+    assert "drain_rate_rps" in summary
+    summary.pop("queue_wait_ewma_s")
+    summary.pop("drain_rate_rps")
     assert summary == {
         "queue_depth": 0,
         "active_slots": 0,
@@ -1147,3 +1155,58 @@ def test_debug_spans_endpoint_shape_and_rid_filter(served):
     some = next(iter(tids - {"engine"}))
     only = _get_json(server.port, f"/debug/spans?rid={some}")
     assert only["spans"] and {s["trace_id"] for s in only["spans"]} == {some}
+
+
+def test_summary_carries_host_side_overload_signals(served):
+    """The router's poll surface grew the migration/scale signals
+    (ISSUE 14): ?summary=1 carries queue_wait_ewma_s / drain_rate_rps
+    off the overload controller — populated after traffic on this
+    overload-on fixture, and still present (as null) in the full
+    state's top level."""
+    _, _, server = served
+    _post(server.port, {"prompt": [9, 8, 7], "max_new_tokens": 2})
+    summary = _get(server.port, "/debug/state?summary=1")
+    assert "queue_wait_ewma_s" in summary and "drain_rate_rps" in summary
+    assert summary["queue_wait_ewma_s"] is not None, (
+        "overload-on fixture served traffic: the wait EWMA must exist"
+    )
+    full = _get(server.port, "/debug/state")
+    assert "queue_wait_ewma_s" in full
+
+
+def test_debug_snapshot_endpoint_contract_smoke(served):
+    """GET /debug/snapshot on a live server: 200 + negotiation headers
+    + a parseable wire stream (arena-less fixture: zero entries), 409
+    on a mismatched fingerprint BEFORE any bytes, 416 on Range.  The
+    warm-path byte-for-byte semantics ride the tiered engine suite in
+    tests/test_engine_snapshot.py."""
+    import http.client
+    import io
+
+    from k8s_device_plugin_tpu.models import engine_snapshot as snap
+
+    _, _, server = served
+
+    def _raw(headers):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=30
+        )
+        conn.request("GET", "/debug/snapshot", headers=headers)
+        resp = conn.getresponse()
+        out = (resp.status, dict(resp.getheaders()), resp.read())
+        conn.close()
+        return out
+
+    status, headers, body = _raw({})
+    assert status == 200
+    assert snap.LAYOUT_HEADER in headers and snap.PARAMS_HEADER in headers
+    with server.engine._lock:
+        layout = snap.snapshot_layout(server.engine)
+    _, entries = snap._parse_snapshot(
+        io.BytesIO(body), layout, headers[snap.PARAMS_HEADER]
+    )
+    assert len(entries) == int(headers[snap.ENTRIES_HEADER])
+    status, _, _ = _raw({snap.PARAMS_HEADER: "deadbeef"})
+    assert status == 409
+    status, _, _ = _raw({"Range": "bytes=0-99"})
+    assert status == 416

@@ -1249,3 +1249,360 @@ def test_killed_stream_assembles_one_timeline_zero_gaps():
         assert len(det) == 1 and det[0]["rid"] == "killed-1"
     finally:
         _teardown(replicas, router)
+
+
+# ======================================================================
+# Elastic fleet (ISSUE 14): migration planner, planned moves, /debug/fleet
+# ======================================================================
+
+
+def test_migration_planner_sustained_hot_budget_and_cooldown():
+    """Planner units on a fake clock: no plan before `sustain_polls`
+    consecutive hot polls, the hottest source pairs with the coldest
+    target, the token-bucket budget paces moves, and the per-source
+    cooldown blocks immediate re-planning."""
+    from k8s_device_plugin_tpu.router.migration import (
+        MigrationConfig,
+        MigrationPlanner,
+    )
+
+    t = [100.0]
+    cfg = MigrationConfig(
+        hot_wait_s=1.0, cold_wait_s=0.3, sustain_polls=3,
+        budget=2.0, refill_per_s=1.0, max_moves_per_plan=2,
+        cooldown_s=10.0,
+    )
+    pl = MigrationPlanner(cfg, now=lambda: t[0])
+
+    def sweep(hot_wait=5.0, cold_wait=0.1):
+        pl.observe("hot:1", wait_ewma_s=hot_wait, drain_rate_rps=None,
+                   queue_depth=8, eligible=True)
+        pl.observe("cold:1", wait_ewma_s=cold_wait, drain_rate_rps=None,
+                   queue_depth=0, eligible=True)
+
+    sweep()
+    assert pl.plan() is None  # 1 hot poll: not sustained
+    sweep()
+    assert pl.plan() is None  # 2: still not
+    sweep()
+    assert pl.plan() == ("hot:1", "cold:1", 2)  # 3: plan, spends budget
+    sweep(), sweep(), sweep()
+    assert pl.plan() is None, "budget spent: no plan until refill"
+    t[0] += 2.0  # refill 2 tokens — but the 10s cooldown still holds
+    sweep()
+    assert pl.plan() is None
+    t[0] += 10.0
+    sweep(), sweep(), sweep()
+    assert pl.plan() == ("hot:1", "cold:1", 2)
+    # A cool poll resets the streak: hot again needs a full sustain run.
+    t[0] += 10.0
+    sweep(), sweep()
+    sweep(hot_wait=0.0)
+    sweep(), sweep()
+    assert pl.plan() is None
+    sweep()
+    assert pl.plan() is not None
+
+
+def test_migration_planner_requires_cold_target_and_eligibility():
+    """Fleet-wide hot is a SCALE signal, not a migration: no cold peer
+    -> no plan.  Ineligible replicas (draining/fenced/unreachable) are
+    neither sources nor targets, and pressure falls back to the
+    queue-depth/drain-rate forecast when no EWMA is exported."""
+    from k8s_device_plugin_tpu.router.migration import (
+        MigrationConfig,
+        MigrationPlanner,
+        replica_pressure,
+    )
+
+    t = [0.0]
+    pl = MigrationPlanner(
+        MigrationConfig(hot_wait_s=1.0, cold_wait_s=0.3, sustain_polls=1),
+        now=lambda: t[0],
+    )
+    # Both hot: nowhere to move.
+    pl.observe("a:1", wait_ewma_s=5.0, drain_rate_rps=None,
+               queue_depth=9, eligible=True)
+    pl.observe("b:1", wait_ewma_s=4.0, drain_rate_rps=None,
+               queue_depth=9, eligible=True)
+    assert pl.plan() is None
+    # A cold peer exists but is fenced (ineligible): still no plan.
+    pl.observe("b:1", wait_ewma_s=0.1, drain_rate_rps=None,
+               queue_depth=0, eligible=False)
+    assert pl.plan() is None
+    # Eligible cold peer: plan fires, hottest -> coldest.
+    pl.observe("b:1", wait_ewma_s=0.1, drain_rate_rps=None,
+               queue_depth=0, eligible=True)
+    src, dst, n = pl.plan()
+    assert (src, dst) == ("a:1", "b:1") and n >= 1
+    # Pressure fallback: no EWMA -> queue/drain forecast; no data -> 0.
+    assert replica_pressure(None, 2.0, 10) == 5.0
+    assert replica_pressure(None, None, 10) == 0.0
+    assert replica_pressure(1.5, 2.0, 10) == 1.5
+    # Config validation.
+    with pytest.raises(ValueError):
+        MigrationPlanner(MigrationConfig(hot_wait_s=0.2, cold_wait_s=0.3))
+    with pytest.raises(ValueError):
+        MigrationPlanner(MigrationConfig(sustain_polls=0))
+
+
+def test_scale_recommendation_verdicts():
+    """scale_up when a hot majority has no cold headroom, scale_down
+    only when EVERYONE is cold with empty queues, hold otherwise —
+    and never anything but hold without data."""
+    from k8s_device_plugin_tpu.router.migration import scale_recommendation
+
+    def row(pressure, depth=0, eligible=True):
+        return {"pressure_s": pressure, "queue_depth": depth,
+                "eligible": eligible}
+
+    up = scale_recommendation(
+        {"a:1": row(5.0, 9), "b:1": row(4.0, 7)},
+        hot_wait_s=2.0, cold_wait_s=0.5,
+    )
+    assert up["action"] == "scale_up"
+    assert up["suggested_replicas"] > up["replicas"]
+    # Hot majority BUT a cold peer exists: migrate first, hold scale.
+    hold = scale_recommendation(
+        {"a:1": row(5.0, 9), "b:1": row(4.0, 7), "c:1": row(0.1)},
+        hot_wait_s=2.0, cold_wait_s=0.5,
+    )
+    assert hold["action"] == "hold" and hold["cold"] == ["c:1"]
+    down = scale_recommendation(
+        {"a:1": row(0.0), "b:1": row(0.1)},
+        hot_wait_s=2.0, cold_wait_s=0.5,
+    )
+    assert down["action"] == "scale_down"
+    assert down["suggested_replicas"] == 1
+    # Cold but with queued work: hold (the queue says otherwise).
+    busy = scale_recommendation(
+        {"a:1": row(0.0, 3), "b:1": row(0.1)},
+        hot_wait_s=2.0, cold_wait_s=0.5,
+    )
+    assert busy["action"] == "hold"
+    # One replica, cold: never scale below one.
+    one = scale_recommendation({"a:1": row(0.0)})
+    assert one["action"] == "hold"
+    # No eligible data: hold, never a guess.
+    none = scale_recommendation({"a:1": row(0.0, eligible=False)})
+    assert none["action"] == "hold"
+
+
+def test_donor_for_picks_adjacent_ring_owner():
+    """The warm-up donor is the peer owning the ring segments the
+    joiner inherits: deterministic, never the joiner itself, None with
+    no peers — and consistent with the router's own ring (the vnode
+    scheme and hash are shared)."""
+    from k8s_device_plugin_tpu.models.engine_snapshot import donor_for
+
+    peers = [f"10.0.0.{i}:8000" for i in range(1, 6)]
+    joiner = "10.0.0.9:8000"
+    donor = donor_for(joiner, peers)
+    assert donor in peers
+    # Deterministic regardless of listing order, joiner excluded.
+    assert donor_for(joiner, list(reversed(peers)) + [joiner]) == donor
+    assert donor_for(joiner, [joiner]) is None
+    assert donor_for(joiner, []) is None
+    # The donor really is the plurality owner of the joiner's segments.
+    from collections import Counter
+
+    from k8s_device_plugin_tpu.router.ring import HashRing, _hash64
+
+    ring = HashRing(peers, vnodes=64)
+    counts = Counter(
+        ring.lookup(_hash64(f"{joiner}#{i}".encode())) for i in range(64)
+    )
+    assert counts[donor] == max(counts.values())
+
+
+def test_summary_signals_reach_replica_state_and_fleet():
+    """The poll loop carries queue_wait_ewma_s / drain_rate_rps into
+    ReplicaState, and GET /debug/fleet turns them into per-replica
+    pressure plus a scale recommendation (hot fleet -> scale_up)."""
+    replicas, router, _ = _fleet(2)
+    try:
+        for r in replicas:
+            r.wait_ewma_s = 4.0
+            r.drain_rate_rps = 2.5
+        assert wait_until(
+            lambda: all(
+                st.queue_wait_ewma_s == 4.0 and st.drain_rate_rps == 2.5
+                for st in router.replicas.values()
+            ),
+            timeout=5,
+        ), {n: st.snapshot() for n, st in router.replicas.items()}
+        fleet = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/debug/fleet", timeout=5
+            ).read()
+        )
+        assert set(fleet["replicas"]) == {r.name for r in replicas}
+        for row in fleet["replicas"].values():
+            assert row["pressure_s"] == 4.0 and row["eligible"]
+        assert fleet["recommendation"]["action"] == "scale_up"
+        assert fleet["migration"] == {"enabled": False}
+        # /debug/router still carries the raw signals per replica.
+        snap = router.snapshot()
+        assert all(
+            st["queue_wait_ewma_s"] == 4.0
+            for st in snap["replicas"].values()
+        )
+    finally:
+        _teardown(replicas, router)
+
+
+def test_planner_driven_migration_zero_drop_bit_identical():
+    """End to end through the REAL planner: a sustained-hot replica's
+    live stream is planned onto the cold peer at a paced token boundary
+    and completes bit-identically — zero client-visible drops, planned
+    and done both metered and on the flight timeline."""
+    import threading
+
+    from k8s_device_plugin_tpu.router.migration import MigrationConfig
+
+    replicas, router, flight = _fleet(
+        2,
+        router_kwargs=dict(
+            migrate=True,
+            migration=MigrationConfig(
+                hot_wait_s=1.0, cold_wait_s=0.3, sustain_polls=2,
+                budget=4.0, refill_per_s=10.0, cooldown_s=0.2,
+                max_moves_per_plan=2,
+            ),
+        ),
+        token_delay_s=0.03,
+    )
+    try:
+        hot = replicas[0]
+        cold = replicas[1]
+        prompt = _home_prompt(router, hot.name)
+        expect = fake_generate(prompt, 30)
+        result: dict = {}
+
+        def _run():
+            result["events"], result["tokens"] = _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": 30},
+                timeout=30,
+            )
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+        # Only once the stream is live does the fleet turn hot: the
+        # planner needs an actual session to move.
+        assert wait_until(lambda: hot.active_streams > 0, timeout=10)
+        hot.wait_ewma_s = 5.0
+        cold.wait_ewma_s = 0.05
+        assert wait_until(
+            lambda: router.metrics.migrations.value(outcome="done") >= 1,
+            timeout=10,
+        ), router.fleet_state()
+        thread.join(timeout=30)
+        assert result["tokens"] == expect, "migrated stream must be " \
+            "bit-identical"
+        assert result["events"][-1]["done"]
+        # The move really crossed replicas: the cold peer served the
+        # continuation as prompt + emitted under the same rid.
+        assert cold.generate_requests >= 1
+        assert router.metrics.migrations.value(outcome="planned") >= 1
+        kinds = [e["kind"] for e in flight.snapshot()["events"]]
+        assert "router.migration_planned" in kinds
+        assert "router.migration_done" in kinds
+        # Zero-drop means zero failovers too: a planned move never
+        # counts as (or causes) a death.
+        assert router.metrics.failovers.value() == 0
+    finally:
+        _teardown(replicas, router)
+
+
+def test_migration_aborts_when_target_breaker_open():
+    """The abort contract: a planned move whose target's breaker is
+    open stays put — the stream finishes on its home replica,
+    bit-identical, with outcome=aborted metered and NO done."""
+    replicas, router, flight = _fleet(
+        2,
+        router_kwargs=dict(
+            migrate=True, breaker_open_s=30.0,
+        ),
+        token_delay_s=0.03,
+    )
+    try:
+        src, target = replicas[0], replicas[1]
+        prompt = _home_prompt(router, src.name)
+        expect = fake_generate(prompt, 12)
+        # Trip the target's breaker (stays open for 30s).
+        breaker = router.replicas[target.name].breaker
+        for _ in range(5):
+            breaker.record_failure()
+        assert breaker.state == "open"
+        import threading
+
+        result: dict = {}
+
+        def _run():
+            result["events"], result["tokens"] = _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": 12},
+                timeout=30,
+            )
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+        assert wait_until(lambda: src.active_streams > 0, timeout=10)
+        assert router.plan_migration(src.name, target=target.name) == 1
+        thread.join(timeout=30)
+        assert result["tokens"] == expect
+        assert router.metrics.migrations.value(outcome="aborted") >= 1
+        assert router.metrics.migrations.value(outcome="done") == 0
+        aborted = [
+            e for e in flight.snapshot()["events"]
+            if e["kind"] == "router.migration_aborted"
+        ]
+        assert aborted and aborted[0]["reason"] == "target_ineligible"
+        # The stream never left home.
+        assert target.generate_requests == 0
+    finally:
+        _teardown(replicas, router)
+
+
+def test_plan_migration_ranks_hottest_prefix_sessions():
+    """plan_migration moves the hottest prefix-block sessions first:
+    with two sessions live on the source — one shared by two streams,
+    one solo — a single-move plan flags a stream of the SHARED prefix."""
+    import threading
+
+    replicas, router, _ = _fleet(2, token_delay_s=0.05)
+    try:
+        src = replicas[0]
+        shared = _home_prompt(router, src.name)
+        solo = _home_prompt(router, src.name, base=200)
+        assert router.policy.key_of(shared) != router.policy.key_of(solo)
+        threads = []
+        for p in (shared, shared + [7], solo):
+            t = threading.Thread(
+                target=lambda pp=p: _stream(
+                    router.port,
+                    {"prompt": pp, "max_new_tokens": 14}, timeout=30,
+                ),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        assert wait_until(lambda: src.active_streams >= 3, timeout=10)
+        assert router.plan_migration(src.name, target=replicas[1].name,
+                                     max_moves=1) == 1
+        shared_key = router.policy.key_of(shared)
+        flagged = [
+            c for c in router._streams.values()
+            if c.migrate_to == replicas[1].name
+            or (c.migrate_to is None and c.replica == replicas[1].name)
+        ]
+        with router._streams_lock:
+            planned_keys = {
+                c.prefix_key
+                for c in router._streams.values()
+                if c.migrate_to is not None
+            }
+        assert planned_keys == {shared_key}, (planned_keys, flagged)
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        _teardown(replicas, router)

@@ -67,6 +67,9 @@ def test_sp_training_matches_dense(kind):
         assert jnp.allclose(a, b, atol=2e-4), "params diverged under sp"
 
 
+@pytest.mark.slow  # composition blanket: sp-vs-dense parity (above) is
+# the tier-1 pin; the sp×tp cross-product rides the slow tier (tier-1
+# wall-clock buy-back — the 870s driver timeout has no headroom)
 def test_sp_composes_with_tp():
     """dp×sp×tp on one mesh: sequence AND tensor parallel simultaneously."""
     cfg = GPTConfig.tiny()

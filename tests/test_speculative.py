@@ -56,6 +56,9 @@ def test_self_draft_accepts_everything(rng):
     assert acc.sum() >= len(acc) // 2
 
 
+@pytest.mark.slow  # invariance blanket: the dense-oracle parity and
+# distribution-preservation pins stay tier-1; the unrelated-draft
+# stress rides the slow tier (tier-1 wall-clock buy-back)
 def test_unrelated_draft_output_invariant(rng):
     """A draft with different weights (and depth) must not change the
     output — only the acceptance rate."""

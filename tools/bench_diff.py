@@ -153,6 +153,25 @@ def load_record(path: str) -> dict:
                 "restored_pages"
             )
             rec["restart_warm_speedup"] = restart.get("warm_speedup")
+        # Elastic block (ELASTIC serving rows, benchmark.py
+        # _run_elastic_phase): cold-join vs peer-warmed-join TTFT p99
+        # over shared-prefix sessions, through the GET /debug/snapshot
+        # wire stream.  The regression tells: entries_restored dropping
+        # to 0 (the peer transfer stopped rehydrating) or the warmed
+        # join running SLOWER than a cold one (warmed_speedup < 1 — the
+        # row screams NO-WARMUP, because a warm-up path that loses to a
+        # cold start is worse than not having one).
+        elastic = parsed.get("elastic")
+        if isinstance(elastic, dict) and not elastic.get("skipped"):
+            rec["elastic_cold_ttft_p99_ms"] = (
+                elastic.get("cold_join") or {}
+            ).get("ttft_p99_ms")
+            rec["elastic_warmed_ttft_p99_ms"] = (
+                elastic.get("warmed_join") or {}
+            ).get("ttft_p99_ms")
+            rec["elastic_entries_restored"] = elastic.get("entries_restored")
+            rec["elastic_wire_bytes"] = elastic.get("wire_bytes")
+            rec["elastic_warmed_speedup"] = elastic.get("warmed_speedup")
         # Trace block (TRACE serving rows, benchmark.py's tracing
         # phase): measured spans-on vs spans-off per-token overhead
         # over the same jobs.  The regression tell: overhead creeping
@@ -238,6 +257,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "overload_pool_exact",
         "restart_cold_ttft_p99_ms", "restart_warm_ttft_p99_ms",
         "restart_restored_pages", "restart_warm_speedup",
+        "elastic_cold_ttft_p99_ms", "elastic_warmed_ttft_p99_ms",
+        "elastic_entries_restored", "elastic_wire_bytes",
+        "elastic_warmed_speedup",
         "trace_overhead", "trace_spans",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
@@ -360,6 +382,25 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("restart_warm_ttft_p99_ms") is not None
+                else ""
+            )
+            + (
+                f"; elastic warmed-join p99 "
+                f"{b['elastic_warmed_ttft_p99_ms']}ms vs cold "
+                f"{b.get('elastic_cold_ttft_p99_ms')}ms "
+                f"({b.get('elastic_entries_restored')} entries shipped"
+                + (
+                    ", NO-WARMUP"
+                    if (b.get("elastic_warmed_speedup") or 1.0) < 1.0
+                    else ""
+                )
+                + (
+                    ", NO-TRANSFER"
+                    if b.get("elastic_entries_restored") == 0
+                    else ""
+                )
+                + ")"
+                if b.get("elastic_warmed_ttft_p99_ms") is not None
                 else ""
             )
             + (

@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Render a router's /debug/fleet into an operator-readable scale plan.
+
+The router computes the elastic-fleet verdict (router/migration.py
+scale_recommendation: scale_up / scale_down / hold from the host-side
+queue-wait and drain-rate signals every replica's summary poll already
+exports); this tool is the human surface — a per-replica pressure table
+plus the recommendation, from a live router or a saved JSON snapshot:
+
+    python tools/fleet_plan.py --url http://router:8100
+    python tools/fleet_plan.py fleet_snapshot.json
+    python tools/fleet_plan.py --url http://router:8100 --json  # machine
+
+Exit code 0 on hold, 3 on scale_up, 4 on scale_down — so a cron/CI
+wrapper can act on the verdict without parsing anything.  Stdlib-only
+and jax-free, like every fleet-side tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+EXIT_CODES = {"hold": 0, "scale_up": 3, "scale_down": 4}
+
+
+def load_fleet(url: str | None, path: str | None) -> dict:
+    if url:
+        import urllib.request
+
+        base = url.rstrip("/")
+        if not base.startswith("http"):
+            base = f"http://{base}"
+        with urllib.request.urlopen(base + "/debug/fleet", timeout=10) as r:
+            return json.loads(r.read() or b"{}")
+    assert path is not None
+    with open(path) as f:
+        return json.load(f)
+
+
+def render(fleet: dict) -> str:
+    """The operator table: one row per replica, then the verdict."""
+    lines = [
+        f"{'replica':<24} {'pressure_s':>10} {'queue':>6} {'slots':>6} "
+        f"{'wait_ewma':>10} {'drain_rps':>10}  state"
+    ]
+    for name, row in sorted((fleet.get("replicas") or {}).items()):
+        state = []
+        if not row.get("reachable", True):
+            state.append("unreachable")
+        if row.get("draining"):
+            state.append("draining")
+        if row.get("fenced"):
+            state.append("fenced")
+        wait = row.get("queue_wait_ewma_s")
+        drain = row.get("drain_rate_rps")
+        lines.append(
+            f"{name:<24} {row.get('pressure_s', 0):>10.3f} "
+            f"{row.get('queue_depth', 0):>6} "
+            f"{row.get('active_slots', 0):>6} "
+            f"{wait if wait is not None else '-':>10} "
+            f"{drain if drain is not None else '-':>10}  "
+            f"{','.join(state) or 'ok'}"
+        )
+    migration = fleet.get("migration") or {}
+    if migration.get("enabled"):
+        lines.append(
+            f"migration: budget {migration.get('budget_tokens')} tokens, "
+            f"{migration.get('plans_total', 0)} plans / "
+            f"{migration.get('moves_planned_total', 0)} moves planned"
+        )
+    else:
+        lines.append("migration: disabled")
+    rec = fleet.get("recommendation") or {}
+    lines.append(
+        f"recommendation: {rec.get('action', 'hold').upper()} "
+        f"({rec.get('replicas', '?')} -> "
+        f"{rec.get('suggested_replicas', '?')} replicas) — "
+        f"{rec.get('reason', 'no reason given')}"
+    )
+    if rec.get("hot"):
+        lines.append(f"  hot:  {', '.join(rec['hot'])}")
+    if rec.get("cold"):
+        lines.append(f"  cold: {', '.join(rec['cold'])}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleet-plan",
+        description="render a router /debug/fleet scale recommendation",
+    )
+    p.add_argument(
+        "snapshot",
+        nargs="?",
+        help="saved /debug/fleet JSON (alternative to --url)",
+    )
+    p.add_argument("--url", default="", help="live router base URL")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the raw fleet JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+    if not args.url and not args.snapshot:
+        p.error("need --url or a snapshot file")
+    try:
+        fleet = load_fleet(args.url or None, args.snapshot)
+    except (OSError, ValueError) as e:
+        print(f"fleet-plan: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(fleet, indent=2))
+    else:
+        print(render(fleet))
+    action = (fleet.get("recommendation") or {}).get("action", "hold")
+    return EXIT_CODES.get(action, 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
