@@ -1196,6 +1196,289 @@ def _run_elastic_phase(eng, args) -> dict:
     return block
 
 
+def _run_disagg_phase(eng, args) -> dict:
+    """DISAGG perf phase: decode ITL p99 flat-vs-growing as long-prompt
+    prefill load scales (ISSUE 15 — disaggregated prefill/decode).
+
+    What the row claims and how it is measured:
+
+    - **Unloaded baseline**: chatty decode requests alone on the main
+      (unified) bench engine; ITL p99 read from the same engine
+      histogram operators scrape.
+    - **Unified control**: the same chatty traffic while a long-prompt
+      request is injected every K steps — the injected prefill chunks
+      run on the SAME step loop, so chatty ITL inflates (the problem
+      disaggregation removes).
+    - **Disagg**: a fresh decode-ROLE engine serves the chatty traffic;
+      the long prompts' prefill runs on the unified engine standing in
+      as the prefill pool, their finished pages cross through the REAL
+      wire encoding (encode_preamble/encode_entry → the snapshot
+      verifier → the arena), and the decode engine admits each long
+      request by restoring pages and skipping the covered chunks.  The
+      injection rate is DOUBLED vs the control — the acceptance bar is
+      decode ITL p99 within ~1.2x of unloaded while prefill load
+      doubles, with the unified control regressing.
+    - **Oracle**: one injected long request's tokens on the decode
+      engine must be bit-identical to the unified engine's (greedy —
+      the handoff acceptance pin, at serving scale).
+    """
+    import io
+
+    from . import engine_handoff as handoff_mod
+    from . import engine_snapshot as snap_mod
+    from .engine import EngineMetrics, ServingEngine
+
+    from ..utils.metrics import MetricsRegistry
+
+    page = eng.paged.page_size
+    long_new = 4
+    # Long prompts fill the paged window minus their tiny decode budget
+    # — the longest prefill this engine can be asked for.
+    long_len = ((eng.paged.max_len - long_new - 2) // page) * page
+    if long_len < 2 * page or long_len <= args.prompt_len:
+        return {
+            "skipped": f"max_len {eng.paged.max_len} leaves no room for a "
+            "long prompt"
+        }
+    chatty_prompts = [
+        [(13 * i + j) % eng.cfg.vocab_size for j in range(args.prompt_len)]
+        for i in range(max(2, args.slots - 1))
+    ]
+    long_prompts = [
+        [(17 * i + 29 + j) % eng.cfg.vocab_size for j in range(long_len)]
+        for i in range(8)
+    ]
+    interval = 24  # steps between injected long prompts (control rate)
+    chatty_new = max(args.decode_tokens, 6 * interval // len(chatty_prompts))
+
+    def _measure(engine, inject=None):
+        """(itl_p99_s, injected request handles) for one traffic run.
+
+        ITL is measured as per-STEP wall time: every active chatty slot
+        emits exactly one token per step, so the step wall clock IS
+        that token's inter-token gap — same quantity the
+        tpu_engine_itl_seconds histogram aggregates, without its bucket
+        quantization (a 1.2x acceptance bar needs exact quantiles)."""
+        gaps: list[float] = []
+        reqs = [engine.submit(p, chatty_new) for p in chatty_prompts]
+        injected = []
+        steps = 0
+        while any(not r.done for r in reqs):
+            t0 = time.perf_counter()
+            engine.step()
+            gaps.append(time.perf_counter() - t0)
+            steps += 1
+            if inject is not None:
+                got = inject(steps)
+                if got is not None:
+                    injected.append(got)
+        # Drain injected stragglers outside the measured window's
+        # bookkeeping (their decode rides the same loop either way).
+        guard = 0
+        while any(not r.done for r in injected):
+            engine.step()
+            guard += 1
+            if guard > 50_000:
+                raise RuntimeError("disagg phase failed to drain")
+        ordered = sorted(gaps)
+        p99 = ordered[min(int(0.99 * len(ordered)), len(ordered) - 1)]
+        return p99, injected
+
+    # The unified engine stands in for BOTH the control and the prefill
+    # pool; chunked prefill on both sides so the comparison is the
+    # architecture, not the chunking.
+    prev_chunk = eng._prefill_chunk
+    eng._prefill_chunk = page * 2
+
+    def _warm_mixed(engine, pre_admit=None):
+        """Untimed warmup replicating the measured traffic SHAPE: the
+        long admission lands in the same slot, with the same occupied
+        chatty slots, as it will during measurement — so slot-indexed
+        scatters and the long-bucket chunk programs compile here, not
+        inside a measured p99."""
+        reqs = [engine.submit(p, 8) for p in chatty_prompts]
+        long_req = None
+        steps = 0
+        while any(not r.done for r in reqs) or (
+            long_req is not None and not long_req.done
+        ):
+            engine.step()
+            steps += 1
+            if steps == 2:
+                if pre_admit is not None:
+                    pre_admit()
+                long_req = engine.submit(long_prompts[0], long_new)
+        engine.kvcache_clear()
+
+    eng.kvcache_clear()
+    try:
+        # Warmup (untimed): the long-bucket chunk program + one full
+        # mixed-slot round.
+        _warm_mixed(eng)
+
+        # --- Unloaded baseline ------------------------------------------
+        itl_unloaded, _ = _measure(eng)
+
+        # --- Unified control: long prefills share the decode loop -------
+        def inject_unified(step, _next=[0]):
+            if step % interval or _next[0] >= len(long_prompts) // 2:
+                return None
+            prompt = long_prompts[_next[0]]
+            _next[0] += 1
+            return eng.submit(prompt, long_new)
+
+        itl_unified, _ = _measure(eng, inject_unified)
+
+        # --- Disagg: decode-role engine + wire-transferred prefixes -----
+        import dataclasses as _dc
+
+        dec = ServingEngine(
+            _dc.replace(eng.cfg, paged=None),
+            eng.params,
+            eng.paged,
+            max_slots=eng.max_slots,
+            metrics=EngineMetrics(MetricsRegistry()),
+            prefill_chunk=page * 2,
+            kv_retain=True,
+            kv_host_cache_mb=64,
+            role="decode",
+        )
+        # The prefill pool's output, as wire bytes (the donor ran the
+        # long prefills above and retains their pages; entries re-read
+        # through the resident path are the bytes /v1/prefill streams).
+        eng.kvcache_clear()
+        with eng._lock:
+            layout = snap_mod.snapshot_layout(eng)
+            fingerprint = snap_mod.params_fingerprint(eng.params)
+        wires = []
+        oracle_tokens = []
+        for prompt in long_prompts:
+            # The donor run doubles as the LOCAL-PREFILL ORACLE: greedy
+            # tokens for the same prompt, same compiled programs.  The
+            # wire then comes from a REAL prefill probe (the tap path
+            # /v1/prefill serves), entries + shipped logits.
+            oracle_tokens.append(list(eng.run([(prompt, long_new)])[0].tokens))
+            tap = eng.handoff_begin(prompt, None)
+            entries = []
+            try:
+                for _ in range(10_000):
+                    eng.step()
+                    while True:
+                        e = tap.pop(0.0)
+                        if e is None:
+                            break
+                        entries.append(e)
+                    if tap.req.done and tap.pushed <= len(entries):
+                        break
+            finally:
+                eng.handoff_end(tap)
+            wires.append(
+                snap_mod.encode_preamble(layout, fingerprint, len(entries))
+                + b"".join(
+                    snap_mod.encode_entry(layout, k, r) for k, r in entries
+                )
+                + (
+                    handoff_mod.encode_logits_section(tap.logits)
+                    if tap.logits is not None
+                    else b""
+                )
+            )
+            eng.kvcache_clear()
+
+        def _admit_wire(idx):
+            buf = io.BytesIO(wires[idx])
+            _, parsed = snap_mod._parse_snapshot(buf, layout, fingerprint)
+            admitted = snap_mod._admit_entries(dec, parsed)
+            logits = handoff_mod.read_logits_section(buf)
+            if logits is not None:
+                with dec._lock:
+                    dec._kv_arena.put(
+                        ("logits", -1, tuple(long_prompts[idx])),
+                        {"logits": logits},
+                        logits.nbytes,
+                    )
+            return admitted
+        # Warmup the decode engine: the same mixed shape, with the long
+        # admission arriving as a HANDOFF (restore scatter + seeded
+        # tail chunk + mixed-slot graft all compile here).
+        dec.run([(chatty_prompts[0], 2)])
+
+        _warm_mixed(dec, pre_admit=lambda: _admit_wire(0))
+        assert dec.handoff_skipped_tokens > 0, (
+            "disagg warmup never skipped covered prefill"
+        )
+
+        handoff_entries = 0
+
+        def inject_disagg(step, _next=[0]):
+            # DOUBLE the control's prefill load: every interval/2 steps.
+            nonlocal handoff_entries
+            if step % (interval // 2) or _next[0] >= len(long_prompts) // 2:
+                return None
+            idx = _next[0]
+            _next[0] += 1
+            handoff_entries += _admit_wire(idx)
+            return dec.submit(long_prompts[idx], long_new)
+
+        itl_disagg, disagg_long = _measure(dec, inject_disagg)
+        tokens_match = bool(disagg_long) and [
+            list(r.tokens) for r in disagg_long
+        ] == oracle_tokens[: len(disagg_long)]
+    finally:
+        eng._prefill_chunk = prev_chunk
+        eng.kvcache_clear()
+
+    def _ms(value):
+        return None if value is None else round(value * 1e3, 3)
+
+    unified_ratio = (
+        round(itl_unified / itl_unloaded, 3)
+        if itl_unified and itl_unloaded
+        else None
+    )
+    disagg_ratio = (
+        round(itl_disagg / itl_unloaded, 3)
+        if itl_disagg and itl_unloaded
+        else None
+    )
+    block = {
+        "prefill_jobs": len(long_prompts) // 2,
+        "long_prompt_tokens": long_len,
+        "itl_p99_unloaded_ms": _ms(itl_unloaded),
+        "unified": {
+            "itl_p99_loaded_ms": _ms(itl_unified),
+            "ratio": unified_ratio,
+        },
+        "disagg": {
+            "itl_p99_loaded_ms": _ms(itl_disagg),
+            "ratio": disagg_ratio,
+            "handoff_entries": handoff_entries,
+            "skipped_prefill_tokens": dec.handoff_skipped_tokens,
+            "tokens_match": tokens_match,
+        },
+    }
+    log(
+        "perf-ledger row: | DISAGG prefill/decode split (b%d, %d-token "
+        "prefills) | decode ITL p99 %.3f ms unloaded → unified %.3f "
+        "(%.2fx) vs disagg %.3f ms at 2x prefill load (%.2fx; %d entries "
+        "shipped, %d prefill tokens skipped, tokens %s) | - | "
+        "`benchmark.py --model serving` | update on bench round |"
+        % (
+            eng.max_slots,
+            long_len,
+            block["itl_p99_unloaded_ms"] or 0.0,
+            block["unified"]["itl_p99_loaded_ms"] or 0.0,
+            unified_ratio or 0.0,
+            block["disagg"]["itl_p99_loaded_ms"] or 0.0,
+            disagg_ratio or 0.0,
+            handoff_entries,
+            dec.handoff_skipped_tokens,
+            "bit-identical" if tokens_match else "DIVERGED",
+        )
+    )
+    return block
+
+
 def run_serving(args) -> None:
     """Continuous-batching serving benchmark through the SAME telemetry
     operators scrape: the TTFT/ITL percentiles in the JSON line are read
@@ -1508,6 +1791,8 @@ def run_serving(args) -> None:
     restart_block = _run_restart_phase(eng, args)
     # --- Elastic phase (ELASTIC row): cold vs peer-warmed join ---------
     elastic_block = _run_elastic_phase(eng, args)
+    # --- Disagg phase (DISAGG row): decode ITL under prefill load ------
+    disagg_block = _run_disagg_phase(eng, args)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
     print(
@@ -1555,6 +1840,7 @@ def run_serving(args) -> None:
                 "overload": overload_block,
                 "restart": restart_block,
                 "elastic": elastic_block,
+                "disagg": disagg_block,
                 "router": router_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,

@@ -58,6 +58,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .engine_admission import AdmissionMixin
+from .engine_handoff import HandoffMixin
 from .engine_kvcache import KVCacheMixin
 from .engine_paging import PagingMixin
 from .engine_sampling import (  # noqa: F401  (re-export: public surface)
@@ -86,7 +87,9 @@ from .transformer import (
 )
 
 
-class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin):
+class ServingEngine(
+    AdmissionMixin, PagingMixin, KVCacheMixin, HandoffMixin, SpeculativeMixin
+):
     """Batch-continuous greedy decoding server (single host, one model).
 
     ``MAX_BIAS``: per-request logit_bias entries are padded to this fixed
@@ -126,6 +129,7 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         overload=None,
         kv_retain: bool = False,
         kv_host_cache_mb: float = 0,
+        role: str = "unified",
         mesh: Optional[Mesh] = None,
         tp_axis: str = "tp",
         racecheck: bool = False,
@@ -543,6 +547,12 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
         # exact-pool accounting other subsystems and tests rely on);
         # the serving CLIs default it ON.
         self._init_kvcache(kv_retain, kv_host_cache_mb)
+        # Disaggregated prefill/decode roles (models/engine_handoff.py):
+        # "unified" (default) is today's engine byte-for-byte; "prefill"
+        # serves POST /v1/prefill probes and publishes finished pages
+        # into the content-addressed arena; "decode" restores handed-off
+        # prefixes and SKIPS the prefill chunks they cover.
+        self._init_handoff(role)
         if racecheck:
             # Lock-discipline detection (utils/racecheck.py): every
             # mutation of the cross-thread state must hold the engine
@@ -1340,7 +1350,9 @@ class ServingEngine(AdmissionMixin, PagingMixin, KVCacheMixin, SpeculativeMixin)
                     else {"enabled": False}
                 ),
                 "kvcache": self.kvcache_state(),
+                "disagg": self.handoff_state(),
                 "config": {
+                    "role": self.role,
                     "max_slots": self.max_slots,
                     "page_size": self.paged.page_size,
                     "num_pages": self.paged.num_pages,

@@ -412,6 +412,18 @@ class AdmissionMixin:
         admitted in); with chunking, step() advances ONE chunk per call,
         so active slots stall at most one chunk's compute per step while
         a long prompt streams in.
+
+        Decode-role engines (models/engine_handoff.py) additionally SKIP
+        the leading chunks every item's shared/restored pages already
+        cover: the job's dense cache is SEEDED from those device pages
+        (their rows are exactly the bytes a same-bucket recompute would
+        write — the content-addressed guarantee the KV tiers already
+        rely on) and ``pos`` starts at the first uncovered chunk, so a
+        handed-off long prompt costs one tail chunk instead of the whole
+        prompt's compute.  The chunk containing each prompt's LAST
+        position always runs (the admission token samples from its
+        logits).  Unified engines never skip — the historical prefill
+        schedule is untouched.
         """
         # Effective prompts: resumed (preempted) requests re-prefill
         # their original prompt PLUS what they had already generated.
@@ -428,7 +440,58 @@ class AdmissionMixin:
             it[1].adapter if it[1].adapter is not None else -1 for it in items
         ]
         aids += [aids[0]] * (batch - n)  # pad rows are discarded anyway
-        spec = decode_cache_spec(self._dense_chunk_model(bucket), batch)
+        # decode_cache_spec is an abstract trace of the whole model
+        # (~100ms of host work) and depends only on (bucket, batch):
+        # cache it like _dense_chunk_models, or EVERY admission pays a
+        # model trace before its prefill even dispatches — the dominant
+        # per-admission host cost on fast backends.
+        spec_key = (bucket, batch)
+        spec = self._prefill_cache.get(("spec", spec_key))
+        if spec is None:
+            spec = decode_cache_spec(self._dense_chunk_model(bucket), batch)
+            self._prefill_cache[("spec", spec_key)] = spec
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        ps = self.paged.page_size
+        skip = 0
+        if self._handoff_skip_covered:
+            # Chunk-aligned token count covered for EVERY item, capped
+            # below every item's last position so the logits-bearing
+            # chunk always computes.
+            skip = min(
+                min(it[3] * ps for it in items),
+                min(len(p) for p in prompts) - 1,
+            )
+            skip -= skip % chunk
+        if skip > 0:
+            # Seed the covered positions from the items' device pages
+            # (restored/shared rows are already on device by admission).
+            # One eager slice-set per pool per layer per item; compiles
+            # per (batch, bucket, skip) shape like the restore scatter.
+            self._wd_grace("handoff_seed")
+            for row_idx, it in enumerate(items):
+                pages = jnp.asarray(
+                    it[2][: -(-skip // ps)], jnp.int32
+                )
+                for name in self._layer_names:
+                    att = self.cache[name]["attn"]
+                    src = cache[name]["attn"]
+                    new_src = dict(src)
+                    for pool in self._kv_pool_names(att):
+                        rows_dev = att[pool][pages]
+                        rows_dev = rows_dev.reshape(
+                            rows_dev.shape[0] * ps, *rows_dev.shape[2:]
+                        )[:skip]
+                        dense = "cached_" + pool[len("pool_"):]
+                        new_src[dense] = (
+                            src[dense].at[row_idx, :skip].set(rows_dev)
+                        )
+                    # The cached append writes K/V at cache_index (one
+                    # scalar per layer, shared across the batch): start
+                    # it at the first UNCOMPUTED position or the first
+                    # computed chunk would clobber the seeded rows.
+                    new_src["cache_index"] = jnp.asarray(skip, jnp.int32)
+                    cache[name]["attn"] = new_src
+            self.handoff_skipped_tokens += skip * len(items)
         self._pending.append(
             {
                 "items": items,
@@ -439,10 +502,8 @@ class AdmissionMixin:
                 "last_idx_host": last_idx,
                 "last_idx": jnp.asarray(last_idx, jnp.int32),
                 "aids": jnp.asarray(aids, jnp.int32),
-                "cache": jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), spec
-                ),
-                "pos": 0,
+                "cache": cache,
+                "pos": skip,
                 "logits": [None] * n,
             }
         )
@@ -467,6 +528,20 @@ class AdmissionMixin:
             if pos <= job["last_idx_host"][i] < pos + chunk:
                 job["logits"][i] = logits_rows[i]
         job["pos"] = pos + chunk
+        # Chunks past every row's LAST position compute nothing a graft
+        # or logit read ever consumes (positions >= plen are masked
+        # padding): stop at the chunk containing the deepest last_idx
+        # instead of running to the bucket — a prompt just past a
+        # power-of-two boundary no longer pays the bucket's full tail.
+        if job["pos"] > max(job["last_idx_host"]):
+            job["pos"] = job["bucket"]
+        if self._handoff_taps:
+            # Prefill→decode handoff (engine_handoff.py): stream every
+            # newly covered full page to its tapped /v1/prefill handler
+            # the moment this chunk's K/V exist — transfer overlaps the
+            # remaining prefill compute.  One dict check when no probe
+            # is tapped.
+            self._handoff_feed(job)
         return job["pos"] >= job["bucket"]
 
     def _admit(self) -> list[Request]:
@@ -529,6 +604,16 @@ class AdmissionMixin:
                 # coverage is complete (engine_kvcache.py); short
                 # coverage falls through to ordinary recompute-resume.
                 if self._kv_retain and self._kv_try_restore_resume(slot, req):
+                    continue
+                # Handoff fast path (engine_handoff.py, decode role):
+                # a fresh page-aligned prompt whose pages AND shipped
+                # logits are resident admits with ZERO prefill compute.
+                if (
+                    self._handoff_skip_covered
+                    and not req.tokens
+                    and self._spec_gamma == 0
+                    and self._handoff_try_admit(slot, req)
+                ):
                     continue
                 # The EFFECTIVE prompt: original tokens plus anything a
                 # previous occupancy already generated (recompute-resume
@@ -707,6 +792,48 @@ class AdmissionMixin:
             self._slot_bias_vals[slot] = [0.0] * self.MAX_BIAS
         self._slot_aid[slot] = req.adapter if req.adapter is not None else -1
 
+    def _sample_first_token(self, req: Request, last_logits) -> int:
+        """Sample one request's ADMISSION token from its last-position
+        logits — the same math the jitted step applies (bias what gets
+        picked, report unbiased logprobs, greedy ignores filters).
+        Shared by prefill activation and the handoff no-prefill
+        admission (engine_handoff.py), which samples from the logits
+        the PREFILL replica shipped — same values, same schedule, so
+        streams stay bit-identical across the split."""
+        last_logits = jnp.asarray(last_logits)
+        if req.logit_bias:
+            ids = jnp.asarray(list(req.logit_bias), jnp.int32)
+            vals = jnp.asarray(list(req.logit_bias.values()), jnp.float32)
+            picked_logits = last_logits.at[ids].add(
+                vals.astype(last_logits.dtype)
+            )
+        else:
+            picked_logits = last_logits
+        if req.temperature > 0:
+            topk = req.top_k if req.top_k is not None else self.cfg.vocab_size
+            topp = req.top_p if req.top_p is not None else 1.0
+            self._rng, sub = jax.random.split(self._rng)
+            filtered = filter_top_k_top_p(
+                (picked_logits / req.temperature)[None, :],
+                jnp.asarray([topk], jnp.int32),
+                jnp.asarray([topp], jnp.float32),
+            )
+            first = int(jax.random.categorical(sub, filtered[0]))
+        else:
+            first = int(jnp.argmax(picked_logits))
+        if req.logprobs:
+            # Appended BEFORE the token so a streaming snapshot never
+            # sees a token without its logprob.
+            req.token_logprobs.append(
+                float(
+                    _token_logprob(
+                        last_logits[None, :],
+                        jnp.asarray([first], jnp.int32),
+                    )[0]
+                )
+            )
+        return first
+
     def _activate(self, job: dict) -> list[Request]:
         """Graft a completed prefill job's K/V into pages, sample each
         request's first token, and mark the slots ready to decode."""
@@ -726,56 +853,7 @@ class AdmissionMixin:
             # Grafted: the private pages are now real K/V and may be
             # prefix-shared by any later request.
             self._pending_pages.difference_update(pages[n_shared:])
-            last_logits = job["logits"][row_idx]
-            if req.logit_bias:
-                # Same semantics as the jitted step: bias what gets
-                # PICKED; reported logprobs (below) stay unbiased.
-                ids = jnp.asarray(list(req.logit_bias), jnp.int32)
-                vals = jnp.asarray(
-                    list(req.logit_bias.values()), jnp.float32
-                )
-                picked_logits = last_logits.at[ids].add(
-                    vals.astype(last_logits.dtype)
-                )
-            else:
-                picked_logits = last_logits
-            # Same normalization the slot scalars get (see
-            # _set_slot_sampler): a greedy slot's token is the argmax
-            # regardless of top_k/top_p.
-            if req.temperature > 0:
-                topk = (
-                    req.top_k
-                    if req.top_k is not None
-                    else self.cfg.vocab_size
-                )
-                topp = req.top_p if req.top_p is not None else 1.0
-            else:
-                topk, topp = self.cfg.vocab_size, 1.0
-            if req.temperature > 0:
-                # Same filter math as the jitted step — the admission
-                # token must come from the same restricted distribution.
-                self._rng, sub = jax.random.split(self._rng)
-                filtered = filter_top_k_top_p(
-                    (picked_logits / req.temperature)[None, :],
-                    jnp.asarray([topk], jnp.int32),
-                    jnp.asarray([topp], jnp.float32),
-                )
-                first = int(jax.random.categorical(sub, filtered[0]))
-            else:
-                first = int(jnp.argmax(picked_logits))
-            if req.logprobs:
-                # Same semantics as the jitted steps: the emitted token's
-                # logprob under the unscaled model distribution.  Appended
-                # BEFORE the token so a streaming snapshot never sees a
-                # token without its logprob.
-                req.token_logprobs.append(
-                    float(
-                        _token_logprob(
-                            jnp.asarray(last_logits)[None, :],
-                            jnp.asarray([first], jnp.int32),
-                        )[0]
-                    )
-                )
+            first = self._sample_first_token(req, job["logits"][row_idx])
             req.tokens.append(first)
             self._slot_last[slot] = first
             self._slot_len[slot] = plen

@@ -202,6 +202,46 @@ def collect_entries(engine, include_device: bool = True) -> dict[tuple, dict]:
     return entries
 
 
+def encode_preamble(layout: dict, fingerprint: str, n_entries: int) -> bytes:
+    """The ``MAGIC | version | header`` stream preamble for a transfer
+    of ``n_entries`` entries.  Shared by :func:`encode_snapshot` and the
+    per-request prefill→decode handoff stream (engine_handoff.py), whose
+    entry count is known up front (the prompt's full-page count) while
+    the entries themselves arrive chunk by chunk."""
+    header = json.dumps(
+        {
+            "version": VERSION,
+            "layout": layout,
+            "params_fingerprint": fingerprint,
+            "entries": int(n_entries),
+            # Integer milliseconds: a float's JSON length varies with
+            # trailing zeros, so two same-content snapshots could differ
+            # in SIZE — the byte-count invariants tier-1 pins would
+            # flake on the timestamp.
+            "created_unix_ms": int(time.time() * 1000),
+        }
+    ).encode()
+    return MAGIC + struct.pack("<II", VERSION, len(header)) + header
+
+
+def encode_entry(layout: dict, key: tuple, rows: dict) -> bytes:
+    """One ``meta | blob`` entry record (per-entry CRC32, layout-ordered
+    blob).  The ONE entry encoder behind the disk snapshot, the peer
+    snapshot stream, and the per-request handoff stream — the formats
+    cannot drift apart because they are the same bytes."""
+    _, root, tokens = key
+    blob = _entry_blob(rows, layout)
+    meta = json.dumps(
+        {
+            "root": int(root),
+            "tokens": [int(t) for t in tokens],
+            "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+            "nbytes": len(blob),
+        }
+    ).encode()
+    return struct.pack("<I", len(meta)) + meta + blob
+
+
 def encode_snapshot(
     layout: dict, fingerprint: str, entries: dict[tuple, dict]
 ) -> Iterator[bytes]:
@@ -210,28 +250,9 @@ def encode_snapshot(
     writer and the ``GET /debug/snapshot`` peer stream share this one
     encoder, so the wire format IS the file format (bit-identical,
     pinned in tier-1)."""
-    header = json.dumps(
-        {
-            "version": VERSION,
-            "layout": layout,
-            "params_fingerprint": fingerprint,
-            "entries": len(entries),
-            "created_unix": round(time.time(), 3),
-        }
-    ).encode()
-    yield MAGIC + struct.pack("<II", VERSION, len(header)) + header
+    yield encode_preamble(layout, fingerprint, len(entries))
     for key, rows in entries.items():
-        _, root, tokens = key
-        blob = _entry_blob(rows, layout)
-        meta = json.dumps(
-            {
-                "root": int(root),
-                "tokens": [int(t) for t in tokens],
-                "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
-                "nbytes": len(blob),
-            }
-        ).encode()
-        yield struct.pack("<I", len(meta)) + meta + blob
+        yield encode_entry(layout, key, rows)
 
 
 def _write_snapshot(

@@ -354,6 +354,49 @@ class EngineMetrics:
             "a clean cold start",
             ["outcome"],
         )
+        # Disaggregated prefill/decode serving (models/engine_handoff.py):
+        # the replica's role plus the per-request KV handoff flow —
+        # prefill-side probe serves, decode-side fetches, and the entry
+        # counts moving through the content-addressed arena.
+        self.role = registry.gauge(
+            "tpu_engine_role",
+            "Serving role of this replica (0 unified, 1 prefill, 2 "
+            "decode — models/engine_handoff.py).  Set once at engine "
+            "construction from --role",
+        )
+        self.handoff_serves = registry.counter(
+            "tpu_engine_handoff_serves_total",
+            "POST /v1/prefill probe streams served by outcome (ok / "
+            "refused / rejected / error / client_gone / aborted); "
+            "refused = fingerprint/role mismatch before any bytes, "
+            "rejected = the probe submit was shed/invalid, aborted = "
+            "the probe died mid-stream and the transfer was torn",
+            ["outcome"],
+        )
+        self.handoff_fetches = registry.counter(
+            "tpu_engine_handoff_fetches_total",
+            "Decode-side prefill fetches (X-Handoff-Source pulls) by "
+            "outcome (ok / unreachable / refused / corrupt / "
+            "layout_mismatch / params_mismatch / disabled); anything "
+            "but ok degrades to ordinary LOCAL prefill — existing "
+            "arena contents are untouched",
+            ["outcome"],
+        )
+        self.handoff_entries = registry.counter(
+            "tpu_engine_handoff_entries_total",
+            "Full KV prefix pages moved by the handoff machinery, by "
+            "direction (published: prefill side into its own arena; "
+            "served: streamed to a /v1/prefill caller; fetched: "
+            "admitted into this decode replica's arena)",
+            ["direction"],
+        )
+        self.handoff_refusals = registry.counter(
+            "tpu_engine_handoff_refusals_total",
+            "Decode-role /generate refusals (409 + X-Prefill-Needed): "
+            "the prompt's full-page prefix was neither resident nor "
+            "fetchable (no X-Handoff-Source locator) — the router "
+            "should have routed the prefill first",
+        )
 
 
 @dataclasses.dataclass

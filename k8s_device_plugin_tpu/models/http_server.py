@@ -143,6 +143,7 @@ from ..utils.spans import (
     sanitize_trace_id,
 )
 from .engine import ServingEngine
+from . import engine_handoff as handoff_mod
 from .engine_overload import SHED_EXPIRED, SHED_INFEASIBLE, ShedError
 from .engine_watchdog import ChipHealthFeed, StepWatchdog, visible_chip_paths
 
@@ -171,8 +172,14 @@ class EngineServer:
         chip_health: Optional[ChipHealthFeed] = None,
         snapshot_dir: str = "",
         snapshot_interval_s: float = 60.0,
+        handoff_timeout_s: float = 30.0,
     ):
         self.engine = engine
+        # Disaggregated prefill/decode (models/engine_handoff.py): the
+        # per-dial budget a decode-role replica spends pulling a prefix
+        # from its X-Handoff-Source before degrading to local prefill,
+        # and the per-probe budget /v1/prefill waits for chunk progress.
+        self._handoff_timeout = float(handoff_timeout_s)
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._loop_alive = False
@@ -281,8 +288,29 @@ class EngineServer:
                     else:
                         self._step_capture()
                     return
+                if path == handoff_mod.PREFILL_ROUTE:
+                    # Disaggregated prefill (models/engine_handoff.py):
+                    # run (or serve) this prompt's full-page KV prefix
+                    # and stream the entries in the snapshot wire
+                    # format as chunks finish.
+                    self._serve_prefill()
+                    return
                 if path != "/generate":
                     self.send_error(404)
+                    return
+                if server.engine.role == "prefill":
+                    # A prefill-role replica emits no decode tokens:
+                    # the typed 409 tells a misrouted caller (the
+                    # router excludes prefill replicas from /generate
+                    # candidates) which surface this replica serves.
+                    self._reply(
+                        409,
+                        {
+                            "error": "replica role is prefill; it serves "
+                            "POST /v1/prefill, not /generate",
+                            "role": "prefill",
+                        },
+                    )
                     return
                 # Trace-ID contract: a valid client X-Request-Id is
                 # adopted verbatim; anything else (including no header)
@@ -415,6 +443,101 @@ class EngineServer:
                         trace_id,
                     )
                     return
+                # Decode-role admission gate (models/engine_handoff.py):
+                # a prompt whose full-page prefix is not resident is
+                # PULLED from the router's X-Handoff-Source locator
+                # before submit (the fetch rides this handler thread —
+                # the step loop keeps decoding others), refused with a
+                # typed 409 + X-Prefill-Needed when there is no
+                # locator, and degraded to ordinary LOCAL prefill when
+                # the fetch fails (prefill replica died mid-transfer,
+                # torn stream, refusal) — never a dropped request.
+                handoff_fetch = None
+                if server.engine.role == "decode":
+                    try:
+                        clean_prompt = [int(t) for t in prompt]
+                    except (TypeError, ValueError) as e:
+                        self._reply(
+                            400, {"error": f"bad prompt: {e}"}, trace_id
+                        )
+                        return
+                    adapter = kwargs.get("adapter")
+                    covered, n_full = server.engine.handoff_coverage(
+                        clean_prompt, adapter
+                    )
+                    source = None
+                    if covered < n_full:
+                        source = self.headers.get(
+                            handoff_mod.HANDOFF_SOURCE_HEADER
+                        )
+                        if source == handoff_mod.HANDOFF_LOCAL:
+                            # The router says there is nothing to pull
+                            # from (short prompt / prefill pool down):
+                            # run the ordinary local prefill.
+                            source = None
+                        elif not source:
+                            eng = server.engine
+                            with eng._lock:
+                                eng.handoff_refusals += 1
+                            if eng.metrics:
+                                eng.metrics.handoff_refusals.inc()
+                            eng.flight.record(
+                                "handoff.refused",
+                                trace_id=trace_id,
+                                prompt_tokens=len(clean_prompt),
+                                missing_pages=n_full - covered,
+                            )
+                            self._reply(
+                                409,
+                                {
+                                    "error": "prefix not resident on this "
+                                    "decode replica and no "
+                                    "X-Handoff-Source locator was sent",
+                                    "missing_pages": n_full - covered,
+                                    "trace_id": trace_id,
+                                },
+                                trace_id,
+                                prefill_needed=str(n_full - covered),
+                            )
+                            return
+                    if covered < n_full and source:
+                        t_fetch = time.monotonic()
+                        fetch_ctx = None
+                        if hop_ctx is not None:
+                            # One more hop: the prefill replica's serve
+                            # span roots under this fetch in the
+                            # assembled fleet timeline.
+                            from ..utils.spans import format_trace_context
+
+                            fetch_span = (
+                                server.engine.spans.reserve_id()
+                                if server.engine.spans
+                                else 0
+                            )
+                            fetch_ctx = format_trace_context(
+                                trace_id, fetch_span, hop_ctx.hop + 1, 0
+                            )
+                        else:
+                            fetch_span = (
+                                server.engine.spans.reserve_id()
+                                if server.engine.spans
+                                else 0
+                            )
+                        handoff_fetch = handoff_mod.fetch_prefill(
+                            server.engine,
+                            source,
+                            clean_prompt,
+                            adapter=adapter,
+                            timeout_s=min(
+                                server._handoff_timeout,
+                                deadline_s
+                                if deadline_s is not None
+                                else server._handoff_timeout,
+                            ),
+                            trace_context=fetch_ctx,
+                        )
+                        handoff_fetch["span_id"] = fetch_span
+                        handoff_fetch["t0"] = t_fetch
                 try:
                     # n samples = n engine requests over ONE shared prompt:
                     # the prefix trie dedupes the prompt pages, so extra
@@ -448,6 +571,24 @@ class EngineServer:
                     self._reply(400, {"error": f"bad prompt: {e}"}, trace_id)
                     return
                 req = reqs[0]
+                if handoff_fetch is not None and server.engine.spans:
+                    # The fetch leg as a span under the request root —
+                    # one request, ONE timeline spanning both replicas
+                    # (the prefill side's handoff.serve span roots
+                    # under this id via the fetch's X-Trace-Context).
+                    server.engine.spans.record_span(
+                        "handoff.fetch",
+                        trace_id,
+                        start_monotonic=handoff_fetch["t0"],
+                        span_id=handoff_fetch["span_id"] or None,
+                        parent_id=req.root_span,
+                        attrs={
+                            "rid": req.rid,
+                            "source": handoff_fetch.get("source"),
+                            "ok": bool(handoff_fetch.get("ok")),
+                            "restored": handoff_fetch.get("restored", 0),
+                        },
+                    )
                 if stream:
                     self._stream_reply(req, deadline_s=deadline_s)
                     return
@@ -897,6 +1038,234 @@ class EngineServer:
                     torn=bool(hit is not None and hit.mode == "truncate"),
                 )
 
+            def _serve_prefill(self) -> None:
+                """POST /v1/prefill {"prompt": [...], "adapter": a?}:
+                the prefill half of disaggregated serving
+                (models/engine_handoff.py).  A resident prefix streams
+                straight from the KV tiers; anything else runs a
+                prefill probe (max_new=1 — no decode step) and streams
+                each full page's entry THE MOMENT its chunk's K/V
+                exist, in the exact snapshot wire format (preamble with
+                the known entry count, then per-CRC entries), so the
+                decode side's transfer overlaps this side's compute.
+                Fingerprint headers refuse with 409 before any compute
+                or bytes; decode-role replicas refuse outright; the
+                ``engine.handoff.serve`` failpoint injects refusal
+                (``error``) or a stream torn after a fraction of the
+                entries (``truncate`` — the prefill-died shape)."""
+                from ..utils import failpoints
+                from . import engine_snapshot as snap_mod
+
+                eng = server.engine
+                metrics = eng.metrics
+
+                def _count(outcome: str) -> None:
+                    if metrics:
+                        metrics.handoff_serves.inc(outcome=outcome)
+
+                if eng.role == "decode":
+                    _count(outcome="refused")
+                    self._reply(
+                        409,
+                        {"error": "replica role is decode; it does not "
+                                  "serve /v1/prefill"},
+                    )
+                    return
+                if server._fence.is_set() or server._draining.is_set():
+                    _count(outcome="refused")
+                    self._reply(
+                        503,
+                        {"error": "replica is fenced or draining"},
+                        retry_after=server._retry_after(),
+                    )
+                    return
+                try:
+                    hit = failpoints.fire("engine.handoff.serve")
+                except failpoints.FailpointError as e:
+                    _count(outcome="error")
+                    self._reply(503, {"error": f"prefill unavailable: {e}"})
+                    return
+                hop_ctx = parse_trace_context(
+                    self.headers.get("X-Trace-Context")
+                )
+                t0 = time.monotonic()
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = [int(t) for t in body["prompt"]]
+                    adapter = (
+                        int(body["adapter"])
+                        if body.get("adapter") is not None
+                        else None
+                    )
+                except (KeyError, TypeError, ValueError) as e:
+                    _count(outcome="rejected")
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                with eng._lock:
+                    layout = snap_mod.snapshot_layout(eng)
+                    fingerprint = snap_mod.params_fingerprint(eng.params)
+                layout_fp = snap_mod.layout_fingerprint(layout)
+                want_layout = self.headers.get(snap_mod.LAYOUT_HEADER)
+                want_params = self.headers.get(snap_mod.PARAMS_HEADER)
+                if (want_layout and want_layout != layout_fp) or (
+                    want_params and want_params != fingerprint
+                ):
+                    _count(outcome="refused")
+                    eng.flight.record(
+                        "handoff.serve_refused",
+                        peer=self.client_address[0],
+                        layout_ok=(not want_layout
+                                   or want_layout == layout_fp),
+                        params_ok=(not want_params
+                                   or want_params == fingerprint),
+                    )
+                    self._reply(
+                        409,
+                        {
+                            "error": "handoff layout/params mismatch",
+                            "layout": layout_fp,
+                            "params_fingerprint": fingerprint,
+                        },
+                    )
+                    return
+                n_full = len(prompt) // eng.paged.page_size
+                resident = eng.handoff_resident_entries(prompt, adapter)
+                tap = None
+                if resident is None:
+                    try:
+                        tap = eng.handoff_begin(prompt, adapter)
+                    except ShedError as e:
+                        _count(outcome="rejected")
+                        self._reply(
+                            503,
+                            {"error": f"prefill probe shed: {e}"},
+                            retry_after=f"{max(e.retry_after_s, 1.0):g}",
+                        )
+                        return
+                    except (TypeError, ValueError) as e:
+                        _count(outcome="rejected")
+                        self._reply(422, {"error": str(e)})
+                        return
+                # Preamble first — the entry count is known before any
+                # compute, so transfer overlaps prefill.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header(snap_mod.LAYOUT_HEADER, layout_fp)
+                self.send_header(snap_mod.PARAMS_HEADER, fingerprint)
+                self.send_header(snap_mod.ENTRIES_HEADER, str(n_full))
+                self.end_headers()
+                emit_cap = n_full
+                if hit is not None and hit.mode == "truncate":
+                    # Tear the stream after a fraction of the entries:
+                    # the prefill-replica-died-mid-transfer byte shape
+                    # (the header still promises n_full, so the decode
+                    # side's parse raises on the missing tail).
+                    frac = float(hit.arg) if hit.arg else 0.5
+                    emit_cap = int(n_full * frac)
+                sent = 0
+                outcome = "ok"
+                deadline = t0 + server._handoff_timeout
+                try:
+                    self.wfile.write(
+                        snap_mod.encode_preamble(layout, fingerprint, n_full)
+                    )
+                    if resident is not None:
+                        for key, rows in resident[:emit_cap]:
+                            self.wfile.write(
+                                snap_mod.encode_entry(layout, key, rows)
+                            )
+                            sent += 1
+                        self.wfile.flush()
+                    else:
+                        while sent < emit_cap:
+                            with server._cond:
+                                server._cond.notify_all()  # wake the loop
+                            entry = tap.pop(timeout=0.2)
+                            if entry is None:
+                                if tap.dead and tap.pushed <= sent:
+                                    outcome = "aborted"  # probe shed/cancel
+                                    break
+                                if time.monotonic() > deadline:
+                                    outcome = "aborted"
+                                    break
+                                continue
+                            key, rows = entry
+                            self.wfile.write(
+                                snap_mod.encode_entry(layout, key, rows)
+                            )
+                            self.wfile.flush()
+                            sent += 1
+                    if emit_cap < n_full:
+                        outcome = "aborted"  # truncate failpoint tore it
+                    elif sent == n_full and n_full:
+                        # Trailing logits section: lets the decode side
+                        # admit with ZERO prefill compute (absent when
+                        # the probe's logits are gone — the decode side
+                        # then pays one tail chunk, nothing breaks).
+                        logits = (
+                            tap.logits if tap is not None else None
+                        )
+                        if logits is None:
+                            with eng._lock:
+                                lg = eng._kv_arena.get(
+                                    (
+                                        "logits",
+                                        eng._trie_root(adapter),
+                                        tuple(prompt),
+                                    )
+                                )
+                            logits = (
+                                lg["logits"] if lg is not None else None
+                            )
+                        if logits is not None:
+                            self.wfile.write(
+                                handoff_mod.encode_logits_section(logits)
+                            )
+                            self.wfile.flush()
+                except OSError:
+                    outcome = "client_gone"  # decode side vanished
+                finally:
+                    if tap is not None:
+                        eng.handoff_end(tap)
+                with eng._lock:
+                    eng.handoff_serves += 1
+                    eng.handoff_served_entries += sent
+                _count(outcome=outcome)
+                if metrics and sent:
+                    metrics.handoff_entries.inc(sent, direction="served")
+                if eng.spans is not None:
+                    attrs = {
+                        "entries": sent,
+                        "outcome": outcome,
+                        "resident": resident is not None,
+                    }
+                    if hop_ctx is not None:
+                        # Cross-process link: this serve roots under the
+                        # decode replica's handoff.fetch span.
+                        attrs["parent"] = hop_ctx.parent_span
+                        attrs["hop"] = hop_ctx.hop
+                        attrs["attempt"] = hop_ctx.attempt
+                    eng.spans.record_span(
+                        "handoff.serve",
+                        hop_ctx.trace_id
+                        if hop_ctx is not None
+                        else sanitize_trace_id(
+                            self.headers.get("X-Request-Id")
+                        ),
+                        start_monotonic=t0,
+                        attrs=attrs,
+                    )
+                eng.flight.record(
+                    "handoff.served",
+                    peer=self.client_address[0],
+                    entries=sent,
+                    of=n_full,
+                    outcome=outcome,
+                    resident=resident is not None,
+                    ms=round((time.monotonic() - t0) * 1e3, 3),
+                )
+
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0]
                 if path == "/healthz":
@@ -942,6 +1311,11 @@ class EngineServer:
                         ov.drain_rate_rps() if ov is not None else None
                     )
                     summary = {
+                        # Disaggregation role (unified/prefill/decode):
+                        # the router's poll loop keeps prefill-role
+                        # replicas out of the /generate ring and feeds
+                        # the split policy from this field.
+                        "role": server.engine.role,
                         "queue_depth": len(server.engine.queue),
                         "active_slots": sum(
                             1 for s in server.engine.slots if s is not None
@@ -1019,6 +1393,13 @@ class EngineServer:
                     # aggregates only, no request-identifying content, so
                     # it stays as open as /metrics.
                     self._reply(200, server.engine.profiler.snapshot())
+                elif path == "/debug/disagg":
+                    # Disaggregation snapshot (models/engine_handoff.py):
+                    # role, handoff serve/fetch/publish counters, and
+                    # the skipped-prefill accounting — counts only,
+                    # never token content, so it stays as open as
+                    # /metrics.
+                    self._reply(200, server.engine.handoff_state())
                 elif path == "/debug/kvcache":
                     # KV tiering snapshot (models/engine_kvcache.py):
                     # tier sizes, hit/evict/restore counters, resume
@@ -1051,6 +1432,7 @@ class EngineServer:
                 trace_id: Optional[str] = None,
                 retry_after: Optional[str] = None,
                 shed: Optional[str] = None,
+                prefill_needed: Optional[str] = None,
             ) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
@@ -1066,6 +1448,13 @@ class EngineServer:
                     # Overload, not drain: the router must keep the
                     # replica in rotation (back off, don't eject).
                     self.send_header("X-Shed", shed)
+                if prefill_needed:
+                    # Decode-role refusal: the prompt needs a prefill
+                    # dispatch, not another decode replica (the router's
+                    # disagg policy reads this — routing.md).
+                    self.send_header(
+                        handoff_mod.PREFILL_NEEDED_HEADER, prefill_needed
+                    )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1597,6 +1986,28 @@ def main(argv: Optional[list[str]] = None) -> None:
         "GET /debug/kvcache's host block; 0 disables)",
     )
     p.add_argument(
+        "--role",
+        choices=["unified", "prefill", "decode"],
+        default="unified",
+        help="disaggregated serving role (models/engine_handoff.py, "
+        "docs/disagg.md): unified (default) prefills and decodes in one "
+        "loop; prefill serves POST /v1/prefill KV-handoff streams and "
+        "answers /generate 409; decode admits requests whose full-page "
+        "prefix is resident (or fetchable via the router's "
+        "X-Handoff-Source locator), skips the prefill compute the "
+        "restored pages cover, and answers 409 + X-Prefill-Needed "
+        "otherwise.  Split roles require --kv-retain 1 and "
+        "--kv-host-cache-mb > 0",
+    )
+    p.add_argument(
+        "--handoff-timeout",
+        type=float,
+        default=30.0,
+        help="seconds a decode-role replica spends pulling a prefix "
+        "from its X-Handoff-Source (and a /v1/prefill probe waits for "
+        "chunk progress) before degrading to ordinary local prefill",
+    )
+    p.add_argument(
         "--tp",
         type=_positive_int,
         default=1,
@@ -1945,6 +2356,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         overload=overload_cfg,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
+        role=args.role,
         mesh=mesh,
         **spec_kw,
     )
@@ -1979,6 +2391,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         chip_health=chip_feed,
         snapshot_dir=args.snapshot_dir,
         snapshot_interval_s=args.snapshot_interval,
+        handoff_timeout_s=args.handoff_timeout,
     )
     if args.snapshot_dir:
         # Rehydrate BEFORE serving: the first admissions restore warm.

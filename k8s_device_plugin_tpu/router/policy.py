@@ -64,6 +64,11 @@ class ReplicaState:
         self.host = host
         self.port = int(port)
         self.breaker = breaker
+        # Disaggregation role off the summary poll (router/disagg.py):
+        # prefill-role replicas serve POST /v1/prefill only and take NO
+        # /generate assignments (candidates() excludes them; the server
+        # keeps them off the affinity ring).
+        self.role = "unified"  # guarded by: owner-thread
         self.reachable = True  # optimistic until a poll says otherwise; guarded by: owner-thread
         self.draining = False  # guarded by: owner-thread
         # Replica self-fencing (summary ``fenced``): a sick replica —
@@ -87,6 +92,7 @@ class ReplicaState:
 
     def snapshot(self) -> dict:
         return {
+            "role": self.role,
             "reachable": self.reachable,
             "draining": self.draining,
             "fenced": self.fenced,
@@ -155,7 +161,9 @@ class RoutingPolicy:
             # Draining and fenced replicas take NO new assignments —
             # not even as a stale-poll hedge (a fenced replica answers
             # 503 by contract; dialing it just burns a retry token).
-            return st.draining or st.fenced
+            # Prefill-role replicas never decode (/generate answers
+            # 409 by contract — router/disagg.py).
+            return st.draining or st.fenced or st.role == "prefill"
 
         eligible = [
             n
@@ -202,7 +210,10 @@ class RoutingPolicy:
         depths = [
             st.queue_depth
             for st in self.replicas.values()
-            if st.reachable and not st.draining and not st.fenced
+            if st.reachable
+            and not st.draining
+            and not st.fenced
+            and st.role != "prefill"
         ]
         if not depths:
             return 0.0

@@ -93,7 +93,13 @@ from ..utils.spans import (
     format_trace_context,
     sanitize_trace_id,
 )
+from ..models.engine_handoff import (
+    HANDOFF_LOCAL,
+    HANDOFF_SOURCE_HEADER,
+    PREFILL_NEEDED_HEADER,
+)
 from .breaker import STATE_VALUE, CircuitBreaker, RetryBudget
+from .disagg import NO_POOL, ROLE_PREFILL, SPLIT, DisaggConfig, DisaggPolicy, pick_prefill
 from .migration import (
     MigrationConfig,
     MigrationPlanner,
@@ -140,6 +146,24 @@ class RouterMetrics:
         self.retries = registry.counter(
             "tpu_router_retries_total",
             "Upstream re-dispatches after a failed attempt",
+        )
+        self.disagg_splits = registry.counter(
+            "tpu_router_disagg_splits_total",
+            "Disaggregation verdicts per request (router/disagg.py): "
+            "split = long prompt stamped with an X-Handoff-Source "
+            "prefill locator; short = below the (pressure-scaled) "
+            "prompt-length threshold, unified dispatch; no_pool = "
+            "split-worthy but no healthy prefill replica — degraded to "
+            "unified dispatch",
+            ("verdict",),
+        )
+        self.disagg_refusals = registry.counter(
+            "tpu_router_disagg_refusals_total",
+            "Decode-replica 409 + X-Prefill-Needed refusals observed "
+            "on dispatch (the prompt's prefix was not resident and no "
+            "locator rode the dial — a misclassified split or a "
+            "decode-only fleet without --disagg); the replica is "
+            "skipped, not tripped",
         )
         self.failovers = registry.counter(
             "tpu_router_failovers_total",
@@ -353,6 +377,9 @@ class RouterServer:
         migrate: bool = False,
         migration: Optional[MigrationConfig] = None,
         migration_burst_gap_s: float = 0.005,
+        disagg: bool = False,
+        disagg_config: Optional[DisaggConfig] = None,
+        prefill_replicas: Optional[list[str]] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -430,8 +457,22 @@ class RouterServer:
             mode=policy_mode,
             seed=seed,
         )
+        # Disaggregated prefill/decode split (router/disagg.py; library
+        # default OFF like migration — the CLI arms it).  Roles are
+        # discovered from each replica's summary poll; --prefill-replicas
+        # names replicas that are prefill-role from the start (they are
+        # polled like any other but never join the /generate ring).
+        self.disagg = (
+            DisaggPolicy(disagg_config) if disagg else None
+        )
+        # Statically configured prefill replicas survive DNS
+        # reconciliation (they are not in the headless Service's
+        # records).
+        self._static_prefill = set(prefill_replicas or ())
         for name in replicas:
             self.add_replica(name)
+        for name in self._static_prefill:
+            self.add_replica(name, role=ROLE_PREFILL)
 
         server = self
 
@@ -499,6 +540,24 @@ class RouterServer:
                         trace_id,
                     )
                     return
+                # Disaggregation verdict (router/disagg.py): a long
+                # prompt gets a prefill-pool locator stamped on every
+                # upstream dial (failover legs included — the next
+                # decode replica can pull the same prefix); everything
+                # else rides the unified path byte-for-byte.
+                handoff_source = None
+                if server.disagg is not None:
+                    verdict, handoff_source = server._classify_disagg(
+                        prompt
+                    )
+                    server.metrics.disagg_splits.inc(verdict=verdict)
+                    if verdict == SPLIT:
+                        server._record(
+                            "router.disagg_split",
+                            rid=trace_id,
+                            source=handoff_source,
+                            prompt_tokens=len(prompt),
+                        )
                 with server._active_lock:
                     server._active += 1
                 # Root span reserved NOW; attempt legs parent on it and
@@ -506,14 +565,18 @@ class RouterServer:
                 # the router half of the fleet timeline.
                 tr = _ReqTrace(server.spans, trace_id)
                 tr.set(stream=bool(body.get("stream")))
+                if handoff_source is not None:
+                    tr.set(handoff_source=handoff_source)
                 try:
                     if body.get("stream"):
                         server._proxy_stream(
-                            self, body, prompt, trace_id, deadline_s, tr
+                            self, body, prompt, trace_id, deadline_s, tr,
+                            handoff=handoff_source,
                         )
                     else:
                         server._proxy_unary(
-                            self, body, prompt, trace_id, deadline_s, tr
+                            self, body, prompt, trace_id, deadline_s, tr,
+                            handoff=handoff_source,
                         )
                 finally:
                     with server._active_lock:
@@ -600,10 +663,13 @@ class RouterServer:
 
     # ------------------------------------------------------- membership
 
-    def add_replica(self, name: str) -> None:
-        """Add one ``host:port`` replica to the ring and replica set
-        (idempotent).  Consistent hashing keeps existing placements for
-        all but ~1/K of the keyspace."""
+    def add_replica(self, name: str, role: str = "unified") -> None:
+        """Add one ``host:port`` replica to the replica set — and, for
+        decode-capable roles, the affinity ring (idempotent).
+        Consistent hashing keeps existing placements for all but ~1/K
+        of the keyspace.  Prefill-role replicas are polled and
+        breaker-tracked like any other but never own ring segments:
+        they serve ``POST /v1/prefill`` pulls, not ``/generate``."""
         with self._lock:
             if name in self.replicas:
                 return
@@ -614,8 +680,11 @@ class RouterServer:
                     n, old, new
                 ),
             )
-            self.replicas[name] = ReplicaState(name, breaker)
-            self.ring.add(name)
+            st = ReplicaState(name, breaker)
+            st.role = role
+            self.replicas[name] = st
+            if role != ROLE_PREFILL:
+                self.ring.add(name)
         self.metrics.replica_up.set(1, replica=name)
         self.metrics.replica_fenced.set(0, replica=name)
         self.metrics.breaker_state.set(STATE_VALUE["closed"], replica=name)
@@ -683,6 +752,9 @@ class RouterServer:
                 st.reachable = True
                 self.metrics.replica_up.set(1, replica=name)
                 self._record("router.replica_up", replica=name)
+            role = str(payload.get("role") or "unified")
+            if role != st.role:
+                self._set_role(name, role)
             st.queue_depth = int(payload.get("queue_depth", 0))
             st.active_slots = int(payload.get("active_slots", 0))
             # Host-side overload signals (queue-wait EWMA + drain-rate
@@ -751,6 +823,27 @@ class RouterServer:
             replica=name,
         )
 
+    def _set_role(self, name: str, role: str) -> None:
+        """A replica's summary poll reported a different role (a
+        redeploy flipped --role): reconcile ring membership — prefill
+        replicas own no ring segments; a replica becoming
+        decode-capable joins the ring (~1/K remap, like any membership
+        change)."""
+        st = self.replicas.get(name)
+        if st is None:
+            return
+        with self._lock:  # same cross-thread license as _mark_draining
+            if self._poll_guard is not None:
+                self._poll_guard.check("set_role")
+            if st.role == role:
+                return
+            st.role = role
+            if role == ROLE_PREFILL:
+                self.ring.remove(name)
+            else:
+                self.ring.add(name)
+        self._record("router.replica_role", replica=name, role=role)
+
     def _refresh_dns(self) -> None:
         """Re-resolve ``--replicas-dns`` (a headless Service name) and
         reconcile ring membership — replicas scale without a router
@@ -772,7 +865,7 @@ class RouterServer:
         current = set(self.replicas)
         for name in resolved - current:
             self.add_replica(name)
-        for name in current - resolved:
+        for name in current - resolved - self._static_prefill:
             self.remove_replica(name)
 
     def _poll_loop(self) -> None:
@@ -803,7 +896,10 @@ class RouterServer:
                 drain_rate_rps=st.drain_rate_rps,
                 queue_depth=st.queue_depth,
                 eligible=(
-                    st.reachable and not st.draining and not st.fenced
+                    st.reachable
+                    and not st.draining
+                    and not st.fenced
+                    and st.role != ROLE_PREFILL
                 ),
             )
         verdict = planner.plan()
@@ -863,7 +959,13 @@ class RouterServer:
         (the default migration target when the caller names none)."""
         best: Optional[tuple[float, str]] = None
         for name, st in self.replicas.items():
-            if name == source or not st.reachable or st.draining or st.fenced:
+            if (
+                name == source
+                or not st.reachable
+                or st.draining
+                or st.fenced
+                or st.role == ROLE_PREFILL
+            ):
                 continue
             pressure = replica_pressure(
                 st.queue_wait_ewma_s, st.drain_rate_rps, st.queue_depth
@@ -877,7 +979,13 @@ class RouterServer:
         NOW and its breaker must grant the dial — a migration aborts
         rather than dogpile a tripping or demoted target."""
         st = self.replicas.get(target)
-        if st is None or st.draining or st.fenced or not st.reachable:
+        if (
+            st is None
+            or st.draining
+            or st.fenced
+            or not st.reachable
+            or st.role == ROLE_PREFILL
+        ):
             return False
         return st.breaker.try_acquire()
 
@@ -887,14 +995,66 @@ class RouterServer:
             "router.migration_aborted", rid=rid, target=target, reason=reason
         )
 
+    def _classify_disagg(
+        self, prompt
+    ) -> tuple[str, Optional[str]]:
+        """(verdict, prefill source): classify one request against the
+        split policy (prompt length × decode-pool pressure) and pick
+        the least-pressured healthy prefill replica as its
+        ``X-Handoff-Source`` locator.  ``no_pool`` (no healthy prefill
+        replica) degrades to unified dispatch — the caller stamps
+        nothing."""
+        prefills: dict[str, float] = {}
+        decode_pressure = 0.0
+        for name, st in list(self.replicas.items()):
+            if not st.reachable or st.draining or st.fenced:
+                continue
+            pressure = replica_pressure(
+                st.queue_wait_ewma_s, st.drain_rate_rps, st.queue_depth
+            )
+            if st.role == ROLE_PREFILL:
+                prefills[name] = pressure
+            else:
+                decode_pressure = max(decode_pressure, pressure)
+        verdict = self.disagg.classify(
+            len(prompt), decode_pressure, bool(prefills)
+        )
+        if verdict != SPLIT:
+            # Short prompt or no healthy prefill pool: the LOCAL
+            # sentinel tells a decode-role replica to run its own
+            # prefill instead of refusing — the unified degradation
+            # (a unified replica ignores the header entirely).
+            return verdict, HANDOFF_LOCAL
+        return verdict, pick_prefill(prefills) or HANDOFF_LOCAL
+
+    def _prefill_needed(self, name: str, trace_id: str, missing) -> None:
+        """One decode replica answered 409 + X-Prefill-Needed: the
+        prompt's prefix is neither resident nor fetchable there.  Not a
+        fault (no breaker hit) — skip the replica and keep walking the
+        ring (a unified replica serves it; with --disagg the locator
+        normally prevents this entirely)."""
+        self.metrics.disagg_refusals.inc()
+        self._record(
+            "router.prefill_needed",
+            replica=name,
+            rid=trace_id,
+            missing_pages=missing,
+        )
+
     def fleet_state(self) -> dict:
         """GET /debug/fleet: per-replica host-side signals, planner
         state, and the fleet scale recommendation — what
         ``tools/fleet_plan.py`` renders and an autoscaler would poll."""
         signals = {}
         for name, st in list(self.replicas.items()):
-            eligible = st.reachable and not st.draining and not st.fenced
+            eligible = (
+                st.reachable
+                and not st.draining
+                and not st.fenced
+                and st.role != ROLE_PREFILL
+            )
             signals[name] = {
+                "role": st.role,
                 "pressure_s": round(
                     replica_pressure(
                         st.queue_wait_ewma_s,
@@ -964,6 +1124,7 @@ class RouterServer:
         stream: bool,
         deadline: Optional[float] = None,
         hop_header: Optional[str] = None,
+        handoff: Optional[str] = None,
     ) -> _Upstream:
         """One upstream POST /generate.  Fires the per-replica
         ``router.replica_conn`` failpoint first (the chaos seam: an
@@ -985,6 +1146,11 @@ class RouterServer:
         }
         if hop_header is not None:
             headers[TRACE_CONTEXT_HEADER] = hop_header
+        if handoff is not None:
+            # Disaggregation locator: the decode replica pulls this
+            # prompt's prefix from the named prefill replica before
+            # admitting (models/engine_handoff.py).
+            headers[HANDOFF_SOURCE_HEADER] = handoff
         if deadline is not None:
             headers["X-Request-Deadline"] = (
                 f"{max(deadline - time.monotonic(), 0.0):.3f}"
@@ -1099,10 +1265,20 @@ class RouterServer:
             k: v
             for k, v in resp.getheaders()
             if k.lower()
-            in ("content-type", "x-request-id", "retry-after", "x-shed")
+            in (
+                "content-type",
+                "x-request-id",
+                "retry-after",
+                "x-shed",
+                "x-prefill-needed",
+            )
         }
         if resp.status == 200:
             return "ok", data, headers
+        if resp.status == 409 and headers.get(PREFILL_NEEDED_HEADER):
+            # Decode-role refusal: prefix not resident, no locator.
+            # Skip the replica (no breaker hit) and keep walking.
+            return "prefill_needed", data, headers
         if resp.status == 503:
             if headers.get("X-Shed"):
                 # Overload shed, not drain: the replica is healthy and
@@ -1120,7 +1296,8 @@ class RouterServer:
     # ------------------------------------------------------------ unary
 
     def _proxy_unary(
-        self, handler, body, prompt, trace_id, deadline_s=None, tr=None
+        self, handler, body, prompt, trace_id, deadline_s=None, tr=None,
+        handoff=None,
     ) -> None:
         t0 = time.monotonic()
         # The client's deadline bounds the whole attempt budget: every
@@ -1211,6 +1388,7 @@ class RouterServer:
                     name, body, prompt, trace_id, exclude, deadline=
                     deadline if deadline_s is not None else None,
                     tr=tr, kind="retry" if attempt > 0 else "primary",
+                    handoff=handoff,
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
@@ -1227,6 +1405,12 @@ class RouterServer:
             up, winner_placement = result
             kind, data, headers = self._classify(up)
             up.close()
+            if kind == "prefill_needed":
+                self._prefill_needed(
+                    up.name, trace_id, headers.get(PREFILL_NEEDED_HEADER)
+                )
+                exclude.add(up.name)
+                continue
             if kind in ("draining", "shed"):
                 ra = headers.get("Retry-After")
                 retry_after = float(ra) if ra else retry_after
@@ -1320,7 +1504,7 @@ class RouterServer:
 
     def _dial_with_hedge(
         self, name, body, prompt, trace_id, exclude, deadline=None,
-        tr=None, kind="primary",
+        tr=None, kind="primary", handoff=None,
     ) -> tuple[_Upstream, Optional[str]]:
         """Dial ``name``; when hedging is on and no response lands
         within the rolling TTFT p99, race a second dispatch along the
@@ -1346,6 +1530,7 @@ class RouterServer:
                     hop_header=tr.header(span_id, attempt_idx)
                     if tr
                     else None,
+                    handoff=handoff,
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 self._span_attempt(
@@ -1469,7 +1654,8 @@ class RouterServer:
     # ----------------------------------------------------------- stream
 
     def _proxy_stream(
-        self, handler, body, prompt, trace_id, deadline_s=None, tr=None
+        self, handler, body, prompt, trace_id, deadline_s=None, tr=None,
+        handoff=None,
     ) -> None:
         """SSE passthrough wrapper: register the stream's migration
         handle (the planner flags it through this registry), relay, and
@@ -1480,14 +1666,16 @@ class RouterServer:
             self._streams[trace_id] = ctl
         try:
             self._relay_stream(
-                handler, body, prompt, trace_id, deadline_s, tr, ctl
+                handler, body, prompt, trace_id, deadline_s, tr, ctl,
+                handoff=handoff,
             )
         finally:
             with self._streams_lock:
                 self._streams.pop(trace_id, None)
 
     def _relay_stream(
-        self, handler, body, prompt, trace_id, deadline_s, tr, ctl
+        self, handler, body, prompt, trace_id, deadline_s, tr, ctl,
+        handoff=None,
     ) -> None:
         """SSE passthrough with zero-drop mid-stream failover AND
         planned migration.
@@ -1664,6 +1852,7 @@ class RouterServer:
                     hop_header=tr.header(leg_span, attempt_idx)
                     if tr
                     else None,
+                    handoff=handoff,
                 )
             except (failpoints.FailpointError, *_CONN_ERRORS) as e:
                 st.failures += 1
@@ -1710,6 +1899,21 @@ class RouterServer:
                     self._migration_aborted(
                         trace_id, name, "shed" if shed else "draining"
                     )
+                exclude.add(name)
+                continue
+            if up.resp.status == 409 and up.resp.getheader(
+                PREFILL_NEEDED_HEADER
+            ):
+                missing = up.resp.getheader(PREFILL_NEEDED_HEADER)
+                up.resp.read()
+                up.close()
+                self._span_attempt(
+                    tr, leg_span, leg_t0, name, attempt_idx, leg_kind,
+                    status=409, outcome="prefill_needed",
+                )
+                self._prefill_needed(name, trace_id, missing)
+                if migration_leg:
+                    self._migration_aborted(trace_id, name, "prefill_needed")
                 exclude.add(name)
                 continue
             if up.resp.status != 200:
@@ -1969,6 +2173,11 @@ class RouterServer:
                 "prefix_max_blocks": self.policy.prefix_max_blocks,
             },
             "ring": self.ring.snapshot(),
+            "disagg": (
+                self.disagg.snapshot()
+                if self.disagg is not None
+                else {"enabled": False}
+            ),
             "retry_budget": round(self.budget.available(), 2),
             "retry_budget_spent": self.budget.spent_total,
             "retry_budget_exhausted": self.budget.exhausted_total,
@@ -2149,6 +2358,51 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="migration budget refill rate (moves per second) — the "
         "sustained pacing knob",
     )
+    p.add_argument(
+        "--disagg",
+        type=int,
+        choices=[0, 1],
+        default=0,
+        help="disaggregated prefill/decode routing (router/disagg.py, "
+        "docs/disagg.md): classify requests by prompt-length threshold "
+        "x decode-pool pressure, stamp long prompts with an "
+        "X-Handoff-Source prefill locator (the decode replica pulls "
+        "the KV prefix over POST /v1/prefill), and fall back to "
+        "unified dispatch whenever the prefill pool is down; requires "
+        "prefill-role replicas (--prefill-replicas, or summary-poll "
+        "role discovery)",
+    )
+    p.add_argument(
+        "--disagg-threshold",
+        type=int,
+        default=256,
+        help="prompt length (tokens) at/above which a request's "
+        "prefill dispatches to the prefill pool while the decode pool "
+        "is calm",
+    )
+    p.add_argument(
+        "--disagg-hot-threshold",
+        type=int,
+        default=64,
+        help="the lower split bar that applies while the decode pool "
+        "runs hot (pressure >= --disagg-hot-wait)",
+    )
+    p.add_argument(
+        "--disagg-hot-wait",
+        type=float,
+        default=0.5,
+        help="decode-pool queue-wait pressure (seconds, max over "
+        "eligible replicas) at/above which the hot threshold applies",
+    )
+    p.add_argument(
+        "--prefill-replicas",
+        default="",
+        help="comma-separated host:port replicas that are prefill-role "
+        "from the start (polled like any replica, never on the "
+        "/generate ring); replicas discovered via --replicas/-dns "
+        "whose summary reports role=prefill are reconciled the same "
+        "way",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -2211,6 +2465,20 @@ def main(argv: Optional[list[str]] = None) -> None:
         request_timeout_s=args.request_timeout,
         policy_mode=args.policy,
         replicas_dns=args.replicas_dns or None,
+        disagg=bool(args.disagg),
+        disagg_config=DisaggConfig(
+            threshold_tokens=args.disagg_threshold,
+            # The hot bar can never sit above the calm bar; clamp so a
+            # lone --disagg-threshold below the default hot bar keeps
+            # working ("split everything past N, hot or not").
+            hot_threshold_tokens=min(
+                args.disagg_hot_threshold, args.disagg_threshold
+            ),
+            hot_wait_s=args.disagg_hot_wait,
+        ),
+        prefill_replicas=[
+            r for r in args.prefill_replicas.split(",") if r
+        ],
         migrate=bool(args.migrate),
         migration=MigrationConfig(
             hot_wait_s=args.migrate_hot_wait,

@@ -397,9 +397,33 @@ class FakeReplica:
         cold_prefill_delay_s: float = 0.0,
         prefix_tokens: int = 0,
         snapshot_chunk_s: float = 0.0,
+        role: str = "unified",
+        prefill_chunk_s: float = 0.0,
     ):
         self.token_delay_s = token_delay_s
         self.prefill_delay_s = prefill_delay_s
+        # Disaggregation double (models/engine_handoff.py): the role
+        # rides the summary poll; a prefill/unified fake serves POST
+        # /v1/prefill in the REAL wire format (one tiny entry per
+        # cumulative 16-token prefix, trickled ``prefill_chunk_s`` per
+        # entry so kill() lands mid-body); a decode fake with
+        # ``prefix_tokens`` set refuses a cold prompt without an
+        # X-Handoff-Source locator (409 + X-Prefill-Needed), pulls the
+        # prefix through the real parser when one rides the dial, and
+        # degrades to "local prefill" (pays cold_prefill_delay_s) when
+        # the fetch fails — the engine contract in miniature.
+        self.role = role
+        self.prefill_chunk_s = prefill_chunk_s
+        self.prefill_serves = 0
+        self.prefill_refusals = 0  # decode-role 409 X-Prefill-Needed answers
+        self.handoff_fetches = 0
+        self.handoff_fetch_failures = 0
+        self.seen_handoff: list = []  # X-Handoff-Source header per /generate
+        # Flight recorder for chaos scoring: handoff.fetched /
+        # handoff.fetch_failed land here like the real engine's.
+        from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+        self.flight = FlightRecorder(capacity=512, name="fake-replica")
         # Warm-prefix model (elastic scale-up scenarios): with
         # ``prefix_tokens`` set, a prompt whose leading prefix-key is
         # NOT in ``warm_prefixes`` pays ``cold_prefill_delay_s`` (the
@@ -464,7 +488,11 @@ class FakeReplica:
                     pass  # killed mid-flight
 
             def do_POST(self):  # noqa: N802
-                if self.path.split("?")[0] != "/generate":
+                path = self.path.split("?")[0]
+                if path == "/v1/prefill":
+                    self._serve_prefill()
+                    return
+                if path != "/generate":
                     self.send_error(404)
                     return
                 # The EngineServer hop-context contract: a valid
@@ -530,6 +558,47 @@ class FakeReplica:
                 prompt = [int(t) for t in body["prompt"]]
                 max_new = int(body.get("max_new_tokens", 16))
                 stream = bool(body.get("stream", False))
+                handoff_src = self.headers.get("X-Handoff-Source")
+                with replica._lock:
+                    replica.seen_handoff.append(handoff_src)
+                if (
+                    replica.role == "decode"
+                    and replica.prefix_tokens
+                    and len(prompt) >= replica.prefix_tokens
+                ):
+                    # The decode-role admission gate in miniature:
+                    # resident admits; a locator pulls; no locator +
+                    # cold prefix refuses 409 + X-Prefill-Needed; a
+                    # failed pull degrades to "local prefill" (the
+                    # cold_prefill_delay_s below) — never a drop.
+                    key = tuple(prompt[: replica.prefix_tokens])
+                    with replica._lock:
+                        resident = key in replica.warm_prefixes
+                    if not resident and handoff_src == "local":
+                        # Router-directed local prefill (short prompt /
+                        # prefill pool down): fall through to the cold
+                        # path below.
+                        pass
+                    elif not resident:
+                        if not handoff_src:
+                            with replica._lock:
+                                replica.prefill_refusals += 1
+                            out = json.dumps(
+                                {"error": "prefix not resident",
+                                 "trace_id": trace_id}
+                            ).encode()
+                            self.send_response(409)
+                            self.send_header(
+                                "Content-Type", "application/json"
+                            )
+                            self.send_header("X-Prefill-Needed", "1")
+                            self.send_header(
+                                "Content-Length", str(len(out))
+                            )
+                            self.end_headers()
+                            self.wfile.write(out)
+                            return
+                        replica.fetch_prefill(handoff_src, prompt)
                 with replica._lock:
                     replica.generate_requests += 1
                     replica.seen_trace_ids.append(trace_id)
@@ -635,6 +704,7 @@ class FakeReplica:
                     with replica._lock:
                         active = replica.active_streams
                     self._json(200, {
+                        "role": replica.role,
                         "queue_depth": active,  # the fake has no queue
                         "active_slots": active,
                         "draining": replica._draining.is_set(),
@@ -676,6 +746,89 @@ class FakeReplica:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _serve_prefill(self) -> None:
+                """The EngineServer POST /v1/prefill contract in
+                miniature: decode role refuses 409; fingerprint headers
+                refuse 409 before any bytes; otherwise one REAL
+                wire-format entry per cumulative 16-token prefix of the
+                prompt, streamed preamble-first and trickled
+                ``prefill_chunk_s`` per entry so kill() lands
+                mid-body.  Served prefixes warm this replica (the
+                publish step)."""
+                from k8s_device_plugin_tpu.models import (
+                    engine_snapshot as snap_mod,
+                )
+                import numpy as np
+
+                if replica.role == "decode":
+                    self._json(409, {"error": "replica role is decode"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = [int(t) for t in body.get("prompt") or []]
+                want_layout = self.headers.get(snap_mod.LAYOUT_HEADER)
+                want_params = self.headers.get(snap_mod.PARAMS_HEADER)
+                layout_fp = snap_mod.layout_fingerprint(
+                    replica.SNAPSHOT_LAYOUT
+                )
+                if (want_layout and want_layout != layout_fp) or (
+                    want_params
+                    and want_params != replica.SNAPSHOT_PARAMS_FP
+                ):
+                    with replica._lock:
+                        replica.prefill_refusals += 1
+                    self._json(409, {"error": "handoff mismatch"})
+                    return
+                ps = replica.SNAPSHOT_LAYOUT["page_size"]
+                n_full = len(prompt) // ps
+                entries = [
+                    (
+                        ("prefix", -1, tuple(prompt[: (i + 1) * ps])),
+                        {
+                            "fake_layer": {
+                                "pool_key": np.zeros((1,), np.float32)
+                            }
+                        },
+                    )
+                    for i in range(n_full)
+                ]
+                with replica._lock:
+                    replica.prefill_serves += 1
+                    if replica.prefix_tokens:
+                        replica.warm_prefixes.add(
+                            tuple(prompt[: replica.prefix_tokens])
+                        )
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream"
+                )
+                self.send_header(snap_mod.LAYOUT_HEADER, layout_fp)
+                self.send_header(
+                    snap_mod.PARAMS_HEADER, replica.SNAPSHOT_PARAMS_FP
+                )
+                self.send_header(snap_mod.ENTRIES_HEADER, str(n_full))
+                self.end_headers()
+                try:
+                    self.wfile.write(
+                        snap_mod.encode_preamble(
+                            replica.SNAPSHOT_LAYOUT,
+                            replica.SNAPSHOT_PARAMS_FP,
+                            n_full,
+                        )
+                    )
+                    self.wfile.flush()
+                    for key, rows in entries:
+                        if replica.prefill_chunk_s:
+                            time.sleep(replica.prefill_chunk_s)
+                        self.wfile.write(
+                            snap_mod.encode_entry(
+                                replica.SNAPSHOT_LAYOUT, key, rows
+                            )
+                        )
+                        self.wfile.flush()
+                except OSError:
+                    pass  # decode side vanished / kill() mid-transfer
 
             def _serve_snapshot(self) -> None:
                 """The EngineServer GET /debug/snapshot contract in
@@ -868,6 +1021,68 @@ class FakeReplica:
             for key, _rows, _nbytes in entries:
                 self.warm_prefixes.add(key[2])
         return {"ok": True, "restored": len(entries), "peer": peer}
+
+    def fetch_prefill(self, source: str, prompt) -> dict:
+        """The decode-side pull in miniature: POST /v1/prefill on
+        ``source``, parse through the REAL wire verifier, adopt the
+        served prefixes as warm.  ANY failure (source killed
+        mid-transfer, torn stream, refusal, unreachable) adopts
+        NOTHING — the caller's cold-prefill path IS the local-prefill
+        degradation.  Records handoff.fetched / handoff.fetch_failed
+        flight events exactly like the engine, so chaos scenarios score
+        the same detector."""
+        import http.client
+
+        from k8s_device_plugin_tpu.models import engine_snapshot as snap_mod
+
+        host, _, port = source.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=10)
+            try:
+                conn.request(
+                    "POST",
+                    "/v1/prefill",
+                    json.dumps(
+                        {"prompt": [int(t) for t in prompt]}
+                    ).encode(),
+                    headers={
+                        "Content-Type": "application/json",
+                        snap_mod.LAYOUT_HEADER: snap_mod.layout_fingerprint(
+                            self.SNAPSHOT_LAYOUT
+                        ),
+                        snap_mod.PARAMS_HEADER: self.SNAPSHOT_PARAMS_FP,
+                    },
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise snap_mod.SnapshotError(
+                        f"source refused: HTTP {resp.status}"
+                    )
+                _, entries = snap_mod._parse_snapshot(
+                    resp, self.SNAPSHOT_LAYOUT, self.SNAPSHOT_PARAMS_FP
+                )
+            finally:
+                conn.close()
+        except (snap_mod.SnapshotError, OSError, ValueError) as e:
+            with self._lock:
+                self.handoff_fetches += 1
+                self.handoff_fetch_failures += 1
+            self.flight.record(
+                "handoff.fetch_failed", source=source, reason=str(e)
+            )
+            return {"ok": False, "reason": str(e), "restored": 0}
+        with self._lock:
+            self.handoff_fetches += 1
+            for key, _rows, _nbytes in entries:
+                self.warm_prefixes.add(
+                    tuple(key[2][: self.prefix_tokens])
+                    if self.prefix_tokens
+                    else tuple(key[2])
+                )
+        self.flight.record(
+            "handoff.fetched", source=source, restored=len(entries)
+        )
+        return {"ok": True, "restored": len(entries), "source": source}
 
     def kill(self) -> None:
         """Abrupt death: reset every live connection (streams cut

@@ -693,3 +693,74 @@ def test_bench_diff_parses_kernels_block(tmp_path):
     e = bench_diff.load_record(str(tmp_path / "e.json"))
     assert "kernels_min_ratio" not in e
     assert "kernels min" not in bench_diff.ledger_row(e, e)
+
+
+def test_bench_diff_parses_disagg_block(tmp_path):
+    """Records grew a DISAGG block (ISSUE 15, benchmark.py
+    _run_disagg_phase): decode ITL p99 flat-vs-growing under prefill
+    load must surface in the normalized record, the field diff, and the
+    ledger row — the row screams ITL-REGRESSED when the disagg decode
+    p99 grows past 1.2x of its unloaded value, NO-HANDOFF when zero
+    entries moved over the wire, and DIVERGED when the handed-off
+    tokens stop matching the local-prefill oracle."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 15,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 16
+    loaded["parsed"]["disagg"] = {
+        "prefill_jobs": 4,
+        "itl_p99_unloaded_ms": 10.0,
+        "unified": {"itl_p99_loaded_ms": 25.0, "ratio": 2.5},
+        "disagg": {"itl_p99_loaded_ms": 11.0, "ratio": 1.1,
+                   "handoff_entries": 12, "tokens_match": True},
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["disagg_ratio"] == 1.1
+    assert b["disagg_unified_ratio"] == 2.5
+    assert b["disagg_handoff_entries"] == 12
+    assert b["disagg_tokens_match"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "disagg_ratio" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "disagg decode p99 11.0ms under prefill load" in row
+    assert "12 entries shipped" in row
+    assert "ITL-REGRESSED" not in row and "NO-HANDOFF" not in row
+    assert "DIVERGED" not in row
+    # Decode p99 grew past 1.2x under prefill load: the split failed.
+    loaded["parsed"]["disagg"]["disagg"]["ratio"] = 1.4
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "ITL-REGRESSED" in bench_diff.ledger_row(a, c)
+    # Zero entries over the wire: silently local prefill.
+    loaded["parsed"]["disagg"]["disagg"]["ratio"] = 1.1
+    loaded["parsed"]["disagg"]["disagg"]["handoff_entries"] = 0
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "NO-HANDOFF" in bench_diff.ledger_row(a, d)
+    # Restored pages no longer replay the oracle.
+    loaded["parsed"]["disagg"]["disagg"]["handoff_entries"] = 12
+    loaded["parsed"]["disagg"]["disagg"]["tokens_match"] = False
+    (tmp_path / "e.json").write_text(json.dumps(loaded))
+    e = bench_diff.load_record(str(tmp_path / "e.json"))
+    assert "DIVERGED" in bench_diff.ledger_row(a, e)
+    # A skipped phase rides in parsed untouched, never in the row.
+    loaded["parsed"]["disagg"] = {"skipped": "prompt too short"}
+    (tmp_path / "f.json").write_text(json.dumps(loaded))
+    f = bench_diff.load_record(str(tmp_path / "f.json"))
+    assert "disagg_ratio" not in f
+    assert "disagg decode p99" not in bench_diff.ledger_row(a, f)

@@ -2152,3 +2152,163 @@ def test_chaos_diurnal_burst_peer_warmed_scale_up(tmp_path):
         for r in (warm_a, warm_b, cold_join, warm_join):
             if not r.killed.is_set():
                 r.stop()
+
+
+def test_chaos_disagg_prefill_death_mid_transfer(tmp_path):
+    """Disaggregated prefill/decode under prefill-pool failure
+    (ISSUE 15): 1 prefill + 2 decode fakes behind a disagg router,
+    long-prompt streams pulling their KV prefix over /v1/prefill.
+
+    Control: the handoff works — pulls succeed, streams bit-identical,
+    zero fetch failures.  Fault: the prefill replica is KILLED mid-body
+    (its /v1/prefill trickles entries, sockets reset mid-transfer)
+    while a live stream's pull is in flight — the decode replica
+    degrades to LOCAL prefill with ZERO dropped streams and
+    bit-identical tokens, and its handoff.fetch_failed flight events
+    score precision/recall 1.0 against the injected kill window (the
+    other decode replica and the whole control phase are the precision
+    control)."""
+    import http.client
+    import threading
+
+    from k8s_device_plugin_tpu.router.disagg import DisaggConfig
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    from tests.fakes import FakeReplica, fake_generate
+
+    chaos_report = _chaos_report()
+    pre = FakeReplica(
+        role="prefill", prefix_tokens=16, prefill_chunk_s=0.05
+    ).start()
+    decodes = [
+        FakeReplica(
+            role="decode", prefix_tokens=16, cold_prefill_delay_s=0.05,
+            token_delay_s=0.02,
+        ).start()
+        for _ in range(2)
+    ]
+    flight = FlightRecorder(capacity=4096, name="chaos-router")
+    router = RouterServer(
+        [d.name for d in decodes],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        poll_interval_s=0.15,
+        hedge=False,
+        backoff_base_s=0.02,
+        backoff_max_s=0.3,
+        upstream_timeout_s=30.0,
+        request_timeout_s=60.0,
+        disagg=True,
+        disagg_config=DisaggConfig(
+            threshold_tokens=32, hot_threshold_tokens=16
+        ),
+        prefill_replicas=[pre.name],
+    ).start()
+
+    def stream(prompt, max_new):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", router.port, timeout=60
+        )
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompt": prompt, "max_new_tokens": max_new,
+                        "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        events = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            event = json.loads(line[5:].strip())
+            events.append(event)
+            if event.get("done") or "error" in event:
+                break
+        conn.close()
+        return events, [e["token"] for e in events if "token" in e]
+
+    try:
+        # --- Control: two long-prompt streams, handoff healthy.
+        for base in (100, 600):
+            prompt = [base + i for i in range(48)]
+            events, tokens = stream(prompt, 6)
+            assert tokens == fake_generate(prompt, 6)
+        assert pre.prefill_serves >= 2
+        assert sum(d.handoff_fetch_failures for d in decodes) == 0
+
+        # --- Fault: kill the prefill replica while a pull is mid-body.
+        prompt = [900 + i for i in range(64)]  # 4 entries x 0.05s trickle
+        served_name = router.ring.order(router.policy.key_of(prompt))[0]
+        served = next(d for d in decodes if d.name == served_name)
+        other = next(d for d in decodes if d.name != served_name)
+        holder: dict = {}
+
+        def run_stream():
+            holder["result"] = stream(prompt, 6)
+
+        t0_kill = time.time()
+        streamer = threading.Thread(target=run_stream, daemon=True)
+        streamer.start()
+        # Land inside the trickled transfer (preamble + ~2 entries out).
+        assert wait_until(
+            lambda: pre.prefill_serves >= 3, timeout=10
+        ), "the pull never started"
+        time.sleep(0.06)
+        pre.kill()
+        streamer.join(timeout=60)
+        t1_kill = time.time()
+        assert "result" in holder, "stream never finished"
+        events, tokens = holder["result"]
+        # ZERO drops, bit-identical through the local-prefill fallback.
+        assert tokens == fake_generate(prompt, 6), "stream must not drop"
+        assert events[-1].get("done") is True
+        assert served.handoff_fetch_failures == 1
+        assert other.handoff_fetch_failures == 0
+        assert served.cold_prefills >= 1, "local prefill never ran"
+
+        # --- Score: decode-side fetch_failed events vs the kill window.
+        injected = [
+            {
+                "cls": "handoff_fetch",
+                "replica": served.name,
+                "t0": t0_kill,
+                "t1": t1_kill,
+            }
+        ]
+        detected = [
+            {"cls": "handoff_fetch", "replica": d.name, "ts": e["ts"]}
+            for d in decodes
+            for e in d.flight.window(kinds=["handoff.fetch_failed"])
+        ]
+        score = chaos_report.score_detections(
+            injected, detected, grace_s=2.0
+        )
+        cls = score["per_class"]["handoff_fetch"]
+        assert cls["precision"] == 1.0 and cls["recall"] == 1.0, score
+        _publish({
+            "scenario": "disagg_prefill_death_mid_transfer",
+            "faults": injected,
+            "detections": detected,
+            "score": score,
+            "slo": {
+                "targets": {"dropped_streams": 0, "bit_identical": True},
+                "measured": {
+                    "dropped_streams": 0,
+                    "fetch_failures": served.handoff_fetch_failures,
+                    "control_serves": pre.prefill_serves,
+                },
+                "pass": True,
+            },
+        })
+    finally:
+        router.stop()
+        for r in [pre] + decodes:
+            if not r.killed.is_set():
+                r.stop()

@@ -644,6 +644,11 @@ def test_spec_engine_validation(rng):
         ServingEngine(cfg, params, paged, spec_gamma=-1, draft_params=qparams)
 
 
+@pytest.mark.slow  # composition blanket (tier-1 budget buy-back, PR 15):
+# spec×sampled mixing in one batch.  The targeted pins stay tier-1 —
+# test_spec_engine_matches_dense_oracle (greedy spec engine) here, and
+# the acceptance-rejection distribution-exactness pins in
+# tests/test_speculative.py (sampled spec math).
 def test_spec_engine_sampled_slots(rng):
     """Speculative SAMPLING: a temp+top_k=1 spec slot must equal the
     greedy oracle exactly (one-hot draft and target distributions force

@@ -759,6 +759,7 @@ def test_debug_state_summary_mode(served):
     summary.pop("queue_wait_ewma_s")
     summary.pop("drain_rate_rps")
     assert summary == {
+        "role": "unified",
         "queue_depth": 0,
         "active_slots": 0,
         "draining": False,

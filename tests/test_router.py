@@ -1606,3 +1606,216 @@ def test_plan_migration_ranks_hottest_prefix_sessions():
             t.join(timeout=30)
     finally:
         _teardown(replicas, router)
+
+
+# ======================================================================
+# Disaggregated prefill/decode routing (router/disagg.py, ISSUE 15)
+# ======================================================================
+
+
+def test_disagg_policy_classify_and_pick():
+    """Pure split-policy units: prompt-length threshold x decode-pool
+    pressure, the hot bar, the no-pool degradation, and the
+    least-pressure prefill pick."""
+    from k8s_device_plugin_tpu.router.disagg import (
+        NO_POOL,
+        SHORT,
+        SPLIT,
+        DisaggConfig,
+        DisaggPolicy,
+        pick_prefill,
+    )
+
+    pol = DisaggPolicy(DisaggConfig(
+        threshold_tokens=256, hot_threshold_tokens=64, hot_wait_s=0.5
+    ))
+    assert pol.classify(300, 0.0, True) == SPLIT
+    assert pol.classify(100, 0.0, True) == SHORT
+    # Hot decode pool drops the bar: the same 100-token prompt splits.
+    assert pol.classify(100, 0.9, True) == SPLIT
+    assert pol.classify(32, 0.9, True) == SHORT
+    # Split-worthy but no healthy prefill replica: unified degradation.
+    assert pol.classify(300, 0.0, False) == NO_POOL
+    assert pick_prefill({}) is None
+    assert pick_prefill({"b:1": 0.2, "a:1": 0.2}) == "a:1"  # tie: name
+    assert pick_prefill({"b:1": 0.1, "a:1": 0.2}) == "b:1"
+    with pytest.raises(ValueError):
+        DisaggConfig(threshold_tokens=8, hot_threshold_tokens=9)
+
+
+def _disagg_fleet(threshold=32):
+    """1 prefill + 2 decode fakes behind a disagg-routing router."""
+    from k8s_device_plugin_tpu.router.disagg import DisaggConfig
+
+    pre = FakeReplica(role="prefill", prefix_tokens=16).start()
+    decodes = [
+        FakeReplica(role="decode", prefix_tokens=16).start()
+        for _ in range(2)
+    ]
+    flight = FlightRecorder(capacity=2048, name="router-test")
+    router = RouterServer(
+        [r.name for r in decodes],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        poll_interval_s=0.1,
+        breaker_open_s=0.3,
+        backoff_base_s=0.02,
+        backoff_max_s=0.2,
+        hedge=False,
+        upstream_timeout_s=10.0,
+        request_timeout_s=30.0,
+        disagg=True,
+        disagg_config=DisaggConfig(
+            threshold_tokens=threshold, hot_threshold_tokens=16
+        ),
+        prefill_replicas=[pre.name],
+    ).start()
+    return pre, decodes, router, flight
+
+
+def test_disagg_split_pulls_prefix_and_stays_off_prefill_ring():
+    """A long prompt is stamped with the prefill locator: the decode
+    replica pulls the prefix over /v1/prefill (real wire format) and
+    serves oracle tokens; the prefill replica never sees /generate and
+    owns no ring segments; a short prompt rides unified with the LOCAL
+    sentinel."""
+    pre, decodes, router, flight = _disagg_fleet()
+    try:
+        long_prompt = list(range(700, 748))  # 48 >= 32: split
+        out = _post(router.port, {"prompt": long_prompt, "max_new_tokens": 5})
+        assert out["tokens"] == fake_generate(long_prompt, 5)
+        assert pre.prefill_serves == 1
+        assert sum(d.handoff_fetches for d in decodes) == 1
+        assert sum(d.handoff_fetch_failures for d in decodes) == 0
+        assert pre.generate_requests == 0
+        served = next(d for d in decodes if d.generate_requests)
+        assert served.seen_handoff[-1] == pre.name
+        # The split is a flight event + metric verdict.
+        assert any(
+            e["kind"] == "router.disagg_split" and e["source"] == pre.name
+            for e in flight.window(kinds=["router.disagg_split"])
+        )
+        # Prefill replicas own no ring segments.
+        assert pre.name not in router.ring.nodes
+        assert router.replicas[pre.name].role == "prefill"
+        # Short prompt: unified dispatch, LOCAL sentinel.
+        out = _post(router.port, {"prompt": [1, 2, 3], "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate([1, 2, 3], 3)
+        all_handoff = [h for d in decodes for h in d.seen_handoff if h]
+        assert "local" in all_handoff
+        # A second session on the same prefix is resident: no new pull.
+        _post(router.port, {"prompt": long_prompt[:16] + list(range(60, 92)),
+                            "max_new_tokens": 3})
+    finally:
+        _teardown([pre] + decodes, router)
+
+
+def test_disagg_stream_split_bit_identical():
+    pre, decodes, router, _ = _disagg_fleet()
+    try:
+        prompt = list(range(800, 848))
+        _, tokens = _stream(
+            router.port, {"prompt": prompt, "max_new_tokens": 6}
+        )
+        assert tokens == fake_generate(prompt, 6)
+        assert pre.prefill_serves == 1
+    finally:
+        _teardown([pre] + decodes, router)
+
+
+def test_disagg_prefill_pool_down_degrades_to_unified():
+    """Kill the prefill pool: the router classifies no_pool, stamps the
+    LOCAL sentinel, and the decode replicas run their own prefill —
+    zero client-visible change."""
+    pre, decodes, router, flight = _disagg_fleet()
+    try:
+        pre.kill()
+        assert wait_until(
+            lambda: not router.replicas[pre.name].reachable, timeout=5
+        )
+        prompt = list(range(900, 948))
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        assert out["tokens"] == fake_generate(prompt, 4)
+        assert sum(d.handoff_fetches for d in decodes) == 0
+        served = next(d for d in decodes if d.generate_requests)
+        assert served.seen_handoff[-1] == "local"
+        assert served.cold_prefills >= 1  # local prefill paid locally
+    finally:
+        _teardown([pre] + decodes, router)
+
+
+def test_disagg_dead_source_mid_routing_degrades_to_local_prefill():
+    """The locator names a prefill replica that dies before the pull:
+    the decode replica's fetch fails, it degrades to local prefill, and
+    the client still gets oracle tokens — plus a handoff.fetch_failed
+    flight event on exactly the serving decode replica."""
+    pre, decodes, router, _ = _disagg_fleet()
+    try:
+        # Kill the prefill replica AFTER the router polled it healthy:
+        # classification still stamps its locator, the pull fails.
+        pre.kill()
+        prompt = list(range(950, 998))
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 4})
+        assert out["tokens"] == fake_generate(prompt, 4)
+        served = next(d for d in decodes if d.generate_requests)
+        assert served.handoff_fetch_failures == 1
+        assert served.flight.window(kinds=["handoff.fetch_failed"])
+        other = next(d for d in decodes if d is not served)
+        assert other.handoff_fetch_failures == 0
+    finally:
+        _teardown([pre] + decodes, router)
+
+
+def test_decode_409_without_disagg_walks_to_unified_replica():
+    """A decode-role replica in a fleet WITHOUT --disagg answers 409 +
+    X-Prefill-Needed for a cold long prompt; the router skips it (no
+    breaker hit) and a unified replica serves — the refusal is metered,
+    never a client error."""
+    dec = FakeReplica(role="decode", prefix_tokens=16).start()
+    uni = FakeReplica().start()
+    flight = FlightRecorder(capacity=512, name="router-test")
+    router = RouterServer(
+        [dec.name, uni.name], host="127.0.0.1", port=0, flight=flight,
+        poll_interval_s=0.1, hedge=False, backoff_base_s=0.02,
+        backoff_max_s=0.2, request_timeout_s=20.0,
+    ).start()
+    try:
+        # Enough attempts that at least one homes on the decode replica.
+        for base in (100, 400, 900):
+            prompt = [base + i for i in range(48)]
+            out = _post(router.port, {"prompt": prompt, "max_new_tokens": 3})
+            assert out["tokens"] == fake_generate(prompt, 3)
+        if dec.prefill_refusals:
+            assert flight.window(kinds=["router.prefill_needed"])
+            # A 409 is a routing verdict, not a fault: breaker closed.
+            state = router.replicas[dec.name].breaker.snapshot()["state"]
+            assert state == "closed"
+    finally:
+        _teardown([dec, uni], router)
+
+
+def test_disagg_role_discovered_by_poll_reconciles_ring():
+    """A replica added as unified whose summary later reports
+    role=prefill leaves the /generate ring (and rejoins when it flips
+    back) — the redeploy-flip path."""
+    a = FakeReplica().start()
+    b = FakeReplica().start()
+    flight = FlightRecorder(capacity=512, name="router-test")
+    router = RouterServer(
+        [a.name, b.name], host="127.0.0.1", port=0, flight=flight,
+        poll_interval_s=0.05, hedge=False,
+    ).start()
+    try:
+        assert set(router.ring.nodes) == {a.name, b.name}
+        a.role = "prefill"
+        assert wait_until(lambda: a.name not in router.ring.nodes, timeout=5)
+        assert router.replicas[a.name].role == "prefill"
+        assert any(
+            e["kind"] == "router.replica_role" and e["role"] == "prefill"
+            for e in flight.window(kinds=["router.replica_role"])
+        )
+        a.role = "unified"
+        assert wait_until(lambda: a.name in router.ring.nodes, timeout=5)
+    finally:
+        _teardown([a, b], router)

@@ -172,6 +172,38 @@ def load_record(path: str) -> dict:
             rec["elastic_entries_restored"] = elastic.get("entries_restored")
             rec["elastic_wire_bytes"] = elastic.get("wire_bytes")
             rec["elastic_warmed_speedup"] = elastic.get("warmed_speedup")
+        # Disagg block (DISAGG serving rows, benchmark.py
+        # _run_disagg_phase): decode ITL p99 unloaded vs under
+        # concurrent long-prompt prefill load, unified engine vs the
+        # role-split prefill/decode pair moving KV over the handoff
+        # wire.  The regression tells: the disagg loaded/unloaded ratio
+        # creeping past 1.2x (the split stopped isolating decode from
+        # prefill — ITL-REGRESSED), zero transferred entries
+        # (NO-HANDOFF: the wire stopped moving pages and "disagg" is
+        # silently local prefill), or tokens_match flipping false
+        # (DIVERGED: restored pages no longer replay the local-prefill
+        # oracle).
+        disagg = parsed.get("disagg")
+        if isinstance(disagg, dict) and not disagg.get("skipped"):
+            rec["disagg_itl_p99_unloaded_ms"] = disagg.get(
+                "itl_p99_unloaded_ms"
+            )
+            rec["disagg_unified_loaded_ms"] = (
+                disagg.get("unified") or {}
+            ).get("itl_p99_loaded_ms")
+            rec["disagg_unified_ratio"] = (disagg.get("unified") or {}).get(
+                "ratio"
+            )
+            rec["disagg_loaded_ms"] = (disagg.get("disagg") or {}).get(
+                "itl_p99_loaded_ms"
+            )
+            rec["disagg_ratio"] = (disagg.get("disagg") or {}).get("ratio")
+            rec["disagg_handoff_entries"] = (
+                disagg.get("disagg") or {}
+            ).get("handoff_entries")
+            rec["disagg_tokens_match"] = (disagg.get("disagg") or {}).get(
+                "tokens_match"
+            )
         # Trace block (TRACE serving rows, benchmark.py's tracing
         # phase): measured spans-on vs spans-off per-token overhead
         # over the same jobs.  The regression tell: overhead creeping
@@ -260,6 +292,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "elastic_cold_ttft_p99_ms", "elastic_warmed_ttft_p99_ms",
         "elastic_entries_restored", "elastic_wire_bytes",
         "elastic_warmed_speedup",
+        "disagg_itl_p99_unloaded_ms", "disagg_unified_loaded_ms",
+        "disagg_unified_ratio", "disagg_loaded_ms", "disagg_ratio",
+        "disagg_handoff_entries", "disagg_tokens_match",
         "trace_overhead", "trace_spans",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
@@ -401,6 +436,30 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("elastic_warmed_ttft_p99_ms") is not None
+                else ""
+            )
+            + (
+                f"; disagg decode p99 {b['disagg_loaded_ms']}ms under "
+                f"prefill load ({b.get('disagg_ratio')}x of unloaded vs "
+                f"unified {b.get('disagg_unified_ratio')}x, "
+                f"{b.get('disagg_handoff_entries')} entries shipped"
+                + (
+                    ", ITL-REGRESSED"
+                    if (b.get("disagg_ratio") or 0.0) > 1.2
+                    else ""
+                )
+                + (
+                    ", NO-HANDOFF"
+                    if b.get("disagg_handoff_entries") == 0
+                    else ""
+                )
+                + (
+                    ""
+                    if b.get("disagg_tokens_match", True)
+                    else ", DIVERGED"
+                )
+                + ")"
+                if b.get("disagg_loaded_ms") is not None
                 else ""
             )
             + (
