@@ -939,6 +939,79 @@ def _run_overload_phase(eng, args, baseline_tps: float) -> dict:
     return block
 
 
+def _run_slo_phase(eng, args) -> dict:
+    """SLO perf phase: what the SLI/usage accounting seam costs on the
+    SAME compiled engine (utils/slo.py; ISSUE 16).
+
+    The same jobs decode with the SLO plane detached, then attached (a
+    host-side toggle like the trace phase — no new compiles); the
+    per-token cost difference is the measured accounting overhead.
+    tools/bench_diff.py screams SLO-OVERHEAD past 1%.  The block also
+    self-checks the alert pipeline: a synthetic burn injected into the
+    SAME tracker must fire the fast-burn page rule (bench_diff screams
+    BURN-ALERT-MISSED if it ever doesn't)."""
+    from ..utils.slo import SLOTracker, UsageMeter
+
+    prompt = lambda i: [  # noqa: E731 — same shape as the main jobs
+        (13 * i + j) % eng.cfg.vocab_size for j in range(args.prompt_len)
+    ]
+    jobs = [
+        (prompt(120 + i), args.decode_tokens)
+        for i in range(2 * eng.max_slots)
+    ]
+    eng.slo = None
+    eng.usage = None
+    t0 = time.perf_counter()
+    off_done = eng.run(jobs)
+    off_dt = time.perf_counter() - t0
+    off_tokens = sum(len(r.tokens) for r in off_done)
+    eng.slo = SLOTracker()
+    eng.usage = UsageMeter()
+    t0 = time.perf_counter()
+    on_done = eng.run(jobs)
+    on_dt = time.perf_counter() - t0
+    on_tokens = sum(len(r.tokens) for r in on_done)
+    off_tps = off_tokens / off_dt if off_dt else 0.0
+    on_tps = on_tokens / on_dt if on_dt else 0.0
+    overhead = (off_tps / on_tps) - 1.0 if on_tps else 0.0
+    verdicts = sum(pair[1] for pair in eng.slo.totals().values())
+    tenants_metered = eng.usage.snapshot()["tracked_tenants"]
+    # Alert-pipeline self-check on the live tracker: a synthetic
+    # sustained burn (50% bad availability, budget 0.001) must fire the
+    # fast-burn page rule on the next evaluation.
+    eng.slo.record("availability", True, n=50)
+    eng.slo.record("availability", False, n=50)
+    burn_alert_fired = any(
+        t["state"] == "fired" and t["rule"] == "fast_burn"
+        for t in eng.slo.evaluate()
+    )
+    eng.slo = None  # leave the engine the way the next phase expects
+    eng.usage = None
+    block = {
+        "overhead": round(overhead, 4),
+        "off_tokens_per_sec": round(off_tps, 2),
+        "on_tokens_per_sec": round(on_tps, 2),
+        "sli_verdicts": verdicts,
+        "tenants_metered": tenants_metered,
+        "burn_alert_fired": burn_alert_fired,
+    }
+    log(
+        "perf-ledger row: | SLO accounting (b%d) | slo off %.2f → on "
+        "%.2f tokens/sec (overhead %+.2f%%; %d verdicts, burn alert "
+        "fired %s) | - | `benchmark.py --model serving` | update on "
+        "bench round |"
+        % (
+            eng.max_slots,
+            off_tps,
+            on_tps,
+            overhead * 100.0,
+            verdicts,
+            burn_alert_fired,
+        )
+    )
+    return block
+
+
 def _run_restart_phase(eng, args) -> dict:
     """RESTART perf phase: cold vs warm post-restart TTFT through the
     crash-safe KV-arena snapshot (models/engine_snapshot.py).
@@ -1795,6 +1868,8 @@ def run_serving(args) -> None:
     disagg_block = _run_disagg_phase(eng, args)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
+    # --- SLO phase (SLO row): accounting overhead + alert self-check ---
+    slo_block = _run_slo_phase(eng, args)
     print(
         json.dumps(
             {
@@ -1842,6 +1917,7 @@ def run_serving(args) -> None:
                 "elastic": elastic_block,
                 "disagg": disagg_block,
                 "router": router_block,
+                "slo": slo_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

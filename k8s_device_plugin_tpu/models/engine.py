@@ -127,6 +127,7 @@ class ServingEngine(
         overlap_steps: int = 1,
         admission: str = "reserve",
         overload=None,
+        slo=None,
         kv_retain: bool = False,
         kv_host_cache_mb: float = 0,
         role: str = "unified",
@@ -511,6 +512,26 @@ class ServingEngine(
                 metrics=metrics,
                 flight=self.flight,
             )
+        # SLO accounting (utils/slo.py, ISSUE 16): per-request SLI
+        # verdicts (TTFT / per-request ITL p99 / availability) into
+        # sliding-window error budgets, plus per-tenant usage meters.
+        # Library default OFF like overload (``slo=None`` — zero cost);
+        # the serving CLIs default it ON.  Pass True for the default
+        # objectives, a dict of threshold overrides
+        # (``{"ttft_target_s": ..., "itl_p99_target_s": ...}``), or a
+        # prebuilt SLOTracker.  Both mutate only under the engine lock.
+        self.slo = None
+        self.usage = None
+        if slo:
+            from ..utils.slo import SLOTracker, UsageMeter, default_objectives
+
+            if isinstance(slo, SLOTracker):
+                self.slo = slo
+            elif isinstance(slo, dict):
+                self.slo = SLOTracker(objectives=default_objectives(**slo))
+            else:
+                self.slo = SLOTracker()
+            self.usage = UsageMeter()
         # Request-scoped tracing (utils/spans.py): None = off, zero cost.
         # Per-slot monotonic stamp of the slot's last emitted token — the
         # inter-token-latency anchor (reset at activation and teardown).
@@ -1251,6 +1272,11 @@ class ServingEngine(
         if consumed <= 0 or last <= 0.0:
             return
         per = (now - last) / consumed
+        req = self.slots[slot]
+        if req is not None and per > req.itl_peak_s:
+            # Per-request peak gap: the SLO plane's per-request ITL p99
+            # stand-in (engine_types.Request.itl_peak_s).
+            req.itl_peak_s = per
         if self.overload is not None:
             # The feasibility predicate's input: measured per-token
             # latency decides whether a deadline can still be met.
@@ -1349,6 +1375,11 @@ class ServingEngine(
                     if self.overload is not None
                     else {"enabled": False}
                 ),
+                "slo": (
+                    self.slo.snapshot()
+                    if self.slo is not None
+                    else {"enabled": False}
+                ),
                 "kvcache": self.kvcache_state(),
                 "disagg": self.handoff_state(),
                 "config": {
@@ -1373,6 +1404,27 @@ class ServingEngine(
             if self.overload is None:
                 return {"enabled": False}
             return self.overload.snapshot()
+
+    def slo_state(self) -> dict:
+        """JSON-safe SLO-plane snapshot for GET /debug/slo: objectives,
+        window counts, burn rates, budget remaining, active alerts
+        (``{"enabled": False}`` when the plane is off)."""
+        with self._lock:
+            if self.slo is None:
+                return {"enabled": False}
+            snap = self.slo.snapshot()
+            snap["enabled"] = True
+            return snap
+
+    def usage_state(self) -> dict:
+        """JSON-safe per-tenant usage snapshot for GET /debug/usage
+        (``{"enabled": False}`` when the SLO plane is off)."""
+        with self._lock:
+            if self.usage is None:
+                return {"enabled": False}
+            snap = self.usage.snapshot()
+            snap["enabled"] = True
+            return snap
 
     def run(self, requests: list[tuple[list[int], int]], **submit_kw) -> list[Request]:
         """Submit all (``submit_kw`` — temperature/top_k/top_p — applies to
@@ -1537,6 +1589,31 @@ def main(argv: Optional[list[str]] = None) -> None:
         "with 503 + Retry-After regardless of priority",
     )
     p.add_argument(
+        "--slo",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="SLO plane (utils/slo.py): per-request SLI verdicts (TTFT, "
+        "per-request ITL p99, availability) into sliding-window error "
+        "budgets with burn-rate alerting, plus per-tenant usage meters "
+        "(default on; 0 disables all accounting — zero per-request cost)",
+    )
+    p.add_argument(
+        "--slo-ttft-target",
+        type=float,
+        default=2.0,
+        help="TTFT objective threshold (seconds): a request whose first "
+        "token lands later counts against the ttft error budget",
+    )
+    p.add_argument(
+        "--slo-itl-target",
+        type=float,
+        default=0.25,
+        help="per-request ITL p99 objective threshold (seconds): a "
+        "request whose worst inter-token gap exceeds this counts "
+        "against the itl_p99 error budget",
+    )
+    p.add_argument(
         "--kv-retain",
         type=int,
         choices=[0, 1],
@@ -1631,6 +1708,12 @@ def main(argv: Optional[list[str]] = None) -> None:
             target_queue_wait_s=args.overload_target_wait,
             max_queue=args.overload_max_queue,
         )
+    slo_cfg = None
+    if args.slo:
+        slo_cfg = {
+            "ttft_target_s": args.slo_ttft_target,
+            "itl_p99_target_s": args.slo_itl_target,
+        }
     eng = ServingEngine(
         cfg, params, paged, max_slots=args.slots,
         metrics=EngineMetrics(registry),
@@ -1638,6 +1721,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         overlap_steps=args.overlap_steps,
         admission=args.admission,
         overload=overload_cfg,
+        slo=slo_cfg,
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
         mesh=mesh,

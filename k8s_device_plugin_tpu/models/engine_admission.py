@@ -121,6 +121,7 @@ class AdmissionMixin:
                         prompt_tokens=len(prompt),
                         at="submit",
                     )
+                    self._slo_observe_submit_shed(tenant)
                     raise
             req = Request(
                 prompt, max_new_tokens, temperature, top_k, top_p,
@@ -292,6 +293,9 @@ class AdmissionMixin:
                 self._kv_drop_snapshot(req.rid)
                 if self.overload is not None:
                     self.overload.on_finish(req)
+                # Excluded from SLI verdicts (the client left, the
+                # service didn't fail) but still metered.
+                self._slo_observe_finish(req, time.monotonic())
             self._update_gauges()
             return True
 
@@ -324,6 +328,9 @@ class AdmissionMixin:
                     waited_s=round(now - req.submitted_at, 3),
                 )
                 ctl.on_finish(req)
+                # Queue sheds never reach _maybe_finish: emit their
+                # availability verdict + usage row here.
+                self._slo_observe_finish(req, now)
                 finished.append(req)
             if expired:
                 self._update_gauges()
@@ -574,6 +581,9 @@ class AdmissionMixin:
                     dead = self.queue.popleft()
                     dead.done = True
                     self._kv_drop_snapshot(dead.rid)
+                    # Cancels are excluded from SLI verdicts but still
+                    # metered (the tenant consumed queue time).
+                    self._slo_observe_finish(dead, time.monotonic())
                 if self.slots[slot] is not None or not self.queue:
                     continue
                 if self.overload is not None:
@@ -964,6 +974,103 @@ class AdmissionMixin:
                 return True
         return False
 
+    def _slo_observe_finish(self, req, now: float, slot=None):
+        """SLI verdicts + tenant usage at the end of a request's life
+        (utils/slo.py; no-op when the SLO plane is off).
+
+        Called under the engine lock from every terminal path: ordinary
+        finish (_maybe_finish, BEFORE the slot tears down so the page
+        count is still live), the expired-queue shed sweep (those
+        requests never pass through _maybe_finish), and — via
+        _slo_observe_submit_shed — the submit-side shed gate.  Verdict
+        rules: a shed is an availability failure; a client cancel is
+        EXCLUDED from every objective (the service didn't fail, the
+        client left); latency objectives score only requests that
+        actually emitted tokens."""
+        if self.slo is None:
+            return
+        if req.shed is not None:
+            self._slo_emit("availability", False)
+        elif not req.cancelled:
+            self._slo_emit("availability", True)
+            if req.tokens and req.first_token_at > 0.0:
+                ttft = self.slo.objectives.get("ttft")
+                if ttft is not None and ttft.threshold_s is not None:
+                    self._slo_emit(
+                        "ttft",
+                        req.first_token_at - req.submitted_at
+                        <= ttft.threshold_s,
+                    )
+                itl = self.slo.objectives.get("itl_p99")
+                if (
+                    itl is not None
+                    and itl.threshold_s is not None
+                    and req.itl_peak_s > 0.0
+                ):
+                    self._slo_emit("itl_p99", req.itl_peak_s <= itl.threshold_s)
+        if self.usage is not None:
+            admitted = req.admitted_at > 0.0
+            queue_wait = max(
+                0.0, (req.admitted_at if admitted else now) - req.submitted_at
+            )
+            pages = 0
+            if slot is not None:
+                # Logical pages covering the sequence (shared prefix
+                # included): page-seconds as a conservative upper bound.
+                pages = self._slot_page_base[slot] + len(
+                    self._slot_pages[slot]
+                )
+            kv_page_s = (
+                pages * max(0.0, now - req.admitted_at) if admitted else 0.0
+            )
+            label = self.usage.record_request(
+                req.tenant,
+                prompt_tokens=len(req.prompt) if admitted else 0,
+                decode_tokens=len(req.tokens),
+                kv_page_seconds=kv_page_s,
+                queue_wait_seconds=queue_wait,
+            )
+            if self.metrics:
+                m = self.metrics
+                m.tenant_requests.inc(tenant=label)
+                if admitted and req.prompt:
+                    m.tenant_prompt_tokens.inc(len(req.prompt), tenant=label)
+                if req.tokens:
+                    m.tenant_decode_tokens.inc(len(req.tokens), tenant=label)
+                if kv_page_s > 0.0:
+                    m.tenant_kv_page_seconds.inc(kv_page_s, tenant=label)
+                if queue_wait > 0.0:
+                    m.tenant_queue_wait_seconds.inc(queue_wait, tenant=label)
+
+    def _slo_emit(self, objective: str, good: bool):
+        self.slo.record(objective, good)
+        if self.metrics:
+            self.metrics.sli_events.inc(
+                objective=objective, verdict="good" if good else "bad"
+            )
+
+    def _slo_observe_submit_shed(self, tenant: str):
+        """A submit-side shed never creates a Request, but the client
+        still saw a failure: one bad availability verdict, one metered
+        (empty) usage row."""
+        if self.slo is None:
+            return
+        self._slo_emit("availability", False)
+        if self.usage is not None:
+            label = self.usage.record_request(tenant)
+            if self.metrics:
+                self.metrics.tenant_requests.inc(tenant=label)
+
+    def observe_submit_shed(self, tenant: str = ""):
+        """Public hook for door sheds that never reach submit() — the
+        HTTP layer's deadline<=0 fail-fast 504.  The client saw a
+        failure, so the SLO plane scores it like any submit-side shed;
+        without this, a fleet could burn its availability budget on
+        door sheds invisibly."""
+        tenant = str(tenant or "")[: self.MAX_TENANT_LEN]
+        with self._lock:
+            self._slo_observe_submit_shed(tenant)
+
     def _maybe_finish(self, slot: int):
         req = self.slots[slot]
         if req is None:
@@ -982,6 +1089,9 @@ class AdmissionMixin:
             req.finished_at = time.monotonic()
             if self.overload is not None:
                 self.overload.on_finish(req)
+            # SLO verdicts + tenant usage ride the same span-outcome
+            # seam, BEFORE _clear_slot so the page count is still live.
+            self._slo_observe_finish(req, req.finished_at, slot=slot)
             if (
                 self.metrics
                 and req.tokens

@@ -271,6 +271,48 @@ class EngineMetrics:
             "names cannot mint unbounded series)",
             ("tenant",),
         )
+        # SLO plane (utils/slo.py, ISSUE 16): one verdict per finished
+        # request per objective, plus per-tenant usage meters.  The
+        # tenant label rides the SAME bounded map as tenant_sheds (first
+        # 16 distinct tenants, later ones fold into _other), so every
+        # family stays under the fleet cardinality budget.
+        self.sli_events = registry.counter(
+            "tpu_engine_sli_events_total",
+            "SLI verdicts by objective (ttft, itl_p99, availability) and "
+            "verdict (good/bad) — the raw feed behind /debug/slo's error "
+            "budgets; rate(verdict=bad) over rate() is the burn input",
+            ("objective", "verdict"),
+        )
+        self.tenant_requests = registry.counter(
+            "tpu_engine_tenant_requests_total",
+            "Finished requests charged per tenant (16-tenant label cap, "
+            "overflow under _other) — the /debug/usage row count",
+            ("tenant",),
+        )
+        self.tenant_prompt_tokens = registry.counter(
+            "tpu_engine_tenant_prompt_tokens_total",
+            "Prompt tokens prefetched per tenant (charged only for "
+            "requests that reached a slot; 16-tenant label cap)",
+            ("tenant",),
+        )
+        self.tenant_decode_tokens = registry.counter(
+            "tpu_engine_tenant_decode_tokens_total",
+            "Decode tokens emitted per tenant (16-tenant label cap)",
+            ("tenant",),
+        )
+        self.tenant_kv_page_seconds = registry.counter(
+            "tpu_engine_tenant_kv_page_seconds_total",
+            "KV page-seconds held per tenant: pages at finish x slot "
+            "residency — a conservative upper bound (shared prefix pages "
+            "charge every sharer; 16-tenant label cap)",
+            ("tenant",),
+        )
+        self.tenant_queue_wait_seconds = registry.counter(
+            "tpu_engine_tenant_queue_wait_seconds_total",
+            "Seconds spent queued per tenant before a slot (or before "
+            "the shed that answered instead; 16-tenant label cap)",
+            ("tenant",),
+        )
         self.goodput_tokens = registry.counter(
             "tpu_engine_goodput_tokens_total",
             "Tokens of requests that COMPLETED within their deadline "
@@ -471,6 +513,12 @@ class Request:
     admitted_at: float = 0.0
     first_token_at: float = 0.0
     finished_at: float = 0.0
+    # Peak per-token inter-token gap seen on this request (_observe_itl
+    # maintains it).  For the short generations this engine serves the
+    # per-request p99 ITL equals the max gap, so the SLO plane scores
+    # this against the itl_p99 objective without a per-request
+    # histogram; 0.0 until a second token lands.
+    itl_peak_s: float = 0.0
     tokens: list[int] = dataclasses.field(default_factory=list)
     token_logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False

@@ -418,6 +418,9 @@ class EngineServer:
                     # Fail fast, never enqueue: the budget is already
                     # spent, and admitting would burn a slot producing
                     # tokens the caller's own deadline forbids it to use.
+                    # Still a client-visible failure — score the
+                    # availability verdict + usage row.
+                    server.engine.observe_submit_shed(tenant)
                     self._reply(
                         504,
                         {
@@ -1342,6 +1345,18 @@ class EngineServer:
                             if drain_rate is not None
                             else None
                         ),
+                        # Compact SLI counters (utils/slo.py): cumulative
+                        # [good, total] per objective.  The router's poll
+                        # loop deltas these between sweeps to aggregate
+                        # fleet-level burn rates for free; None when the
+                        # SLO plane is off.  Racy lock-free reads like
+                        # every other summary scalar — a torn read shows
+                        # one verdict's drift.
+                        "slo": (
+                            {"objectives": server.engine.slo.totals()}
+                            if server.engine.slo is not None
+                            else None
+                        ),
                     }
                     if "summary=1" in (self.path.split("?", 1) + [""])[1]:
                         # ?summary=1: the summary ALONE — skips the
@@ -1413,6 +1428,18 @@ class EngineServer:
                     # overload incident.  Counts and tenant NAMES only
                     # (tenants are routing identifiers, not content).
                     self._reply(200, server.engine.overload_state())
+                elif path == "/debug/slo":
+                    # SLO plane (utils/slo.py): objectives, sliding-
+                    # window burn rates, budget remaining, active burn
+                    # alerts.  Counts and targets only — as open as
+                    # /metrics.
+                    self._reply(200, server.engine.slo_state())
+                elif path == "/debug/usage":
+                    # Per-tenant usage meters (prompt/decode tokens, KV
+                    # page-seconds, queue-wait seconds) under the
+                    # 16-tenant label cap.  Tenant NAMES only (routing
+                    # identifiers, not content), like /debug/admission.
+                    self._reply(200, server.engine.usage_state())
                 elif path == "/debug/incidents":
                     self._reply(200, server.engine.anomaly.snapshot())
                 elif path == "/debug/flight":
@@ -1965,6 +1992,33 @@ def main(argv: Optional[list[str]] = None) -> None:
         "with 503 + Retry-After regardless of priority",
     )
     p.add_argument(
+        "--slo",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="SLO plane (utils/slo.py, default on): per-request SLI "
+        "verdicts (TTFT, per-request ITL p99, availability) into "
+        "sliding-window error budgets with multi-window burn-rate "
+        "alerting at GET /debug/slo, plus per-tenant usage meters at "
+        "GET /debug/usage and tpu_engine_tenant_* counters; 0 disables "
+        "all accounting (zero per-request cost)",
+    )
+    p.add_argument(
+        "--slo-ttft-target",
+        type=float,
+        default=2.0,
+        help="TTFT objective threshold (seconds): a request whose first "
+        "token lands later counts against the ttft error budget",
+    )
+    p.add_argument(
+        "--slo-itl-target",
+        type=float,
+        default=0.25,
+        help="per-request ITL p99 objective threshold (seconds): a "
+        "request whose worst inter-token gap exceeds this counts "
+        "against the itl_p99 error budget",
+    )
+    p.add_argument(
         "--kv-retain",
         type=int,
         choices=[0, 1],
@@ -2354,6 +2408,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         overlap_steps=args.overlap_steps,
         admission=args.admission,
         overload=overload_cfg,
+        slo=(
+            {
+                "ttft_target_s": args.slo_ttft_target,
+                "itl_p99_target_s": args.slo_itl_target,
+            }
+            if args.slo
+            else None
+        ),
         kv_retain=bool(args.kv_retain),
         kv_host_cache_mb=args.kv_host_cache_mb,
         role=args.role,

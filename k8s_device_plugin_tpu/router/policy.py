@@ -86,6 +86,12 @@ class ReplicaState:
         # traffic yet) — planners treat None as "no opinion".
         self.queue_wait_ewma_s = None  # guarded by: owner-thread
         self.drain_rate_rps = None  # guarded by: owner-thread
+        # Cumulative per-objective [good, total] SLI counters off the
+        # summary poll (utils/slo.py): the poll thread deltas them
+        # against the previous poll into the router's fleet SLO tracker
+        # (a shrunk counter = replica restart -> re-baselined from the
+        # fresh totals).  None until the replica exports an SLO block.
+        self.slo_totals = None  # guarded by: owner-thread
         self.last_poll = 0.0  # last successful poll (monotonic); guarded by: owner-thread
         self.dispatches = 0
         self.failures = 0
@@ -100,6 +106,7 @@ class ReplicaState:
             "active_slots": self.active_slots,
             "queue_wait_ewma_s": self.queue_wait_ewma_s,
             "drain_rate_rps": self.drain_rate_rps,
+            "slo_totals": self.slo_totals,
             "breaker": self.breaker.snapshot(),
             "dispatches": self.dispatches,
             "failures": self.failures,

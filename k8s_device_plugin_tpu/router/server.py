@@ -49,7 +49,9 @@ Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
 ``GET /metrics`` (Prometheus), ``GET /debug/router`` (full snapshot),
 ``GET /debug/fleet`` (per-replica host-side signals + migration planner
 state + the scale-up/down recommendation ``tools/fleet_plan.py``
-renders), ``GET /debug/spans`` (the router's request-span ring;
+renders), ``GET /debug/slo`` (fleet error budgets + burn-rate alerts
+merged from the per-replica SLI counters every summary poll carries),
+``GET /debug/spans`` (the router's request-span ring;
 ``?rid=`` filters one trace).  Every fault-handling decision is a
 flight event (``router.*``, per-request ones carrying ``rid``) so a
 chaos run can join injected replica kills against what the router saw.
@@ -87,6 +89,7 @@ import http.client
 
 from ..utils import failpoints
 from ..utils.metrics import MetricsRegistry, write_exposition
+from ..utils.slo import SLOTracker
 from ..utils.spans import (
     TRACE_CONTEXT_HEADER,
     SpanRecorder,
@@ -221,6 +224,25 @@ class RouterMetrics:
         self.poll_seconds = registry.histogram(
             "tpu_router_poll_seconds",
             "Per-replica summary poll latency",
+        )
+        # Fleet SLO plane (utils/slo.py, --slo): burn rates over the
+        # fleet-merged SLI deltas every summary poll carries, and the
+        # alert transitions the multi-window rules fired.  Objective and
+        # window are closed label sets (3 objectives x 3 windows), never
+        # per-replica or per-tenant.
+        self.slo_burn_rate = registry.gauge(
+            "tpu_slo_burn_rate",
+            "Fleet error-budget burn rate per objective and sliding "
+            "window (1.0 = spending exactly the whole budget over the "
+            "objective period; the fast-burn page rule fires at 14.4)",
+            ("objective", "window"),
+        )
+        self.slo_burn_alerts = registry.counter(
+            "tpu_router_slo_burn_alerts_total",
+            "Multi-window burn-rate alerts FIRED per objective and "
+            "severity (page: fast burn; ticket: slow burn) — "
+            "clears are flight events, not counted here",
+            ("objective", "severity"),
         )
 
     def drop_replica(self, name: str) -> None:
@@ -380,6 +402,7 @@ class RouterServer:
         disagg: bool = False,
         disagg_config: Optional[DisaggConfig] = None,
         prefill_replicas: Optional[list[str]] = None,
+        slo: bool = False,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -457,6 +480,23 @@ class RouterServer:
             mode=policy_mode,
             seed=seed,
         )
+        # Fleet SLO plane (utils/slo.py; library default OFF like
+        # migration — the CLI arms it).  Every summary poll carries each
+        # replica's cumulative per-objective [good, total] SLI counters;
+        # the poll thread deltas them into this fleet-level tracker and
+        # evaluates the multi-window burn-rate rules once per sweep.
+        # Alert transitions fan out three ways: slo.burn_alert flight
+        # events, direct incidents (the AnomalyMonitor below — the
+        # router's first; served at nothing yet, rides flight dumps and
+        # the on_incident log), and tpu_slo_burn_rate gauges.  Owner
+        # discipline: the tracker and the per-replica baselines are poll
+        # state, mutated only on the poll thread.
+        self.slo = SLOTracker() if slo else None
+        self.slo_anomaly = None
+        if slo:
+            from ..utils.anomaly import AnomalyMonitor
+
+            self.slo_anomaly = AnomalyMonitor(flight=flight)
         # Disaggregated prefill/decode split (router/disagg.py; library
         # default OFF like migration — the CLI arms it).  Roles are
         # discovered from each replica's summary poll; --prefill-replicas
@@ -620,6 +660,13 @@ class RouterServer:
                     # renders this; a warm-joining replica reads the
                     # membership keys to pick its snapshot donor).
                     self._reply(200, server.fleet_state())
+                elif path == "/debug/slo":
+                    # Fleet SLO view (utils/slo.py): the burn rates and
+                    # error budgets over the poll-merged SLI deltas,
+                    # plus each replica's own cumulative counters — a
+                    # single-replica fleet's totals here match that
+                    # replica's /debug/slo exactly.
+                    self._reply(200, server.slo_state())
                 elif path == "/debug/spans":
                     # ?rid=<trace id>: one request's tree only — the
                     # trace assembler's live mode pulls per-request,
@@ -774,6 +821,7 @@ class RouterServer:
             fenced = bool(payload.get("fenced", False))
             if fenced != st.fenced:
                 self._mark_fenced(name, fenced)
+            self._merge_slo(st, payload.get("slo"))
             st.last_poll = time.monotonic()
             self.metrics.replica_queue_depth.set(
                 st.queue_depth, replica=name
@@ -781,6 +829,67 @@ class RouterServer:
         # Proactive migration rides the poll cadence: feed the planner
         # this sweep's signals, then execute at most one plan verdict.
         self._maybe_plan_migrations()
+        # The fleet burn-rate rules ride the same cadence: one
+        # evaluation per sweep over the freshly merged SLI deltas.
+        self._evaluate_slo()
+
+    def _merge_slo(self, st, slo_block) -> None:
+        """Delta one replica's cumulative SLI counters into the fleet
+        tracker (poll thread only — the tracker is poll state).  A
+        counter that SHRANK means the replica restarted: its fresh
+        totals ARE the delta (the new process's events), so a restart
+        re-baselines without inventing negative traffic."""
+        if self.slo is None or not slo_block:
+            return
+        totals = slo_block.get("objectives")
+        if not isinstance(totals, dict):
+            return
+        previous = st.slo_totals or {}
+        clean: dict = {}
+        for objective, pair in totals.items():
+            try:
+                good, total = int(pair[0]), int(pair[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            clean[objective] = [good, total]
+            prev_good, prev_total = previous.get(objective, (0, 0))
+            d_good, d_total = good - prev_good, total - prev_total
+            if d_good < 0 or d_total < 0:
+                d_good, d_total = good, total
+            self.slo.ingest(objective, d_good, d_total)
+        st.slo_totals = clean
+
+    def _evaluate_slo(self) -> None:
+        """Per-sweep burn-rate evaluation (poll thread only): refresh
+        the tpu_slo_burn_rate gauges and fan out every alert
+        transition — slo.burn_alert flight event, a direct incident on
+        fire (page/ticket severity rides both), and the fired counter."""
+        if self.slo is None:
+            return
+        for objective in self.slo.objectives:
+            for wname, wsec in self.slo.windows.items():
+                self.metrics.slo_burn_rate.set(
+                    round(self.slo.burn_rate(objective, wsec), 4),
+                    objective=objective,
+                    window=wname,
+                )
+        for transition in self.slo.evaluate():
+            self._record("slo.burn_alert", **transition)
+            if transition["state"] == "fired":
+                self.metrics.slo_burn_alerts.inc(
+                    objective=transition["objective"],
+                    severity=transition["severity"],
+                )
+                if self.slo_anomaly is not None:
+                    self.slo_anomaly.report(
+                        "slo.burn_rate",
+                        observed=max(
+                            transition["burn_rates"].values(), default=0.0
+                        ),
+                        objective=transition["objective"],
+                        rule=transition["rule"],
+                        severity=transition["severity"],
+                    )
 
     def _mark_draining(self, name: str, draining: bool) -> None:
         st = self.replicas.get(name)
@@ -1067,6 +1176,7 @@ class RouterServer:
                 "active_slots": st.active_slots,
                 "queue_wait_ewma_s": st.queue_wait_ewma_s,
                 "drain_rate_rps": st.drain_rate_rps,
+                "slo_totals": st.slo_totals,
                 "eligible": eligible,
                 "reachable": st.reachable,
                 "draining": st.draining,
@@ -1088,7 +1198,46 @@ class RouterServer:
                 hot_wait_s=cfg.hot_wait_s,
                 cold_wait_s=cfg.cold_wait_s,
             ),
+            # Compact fleet SLO view (the full version is /debug/slo):
+            # burn rates + active alerts so fleet_plan.py — and, later,
+            # ROADMAP #5's autoscaler — can act on budget burn, not
+            # just queue pressure.
+            "slo": self._fleet_slo_summary(),
         }
+
+    def _fleet_slo_summary(self) -> dict:
+        if self.slo is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "burn_rates": {
+                objective: {
+                    wname: round(self.slo.burn_rate(objective, wsec), 3)
+                    for wname, wsec in self.slo.windows.items()
+                }
+                for objective in self.slo.objectives
+            },
+            "budget_remaining": {
+                objective: round(self.slo.budget_remaining(objective), 4)
+                for objective in self.slo.objectives
+            },
+            "alerts": self.slo.active_alerts(),
+        }
+
+    def slo_state(self) -> dict:
+        """GET /debug/slo: the fleet-merged tracker's full snapshot
+        plus each replica's own cumulative SLI counters.  For a
+        single-replica fleet the fleet totals equal that replica's own
+        /debug/slo totals — the aggregation-correctness check the
+        chaos suite pins."""
+        if self.slo is None:
+            return {"enabled": False}
+        snap = self.slo.snapshot()
+        snap["enabled"] = True
+        snap["replicas"] = {
+            name: st.slo_totals for name, st in list(self.replicas.items())
+        }
+        return snap
 
     # ------------------------------------------------------ dispatching
 
@@ -2403,6 +2552,19 @@ def main(argv: Optional[list[str]] = None) -> None:
         "whose summary reports role=prefill are reconciled the same "
         "way",
     )
+    p.add_argument(
+        "--slo",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="fleet SLO plane (utils/slo.py, default on): merge the "
+        "per-replica SLI counters each summary poll carries into "
+        "fleet-level sliding-window burn rates, evaluate the "
+        "multi-window fast-burn/slow-burn alert rules every sweep "
+        "(slo.burn_alert flight events + direct incidents + "
+        "tpu_slo_burn_rate gauges), and serve the fleet view at GET "
+        "/debug/slo; 0 disables fleet SLO accounting",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -2479,6 +2641,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         prefill_replicas=[
             r for r in args.prefill_replicas.split(",") if r
         ],
+        slo=bool(args.slo),
         migrate=bool(args.migrate),
         migration=MigrationConfig(
             hot_wait_s=args.migrate_hot_wait,
@@ -2510,7 +2673,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     print(
         f"routing on :{server.port} over {len(server.replicas)} replicas "
         "(POST /generate, GET /healthz /metrics /debug/router "
-        "/debug/fleet /debug/spans)",
+        "/debug/fleet /debug/slo /debug/spans)",
         file=sys.stderr,
         flush=True,
     )

@@ -439,6 +439,13 @@ class FakeReplica:
         # set them directly to shape hot/cold fleets.
         self.wait_ewma_s = None
         self.drain_rate_rps = None
+        # SLI counters the summary poll exports (the EngineServer
+        # ?summary=1 "slo" contract, utils/slo.py): cumulative
+        # per-objective [good, total].  Test-settable (sli() bumps them)
+        # so SLO chaos scenarios script fault windows; None = the fake
+        # runs without an SLO plane (the field reads null, like a real
+        # replica started with --slo=0).
+        self.slo_totals = None
         # Snapshot donor knobs: ``snapshot_payload`` overrides the body
         # served at GET /debug/snapshot (e.g. real-engine-layout bytes);
         # ``snapshot_chunk_s`` trickles the stream so a kill() can land
@@ -715,6 +722,17 @@ class FakeReplica:
                         # shape hot/cold fleets for the planner.
                         "queue_wait_ewma_s": replica.wait_ewma_s,
                         "drain_rate_rps": replica.drain_rate_rps,
+                        # Cumulative SLI counters (EngineServer summary
+                        # contract): the router deltas these into its
+                        # fleet SLO tracker.
+                        "slo": (
+                            {"objectives": {
+                                k: list(v)
+                                for k, v in replica.slo_totals.items()
+                            }}
+                            if replica.slo_totals is not None
+                            else None
+                        ),
                     })
                 elif path == "/debug/snapshot":
                     self._serve_snapshot()
@@ -911,6 +929,17 @@ class FakeReplica:
         )
         self._thread.start()
         return self
+
+    # --- the EngineServer SLO summary contract (utils/slo.py) ---
+    def sli(self, objective: str, good: int = 0, bad: int = 0) -> None:
+        """Accrue cumulative SLI verdicts on one objective — what a
+        real engine's finish seam does; SLO chaos scenarios script
+        fault windows by bumping ``bad`` on a victim replica."""
+        if self.slo_totals is None:
+            self.slo_totals = {}
+        pair = self.slo_totals.setdefault(objective, [0, 0])
+        pair[0] += good
+        pair[1] += good + bad
 
     # --- the EngineServer drain contract ---
     def begin_drain(self, retry_after: str = "1") -> None:

@@ -432,6 +432,59 @@ def test_bench_diff_parses_overload_block(tmp_path):
     assert "PAGE-LEAK" in bench_diff.ledger_row(a, d)
 
 
+def test_bench_diff_parses_slo_block(tmp_path):
+    """Records grew an SLO block (ISSUE 16, benchmark.py
+    _run_slo_phase): the slo-on vs slo-off accounting overhead, the
+    verdict count, and the burn-alert self-check must surface in the
+    normalized record, the field diff, and the ledger row — and the
+    row must scream SLO-OVERHEAD past 1% and BURN-ALERT-MISSED when
+    the synthetic burn fails to fire the page rule."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 8,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 9
+    loaded["parsed"]["slo"] = {
+        "overhead": 0.004, "off_tokens_per_sec": 101.0,
+        "on_tokens_per_sec": 100.6, "sli_verdicts": 24,
+        "tenants_metered": 1, "burn_alert_fired": True,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["slo_overhead"] == 0.004
+    assert b["slo_verdicts"] == 24
+    assert b["slo_burn_alert_fired"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "slo_overhead" in diff and "slo_burn_alert_fired" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "slo overhead 0.004" in row and "24 verdicts" in row
+    assert "SLO-OVERHEAD" not in row and "BURN-ALERT-MISSED" not in row
+    # Accounting past 1% per token screams...
+    loaded["parsed"]["slo"]["overhead"] = 0.03
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "SLO-OVERHEAD" in bench_diff.ledger_row(a, c)
+    # ...and a dead pager screams loudest.
+    loaded["parsed"]["slo"]["overhead"] = 0.004
+    loaded["parsed"]["slo"]["burn_alert_fired"] = False
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "BURN-ALERT-MISSED" in bench_diff.ledger_row(a, d)
+
+
 def test_bench_diff_parses_restart_block(tmp_path):
     """Records grew a RESTART block (ISSUE 10, benchmark.py
     _run_restart_phase): cold vs warm post-restart TTFT p99 and the

@@ -2312,3 +2312,191 @@ def test_chaos_disagg_prefill_death_mid_transfer(tmp_path):
         for r in [pre] + decodes:
             if not r.killed.is_set():
                 r.stop()
+
+
+# ======================================================================
+# Scenario 13: fleet SLO burn-rate alerting under injected fault windows
+# ======================================================================
+
+
+def test_chaos_slo_burn_alerts_joined_per_objective(tmp_path):
+    """The ISSUE 16 acceptance scenario: three injected fault windows —
+    an overload-storm-shaped availability/TTFT burn and a readback-
+    stall-shaped ITL burn, expressed as the engine-side SLI verdicts
+    those faults produce — must each fire the router's fast-burn page
+    alert for exactly its own objective, joined per objective at
+    precision/recall 1.0.  A replica kill mid-scenario re-baselines the
+    fleet counters without minting phantom traffic, and a separate
+    clean fleet (good verdicts only) is the precision control: zero
+    alerts."""
+    from tests.fakes import FakeReplica
+    from tests.sim.fleet import wait_until
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(3, slo=True)
+    try:
+        def fired(objective):
+            return [
+                e for e in flight.snapshot()["events"]
+                if e["kind"] == "slo.burn_alert"
+                and e.get("state") == "fired"
+                and e.get("rule") == "fast_burn"
+                and e.get("objective") == objective
+            ]
+
+        # Healthy baseline: every replica reports clean verdicts on
+        # every objective across a few poll sweeps.
+        for r in replicas:
+            for objective in ("availability", "ttft", "itl_p99"):
+                r.sli(objective, good=40)
+        assert wait_until(
+            lambda: router.slo.totals().get("availability", [0, 0])[1]
+            >= 120,
+            timeout=10,
+        ), "baseline verdicts never merged"
+        assert not [
+            e for e in flight.snapshot()["events"]
+            if e["kind"] == "slo.burn_alert"
+        ], "clean baseline fired an alert"
+
+        injected = []
+
+        # Window 1 — overload storm on replica 0: sheds are
+        # availability-bad verdicts (engine_admission's shed seam).
+        t0 = time.time()
+        replicas[0].sli("availability", good=10, bad=90)
+        assert wait_until(
+            lambda: fired("availability"), timeout=10
+        ), "availability fast-burn never fired"
+        injected.append({
+            "cls": "burn_availability", "replica": replicas[0].name,
+            "t0": t0, "t1": time.time() + 1.0,
+        })
+
+        # Window 2 — the same storm's queue-wait tail: TTFT-bad
+        # verdicts on replica 0.
+        t0 = time.time()
+        replicas[0].sli("ttft", good=20, bad=80)
+        assert wait_until(
+            lambda: fired("ttft"), timeout=10
+        ), "ttft fast-burn never fired"
+        injected.append({
+            "cls": "burn_ttft", "replica": replicas[0].name,
+            "t0": t0, "t1": time.time() + 1.0,
+        })
+
+        # Window 3 — readback-stall shape on replica 1: stalled decode
+        # steps are per-request ITL-p99 violations.
+        t0 = time.time()
+        replicas[1].sli("itl_p99", good=10, bad=90)
+        assert wait_until(
+            lambda: fired("itl_p99"), timeout=10
+        ), "itl_p99 fast-burn never fired"
+        injected.append({
+            "cls": "burn_itl_p99", "replica": replicas[1].name,
+            "t0": t0, "t1": time.time() + 1.0,
+        })
+
+        # Replica kill + revival mid-scenario: the revived process
+        # restarts its counters from zero; the router must re-baseline
+        # (fresh totals ARE the delta) instead of going negative or
+        # double-counting the dead process's history.
+        totals_before_kill = router.slo.totals()
+        victim = replicas[2]
+        victim_port = victim.port
+        victim.kill()
+        assert wait_until(
+            lambda: not router.replicas[victim.name].reachable, timeout=10
+        ), "router never noticed the kill"
+        revived = FakeReplica(port=victim_port).start()
+        replicas.append(revived)
+        revived.sli("availability", good=25)
+        assert wait_until(
+            lambda: router.slo.totals()["availability"][0]
+            == totals_before_kill["availability"][0] + 25,
+            timeout=10,
+        ), (router.slo.totals(), totals_before_kill)
+
+        # Join: every fast-burn fired event, keyed per objective.
+        detected = [
+            {"cls": f"burn_{e['objective']}", "ts": e["ts"]}
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "slo.burn_alert"
+            and e.get("state") == "fired"
+            and e.get("rule") == "fast_burn"
+        ]
+        score = chaos_report.score_detections(
+            injected, detected, grace_s=2.0
+        )
+        for cls in ("burn_availability", "burn_ttft", "burn_itl_p99"):
+            assert score["per_class"][cls]["precision"] == 1.0, score
+            assert score["per_class"][cls]["recall"] == 1.0, score
+        # Severity + metrics fan-out: page severity on the counter, the
+        # gauge past the page factor, and a direct incident per fire.
+        m = router.metrics
+        for objective in ("availability", "ttft", "itl_p99"):
+            assert m.slo_burn_alerts.value(
+                objective=objective, severity="page"
+            ) == 1.0, objective
+            assert m.slo_burn_rate.value(
+                objective=objective, window="5m"
+            ) >= 14.4, objective
+        incidents = router.slo_anomaly.snapshot()["incidents"]
+        assert len(
+            [i for i in incidents if i["metric"] == "slo.burn_rate"]
+        ) >= 3
+
+        # Precision control: a clean single-replica fleet (good
+        # verdicts only) over the same machinery fires NOTHING.
+        c_replicas, c_router, c_flight = _router_fleet(1, slo=True)
+        try:
+            c_replicas[0].sli("availability", good=80)
+            c_replicas[0].sli("ttft", good=80)
+            assert wait_until(
+                lambda: c_router.slo.totals().get(
+                    "availability", [0, 0]
+                )[1] >= 80,
+                timeout=10,
+            ), "control fleet never merged"
+            control_alerts = [
+                e for e in c_flight.snapshot()["events"]
+                if e["kind"] == "slo.burn_alert"
+            ]
+            assert control_alerts == [], control_alerts
+            control_budget = c_router.slo.budget_remaining("availability")
+            assert control_budget == 1.0, control_budget
+        finally:
+            _teardown_router(c_replicas, c_router)
+
+        slo = {
+            "targets": {
+                "burn_alert_precision": 1.0,
+                "burn_alert_recall": 1.0,
+                "control_alerts": 0,
+            },
+            "measured": {
+                "per_class": score["per_class"],
+                "alerts_fired_total": router.slo.snapshot()[
+                    "alerts_fired_total"
+                ],
+                "fleet_totals": router.slo.totals(),
+                "control_alerts": len(control_alerts),
+                "control_budget_remaining": control_budget,
+                "rebaseline_ok": True,
+            },
+            "pass": True,
+        }
+        result = {
+            "scenario": "slo_burn_alerts", "replicas": 3,
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": all(
+                score["per_class"][c]["precision"] == 1.0
+                and score["per_class"][c]["recall"] == 1.0
+                for c in ("burn_availability", "burn_ttft", "burn_itl_p99")
+            ),
+        }
+        _publish(result)
+        assert result["pass"], score
+    finally:
+        _teardown_router(replicas, router)

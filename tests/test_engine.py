@@ -127,6 +127,7 @@ def test_page_boundary_crossing(rng):
     assert req.tokens == _oracle(cfg, params, prompt, 9)
 
 
+@pytest.mark.slow  # composition blanket: concurrency blanket; interleaving stays pinned by test_concurrent_submit_while_stepping
 def test_concurrent_requests_independent(rng):
     """Several live slots share one pool; outputs match per-request
     dense decoding (no cross-slot leakage through the pages)."""
@@ -292,6 +293,7 @@ def test_engine_composes_with_gqa_window_and_quant(rng):
     assert qreq.tokens == _oracle(qcfg, qparams, prompt, 6)
 
 
+@pytest.mark.slow  # composition blanket: mixed-mode blanket; greedy parity + sampled invariants stay pinned by test_single_request_matches_dense_decode and test_top_k_restricts_every_emitted_token
 def test_mixed_greedy_and_sampled_slots(rng):
     """A sampling request sharing the batch must not perturb a greedy
     neighbor (its tokens still match the dense oracle exactly), sampled
@@ -831,6 +833,7 @@ def test_chunked_prefill_interleaves_with_decode(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+@pytest.mark.slow  # composition blanket: chunking x prefix-share composition; each stays pinned by test_chunked_prefill_matches_oracle and test_prefix_sharing_shares_pages_and_preserves_outputs
 def test_chunked_prefill_prefix_share_waits_for_graft(rng):
     """A later request must NOT prefix-share pages whose owner's chunked
     prefill hasn't grafted yet (it would decode against zeros): B (small
@@ -1194,6 +1197,7 @@ def test_decode_block_composes_with_window_kernel_and_pages(rng):
     assert len(eng.free_pages) == paged.num_pages - 1
 
 
+@pytest.mark.slow  # composition blanket: sampled decode-block variant; block parity stays pinned by test_decode_block_matches_single_step_greedy
 def test_decode_block_sampled_slots(rng):
     """Sampled slots in a block draw per-step from the same filtered
     distributions (different key schedule than single-stepping, same
@@ -1226,6 +1230,7 @@ def test_decode_block_sampled_slots(rng):
         ctx.append(tok)
 
 
+@pytest.mark.slow  # composition blanket: churn composition; block parity stays pinned by test_decode_block_matches_single_step_greedy and test_decode_blocks_engage_while_page_blocked
 def test_decode_block_stays_fine_grained_under_churn(rng):
     """With queued work the engine must NOT block-decode (admission
     latency); mid-flight submissions still join live and everything
@@ -1373,6 +1378,7 @@ def test_logprobs_match_dense_replay(rng):
     assert plain.token_logprobs == []
 
 
+@pytest.mark.slow  # composition blanket: logprobs x blocks composition; logprobs stay pinned by test_logprobs_match_dense_replay
 def test_logprobs_through_decode_blocks(rng):
     cfg = _cfg()
     params = _params(cfg, rng)
@@ -1750,6 +1756,7 @@ def test_steady_state_feeds_device_outputs_forward(rng):
     assert req.tokens == _oracle(cfg, params, prompt, 12)
 
 
+@pytest.mark.slow  # composition blanket: saturation composition; engagement stays pinned by test_decode_blocks_engage_while_page_blocked
 def test_decode_blocks_engage_while_saturated_with_queue(rng):
     """A loaded server (every slot busy, more requests queued) must still
     use decode blocks — no admission is possible until a finish anyway.

@@ -92,6 +92,7 @@ def test_ragged_vocab_pads_and_masks():
         fused_linear_xent(hidden, w, labels, 0)
 
 
+@pytest.mark.slow  # composition blanket: end-to-end train step; fused math stays pinned by test_loss_matches_naive and test_grads_match_naive
 def test_fused_lm_train_step_matches_standard():
     """End-to-end: one fused-tail train step == one standard train step —
     same params in, same loss, same updated params (shared head weights)."""

@@ -69,6 +69,7 @@ def test_mqa_extreme_and_indivisible(cfg):
         TransformerLM(bad).init(jax.random.PRNGKey(0), ids)
 
 
+@pytest.mark.slow  # composition blanket: training soak; GQA math stays pinned by test_gqa_decode_matches_full_forward and test_gqa_causality_and_finite
 def test_gqa_trains(cfg):
     model = TransformerLM(cfg)
     rng = jax.random.PRNGKey(0)

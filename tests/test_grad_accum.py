@@ -67,6 +67,7 @@ def test_accum_multi_step_training_descends(rng=jax.random.PRNGKey(2)):
     assert int(state.step) == 6
 
 
+@pytest.mark.slow  # composition blanket: batchnorm-stats variant; accumulation math stays pinned by test_accum_matches_full_batch_lm
 def test_accum_batchnorm_stats_sequential(rng=jax.random.PRNGKey(3)):
     """BatchNorm models: A microbatches through one accumulated step
     must leave the SAME running stats as A separate steps over those

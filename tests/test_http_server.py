@@ -42,6 +42,9 @@ def served():
         # way (pinned in tests/test_overload.py), so every oracle test
         # here ALSO exercises the controller-on admission path.
         overload=True,
+        # The serving-CLI default: the SLO plane ON, so every request
+        # through this module also exercises the verdict/usage seam.
+        slo=True,
     )
     server = EngineServer(
         engine, host="127.0.0.1", port=0, registry=registry,
@@ -78,6 +81,7 @@ def test_generate_matches_oracle(served):
     assert got["tokens"] == _oracle(cfg, params, prompt, 6)
 
 
+@pytest.mark.slow  # composition blanket: HTTP concurrency blanket; engine-level interleaving stays pinned by test_engine.py::test_concurrent_submit_while_stepping
 def test_concurrent_requests_all_correct(served):
     cfg, params, server = served
     prompts = [[3, 141, 59], [400, 2, 2, 17], [9], [7, 7, 3], [5, 6]]
@@ -287,6 +291,7 @@ def test_stop_sequences_over_http_and_stream(served):
     assert streamed == done["tokens"] == want[:first]
 
 
+@pytest.mark.slow  # composition blanket: opt-in --debug-trace surface; span nesting stays pinned by test_debug_spans_endpoint_shape_and_rid_filter and the forensics drive
 def test_debug_trace_endpoint(served):
     """POST /debug/trace captures a jax.profiler trace of the live loop
     and replies with the dir (which must contain profile output)."""
@@ -608,6 +613,7 @@ def test_sigusr2_dumps_live_engine_flight(served, tmp_path):
         flight_mod.unregister(box)
 
 
+@pytest.mark.slow  # composition blanket: live profiler capture; GET /debug/profile breakdown stays pinned in tier-1 and the forensics drive covers the capture POST
 def test_profile_capture_spans_live_steps(served):
     """POST /debug/profile/capture grabs a jax.profiler trace spanning
     the next engine step(s) of a LIVE serving loop."""
@@ -758,6 +764,16 @@ def test_debug_state_summary_mode(served):
     assert "drain_rate_rps" in summary
     summary.pop("queue_wait_ewma_s")
     summary.pop("drain_rate_rps")
+    # Cumulative SLI counters (ISSUE 16) ride the summary too — compact
+    # [good, total] pairs the router deltas into its fleet tracker.
+    # Values depend on traffic order within the module fixture; the
+    # shape is pinned here.
+    slo = summary.pop("slo")
+    assert set(slo) == {"objectives"}
+    assert set(slo["objectives"]) == {"ttft", "itl_p99", "availability"}
+    for pair in slo["objectives"].values():
+        good, total = pair
+        assert 0 <= good <= total
     assert summary == {
         "role": "unified",
         "queue_depth": 0,
@@ -766,6 +782,42 @@ def test_debug_state_summary_mode(served):
         "fenced": False,
         "loop_alive": True,
     }
+
+
+def test_debug_slo_and_usage_endpoints(served):
+    """GET /debug/slo + /debug/usage (ISSUE 16): the engine's own SLO
+    tracker snapshot and the per-tenant usage meter, over the wire."""
+    _, _, server = served
+    out = _post(server.port, {
+        "prompt": [5, 6, 7], "max_new_tokens": 3, "tenant": "slo-probe",
+    })
+    assert len(out["tokens"]) == 3
+    slo = _get_json(server.port, "/debug/slo")
+    assert slo["enabled"] is True
+    avail = slo["objectives"]["availability"]
+    assert avail["target"] == 0.999
+    good, total = avail["totals"]
+    assert total >= 1 and good >= 1
+    assert set(avail["windows"]) == {"5m", "30m", "6h"}
+    assert [r["name"] for r in slo["rules"]] == ["fast_burn", "slow_burn"]
+    usage = _get_json(server.port, "/debug/usage")
+    assert usage["enabled"] is True
+    probe = usage["tenants"]["slo-probe"]
+    assert probe["requests"] >= 1
+    assert probe["prompt_tokens"] >= 3
+    assert probe["decode_tokens"] >= 3
+    assert probe["kv_page_seconds"] > 0.0
+    # The tenant-labeled meters exported the same charge.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=30
+    ) as resp:
+        metrics_text = resp.read().decode()
+    assert 'tpu_engine_tenant_requests_total{tenant="slo-probe"}' in (
+        metrics_text
+    )
+    assert 'tpu_engine_sli_events_total{objective="availability",' in (
+        metrics_text
+    )
 
 
 # ======================================================================

@@ -73,6 +73,7 @@ def test_pretrained_checkpoint_loads_and_is_noop(rng):
     )
 
 
+@pytest.mark.slow  # composition blanket: training soak; adapter math stays pinned by test_merge_matches_adapted_model and test_lora_dense_params_and_noop_init
 def test_masked_training_updates_only_adapters(rng):
     cfg = _cfg(lora_rank=2)
     model = TransformerLM(cfg)

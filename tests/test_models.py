@@ -26,6 +26,7 @@ def test_alexnet_forward_shape(rng):
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow  # composition blanket: full ResNet50 forward; structure stays pinned by test_resnet50_structure and the space_to_depth/image_train_step tests
 def test_resnet_forward_shape_and_stats(rng):
     model = ResNet18Thin(num_classes=10, dtype=jnp.float32)
     batch = synthetic_image_batch(rng, 2, image_size=32, num_classes=10)
@@ -152,7 +153,15 @@ def test_bert_flash_and_masked_paths_agree(rng):
     "model,batch_kwargs,input_key",
     [
         (AlexNet(num_classes=10, width=0.05, dtype=jnp.float32), dict(image_size=64, num_classes=10), "images"),
-        (ResNet18Thin(num_classes=10, dtype=jnp.float32), dict(image_size=32, num_classes=10), "images"),
+        # composition blanket: the AlexNet case pins the generic image
+        # train loop; resnet training stays pinned by
+        # test_resnet_space_to_depth_stem_trains.
+        pytest.param(
+            ResNet18Thin(num_classes=10, dtype=jnp.float32),
+            dict(image_size=32, num_classes=10),
+            "images",
+            marks=pytest.mark.slow,
+        ),
     ],
 )
 def test_image_train_step_decreases_loss(rng, model, batch_kwargs, input_key):
@@ -239,6 +248,7 @@ def test_vit_forward_shape_and_flash_alignment(rng):
     assert ViTConfig.base().num_tokens % 128 == 0
 
 
+@pytest.mark.slow  # composition blanket: ViT training soak; ViT forward/flash alignment stays pinned by test_vit_forward_shape_and_flash_alignment
 def test_vit_train_step_decreases_loss(rng):
     from k8s_device_plugin_tpu.models.vit import ViT, ViTConfig
 

@@ -48,6 +48,7 @@ def test_moe_capacity_drops_are_bounded(cfg):
     assert bool(jnp.isfinite(aux))
 
 
+@pytest.mark.slow  # composition blanket: training soak; router gradient + EP parity stay pinned by test_aux_loss_changes_router_gradient and test_moe_ep_sharded_matches_unsharded
 def test_moe_transformer_trains(cfg):
     model = TransformerLM(cfg, mlp_factory=moe_mlp_factory(cfg, num_experts=4))
     rng = jax.random.PRNGKey(0)
@@ -91,6 +92,7 @@ def test_moe_ep_sharded_matches_unsharded(cfg):
     assert jnp.allclose(float(loss), float(ref_loss), rtol=1e-4), (loss, ref_loss)
 
 
+@pytest.mark.slow  # composition blanket: training-loop wiring; the gradient-level pin test_aux_loss_changes_router_gradient stays
 def test_aux_loss_coeff_wires_load_balancing_into_training(cfg):
     """make_train_step(aux_loss_coeff=...) must make 'intermediates' mutable
     and add the sown moe_aux_loss — with coeff=0 sow is a silent no-op and

@@ -63,6 +63,7 @@ def test_param_sharding_rule():
     assert sh["tiny_bias"].spec == P()
 
 
+@pytest.mark.slow  # composition blanket: full dp*mp train step; sharding rules stay pinned by the param_sharding_rule/batch layout units and test_tensor's tp step
 def test_sharded_train_step_runs_and_preserves_shardings():
     rng = jax.random.PRNGKey(0)
     mesh = make_mesh({"dp": 2, "mp": 4})

@@ -113,6 +113,7 @@ def test_1f1b_rejects_mismatched_stage_count():
         pipeline_1f1b_grads(stage_fn, stacked, xs, xs, mesh)
 
 
+@pytest.mark.slow  # composition blanket: LM-level schedule cross-check; 1f1b math stays pinned by test_1f1b_matches_serial across stage/micro shapes
 def test_pipelined_lm_1f1b_matches_gpipe():
     """Full-model integration: the 1F1B train step (embed vjp + interleaved
     stage/head grads) must match the GPipe autodiff train step — same

@@ -89,6 +89,7 @@ def test_sp_composes_with_tp():
     assert jnp.allclose(float(loss), float(ref_loss), rtol=1e-4), (loss, ref_loss)
 
 
+@pytest.mark.slow  # composition blanket: remat parity also held by test_pipeline_lm.py::test_remat_pipeline_parity; sp parity pin test_sp_training_matches_dense stays
 def test_remat_loss_identical():
     """cfg.remat changes memory strategy, not numerics."""
     import dataclasses

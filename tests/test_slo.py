@@ -1,0 +1,789 @@
+"""Fleet SLO plane (utils/slo.py + the engine verdict seam + the
+router's fleet aggregation): sliding-window SLI accounting, error
+budgets, multi-window multi-burn-rate alerting with hysteresis, and
+per-tenant usage metering.
+
+Layout mirrors test_overload.py: the tracker/meter units drive the
+classes directly with a fake clock (``now=lambda: clock[0]``) — zero
+sleeps, zero engines.  The engine integration rides the session-scoped
+compiled ``shared_engine`` fixture with the SLO plane attached post-hoc
+(same discipline as overload_engine: warmed prompt buckets only, so no
+new XLA compiles).  The router tests run a real RouterServer over
+FakeReplica doubles and pin the acceptance contract: for a
+single-replica fleet the router's aggregated totals exactly equal the
+replica's own exported totals.
+"""
+
+import time
+import urllib.request
+import json
+
+import pytest
+
+from k8s_device_plugin_tpu.utils.slo import (
+    DEFAULT_RULES,
+    DEFAULT_WINDOWS,
+    STRUCTURED_VALIDITY,
+    BurnRateRule,
+    Objective,
+    SLOTracker,
+    UsageMeter,
+    default_objectives,
+)
+
+
+def _tracker(clock, **kw):
+    return SLOTracker(now=lambda: clock[0], **kw)
+
+
+# ======================================================================
+# Objectives and windows (fake clock; no engine)
+# ======================================================================
+
+
+def test_default_objectives_shape():
+    objs = {o.name: o for o in default_objectives()}
+    assert set(objs) == {"ttft", "itl_p99", "availability"}
+    assert objs["ttft"].threshold_s == 2.0
+    assert objs["itl_p99"].threshold_s == 0.25
+    assert objs["availability"].threshold_s is None
+    assert objs["availability"].target == 0.999
+    # Latency cuts are tunable; ratio targets are the contract.
+    tuned = {o.name: o for o in default_objectives(0.5, 0.1)}
+    assert tuned["ttft"].threshold_s == 0.5
+    assert tuned["itl_p99"].threshold_s == 0.1
+    assert tuned["ttft"].target == objs["ttft"].target
+    # structured_validity is reserved, not default-accounted.
+    assert STRUCTURED_VALIDITY not in objs
+
+
+def test_error_budget_math():
+    assert Objective("x", target=0.99).error_budget == pytest.approx(0.01)
+    # target=1.0 clamps to a tiny budget rather than dividing by zero.
+    assert Objective("x", target=1.0).error_budget > 0
+
+
+def test_rule_referencing_unknown_window_rejected():
+    with pytest.raises(ValueError):
+        SLOTracker(
+            rules=(BurnRateRule("bad", "page", 2.0, ("5m", "99d")),)
+        )
+
+
+def test_window_counts_slide_with_the_clock():
+    clock = [1000.0]
+    t = _tracker(clock)
+    t.record("availability", True, n=8)
+    t.record("availability", False, n=2)
+    assert t.window_counts("availability", 300.0) == (8, 10)
+    assert t.window_counts("availability", 21600.0) == (8, 10)
+    # Advance past the 5m window: the short window forgets, the long
+    # window still remembers — the multi-window property burn rules use.
+    clock[0] += 400.0
+    assert t.window_counts("availability", 300.0) == (0, 0)
+    assert t.window_counts("availability", 1800.0) == (8, 10)
+    clock[0] += 21700.0
+    assert t.window_counts("availability", 21600.0) == (0, 0)
+    # Lifetime totals never slide.
+    assert t.totals()["availability"] == [8, 10]
+
+
+def test_ring_reuses_buckets_after_wraparound():
+    clock = [0.0]
+    t = _tracker(clock, windows={"5m": 300.0}, rules=())
+    t.record("availability", False, n=5)
+    # Wrap the ring several times over: the stale bucket must be
+    # recycled, not double-counted.
+    clock[0] += 10 * 300.0
+    t.record("availability", True, n=3)
+    assert t.window_counts("availability", 300.0) == (3, 3)
+    assert t.totals()["availability"] == [3, 8]
+
+
+def test_record_latency_verdicts_against_threshold():
+    clock = [0.0]
+    t = _tracker(clock)
+    assert t.record_latency("ttft", 1.0) is True
+    assert t.record_latency("ttft", 3.0) is False
+    assert t.totals()["ttft"] == [1, 2]
+    # Objectives without a threshold (or unknown) are vacuously good
+    # and account nothing.
+    assert t.record_latency("availability", 5.0) is True
+    assert t.totals()["availability"] == [0, 0]
+    assert t.record_latency("nope", 5.0) is True
+
+
+def test_record_unknown_objective_is_ignored():
+    clock = [0.0]
+    t = _tracker(clock)
+    t.record("nope", True)
+    t.record("availability", True, n=0)
+    t.record("availability", True, n=-3)
+    assert all(v == [0, 0] for v in t.totals().values())
+
+
+def test_burn_rate_and_budget_remaining():
+    clock = [0.0]
+    t = _tracker(clock)
+    # availability target 0.999 -> budget 0.001; 1 bad in 100 is a
+    # bad_fraction of 0.01 = burn 10x.
+    t.record("availability", True, n=99)
+    t.record("availability", False, n=1)
+    assert t.bad_fraction("availability", 300.0) == pytest.approx(0.01)
+    assert t.burn_rate("availability", 300.0) == pytest.approx(10.0)
+    assert t.budget_remaining("availability") == pytest.approx(1.0 - 10.0)
+    # An idle window burns nothing (an idle engine is not out of SLO).
+    assert t.burn_rate("ttft", 300.0) == 0.0
+    assert t.budget_remaining("ttft") == 1.0
+
+
+def test_ingest_merges_deltas_and_clamps():
+    clock = [0.0]
+    t = _tracker(clock)
+    t.ingest("availability", 5, 8)
+    assert t.totals()["availability"] == [5, 8]
+    # good > total clamps (a corrupt replica payload must not mint
+    # negative bad counts); total <= 0 is a no-op.
+    t.ingest("availability", 10, 4)
+    assert t.totals()["availability"] == [9, 12]
+    t.ingest("availability", -3, 2)
+    assert t.totals()["availability"] == [9, 14]
+    t.ingest("availability", 1, 0)
+    t.ingest("unknown", 1, 1)
+    assert t.totals()["availability"] == [9, 14]
+
+
+# ======================================================================
+# Burn-rate alerting (fake clock)
+# ======================================================================
+
+
+def test_fast_burn_fires_only_when_both_windows_burn():
+    clock = [100000.0]
+    t = _tracker(clock)
+    # Clean traffic 10 minutes ago (outside the 5m window, inside the
+    # 30m one), then one catastrophic bucket with nothing else recent:
+    # the 5m window burns at 100% bad_fraction but the 30m window is
+    # diluted under 14.4x -> no page.  This is the "single bad bucket
+    # never pages" multi-window property.
+    t.record("availability", True, n=10000)
+    clock[0] += 600.0
+    t.record("availability", False, n=10)
+    assert t.burn_rate("availability", 300.0) >= 14.4
+    assert t.burn_rate("availability", 1800.0) < 3.0
+    assert t.evaluate() == []
+    assert t.active_alerts() == []
+
+
+def test_fast_burn_fires_clears_with_hysteresis_and_refires():
+    clock = [100000.0]
+    t = _tracker(clock)
+    # A real incident: sustained failures land in BOTH the 5m and 30m
+    # windows (availability budget 0.001, so any visible bad fraction
+    # burns far past 14.4x).
+    t.record("availability", False, n=50)
+    t.record("availability", True, n=50)
+    fired = t.evaluate()
+    assert [(d["objective"], d["rule"], d["state"]) for d in fired] == [
+        ("availability", "fast_burn", "fired"),
+        ("availability", "slow_burn", "fired"),
+    ]
+    page = fired[0]
+    assert page["severity"] == "page"
+    assert page["factor"] == 14.4
+    assert set(page["burn_rates"]) == {"5m", "30m"}
+    assert all(b >= 14.4 for b in page["burn_rates"].values())
+    # Still burning: no duplicate transition, but the alert is active.
+    assert t.evaluate() == []
+    assert len(t.active_alerts()) == 2
+    assert {a["state"] for a in t.active_alerts()} == {"active"}
+    # Recovery: the bad buckets age out of every window...
+    clock[0] += 22000.0
+    t.record("availability", True, n=100)
+    # ...but hysteresis holds the alert through clear_evals-1 clean
+    # evaluations before clearing — one clean poll never closes a page.
+    assert t.evaluate() == []
+    assert t.evaluate() == []
+    cleared = t.evaluate()
+    assert {(d["rule"], d["state"]) for d in cleared} == {
+        ("fast_burn", "cleared"),
+        ("slow_burn", "cleared"),
+    }
+    assert t.active_alerts() == []
+    # A relapse fires a NEW transition and bumps the lifetime count.
+    t.record("availability", False, n=50)
+    refired = t.evaluate()
+    assert any(d["state"] == "fired" for d in refired)
+    assert t.snapshot()["alerts_fired_total"] == 4
+
+
+def test_hysteresis_counter_resets_on_relapse():
+    clock = [100000.0]
+    t = _tracker(clock, windows={"5m": 300.0},
+                 rules=(BurnRateRule("fb", "page", 2.0, ("5m",)),))
+    t.record("availability", False, n=10)
+    assert [d["state"] for d in t.evaluate()] == ["fired"]
+    # Two clean evals, then the burn resumes: the clean streak must
+    # reset, so two MORE clean evals still don't clear.
+    clock[0] += 400.0
+    t.record("availability", True, n=10)
+    assert t.evaluate() == []
+    assert t.evaluate() == []
+    t.record("availability", False, n=10)
+    assert t.evaluate() == []  # burning again; no transition
+    clock[0] += 400.0
+    t.record("availability", True, n=10)
+    assert t.evaluate() == []
+    assert t.evaluate() == []
+    assert [d["state"] for d in t.evaluate()] == ["cleared"]
+
+
+def test_snapshot_shape():
+    clock = [0.0]
+    t = _tracker(clock)
+    t.record("availability", False, n=2)
+    t.record("availability", True, n=8)
+    snap = t.snapshot()
+    assert set(snap) == {"objectives", "rules", "alerts",
+                         "alerts_fired_total"}
+    avail = snap["objectives"]["availability"]
+    assert avail["totals"] == [8, 10]
+    assert set(avail["windows"]) == set(DEFAULT_WINDOWS)
+    assert avail["windows"]["5m"]["total"] == 10
+    assert avail["windows"]["5m"]["burn_rate"] == pytest.approx(200.0)
+    assert avail["budget_remaining"] == pytest.approx(1 - 200.0)
+    assert [r["name"] for r in snap["rules"]] == [
+        r.name for r in DEFAULT_RULES
+    ]
+
+
+# ======================================================================
+# UsageMeter (no engine)
+# ======================================================================
+
+
+def test_usage_meter_accumulates_per_tenant():
+    m = UsageMeter()
+    assert m.record_request("a", prompt_tokens=10, decode_tokens=4,
+                            kv_page_seconds=2.5,
+                            queue_wait_seconds=0.5) == "a"
+    m.record_request("a", prompt_tokens=5, decode_tokens=1)
+    m.record_request("", decode_tokens=2)  # empty tenant -> "default"
+    snap = m.snapshot()
+    assert snap["tracked_tenants"] == 2
+    assert snap["tenants"]["a"] == {
+        "requests": 2, "prompt_tokens": 15, "decode_tokens": 5,
+        "kv_page_seconds": 2.5, "queue_wait_seconds": 0.5,
+    }
+    assert snap["tenants"]["default"]["decode_tokens"] == 2
+
+
+def test_usage_meter_folds_past_the_tenant_cap():
+    m = UsageMeter(max_tracked_tenants=3)
+    for i in range(5):
+        label = m.record_request(f"t{i}", decode_tokens=1)
+        assert label == (f"t{i}" if i < 3 else "_other")
+    # A tracked tenant keeps its row even after the fold opens.
+    assert m.record_request("t0") == "t0"
+    snap = m.snapshot()
+    assert snap["max_tracked_tenants"] == 3
+    assert snap["tracked_tenants"] == 3
+    assert set(snap["tenants"]) == {"t0", "t1", "t2", "_other"}
+    assert snap["tenants"]["_other"]["requests"] == 2
+
+
+def test_usage_meter_rejects_negative_charges():
+    m = UsageMeter()
+    m.record_request("a", prompt_tokens=-5, decode_tokens=-1,
+                     kv_page_seconds=-2.0, queue_wait_seconds=-1.0)
+    row = m.snapshot()["tenants"]["a"]
+    assert row == {"requests": 1, "prompt_tokens": 0, "decode_tokens": 0,
+                   "kv_page_seconds": 0.0, "queue_wait_seconds": 0.0}
+
+
+# ======================================================================
+# Engine integration (session-scoped compiled engine; warmed buckets)
+# ======================================================================
+
+LONG = ([3, 141, 59], 25)  # pins one slot for a whole test (bucket 4)
+SHORT = ([9, 10], 4)  # the other slot's occupant (bucket 2)
+
+
+def _drain(eng, subs, guard=8000):
+    while not all(r.done for r in subs):
+        eng.step()
+        guard -= 1
+        assert guard > 0, "engine failed to drain"
+
+
+@pytest.fixture
+def slo_engine(shared_engine):
+    """The shared engine with the SLO plane attached for one test;
+    always detached on the way out so later suites see the stock
+    engine (the overload_engine discipline)."""
+    from k8s_device_plugin_tpu.utils.slo import SLOTracker, UsageMeter
+
+    _, _, eng = shared_engine
+    # Warm both prompt buckets BEFORE attaching the tracker: when this
+    # file is the first jax suite to run, the initial prefill pays the
+    # XLA compile — seconds of wall clock that would (correctly!) score
+    # as a TTFT violation and make the verdict assertions order-
+    # dependent on the rest of tier-1.
+    warm = [eng.submit(*LONG), eng.submit(*SHORT)]
+    _drain(eng, warm)
+    eng.slo = SLOTracker()
+    eng.usage = UsageMeter()
+    yield eng
+    eng.slo = None
+    eng.usage = None
+    assert all(s is None for s in eng.slots) and not eng.queue
+    assert len(eng.free_pages) == eng.paged.num_pages - 1
+
+
+def test_engine_emits_verdicts_and_usage_at_finish(slo_engine):
+    eng = slo_engine
+    a = eng.submit(*LONG, tenant="acme")
+    b = eng.submit(*SHORT, tenant="beta")
+    _drain(eng, [a, b])
+    totals = eng.slo.totals()
+    assert totals["availability"] == [2, 2]
+    # Both requests emitted tokens -> both scored for TTFT; on-CPU TTFT
+    # is well under the 2s default, so both verdicts are good.
+    assert totals["ttft"] == [2, 2]
+    # ITL scored for any request whose peak gap was observed.
+    assert totals["itl_p99"][1] >= 1
+    assert a.itl_peak_s > 0.0
+    usage = eng.usage.snapshot()
+    assert set(usage["tenants"]) == {"acme", "beta"}
+    acme = usage["tenants"]["acme"]
+    assert acme["requests"] == 1
+    assert acme["prompt_tokens"] == len(LONG[0])
+    assert acme["decode_tokens"] == len(a.tokens)
+    # The long decode held pages for its whole residency.
+    assert acme["kv_page_seconds"] > 0.0
+    # The engine's own debug surfaces agree with the tracker.
+    slo_state = eng.slo_state()
+    assert slo_state["enabled"] is True
+    assert slo_state["objectives"]["availability"]["totals"] == [2, 2]
+    usage_state = eng.usage_state()
+    assert usage_state["enabled"] is True
+    assert usage_state["tenants"]["beta"]["decode_tokens"] == len(b.tokens)
+    assert eng.debug_state()["slo"]["objectives"]["availability"][
+        "totals"
+    ] == [2, 2]
+
+
+def test_engine_shed_scores_availability_bad(slo_engine):
+    """Expired-queue sheds bypass _maybe_finish; the sweep must still
+    emit the availability-bad verdict and an (unadmitted) usage row."""
+    from k8s_device_plugin_tpu.models.engine_overload import (
+        OverloadConfig,
+        OverloadController,
+    )
+
+    eng = slo_engine
+    eng.overload = OverloadController(
+        eng.max_slots, OverloadConfig(shed_wait_factor=1e9)
+    )
+    try:
+        pinner = eng.submit(*LONG)
+        occupant = eng.submit(*SHORT)
+        eng.step()  # both in slots; queue empty
+        doomed = eng.submit(
+            [9, 11], 3, tenant="late", deadline_s=0.0005
+        )
+        time.sleep(0.002)
+        _drain(eng, [pinner, occupant, doomed])
+        assert doomed.shed is not None
+        totals = eng.slo.totals()
+        # 2 good completions + 1 shed.
+        assert totals["availability"] == [2, 3]
+        late = eng.usage.snapshot()["tenants"]["late"]
+        assert late["requests"] == 1
+        assert late["prompt_tokens"] == 0  # never admitted
+        assert late["decode_tokens"] == 0
+    finally:
+        eng.overload = None
+
+
+def test_door_shed_hook_scores_availability_bad(slo_engine):
+    """observe_submit_shed — the HTTP layer's deadline<=0 fail-fast
+    answers 504 without ever reaching submit(), but the client still
+    saw a failure: the public hook scores one availability-bad verdict
+    and meters the tenant with an empty usage row."""
+    eng = slo_engine
+    eng.observe_submit_shed("door")
+    eng.observe_submit_shed(None)  # headerless clients fold to default
+    assert eng.slo.totals()["availability"] == [0, 2]
+    tenants = eng.usage.snapshot()["tenants"]
+    assert tenants["door"]["requests"] == 1
+    assert tenants["door"]["prompt_tokens"] == 0
+    assert tenants["default"]["requests"] == 1
+
+
+def test_engine_cancel_excluded_from_availability(slo_engine):
+    """A client cancel is not a service failure: excluded from every
+    objective, but still metered (the tenant consumed queue time)."""
+    eng = slo_engine
+    pinner = eng.submit(*LONG)
+    occupant = eng.submit(*SHORT)
+    eng.step()
+    queued = eng.submit([9, 12], 3, tenant="flaky")
+    queued.cancelled = True
+    _drain(eng, [pinner, occupant, queued])
+    totals = eng.slo.totals()
+    assert totals["availability"] == [2, 2]
+    assert eng.usage.snapshot()["tenants"]["flaky"]["requests"] == 1
+
+
+def test_engine_slo_disabled_surfaces(shared_engine):
+    _, _, eng = shared_engine
+    assert eng.slo is None and eng.usage is None
+    assert eng.slo_state() == {"enabled": False}
+    assert eng.usage_state() == {"enabled": False}
+    assert eng.debug_state()["slo"] == {"enabled": False}
+
+
+# ======================================================================
+# Router fleet aggregation (FakeReplica doubles; no jax)
+# ======================================================================
+
+
+def _get(port, path, timeout=5):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def _wait(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def slo_fleet():
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    from tests.fakes import FakeReplica
+
+    replica = FakeReplica().start()
+    flight = FlightRecorder(capacity=2048, name="slo-router-test")
+    router = RouterServer(
+        [replica.name],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        poll_interval_s=0.05,
+        hedge=False,
+        slo=True,
+    ).start()
+    yield replica, router, flight
+    router.stop()
+    if not replica.killed.is_set():
+        replica.stop()
+
+
+def test_router_aggregates_single_replica_exactly(slo_fleet):
+    """The acceptance contract: for a single-replica fleet the
+    router's /debug/slo totals exactly equal the replica's own
+    exported totals (first poll ingests the full counters; later
+    polls ingest deltas)."""
+    replica, router, _ = slo_fleet
+    replica.sli("availability", good=10)
+    replica.sli("ttft", good=9, bad=1)
+    _wait(
+        lambda: router.slo.totals().get("ttft") == [9, 10],
+        msg="first poll merge",
+    )
+    assert router.slo.totals()["availability"] == [10, 10]
+    # Second batch arrives as a delta on a later poll.
+    replica.sli("availability", good=5, bad=1)
+    _wait(
+        lambda: router.slo.totals().get("availability") == [15, 16],
+        msg="delta merge",
+    )
+    # Replica's own view vs the router's fleet view, over the wire.
+    replica_view = _get(replica.port, "/debug/state?summary=1")["slo"]
+    router_view = _get(router.port, "/debug/slo")
+    assert router_view["enabled"] is True
+    for name, pair in replica_view["objectives"].items():
+        assert router_view["objectives"][name]["totals"] == list(pair)
+    # The per-replica raw counters are visible too.
+    assert router_view["replicas"][replica.name]["ttft"] == [9, 10]
+    # fleet_state carries the compact burn/budget summary.
+    fleet = router.fleet_state()
+    assert fleet["slo"]["enabled"] is True
+    assert fleet["slo"]["budget_remaining"]["availability"] <= 1.0
+    assert fleet["replicas"][replica.name]["slo_totals"]["ttft"] == [9, 10]
+
+
+def test_router_rebaselines_on_replica_restart(slo_fleet):
+    """A replica restart shrinks its cumulative counters; the router
+    must treat the fresh totals as the delta instead of going
+    negative or double-counting."""
+    replica, router, _ = slo_fleet
+    replica.sli("availability", good=20)
+    _wait(
+        lambda: router.slo.totals().get("availability") == [20, 20],
+        msg="initial merge",
+    )
+    # Simulate restart: counters reset, then 3 fresh events.
+    replica.slo_totals = None
+    replica.sli("availability", good=3)
+    _wait(
+        lambda: router.slo.totals().get("availability") == [23, 23],
+        msg="re-baselined merge",
+    )
+
+
+def test_router_fires_burn_alert_and_incident(slo_fleet):
+    """A replica reporting sustained bad verdicts must push the fleet
+    tracker over the fast-burn factor: slo.burn_alert flight event,
+    metrics counter, gauge, and a direct incident."""
+    replica, router, flight = slo_fleet
+    replica.sli("availability", good=50, bad=50)
+    _wait(
+        lambda: any(
+            a["rule"] == "fast_burn" and a["objective"] == "availability"
+            for a in router.slo.active_alerts()
+        ),
+        msg="fast burn alert",
+    )
+    events = [
+        e for e in flight.snapshot()["events"]
+        if e["kind"] == "slo.burn_alert" and e.get("state") == "fired"
+    ]
+    assert any(
+        e["objective"] == "availability" and e["rule"] == "fast_burn"
+        for e in events
+    )
+    m = router.metrics
+    assert (
+        m.slo_burn_alerts.value(objective="availability", severity="page")
+        >= 1
+    )
+    assert m.slo_burn_rate.value(objective="availability", window="5m") > 14.4
+    incidents = router.slo_anomaly.snapshot()["incidents"]
+    assert any(
+        i["metric"] == "slo.burn_rate" for i in incidents
+    )
+    # The fleet summary carries the active alert.
+    fleet = router.fleet_state()["slo"]
+    assert any(a["rule"] == "fast_burn" for a in fleet["alerts"])
+
+
+# ======================================================================
+# tools/slo_report.py (stdlib CLI; loaded by path like the other tools)
+# ======================================================================
+
+
+def _load_slo_report():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "slo_report", os.path.join(repo, "tools", "slo_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slo_report_renders_snapshot_and_exit_codes(tmp_path, capsys):
+    tool = _load_slo_report()
+    clock = [0.0]
+    t = _tracker(clock)
+    t.record("availability", False, n=50)
+    t.record("availability", True, n=50)
+    t.evaluate()
+    snap = t.snapshot()
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(snap))
+    # Page-severity active alert -> exit 4; the tables name the burn.
+    assert tool.main([str(path)]) == 4
+    out = capsys.readouterr().out
+    assert "availability" in out
+    assert "[PAGE]" in out and "fast_burn" in out
+    # A clean tracker reports 0.
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(_tracker([0.0]).snapshot()))
+    assert tool.main([str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "active alerts: none" in out
+    # --json round-trips the snapshot.
+    assert tool.main([str(path), "--json"]) == 4
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["slo"]["alerts_fired_total"] == 2
+
+
+def test_slo_report_replays_flight_dump(tmp_path, capsys):
+    """Post-incident path: reconstruct active alerts from a flight
+    dump's slo.burn_alert transitions — fired then cleared cancels."""
+    tool = _load_slo_report()
+    events = [
+        {"kind": "slo.burn_alert", "state": "fired",
+         "objective": "availability", "rule": "fast_burn",
+         "severity": "page", "factor": 14.4,
+         "burn_rates": {"5m": 500.0, "30m": 500.0}},
+        {"kind": "slo.burn_alert", "state": "fired",
+         "objective": "ttft", "rule": "slow_burn",
+         "severity": "ticket", "factor": 3.0,
+         "burn_rates": {"30m": 4.0, "6h": 4.0}},
+        {"kind": "slo.burn_alert", "state": "cleared",
+         "objective": "availability", "rule": "fast_burn",
+         "severity": "page"},
+        {"kind": "other.event"},
+    ]
+    dump = tmp_path / "flight.json"
+    dump.write_text(json.dumps({"name": "x", "events": events}))
+    # Page cleared, ticket still active -> exit 3.
+    assert tool.main(["--flight", str(dump)]) == 3
+    out = capsys.readouterr().out
+    assert "[TICKET] ttft slow_burn" in out
+    assert "availability" not in out
+
+
+def test_fleet_plan_renders_slo_columns(slo_fleet):
+    """tools/fleet_plan.py grew the SLO view: the per-replica
+    availability SLI column and the fleet burn/budget lines render
+    from a live /debug/fleet, alerts included."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_plan", os.path.join(repo, "tools", "fleet_plan.py")
+    )
+    fleet_plan = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_plan)
+
+    replica, router, _ = slo_fleet
+    replica.sli("availability", good=50, bad=50)
+    _wait(
+        lambda: any(
+            a["rule"] == "fast_burn" for a in router.slo.active_alerts()
+        ),
+        msg="burn alert",
+    )
+    out = fleet_plan.render(router.fleet_state())
+    assert "avail_sli" in out
+    assert "50/100" in out
+    assert "slo availability: burn" in out
+    assert "budget" in out
+    assert "slo ALERT [PAGE] availability fast_burn" in out
+    # A slo-less fleet renders the disabled line, not a crash.
+    bare = fleet_plan.render({"replicas": {}, "slo": {"enabled": False}})
+    assert "slo: disabled" in bare
+
+
+def test_slo_report_live_url_with_usage(slo_fleet, capsys):
+    """--url against the live router: fleet /debug/slo renders; the
+    absent /debug/usage endpoint downgrades gracefully."""
+    tool = _load_slo_report()
+    replica, router, _ = slo_fleet
+    replica.sli("availability", good=10)
+    _wait(
+        lambda: router.slo.totals().get("availability") == [10, 10],
+        msg="poll merge",
+    )
+    assert tool.main(["--url", f"127.0.0.1:{router.port}"]) == 0
+    out = capsys.readouterr().out
+    assert "availability" in out and "10/10" in out
+
+
+# ======================================================================
+# metrics_lint tenant-family budget (ISSUE 16 cardinality contract)
+# ======================================================================
+
+
+def _load_metrics_lint():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(repo, "tools", "metrics_lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_lint_tenant_family_budget():
+    """Every tenant-labeled family is explicitly capped at 17 series
+    (16 tracked tenants + the _other fold): a tenant label escaping
+    the bounded map fails the lint long before the generic 64."""
+    lint_mod = _load_metrics_lint()
+    fam = "tpu_engine_tenant_requests_total"
+
+    def exposition(n):
+        lines = [f"# HELP {fam} requests per tenant",
+                 f"# TYPE {fam} counter"]
+        lines += [f'{fam}{{tenant="t{i}"}} 1' for i in range(n)]
+        return "\n".join(lines) + "\n"
+
+    assert lint_mod.lint(exposition(17)) == []
+    errors = lint_mod.lint(exposition(18))
+    assert any("18 series exceeds" in e and "17" in e for e in errors), (
+        errors
+    )
+    # The generic default still governs unlisted families.
+    assert lint_mod.FAMILY_BUDGETS[fam] == 17
+    assert lint_mod.DEFAULT_CARDINALITY_BUDGET == 64
+
+
+def test_metrics_lint_clean_on_live_slo_router(slo_fleet):
+    """The router /metrics with the SLO plane lit (burn-rate gauges +
+    alert counters populated) stays lint-clean — the second half of
+    the both-servers live-scrape contract (the engine half rides
+    tests/test_http_server.py with the served fixture's slo=True)."""
+    import urllib.request as _url
+
+    lint_mod = _load_metrics_lint()
+    replica, router, _ = slo_fleet
+    replica.sli("availability", good=50, bad=50)
+    _wait(
+        lambda: any(
+            a["rule"] == "fast_burn" for a in router.slo.active_alerts()
+        ),
+        msg="burn alert",
+    )
+    assert (
+        lint_mod.lint_url(f"http://127.0.0.1:{router.port}/metrics") == []
+    )
+    with _url.urlopen(
+        f"http://127.0.0.1:{router.port}/metrics", timeout=5
+    ) as resp:
+        text = resp.read().decode()
+    assert "tpu_slo_burn_rate{" in text
+    assert "tpu_router_slo_burn_alerts_total{" in text
+
+
+def test_router_slo_disabled_by_default():
+    from k8s_device_plugin_tpu.router.server import RouterServer
+
+    from tests.fakes import FakeReplica
+
+    replica = FakeReplica().start()
+    router = RouterServer(
+        [replica.name],
+        host="127.0.0.1",
+        port=0,
+        poll_interval_s=0.05,
+        hedge=False,
+    ).start()
+    try:
+        time.sleep(0.15)
+        assert router.slo is None
+        assert _get(router.port, "/debug/slo") == {"enabled": False}
+        assert router.fleet_state()["slo"] == {"enabled": False}
+    finally:
+        router.stop()
+        replica.stop()

@@ -123,6 +123,7 @@ def test_max_seq_headroom_guard(rng):
         speculative_generate(cfg, params, cfg, params, prompt, 22, gamma=4)
 
 
+@pytest.mark.slow  # composition blanket: statistical soak; correctness stays pinned by test_sample_spec_deterministic_and_valid and test_spec_engine_matches_dense_oracle
 def test_sample_spec_preserves_target_distribution(rng):
     """The acceptance-rejection variant must leave each token marginally
     distributed as target-only sampling.  Two-sample check on token #2

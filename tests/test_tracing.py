@@ -8,6 +8,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from k8s_device_plugin_tpu.utils import tracing
 
@@ -69,6 +70,7 @@ def test_benchmark_gpt_train_smoke(capsys):
     assert out["throughput"] > 0
 
 
+@pytest.mark.slow  # composition blanket: decode benchmark smoke; the harness stays pinned by test_benchmark_gpt_train_smoke and test_benchmark_sampled_decode_smoke
 def test_benchmark_gpt_decode_smoke(capsys, tmp_path):
     from k8s_device_plugin_tpu.models import benchmark
 
@@ -102,6 +104,7 @@ def test_benchmark_sampled_decode_smoke(capsys):
     assert out["throughput"] > 0
 
 
+@pytest.mark.slow  # composition blanket: pipelined benchmark smoke; the harness stays pinned by test_benchmark_gpt_train_smoke
 def test_benchmark_pipelined_1f1b_smoke(capsys):
     from k8s_device_plugin_tpu.models import benchmark
 

@@ -230,6 +230,20 @@ def load_record(path: str) -> dict:
                 name: (shape or {}).get("kernel_vs_gather")
                 for name, shape in (kernels.get("shapes") or {}).items()
             }
+        # SLO block (SLO serving rows, benchmark.py _run_slo_phase):
+        # measured slo-on vs slo-off per-token accounting overhead over
+        # the same jobs, plus the alert-pipeline self-check.  The
+        # regression tells: overhead creeping past 1% (the verdict/
+        # usage seam stopped being free — SLO-OVERHEAD), or
+        # burn_alert_fired flipping false (a synthetic sustained burn
+        # no longer fires the fast-burn page rule — BURN-ALERT-MISSED,
+        # the worst possible observability regression: the pager is
+        # dead and nothing else would say so).
+        slo = parsed.get("slo")
+        if isinstance(slo, dict):
+            rec["slo_overhead"] = slo.get("overhead")
+            rec["slo_verdicts"] = slo.get("sli_verdicts")
+            rec["slo_burn_alert_fired"] = slo.get("burn_alert_fired")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -296,6 +310,7 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "disagg_unified_ratio", "disagg_loaded_ms", "disagg_ratio",
         "disagg_handoff_entries", "disagg_tokens_match",
         "trace_overhead", "trace_spans",
+        "slo_overhead", "slo_verdicts", "slo_burn_alert_fired",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -472,6 +487,23 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("trace_overhead") is not None
+                else ""
+            )
+            + (
+                f"; slo overhead {b['slo_overhead']} "
+                f"({b.get('slo_verdicts')} verdicts"
+                + (
+                    ", SLO-OVERHEAD"
+                    if (b.get("slo_overhead") or 0.0) > 0.01
+                    else ""
+                )
+                + (
+                    ""
+                    if b.get("slo_burn_alert_fired", True)
+                    else ", BURN-ALERT-MISSED"
+                )
+                + ")"
+                if b.get("slo_overhead") is not None
                 else ""
             )
             + (

@@ -149,6 +149,11 @@ def _event_kinds(repo: Repo):
             chain = _attr_chain(node.func)
             if not chain or chain[-1] not in ("record", "_record"):
                 continue
+            # ``*.slo.record("availability", ...)`` is the SLOTracker
+            # verdict API (utils/slo.py), not a flight-event record —
+            # objective names are catalogued as SLOs, not event kinds.
+            if len(chain) >= 2 and chain[-2] == "slo":
+                continue
             arg = node.args[0]
             values = []
             if isinstance(arg, ast.IfExp):

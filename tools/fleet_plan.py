@@ -43,7 +43,7 @@ def render(fleet: dict) -> str:
     """The operator table: one row per replica, then the verdict."""
     lines = [
         f"{'replica':<24} {'pressure_s':>10} {'queue':>6} {'slots':>6} "
-        f"{'wait_ewma':>10} {'drain_rps':>10}  state"
+        f"{'wait_ewma':>10} {'drain_rps':>10} {'avail_sli':>10}  state"
     ]
     for name, row in sorted((fleet.get("replicas") or {}).items()):
         state = []
@@ -55,12 +55,17 @@ def render(fleet: dict) -> str:
             state.append("fenced")
         wait = row.get("queue_wait_ewma_s")
         drain = row.get("drain_rate_rps")
+        # Cumulative availability SLI off the summary poll (ISSUE 16):
+        # good/total per replica, "-" until the replica exports it.
+        avail = (row.get("slo_totals") or {}).get("availability")
+        sli = f"{avail[0]}/{avail[1]}" if avail else "-"
         lines.append(
             f"{name:<24} {row.get('pressure_s', 0):>10.3f} "
             f"{row.get('queue_depth', 0):>6} "
             f"{row.get('active_slots', 0):>6} "
             f"{wait if wait is not None else '-':>10} "
-            f"{drain if drain is not None else '-':>10}  "
+            f"{drain if drain is not None else '-':>10} "
+            f"{sli:>10}  "
             f"{','.join(state) or 'ok'}"
         )
     migration = fleet.get("migration") or {}
@@ -72,6 +77,30 @@ def render(fleet: dict) -> str:
         )
     else:
         lines.append("migration: disabled")
+    # Fleet SLO burn view (ISSUE 16; the full report is
+    # tools/slo_report.py): per-objective burn rates + budget remaining
+    # next to the pressure verdict, so an operator sees budget burn and
+    # queue pressure in one glance.
+    slo = fleet.get("slo") or {}
+    if slo.get("enabled"):
+        burns = slo.get("burn_rates") or {}
+        budgets = slo.get("budget_remaining") or {}
+        for objective in sorted(burns):
+            per_w = ", ".join(
+                f"{w} {b}" for w, b in sorted(burns[objective].items())
+            )
+            lines.append(
+                f"slo {objective}: burn {per_w}; "
+                f"budget {budgets.get(objective, '?')}"
+            )
+        for alert in slo.get("alerts") or []:
+            lines.append(
+                f"slo ALERT [{alert.get('severity', '?').upper()}] "
+                f"{alert.get('objective')} {alert.get('rule')} "
+                f">= {alert.get('factor')}x"
+            )
+    else:
+        lines.append("slo: disabled")
     rec = fleet.get("recommendation") or {}
     lines.append(
         f"recommendation: {rec.get('action', 'hold').upper()} "

@@ -18,7 +18,11 @@ rendered text the way a Prometheus scraper would, strictly:
   bucket equals ``_count``,
 - per-family series cardinality stays under a budget (default 64 —
   far above the per-chip/per-pod series a 16-chip host can emit, low
-  enough to catch a per-request label before it ships).
+  enough to catch a per-request label before it ships).  Families with
+  a declared contract get an explicitly tighter budget: every
+  tenant-labeled family (``tpu_engine_tenant_*``) is capped at 17
+  series — the bounded 16-tenant map plus the ``_other`` fold — so a
+  tenant label escaping the cap fails the lint long before 64.
 
 Usage (CI or live debugging; exits nonzero on any finding):
 
@@ -65,6 +69,23 @@ TYPE_SUFFIXES = {
 }
 
 DEFAULT_CARDINALITY_BUDGET = 64
+
+# Explicit per-family budgets, tighter than the generic default.  The
+# tenant-labeled families (engine_types.py EngineMetrics) ride the
+# bounded 16-tenant map — the first 16 distinct tenants get their own
+# label value, every later one folds into ``_other`` — so each family
+# legally tops out at 17 series.  An 18th series means the fold broke
+# (a per-request tenant label escaped the cap), which this lint must
+# catch even though 18 is far under the generic 64.
+TENANT_FAMILY_BUDGET = 17
+FAMILY_BUDGETS = {
+    "tpu_engine_tenant_sheds_total": TENANT_FAMILY_BUDGET,
+    "tpu_engine_tenant_requests_total": TENANT_FAMILY_BUDGET,
+    "tpu_engine_tenant_prompt_tokens_total": TENANT_FAMILY_BUDGET,
+    "tpu_engine_tenant_decode_tokens_total": TENANT_FAMILY_BUDGET,
+    "tpu_engine_tenant_kv_page_seconds_total": TENANT_FAMILY_BUDGET,
+    "tpu_engine_tenant_queue_wait_seconds_total": TENANT_FAMILY_BUDGET,
+}
 
 
 def _family_of(sample_name: str, types: dict[str, str]) -> str | None:
@@ -187,10 +208,13 @@ def lint(
                 )
 
     for family, series in family_series.items():
-        if len(series) > cardinality_budget:
+        budget = min(
+            cardinality_budget, FAMILY_BUDGETS.get(family, cardinality_budget)
+        )
+        if len(series) > budget:
             errors.append(
                 f"{family}: {len(series)} series exceeds the cardinality "
-                f"budget of {cardinality_budget}"
+                f"budget of {budget}"
             )
     return errors
 

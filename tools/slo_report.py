@@ -1,0 +1,235 @@
+#!/usr/bin/env python3
+"""Render the SLO plane (utils/slo.py) into an operator-readable
+error-budget report.
+
+Works against any /debug/slo — an engine's own view or the router's
+fleet-merged one — or offline against a saved snapshot / a flight-
+recorder dump (replaying its ``slo.burn_alert`` transitions, the
+post-incident path when the process is already gone):
+
+    python tools/slo_report.py --url http://replica:8000
+    python tools/slo_report.py --url http://router:8100   # fleet view
+    python tools/slo_report.py slo_snapshot.json
+    python tools/slo_report.py --flight flight_dump.json
+    python tools/slo_report.py --url http://router:8100 --json  # machine
+
+Per-tenant usage (/debug/usage — engines only; the router has no
+tenant meter) rides along when the endpoint answers.
+
+Exit code 0 when no alert is active, 3 when the worst active alert is
+ticket-severity (slow burn), 4 when a page-severity (fast burn) alert
+is active — so a cron/CI wrapper can act on budget burn without
+parsing anything, exactly like fleet_plan.py's verdict codes.
+Stdlib-only and jax-free, like every fleet-side tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+EXIT_CODES = {"ok": 0, "ticket": 3, "page": 4}
+
+
+def _fetch(base: str, path: str) -> dict | None:
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError:
+        return None  # endpoint absent (a router has no /debug/usage)
+
+
+def load_live(url: str) -> tuple[dict, dict | None]:
+    """(slo snapshot, usage snapshot or None) from a live server."""
+    base = url.rstrip("/")
+    if not base.startswith("http"):
+        base = f"http://{base}"
+    slo = _fetch(base, "/debug/slo")
+    if slo is None:
+        raise ValueError(f"{base}/debug/slo answered an HTTP error")
+    usage = _fetch(base, "/debug/usage")
+    if usage is not None and not usage.get("enabled", False):
+        usage = None
+    return slo, usage
+
+
+def alerts_from_flight(dump: dict) -> list[dict]:
+    """Replay a flight dump's slo.burn_alert transitions into the set
+    of alerts still active at the end of the window.  The dump may be
+    a FlightRecorder.snapshot() dict or a bare event list."""
+    events = dump.get("events", dump) if isinstance(dump, dict) else dump
+    active: dict[tuple[str, str], dict] = {}
+    for event in events:
+        if event.get("kind") != "slo.burn_alert":
+            continue
+        key = (str(event.get("objective")), str(event.get("rule")))
+        if event.get("state") == "fired":
+            active[key] = dict(event)
+        elif event.get("state") == "cleared":
+            active.pop(key, None)
+    return list(active.values())
+
+
+def worst_severity(alerts: list[dict]) -> str:
+    severities = {a.get("severity") for a in alerts}
+    if "page" in severities:
+        return "page"
+    if "ticket" in severities:
+        return "ticket"
+    return "ok" if not severities else "ticket"
+
+
+def render_slo(slo: dict) -> str:
+    """The operator table: one row per objective with its window burn
+    rates and budget remaining, then the active alerts."""
+    windows: list[str] = []
+    for obj in (slo.get("objectives") or {}).values():
+        windows = list(obj.get("windows") or {})
+        break
+    header = f"{'objective':<20} {'target':>8} {'good/total':>14}"
+    for w in windows:
+        header += f" {'burn ' + w:>10}"
+    header += f" {'budget':>8}"
+    lines = [header]
+    for name, obj in sorted((slo.get("objectives") or {}).items()):
+        good, total = obj.get("totals", [0, 0])
+        row = (
+            f"{name:<20} {obj.get('target', 0):>8} "
+            f"{f'{good}/{total}':>14}"
+        )
+        for w in windows:
+            burn = (obj.get("windows") or {}).get(w, {}).get("burn_rate", 0)
+            row += f" {burn:>10.3f}"
+        remaining = obj.get("budget_remaining")
+        row += f" {remaining if remaining is not None else '-':>8}"
+        lines.append(row)
+    alerts = slo.get("alerts") or []
+    if alerts:
+        lines.append(f"active alerts ({len(alerts)}):")
+        for a in alerts:
+            burns = ", ".join(
+                f"{w}={b}" for w, b in (a.get("burn_rates") or {}).items()
+            )
+            lines.append(
+                f"  [{a.get('severity', '?').upper()}] "
+                f"{a.get('objective')} {a.get('rule')} "
+                f">= {a.get('factor')}x ({burns})"
+            )
+    else:
+        lines.append("active alerts: none")
+    fired = slo.get("alerts_fired_total")
+    if fired is not None:
+        lines.append(f"alerts fired (lifetime): {fired}")
+    return "\n".join(lines)
+
+
+def render_usage(usage: dict) -> str:
+    """Per-tenant top-talkers, heaviest decode consumers first."""
+    lines = [
+        f"{'tenant':<20} {'requests':>9} {'prompt_tok':>11} "
+        f"{'decode_tok':>11} {'kv_page_s':>11} {'queue_s':>9}"
+    ]
+    tenants = usage.get("tenants") or {}
+    by_decode = sorted(
+        tenants.items(),
+        key=lambda kv: kv[1].get("decode_tokens", 0),
+        reverse=True,
+    )
+    for name, row in by_decode:
+        lines.append(
+            f"{name:<20} {row.get('requests', 0):>9} "
+            f"{row.get('prompt_tokens', 0):>11} "
+            f"{row.get('decode_tokens', 0):>11} "
+            f"{row.get('kv_page_seconds', 0.0):>11.2f} "
+            f"{row.get('queue_wait_seconds', 0.0):>9.2f}"
+        )
+    lines.append(
+        f"tenants tracked: {usage.get('tracked_tenants', len(tenants))}"
+        f"/{usage.get('max_tracked_tenants', '?')}"
+        " (later tenants fold into _other)"
+    )
+    return "\n".join(lines)
+
+
+def render_flight_alerts(alerts: list[dict]) -> str:
+    lines = [f"alerts active at end of flight window ({len(alerts)}):"]
+    if not alerts:
+        lines = ["alerts active at end of flight window: none"]
+    for a in sorted(
+        alerts, key=lambda a: (a.get("objective", ""), a.get("rule", ""))
+    ):
+        burns = ", ".join(
+            f"{w}={b}" for w, b in (a.get("burn_rates") or {}).items()
+        )
+        lines.append(
+            f"  [{a.get('severity', '?').upper()}] "
+            f"{a.get('objective')} {a.get('rule')} "
+            f">= {a.get('factor')}x ({burns})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="slo-report",
+        description="render /debug/slo error budgets, burn alerts, "
+        "and per-tenant usage",
+    )
+    p.add_argument(
+        "snapshot",
+        nargs="?",
+        help="saved /debug/slo JSON (alternative to --url/--flight)",
+    )
+    p.add_argument(
+        "--url", default="", help="live engine or router base URL"
+    )
+    p.add_argument(
+        "--flight",
+        default="",
+        help="flight-recorder dump: replay slo.burn_alert transitions",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the raw report JSON instead of the tables",
+    )
+    args = p.parse_args(argv)
+    if not args.url and not args.snapshot and not args.flight:
+        p.error("need --url, --flight, or a snapshot file")
+    usage = None
+    try:
+        if args.flight:
+            with open(args.flight) as f:
+                alerts = alerts_from_flight(json.load(f))
+            if args.json:
+                print(json.dumps({"alerts": alerts}, indent=2))
+            else:
+                print(render_flight_alerts(alerts))
+            return EXIT_CODES[worst_severity(alerts)]
+        if args.url:
+            slo, usage = load_live(args.url)
+        else:
+            with open(args.snapshot) as f:
+                slo = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"slo-report: {e}", file=sys.stderr)
+        return 1
+    if not slo.get("enabled", True):
+        print("slo-report: SLO plane disabled on this server")
+        return 0
+    if args.json:
+        print(json.dumps({"slo": slo, "usage": usage}, indent=2))
+    else:
+        print(render_slo(slo))
+        if usage is not None:
+            print()
+            print(render_usage(usage))
+    return EXIT_CODES[worst_severity(slo.get("alerts") or [])]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
