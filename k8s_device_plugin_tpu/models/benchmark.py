@@ -598,6 +598,216 @@ def _run_router_phase(args) -> dict | None:
     return block
 
 
+def _run_canary_phase(args) -> dict | None:
+    """CANARY perf phase: the active correctness plane's overhead and
+    detection self-check (router/prober.py, ISSUE 17).
+
+    What the row claims and how it is measured:
+
+    - **overhead** — serving throughput (client-observed tokens/sec
+      through the router over the SAME seeded traffic) with the canary
+      prober running at an aggressive interval vs with it off, against
+      real (tiny) serving replicas.  The prober-ON pass runs FIRST so
+      any residual warmth favors the OFF control — the overhead number
+      is conservative.  bench_diff screams PROBE-OVERHEAD past 1%.
+    - **mismatch_detected / fences** — the detection self-check: after
+      the measured passes, the ``engine.readback=corrupt`` failpoint
+      (docs/chaos.md) flips one token byte in every readback; the
+      prober MUST verdict mismatch within a few sweeps and auto-fence.
+      bench_diff screams MISMATCH-MISSED when this flips false — a
+      blind detector is the worst possible correctness-plane
+      regression, and nothing else would say so.
+
+    Returns the JSON ``canary`` block (None when the router phase is
+    disabled via --router-replicas < 2 — same replicas budget)."""
+    import dataclasses
+    import os as _os
+    import sys as _sys
+    import threading
+    import time as _time
+
+    from ..router.prober import CanaryConfig
+    from ..router.server import RouterServer
+    from ..utils import failpoints
+    from ..utils.metrics import MetricsRegistry
+    from .engine import EngineMetrics, ServingEngine
+    from .http_server import EngineServer
+    from .transformer import GPTConfig, PagedConfig, TransformerLM
+
+    if getattr(args, "router_replicas", 2) < 2:
+        return None
+    try:
+        from tests.sim.traffic import RouterTraffic
+    except ImportError:
+        _sys.path.insert(
+            0,
+            _os.path.dirname(
+                _os.path.dirname(
+                    _os.path.dirname(_os.path.abspath(__file__))
+                )
+            ),
+        )
+        from tests.sim.traffic import RouterTraffic
+
+    page_size = 4
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    paged = PagedConfig(
+        page_size=page_size, num_pages=64, max_pages_per_seq=16
+    )
+    servers = []
+    for i in range(2):
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(100 + i), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg,
+            params,
+            paged,
+            max_slots=4,
+            metrics=EngineMetrics(registry),
+        )
+        servers.append(
+            EngineServer(
+                engine,
+                host="127.0.0.1",
+                port=0,
+                registry=registry,
+                enable_admin=True,  # the prober's auto-fence target
+            ).start()
+        )
+
+    def _post_replica(port, prompt, max_new):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": max_new}
+            ).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=120).read()
+
+    # Warm every (batch, bucket) shape BOTH the traffic replay and the
+    # canary probes can hit, so no XLA compile lands inside either
+    # measured pass (the probe prompt is tiny — its bucket too).
+    for server in servers:
+        for group in (1, 2, 3, 4):
+            threads = [
+                threading.Thread(
+                    target=_post_replica,
+                    args=(server.port, [7 + g] * 18, 6),
+                )
+                for g in range(group)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        _post_replica(server.port, [11, 13, 17, 19], 4)
+
+    replica_names = [f"127.0.0.1:{s.port}" for s in servers]
+    canary_cfg = CanaryConfig(
+        interval_s=0.25,  # far hotter than production: worst case
+        probe_tokens=4,
+        prompts=((11, 13, 17, 19),),
+        k_mismatch=2,
+        fence=True,
+    )
+
+    def _measure(canary_on):
+        router = RouterServer(
+            replica_names,
+            host="127.0.0.1",
+            port=0,
+            prefix_block_tokens=page_size,
+            prefix_max_blocks=4,
+            poll_interval_s=0.2,
+            hedge=False,
+            seed=3,
+            canary=canary_on,
+            canary_config=canary_cfg,
+        ).start()
+        traffic = RouterTraffic(
+            "127.0.0.1",
+            router.port,
+            seed=23,
+            sessions=4,
+            prefix_len=16,
+            vocab=cfg.vocab_size,
+        )
+        # Warm pass, then the measured pass over identical shapes.
+        traffic.run(8, concurrency=4, suffix_len=(1, 4), max_new=(4, 8))
+        report = traffic.run(
+            24, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        tps = report.tokens / max(report.duration_s, 1e-9)
+        return router, tps, report
+
+    # Prober ON first: residual warmth then favors the OFF control,
+    # never the claim.
+    router_on, tps_on, report_on = _measure(True)
+    probes = sum(
+        row["probes"]
+        for row in router_on.prober.snapshot()["replicas"].values()
+    )
+
+    # Detection self-check on the still-running canary router: corrupt
+    # every readback, wait for mismatch -> auto-fence.
+    failpoints.arm_spec("engine.readback=corrupt")
+    mismatch_detected = False
+    fences = 0
+    try:
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline:
+            snap = router_on.prober.snapshot()
+            fences = snap["fences_fired"]
+            if fences >= 1:
+                mismatch_detected = True
+                break
+            _time.sleep(0.1)
+    finally:
+        failpoints.disarm("engine.readback")
+    router_on.stop()
+    for server in servers:
+        server.unfence()
+
+    router_off, tps_off, report_off = _measure(False)
+    router_off.stop()
+    for server in servers:
+        server.stop()
+
+    overhead = max(0.0, 1.0 - tps_on / tps_off) if tps_off else None
+    block = {
+        "replicas": 2,
+        "interval_s": canary_cfg.interval_s,
+        "tokens_per_sec_canary": round(tps_on, 2),
+        "tokens_per_sec_control": round(tps_off, 2),
+        "overhead": round(overhead, 4) if overhead is not None else None,
+        "probes": probes,
+        "dropped": report_on.dropped + report_off.dropped,
+        "mismatch_detected": mismatch_detected,
+        "fences": fences,
+    }
+    log(
+        "perf-ledger row: | CANARY active probing (interval %.2fs) | "
+        "overhead %s (%.2f vs %.2f tokens/sec, %d probes); injected "
+        "corruption %s (%d fences) | - | `benchmark.py --model serving` "
+        "| update on bench round |"
+        % (
+            canary_cfg.interval_s,
+            block["overhead"],
+            tps_on,
+            tps_off,
+            probes,
+            "detected+fenced" if mismatch_detected else "MISSED",
+            fences,
+        )
+    )
+    return block
+
+
 def _run_kernels_phase(args) -> dict | None:
     """KERNELS perf phase: the split-K paged-attention kernel vs the
     engine's gather fallback vs the old single-pass Pallas path, per
@@ -1870,6 +2080,8 @@ def run_serving(args) -> None:
     router_block = _run_router_phase(args)
     # --- SLO phase (SLO row): accounting overhead + alert self-check ---
     slo_block = _run_slo_phase(eng, args)
+    # --- Canary phase (CANARY row): prober overhead + detection check --
+    canary_block = _run_canary_phase(args)
     print(
         json.dumps(
             {
@@ -1918,6 +2130,7 @@ def run_serving(args) -> None:
                 "disagg": disagg_block,
                 "router": router_block,
                 "slo": slo_block,
+                "canary": canary_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

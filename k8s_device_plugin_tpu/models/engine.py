@@ -937,13 +937,29 @@ class ServingEngine(
         # Chaos seam (docs/chaos.md): delay stalls the readback sync —
         # the injected step-time blowup the engine.step_seconds anomaly
         # detector must catch; error escapes step() and kills the owner
-        # loop (the engine-death shape: /healthz flips 503).  Disarmed
-        # cost is one dict truthiness check per step.
-        failpoints.fire("engine.readback")
+        # loop (the engine-death shape: /healthz flips 503); corrupt
+        # flips bytes of the synced token buffer IN PLACE — the stream
+        # keeps flowing with wrong tokens, the silent-data-corruption
+        # ground truth the canary prober's bit-exactness verdict is
+        # scored against.  Disarmed cost is one dict truthiness check
+        # per step.
+        hit = failpoints.fire("engine.readback")
         arr = np.asarray(rec["out"])
         if rec["want_lp"]:
-            return arr[0].astype(np.int64), arr[1]
-        return arr, None
+            toks, lps = arr[0].astype(np.int64), arr[1]
+        else:
+            toks, lps = arr, None
+        if hit is not None and hit.mode == "corrupt":
+            # Flip nbytes low-order bytes of the token buffer (int64
+            # little-endian: byte 0 is token 0's LSB, so 1 byte = one
+            # off-by-one wrong token) — applied AFTER any logprob
+            # unpack so the flip always lands on token integers, never
+            # rounds away in a float conversion.
+            nbytes = int(hit.arg) if hit.arg else 1
+            toks = np.array(toks, dtype=np.int64)
+            flat = toks.view(np.uint8).reshape(-1)
+            flat[: max(1, min(nbytes, flat.size))] ^= 0x01
+        return toks, lps
 
     def _record_hit(self) -> None:
         self.overlap_hits += 1

@@ -209,6 +209,11 @@ class EngineServer:
         self.fence_detail = None
         self.fence_at = 0.0
         self.fences = 0
+        # Params fingerprint served on ?summary=1 (the canary prober's
+        # oracle key) — lazily computed on first poll and cached: the
+        # weights never change in-process, and the CRC sweep must not
+        # ride every poll.
+        self._params_fp_cache: Optional[str] = None
         # Crash-safe warm restart (models/engine_snapshot.py): the KV
         # host arena persists here on fence/drain/SIGTERM and on the
         # periodic timer, and rehydrates via load_snapshot() at startup.
@@ -1357,6 +1362,19 @@ class EngineServer:
                             if server.engine.slo is not None
                             else None
                         ),
+                        # Canary-prober oracle key + staleness feed
+                        # (router/prober.py): the weights fingerprint the
+                        # token oracle is captured against (computed once,
+                        # cached — params never change in-process), and a
+                        # cumulative request counter whose freezing while
+                        # probes keep landing is the metric-staleness
+                        # verdict.
+                        "params_fingerprint": server.params_fp(),
+                        "requests_total": (
+                            int(server.engine.metrics.requests.value())
+                            if server.engine.metrics is not None
+                            else None
+                        ),
                     }
                     if "summary=1" in (self.path.split("?", 1) + [""])[1]:
                         # ?summary=1: the summary ALONE — skips the
@@ -1559,6 +1577,19 @@ class EngineServer:
             f"chip_{info.get('kind', 'fault')}", source="chip_health",
             detail=info,
         )
+
+    def params_fp(self) -> str:
+        """The engine's weights fingerprint (engine_snapshot CRC sweep),
+        computed on first use and cached — the ?summary=1 oracle key the
+        canary prober captures token oracles against.  A redeploy with
+        new weights is a new process, hence a new fingerprint."""
+        fp = self._params_fp_cache
+        if fp is None:
+            from . import engine_snapshot as snap_mod
+
+            fp = snap_mod.params_fingerprint(self.engine.params)
+            self._params_fp_cache = fp
+        return fp
 
     def begin_fence(
         self, reason: str, source: str = "operator", detail=None

@@ -123,6 +123,34 @@ def build_parser() -> argparse.ArgumentParser:
         "1 restores report-on-first-failure)",
     )
     p.add_argument(
+        "--selftest-interval",
+        type=float,
+        default=0.0,
+        help="seconds between idle-chip self-test sweeps "
+        "(plugin/selftest.py, docs/operations.md \"Active probing\"): "
+        "chips the allocation ledger shows unallocated get a "
+        "deterministic matmul-checksum probe; fail-threshold "
+        "consecutive divergences fire a selftest.fail incident and "
+        "quarantine the chip through the health override file before "
+        "the kubelet places a pod on it.  0 disables (default)",
+    )
+    p.add_argument(
+        "--selftest-fail-threshold",
+        type=int,
+        default=2,
+        help="consecutive self-test checksum failures before the "
+        "incident + quarantine (one blip never quarantines)",
+    )
+    p.add_argument(
+        "--selftest-quarantine",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="quarantine policy: 1 = a confirmed self-test failure "
+        "writes the run/tpu/health/accelN override (next health sweep "
+        "reports Unhealthy); 0 = observe-only (incidents still fire)",
+    )
+    p.add_argument(
         "--failpoints",
         default="",
         help="arm chaos failpoints: 'name=mode[:arg][*count];...' with "
@@ -279,6 +307,27 @@ def main(argv: list[str] | None = None) -> int:
             interval_s=args.pod_resources_interval,
         )
         debug_endpoints["/debug/pods"] = poller.snapshot
+    selftest = None
+    if args.selftest_interval > 0:
+        # Idle-chip self-test sweep (plugin/selftest.py): the plugin
+        # half of the active correctness plane.  Discovery re-runs per
+        # sweep (chips unplug); the ledger arbitrates idleness.
+        from .selftest import SelftestConfig, SelftestSweeper
+
+        selftest = SelftestSweeper(
+            lambda: discovery.discover(root=args.root).chips,
+            ledger.granted,
+            config=SelftestConfig(
+                interval_s=args.selftest_interval,
+                fail_threshold=args.selftest_fail_threshold,
+                quarantine=bool(args.selftest_quarantine),
+            ),
+            root=args.root,
+            metrics=default_plugin_metrics(),
+            flight=box,
+            anomaly=monitor,
+        )
+        debug_endpoints["/debug/selftest"] = selftest.snapshot
     metrics_server = None
 
     def _on_signal(signum, _frame):
@@ -316,8 +365,12 @@ def main(argv: list[str] | None = None) -> int:
             )
         if poller is not None:
             poller.start()
+        if selftest is not None:
+            selftest.start()
         manager.run()
     finally:
+        if selftest is not None:
+            selftest.stop()
         if poller is not None:
             poller.stop()
         if metrics_server is not None:

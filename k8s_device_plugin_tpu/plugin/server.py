@@ -155,6 +155,35 @@ class PluginMetrics:
             "records served at the MetricsServer's /debug/incidents",
             ["metric"],
         )
+        # Idle-chip self-test sweep (plugin/selftest.py, --selftest-*):
+        # active correctness probes on chips the allocation ledger shows
+        # idle.  Verdict is a closed set (pass/fail/skip_busy/error).
+        self.selftests = registry.counter(
+            "tpu_chip_selftest_total",
+            "Idle-chip self-test probes per chip and verdict (pass: "
+            "matmul checksum bit-exact; fail: diverged — "
+            "fail_threshold consecutive fires selftest.fail and "
+            "quarantines via the health override file; skip_busy: "
+            "ledger shows the chip allocated, never probed; error: "
+            "probe machinery raised)",
+            ["device", "verdict"],
+        )
+        self.selftest_seconds = registry.histogram(
+            "tpu_chip_selftest_seconds",
+            "Wall time of one idle-chip self-test probe (seeded int64 "
+            "matmul + crc32)",
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 1.0,
+            ),
+        )
+        self.selftest_quarantined = registry.gauge(
+            "tpu_chip_selftest_quarantined",
+            "1 while the chip sits quarantined by a failed self-test "
+            "(health override file written; operator removes it to "
+            "recover — docs/operations.md triage table)",
+            ["device"],
+        )
         # --- pod attribution (plugin/attribution.py).  Cardinality is
         # bounded by the host's chip count (<= 16): at most one
         # owner-info series per chip and one tpu_pod_chips series per

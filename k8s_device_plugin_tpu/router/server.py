@@ -110,6 +110,7 @@ from .migration import (
     scale_recommendation,
 )
 from .policy import FAILOVER, MIGRATION, ReplicaState, RoutingPolicy
+from .prober import CanaryConfig, CanaryProber
 from .ring import HashRing
 
 FAILPOINT_CONN = "router.replica_conn"
@@ -243,6 +244,39 @@ class RouterMetrics:
             "severity (page: fast burn; ticket: slow burn) — "
             "clears are flight events, not counted here",
             ("objective", "severity"),
+        )
+        # Active correctness plane (router/prober.py, --canary): the
+        # canary prober's verdict counters and probe-latency
+        # histograms.  Verdict is a closed set (prober.VERDICTS plus
+        # the synthetic "router" replica for the end-to-end path).
+        self.canary_probes = registry.counter(
+            "tpu_router_canary_probes_total",
+            "Canary probe verdicts per replica (capture: oracle "
+            "learned; match: bit-exact; mismatch: wrong tokens — K "
+            "consecutive fires canary.mismatch + auto-fence; stale: "
+            "summary counters frozen while probes land; error: dial "
+            "failed; skip_fenced: replica already fenced).  The "
+            "synthetic replica \"router\" is the through-router "
+            "end-to-end probe",
+            ("replica", "verdict"),
+        )
+        self.canary_fences = registry.counter(
+            "tpu_router_canary_fences_total",
+            "Auto-fences fired by the canary prober after K "
+            "consecutive bit-exactness mismatches (POST /debug/fence "
+            "accepted by the replica)",
+            ("replica",),
+        )
+        self.canary_probe_ttft = registry.histogram(
+            "tpu_router_canary_probe_ttft_seconds",
+            "Canary probe time-to-first-token (direct replica dials; "
+            "the active-probing latency SLI, unlabeled on purpose — "
+            "per-replica attribution lives in /debug/canary)",
+        )
+        self.canary_probe_itl = registry.histogram(
+            "tpu_router_canary_probe_itl_seconds",
+            "Canary probe mean inter-token latency (direct replica "
+            "dials)",
         )
 
     def drop_replica(self, name: str) -> None:
@@ -403,6 +437,8 @@ class RouterServer:
         disagg_config: Optional[DisaggConfig] = None,
         prefill_replicas: Optional[list[str]] = None,
         slo: bool = False,
+        canary: bool = False,
+        canary_config: Optional[CanaryConfig] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -667,6 +703,16 @@ class RouterServer:
                     # single-replica fleet's totals here match that
                     # replica's /debug/slo exactly.
                     self._reply(200, server.slo_state())
+                elif path == "/debug/canary":
+                    # Active correctness plane (router/prober.py):
+                    # per-replica probe verdicts, mismatch streaks,
+                    # captured oracles, and fences fired.
+                    if server.prober is None:
+                        self._reply(
+                            404, {"error": "canary prober off (--canary)"}
+                        )
+                    else:
+                        self._reply(200, server.prober.snapshot())
                 elif path == "/debug/spans":
                     # ?rid=<trace id>: one request's tree only — the
                     # trace assembler's live mode pulls per-request,
@@ -707,6 +753,26 @@ class RouterServer:
         self._httpd.daemon_threads = True
         self._http_thread: Optional[threading.Thread] = None
         self._poll_thread: Optional[threading.Thread] = None
+        # Active correctness plane (router/prober.py; library default
+        # OFF like migration/slo — the CLI arms it).  Built after the
+        # HTTP server so the through-router probes can dial our own
+        # bound port.  The prober runs its own thread and never touches
+        # poll state: it reads each replica's summary itself and acts
+        # only through the replica's public /debug/fence endpoint — the
+        # poll loop then notices fenced=true and demotes normally.
+        self.prober: Optional[CanaryProber] = None
+        if canary:
+            from ..utils.anomaly import AnomalyMonitor
+
+            self.canary_anomaly = AnomalyMonitor(flight=flight)
+            self.prober = CanaryProber(
+                lambda: list(self.replicas.keys()),
+                config=canary_config,
+                router_url=f"127.0.0.1:{self.port}",
+                metrics=self.metrics,
+                flight=flight,
+                anomaly=self.canary_anomaly,
+            )
 
     # ------------------------------------------------------- membership
 
@@ -2353,6 +2419,8 @@ class RouterServer:
             daemon=True,
         )
         self._http_thread.start()
+        if self.prober is not None:
+            self.prober.start()
         return self
 
     def begin_drain(self, grace_s: float = 10.0) -> None:
@@ -2382,6 +2450,8 @@ class RouterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.prober is not None:
+            self.prober.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._poll_thread is not None:
@@ -2565,6 +2635,55 @@ def main(argv: Optional[list[str]] = None) -> None:
         "tpu_slo_burn_rate gauges), and serve the fleet view at GET "
         "/debug/slo; 0 disables fleet SLO accounting",
     )
+    p.add_argument(
+        "--canary",
+        type=int,
+        choices=[0, 1],
+        default=0,
+        help="active correctness plane (router/prober.py, "
+        "docs/operations.md \"Active probing\"): continuously probe "
+        "every replica with seeded deterministic canary prompts, "
+        "verdict bit-exactness against oracles captured from the "
+        "fleet's own first clean response per params fingerprint, "
+        "detect summary-counter staleness, and serve GET /debug/canary",
+    )
+    p.add_argument(
+        "--canary-interval",
+        type=float,
+        default=5.0,
+        help="seconds between canary sweeps (every replica probed once "
+        "per sweep; the probe budget IS the overhead budget — the "
+        "serving bench pins it at <=1%% of throughput)",
+    )
+    p.add_argument(
+        "--canary-tokens",
+        type=int,
+        default=4,
+        help="new tokens per canary probe",
+    )
+    p.add_argument(
+        "--canary-k",
+        type=int,
+        default=3,
+        help="consecutive bit-exactness mismatches before the "
+        "canary.mismatch incident and auto-fence (one blip never acts)",
+    )
+    p.add_argument(
+        "--canary-stale-sweeps",
+        type=int,
+        default=5,
+        help="consecutive sweeps with a frozen requests_total summary "
+        "counter (while probes land) before the canary.stale incident",
+    )
+    p.add_argument(
+        "--canary-fence",
+        type=int,
+        choices=[0, 1],
+        default=1,
+        help="auto-fence policy: 1 = a confirmed mismatch POSTs the "
+        "replica's /debug/fence so the fenced-demotion machinery "
+        "drains it; 0 = observe-only (incidents still fire)",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -2642,6 +2761,14 @@ def main(argv: Optional[list[str]] = None) -> None:
             r for r in args.prefill_replicas.split(",") if r
         ],
         slo=bool(args.slo),
+        canary=bool(args.canary),
+        canary_config=CanaryConfig(
+            interval_s=args.canary_interval,
+            probe_tokens=args.canary_tokens,
+            k_mismatch=args.canary_k,
+            stale_sweeps=args.canary_stale_sweeps,
+            fence=bool(args.canary_fence),
+        ),
         migrate=bool(args.migrate),
         migration=MigrationConfig(
             hot_wait_s=args.migrate_hot_wait,
@@ -2673,7 +2800,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     print(
         f"routing on :{server.port} over {len(server.replicas)} replicas "
         "(POST /generate, GET /healthz /metrics /debug/router "
-        "/debug/fleet /debug/slo /debug/spans)",
+        "/debug/fleet /debug/slo /debug/canary /debug/spans)",
         file=sys.stderr,
         flush=True,
     )

@@ -48,6 +48,16 @@ Fault modes:
     the disk-corruption shape the warm-restart degradation contract is
     scored against.  Sites that do not understand truncation ignore the
     hit (non-error hits are advisory by design).
+``corrupt[:nbytes]``
+    ``fire()`` returns a hit the CALL SITE interprets as "flip bytes of
+    your output IN PLACE" — the silent-data-corruption sibling of
+    ``truncate``: nothing tears, nothing errors, the payload is simply
+    WRONG.  The engine's decode readback (``engine.readback``) flips
+    ``nbytes`` bytes (default 1) of the synced token buffer, so the
+    stream keeps flowing with a wrong token — the SDC ground truth the
+    canary prober's bit-exactness verdict is scored against
+    (docs/chaos.md).  Sites that do not understand corruption ignore
+    the hit.
 
 Spec grammar (``--failpoints`` on both CLIs, ``TPU_FAILPOINTS`` env)::
 
@@ -72,7 +82,7 @@ log = logging.getLogger("tpu.failpoints")
 
 ENV = "TPU_FAILPOINTS"
 
-MODES = ("error", "delay", "hang", "flap", "truncate")
+MODES = ("error", "delay", "hang", "flap", "truncate", "corrupt")
 
 # Hard ceiling on hang-mode blocking: chaos must stay recoverable.
 MAX_HANG_S = 30.0
@@ -200,6 +210,19 @@ def parse_spec(spec: str) -> list[tuple[str, str, Optional[str], Optional[int]]]
                 raise ValueError(
                     f"failpoint {name!r}: truncate fraction must be in "
                     f"[0, 1), got {fraction}"
+                )
+        if mode == "corrupt" and arg is not None:
+            try:
+                nbytes = int(arg)
+            except ValueError:
+                raise ValueError(
+                    f"failpoint {name!r}: corrupt nbytes {arg!r} is not "
+                    "an integer"
+                ) from None
+            if nbytes < 1:
+                raise ValueError(
+                    f"failpoint {name!r}: corrupt nbytes must be >= 1, "
+                    f"got {nbytes}"
                 )
         out.append((name, mode, arg, count))
     return out
@@ -379,11 +402,11 @@ class FailpointRegistry:
             limit = min(float(fp.arg), MAX_HANG_S) if fp.arg else MAX_HANG_S
             fp.unhang.wait(timeout=limit)
             return FailpointHit(name, "hang", n, True, fp.arg)
-        if fp.mode == "truncate":
-            # Advisory: the call site tears its own output (snapshot
-            # writer/reader — docs/chaos.md catalog); sites that do not
-            # understand truncation ignore the hit.
-            return FailpointHit(name, "truncate", n, True, fp.arg)
+        if fp.mode in ("truncate", "corrupt"):
+            # Advisory: the call site tears (truncate) or byte-flips
+            # (corrupt) its own output — docs/chaos.md catalog; sites
+            # that do not understand the advice ignore the hit.
+            return FailpointHit(name, fp.mode, n, True, fp.arg)
         # flap: fault value alternates every `period` triggers, starting
         # ACTIVE (the first probe after arming sees the fault).
         period = int(fp.arg) if fp.arg else 1

@@ -51,9 +51,11 @@ PLUGIN_TIER_FILES = {
     "test_health.py",
     "test_manager.py",
     "test_native.py",
+    "test_prober.py",
     "test_protocol.py",
     "test_resources.py",
     "test_router.py",
+    "test_selftest.py",
     "test_server.py",
     "test_spans.py",
     "test_stress.py",

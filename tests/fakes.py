@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import grpc
 
 from k8s_device_plugin_tpu.kubelet import constants
+from k8s_device_plugin_tpu.utils import failpoints
 from k8s_device_plugin_tpu.utils.spans import (
     SpanRecorder,
     parse_trace_context,
@@ -414,6 +415,26 @@ class FakeReplica:
         # the fetch fails — the engine contract in miniature.
         self.role = role
         self.prefill_chunk_s = prefill_chunk_s
+        # Silent-data-corruption knob (canary prober tests): after
+        # ``corrupt_after`` clean /generate responses, every later
+        # response gets its FIRST generated token flipped (t ^ 1 — one
+        # wrong bit, stream keeps flowing), for ``corrupt_count``
+        # responses (None = forever).  The scoped
+        # ``engine.readback.<host:port>=corrupt`` failpoint drives the
+        # same flip, so chaos scenarios inject through the first-class
+        # registry and unit tests through the knob.  The params
+        # fingerprint the summary exports is test-settable so
+        # oracle-refresh-on-redeploy tests can rotate it.
+        self.corrupt_after: int | None = None
+        self.corrupt_count: int | None = None
+        self.corrupted_serves = 0
+        self.params_fp = self.SNAPSHOT_PARAMS_FP
+        # Freeze-summary knob (staleness-detector tests): while set, the
+        # summary's requests_total stops advancing even though /generate
+        # keeps serving — the zombie-telemetry shape the prober's
+        # staleness verdict exists for.
+        self.freeze_summary_counters = False
+        self._frozen_requests_total: int | None = None
         self.prefill_serves = 0
         self.prefill_refusals = 0  # decode-role 409 X-Prefill-Needed answers
         self.handoff_fetches = 0
@@ -498,6 +519,28 @@ class FakeReplica:
                 path = self.path.split("?")[0]
                 if path == "/v1/prefill":
                     self._serve_prefill()
+                    return
+                if path in ("/debug/fence", "/debug/unfence"):
+                    # The EngineServer admin-fence contract (always
+                    # enabled on the fake — tests ARE the operator):
+                    # the canary prober's auto-fence dials this.
+                    if path == "/debug/fence":
+                        length = int(
+                            self.headers.get("Content-Length", "0")
+                        )
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        reason = str(body.get("reason") or "operator")
+                        changed = not replica._fenced.is_set()
+                        replica.begin_fence(reason)
+                        self._json(200, {
+                            "fenced": True,
+                            "reason": replica.fence_reason,
+                            "changed": changed,
+                        })
+                    else:
+                        changed = replica._fenced.is_set()
+                        replica.unfence()
+                        self._json(200, {"fenced": False, "changed": changed})
                     return
                 if path != "/generate":
                     self.send_error(404)
@@ -630,6 +673,7 @@ class FakeReplica:
                         span_id=root_span, attrs=attrs,
                     )
 
+                corrupting = replica._corrupt_this_serve()
                 delay = replica.prefill_delay_s
                 if replica.prefix_tokens and len(prompt) >= replica.prefix_tokens:
                     key = tuple(prompt[: replica.prefix_tokens])
@@ -648,10 +692,12 @@ class FakeReplica:
                 if not stream:
                     tokens = []
                     seq = list(prompt)
-                    for _ in range(max_new):
+                    for i in range(max_new):
                         if replica.token_delay_s:
                             time.sleep(replica.token_delay_s)
                         t = fake_next_token(seq)
+                        if corrupting and i == 0:
+                            t ^= 1  # SDC: one flipped bit, stream flows on
                         seq.append(t)
                         tokens.append(t)
                     out = json.dumps(
@@ -682,6 +728,8 @@ class FakeReplica:
                         if replica.token_delay_s:
                             time.sleep(replica.token_delay_s)
                         t = fake_next_token(seq)
+                        if corrupting and i == 0:
+                            t ^= 1  # SDC: one flipped bit, stream flows on
                         seq.append(t)
                         tokens.append(t)
                         ev = {"token": t, "index": i, "rid": rid,
@@ -710,6 +758,15 @@ class FakeReplica:
                 if path == "/debug/state":
                     with replica._lock:
                         active = replica.active_streams
+                        if replica.freeze_summary_counters:
+                            if replica._frozen_requests_total is None:
+                                replica._frozen_requests_total = (
+                                    replica.generate_requests
+                                )
+                            requests_total = replica._frozen_requests_total
+                        else:
+                            replica._frozen_requests_total = None
+                            requests_total = replica.generate_requests
                     self._json(200, {
                         "role": replica.role,
                         "queue_depth": active,  # the fake has no queue
@@ -722,6 +779,11 @@ class FakeReplica:
                         # shape hot/cold fleets for the planner.
                         "queue_wait_ewma_s": replica.wait_ewma_s,
                         "drain_rate_rps": replica.drain_rate_rps,
+                        # Canary-prober contract (EngineServer summary):
+                        # the oracle key + the liveness counter the
+                        # staleness detector watches.
+                        "params_fingerprint": replica.params_fp,
+                        "requests_total": requests_total,
                         # Cumulative SLI counters (EngineServer summary
                         # contract): the router deltas these into its
                         # fleet SLO tracker.
@@ -929,6 +991,30 @@ class FakeReplica:
         )
         self._thread.start()
         return self
+
+    # --- silent-data-corruption seam (canary prober ground truth) ---
+    def _corrupt_this_serve(self) -> bool:
+        """Should THIS /generate response get its first token flipped?
+        Two triggers, or'd: the scoped ``engine.readback.<host:port>``
+        failpoint in ``corrupt`` mode (what chaos scenarios arm — the
+        same registry name the real engine's readback honours) and the
+        ``corrupt_after``/``corrupt_count`` knob (unit tests).  Counted
+        in ``corrupted_serves`` either way so tests can assert exactly
+        how many poisoned responses left the building."""
+        hit = failpoints.fire_scoped("engine.readback", scope=self.name)
+        corrupt = hit is not None and hit.mode == "corrupt"
+        if not corrupt and self.corrupt_after is not None:
+            with self._lock:
+                past_clean = self.generate_requests > self.corrupt_after
+                in_budget = (
+                    self.corrupt_count is None
+                    or self.corrupted_serves < self.corrupt_count
+                )
+            corrupt = past_clean and in_budget
+        if corrupt:
+            with self._lock:
+                self.corrupted_serves += 1
+        return corrupt
 
     # --- the EngineServer SLO summary contract (utils/slo.py) ---
     def sli(self, objective: str, good: int = 0, bad: int = 0) -> None:

@@ -485,6 +485,61 @@ def test_bench_diff_parses_slo_block(tmp_path):
     assert "BURN-ALERT-MISSED" in bench_diff.ledger_row(a, d)
 
 
+def test_bench_diff_parses_canary_block(tmp_path):
+    """Records grew a CANARY block (ISSUE 17, benchmark.py
+    _run_canary_phase): the prober-on vs prober-off serving overhead,
+    probe count, and the injected-corruption detection self-check must
+    surface in the normalized record, the field diff, and the ledger
+    row — and the row must scream PROBE-OVERHEAD past 1% and
+    MISMATCH-MISSED when the self-check's corruption went undetected
+    (a blind canary is the worst correctness-plane regression)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 8,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 9
+    loaded["parsed"]["canary"] = {
+        "overhead": 0.006, "tokens_per_sec_canary": 99.4,
+        "tokens_per_sec_control": 100.0, "probes": 14,
+        "mismatch_detected": True, "fences": 1,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["canary_overhead"] == 0.006
+    assert b["canary_probes"] == 14
+    assert b["canary_mismatch_detected"] is True
+    assert b["canary_fences"] == 1
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "canary_overhead" in diff and "canary_mismatch_detected" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "canary overhead 0.006" in row and "14 probes" in row
+    assert "PROBE-OVERHEAD" not in row and "MISMATCH-MISSED" not in row
+    # Probing past 1% of serving throughput screams...
+    loaded["parsed"]["canary"]["overhead"] = 0.02
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "PROBE-OVERHEAD" in bench_diff.ledger_row(a, c)
+    # ...and a blind canary screams loudest.
+    loaded["parsed"]["canary"]["overhead"] = 0.006
+    loaded["parsed"]["canary"]["mismatch_detected"] = False
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "MISMATCH-MISSED" in bench_diff.ledger_row(a, d)
+
+
 def test_bench_diff_parses_restart_block(tmp_path):
     """Records grew a RESTART block (ISSUE 10, benchmark.py
     _run_restart_phase): cold vs warm post-restart TTFT p99 and the

@@ -2500,3 +2500,150 @@ def test_chaos_slo_burn_alerts_joined_per_objective(tmp_path):
         assert result["pass"], score
     finally:
         _teardown_router(replicas, router)
+
+
+# ======================================================================
+# Scenario 9: silent corruption -> canary detect -> auto-fence -> drain
+# ======================================================================
+
+
+def test_chaos_canary_silent_corruption_detect_and_fence(tmp_path):
+    """Inject silent data corruption on one of 3 replicas (the scoped
+    ``engine.readback.<victim>=corrupt`` failpoint: streams keep
+    flowing, tokens are WRONG) and score the active correctness plane
+    (ISSUE 17): the canary prober must verdict K consecutive
+    mismatches, fire the canary.mismatch incident, and auto-fence the
+    victim through POST /debug/fence so the router's fenced-demotion
+    path routes around it — precision/recall 1.0 with the two clean
+    replicas as the control, and ZERO client-visible wrong-token or
+    dropped streams across the before/after traffic phases
+    (expected_fn verifies every stream bit-exactly)."""
+    from k8s_device_plugin_tpu.router.prober import CanaryConfig
+    from k8s_device_plugin_tpu.utils import failpoints
+    from tests.fakes import fake_generate
+    from tests.sim.fleet import wait_until
+    from tests.sim.traffic import RouterTraffic
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _router_fleet(
+        3,
+        token_delay_s=0.005,
+        canary=True,
+        canary_config=CanaryConfig(
+            interval_s=0.1,
+            probe_tokens=4,
+            prompts=((11, 13, 17, 19),),
+            k_mismatch=2,
+        ),
+    )
+    victim = replicas[0]
+    try:
+        # Phase 1 — clean serving: verified traffic through the router
+        # while the prober captures its oracle and verdicts the whole
+        # fleet `match`.
+        traffic = RouterTraffic(
+            "127.0.0.1", router.port,
+            seed=29, sessions=5, prefix_len=32,
+            expected_fn=fake_generate,
+        )
+        report_before = traffic.run(
+            30, concurrency=5, max_new=(6, 10), timeout_s=60.0
+        )
+        assert report_before.dropped == 0, report_before.as_dict()
+        assert wait_until(
+            lambda: all(
+                row["verdict"] == "match"
+                for row in router.prober.snapshot()["replicas"].values()
+            ) and len(router.prober.snapshot()["replicas"]) == 3,
+            timeout=10,
+        ), router.prober.snapshot()
+        # Phase 2 — inject SDC on the victim only (no traffic in
+        # flight: the prober must catch and fence the sick replica
+        # BEFORE any client sees a wrong token).
+        t0 = time.time()
+        failpoints.arm(f"engine.readback.{victim.name}", "corrupt")
+        injected = [{
+            "cls": "silent_corruption", "replica": victim.name,
+            "t0": t0, "t1": t0 + 10.0,
+        }]
+        assert wait_until(
+            lambda: router.prober.snapshot()["fences_fired"] >= 1,
+            timeout=10,
+        ), "canary never fenced the corrupted replica"
+        t_detect = time.time()
+        failpoints.disarm(f"engine.readback.{victim.name}")
+        assert victim._fenced.is_set()
+        assert victim.fence_reason == "canary-mismatch"
+        assert victim.corrupted_serves >= 2  # K probes saw wrong tokens
+        # The router's own poll demotes the fenced victim (PR 10).
+        assert wait_until(
+            lambda: router.replicas[victim.name].fenced, timeout=5
+        ), "router poll never observed the canary fence"
+        # Phase 3 — traffic resumes on the 2-replica fleet: bit-exact,
+        # zero drops; the fenced victim serves nothing.
+        served_before = victim.generate_requests
+        report_after = traffic.run(
+            30, concurrency=5, max_new=(6, 10), timeout_s=60.0
+        )
+        assert report_after.dropped == 0, report_after.as_dict()
+        assert report_after.completed == report_after.submitted
+        # The fenced victim served NOTHING in phase 3: fenced replicas
+        # 503, the router stops picking them, and the prober's sweep
+        # verdicts skip_fenced without dialing /generate.
+        assert victim.generate_requests == served_before
+        # Detection scoring: confirmed canary.mismatch incidents (the
+        # flight carries the replica key) against the injected window;
+        # the two clean replicas are the precision control.
+        detected = [
+            {"cls": "silent_corruption", "replica": e["replica"],
+             "ts": e["ts"]}
+            for e in flight.snapshot()["events"]
+            if e["kind"] == "canary.mismatch"
+        ]
+        score = chaos_report.score_detections(
+            injected, detected, grace_s=2.0
+        )
+        sdc = score["per_class"]["silent_corruption"]
+        assert sdc["precision"] == 1.0, score
+        assert sdc["recall"] == 1.0, score
+        clean = {r.name for r in replicas[1:]}
+        assert not [
+            d for d in detected if d["replica"] in clean
+        ], detected
+        snap = router.prober.snapshot()
+        slo = {
+            "targets": {
+                "wrong_token_streams": 0,
+                "dropped_streams": 0,
+                "detect_to_fence_s": 5.0,
+            },
+            "measured": {
+                "dropped_before": report_before.dropped,
+                "dropped_after": report_after.dropped,
+                "detect_latency_s": round(t_detect - t0, 3),
+                "fences_fired": snap["fences_fired"],
+                "victim_corrupted_serves": victim.corrupted_serves,
+                "victim_served_after_fence": (
+                    victim.generate_requests - served_before
+                ),
+                "traffic_before": report_before.as_dict(),
+                "traffic_after": report_after.as_dict(),
+            },
+            "pass": (
+                report_before.dropped == 0 and report_after.dropped == 0
+            ),
+        }
+        result = {
+            "scenario": "canary_silent_corruption", "replicas": 3,
+            "injected": injected, "detected": detected,
+            "score": score, "slo": slo,
+            "pass": (
+                sdc["precision"] == 1.0 and sdc["recall"] == 1.0
+                and slo["pass"]
+            ),
+        }
+        _publish(result)
+        assert result["pass"], result
+    finally:
+        failpoints.disarm_all()
+        _teardown_router(replicas, router)

@@ -162,6 +162,30 @@ def test_truncate_spec_grammar():
             parse_spec(bad)
 
 
+def test_corrupt_mode_is_advisory_with_nbytes_arg():
+    """Corrupt (ISSUE 17) is advisory like truncate: the hit carries
+    the byte budget and the call site (engine.readback, the selftest
+    probe) flips its own bits — silent-data-corruption injection for
+    the canary/selftest chaos scenarios."""
+    reg = FailpointRegistry("t")
+    reg.arm("p", "corrupt", arg="2", count=1)
+    hit = reg.fire("p")
+    assert hit.mode == "corrupt" and hit.value is True and hit.arg == "2"
+    assert reg.fire("p") is None  # budget spent
+    reg.arm("p", "corrupt")  # bare: call sites default to 1 byte
+    assert reg.fire("p").arg is None
+
+
+def test_corrupt_spec_grammar():
+    assert parse_spec("engine.readback=corrupt:2*3") == [
+        ("engine.readback", "corrupt", "2", 3)
+    ]
+    assert parse_spec("p=corrupt") == [("p", "corrupt", None, None)]
+    for bad in ("p=corrupt:0", "p=corrupt:-1", "p=corrupt:one"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+
 def test_rearm_replaces():
     reg = FailpointRegistry("t")
     reg.arm("p", "error")
@@ -376,6 +400,33 @@ def test_engine_readback_delay_failpoint_stalls_but_stays_correct(
     assert elapsed >= 0.06  # >= 3 of the 4 x 20ms delays actually hit
     assert failpoints.DEFAULT.triggers("engine.readback") == 4
     assert not failpoints.is_armed("engine.readback")  # self-disarmed
+
+
+def test_engine_readback_corrupt_failpoint_flips_tokens(shared_engine):
+    """engine.readback=corrupt (ISSUE 17): the silent-data-corruption
+    injection the canary prober is scored against.  The stream keeps
+    flowing — same length, no error — but the tokens are WRONG, and the
+    corruption is in the post-unpack int64 token array (a float32
+    logprob bit would round away)."""
+    _, _, eng = shared_engine
+
+    def _serve(prompt, n):
+        req = eng.submit(prompt, n)
+        guard = 500
+        while not req.done and guard:
+            eng.step()
+            guard -= 1
+        assert req.done
+        return list(req.tokens)
+
+    baseline = _serve([3, 141, 59], 6)
+    failpoints.arm("engine.readback", "corrupt", count=1)
+    corrupted = _serve([3, 141, 59], 6)
+    assert len(corrupted) == len(baseline)  # stream flowed on
+    assert corrupted != baseline  # ...but the answer is wrong
+    # Self-disarmed after the count budget: bit-exact again.
+    assert not failpoints.is_armed("engine.readback")
+    assert _serve([3, 141, 59], 6) == baseline
 
 
 # ------------------------------------------------- chaos suite guardrails

@@ -774,6 +774,14 @@ def test_debug_state_summary_mode(served):
     for pair in slo["objectives"].values():
         good, total = pair
         assert 0 <= good <= total
+    # Canary-prober oracle key + staleness feed (ISSUE 17): the weights
+    # fingerprint is stable (params never change in-process), and the
+    # cumulative request counter depends on module traffic order — the
+    # advancing behaviour is pinned in
+    # test_summary_params_fingerprint_and_requests_total.
+    fp = summary.pop("params_fingerprint")
+    assert isinstance(fp, str) and fp
+    assert isinstance(summary.pop("requests_total"), int)
     assert summary == {
         "role": "unified",
         "queue_depth": 0,
@@ -782,6 +790,55 @@ def test_debug_state_summary_mode(served):
         "fenced": False,
         "loop_alive": True,
     }
+
+
+def test_summary_params_fingerprint_and_requests_total(served):
+    """The ?summary=1 canary contract (ISSUE 17): params_fingerprint is
+    the real snapshot-format fingerprint of the engine's own weights,
+    stable across polls; requests_total advances with every served
+    request (the prober's staleness detector watches it freeze)."""
+    from k8s_device_plugin_tpu.models import engine_snapshot as snap_mod
+
+    _, params, server = served
+    s1 = _get_json(server.port, "/debug/state?summary=1")
+    assert s1["params_fingerprint"] == snap_mod.params_fingerprint(params)
+    _post(server.port, {"prompt": [5, 6, 7], "max_new_tokens": 3})
+    s2 = _get_json(server.port, "/debug/state?summary=1")
+    assert s2["params_fingerprint"] == s1["params_fingerprint"]
+    assert s2["requests_total"] == s1["requests_total"] + 1
+
+
+def test_canary_prober_against_real_engine(served):
+    """The shared-compile integration: the canary prober captures its
+    oracle from the real engine's own first greedy response and every
+    later probe matches bit-exactly — same warmed prompt bucket as the
+    module's other traffic, zero new XLA compiles."""
+    from k8s_device_plugin_tpu.router.prober import (
+        CanaryConfig,
+        CanaryProber,
+    )
+
+    _, _, server = served
+    name = f"127.0.0.1:{server.port}"
+    prober = CanaryProber(
+        lambda: [name],
+        config=CanaryConfig(
+            interval_s=0.05,
+            probe_tokens=3,
+            prompts=((5, 6, 7),),  # the module's warmed bucket
+            via_router=False,
+        ),
+    )
+    assert prober.probe_once() == {name: "capture"}
+    assert prober.probe_once() == {name: "match"}
+    snap = prober.snapshot()
+    [oracle] = snap["oracles"]
+    # The oracle IS the engine's unary answer for the same prompt —
+    # greedy decode is a pure function of (weights, prompt).
+    unary = _post(server.port, {"prompt": [5, 6, 7], "max_new_tokens": 3})
+    assert oracle["tokens"] == unary["tokens"]
+    row = snap["replicas"][name]
+    assert row["mismatches"] == 0 and row["ttft_s"] is not None
 
 
 def test_debug_slo_and_usage_endpoints(served):

@@ -1,0 +1,522 @@
+"""Fleet canary prober (router/prober.py): the active correctness
+plane's fleet half.
+
+Layout mirrors test_slo.py: the unit suite drives
+:meth:`CanaryProber.probe_once` sweep by sweep against FakeReplica
+doubles — no daemon thread, no sleeps-for-sweeps, jax-free.  The
+FakeReplica corruption knob (``corrupt_after``/``corrupt_count``) is
+the ground truth: its greedy stream is a pure function of the prompt
+(fake_generate), exactly the determinism the oracle scheme leans on.
+The RouterServer integration runs the real daemon (`canary=True`) over
+fakes and pins /debug/canary + the metric families + the live-scrape
+metrics lint (satellite 5's router half).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_device_plugin_tpu.router.prober import (
+    DEFAULT_PROMPTS,
+    VERDICTS,
+    CanaryConfig,
+    CanaryProber,
+)
+from k8s_device_plugin_tpu.utils.anomaly import AnomalyMonitor
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+from tests.fakes import FakeReplica, fake_generate
+
+
+def _get(port, path, timeout=5):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def _wait(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _prober(replicas, **cfg_kw):
+    """Prober over a fixed fake fleet: one prompt (so every sweep
+    re-probes the same oracle), no router path, incidents captured."""
+    cfg_kw.setdefault("interval_s", 0.05)
+    cfg_kw.setdefault("prompts", ((11, 13, 17, 19),))
+    cfg = CanaryConfig(**cfg_kw)
+    flight = FlightRecorder(capacity=1024, name="canary-test")
+    monitor = AnomalyMonitor(flight=flight)
+    prober = CanaryProber(
+        lambda: [r.name for r in replicas],
+        config=cfg,
+        flight=flight,
+        anomaly=monitor,
+    )
+    return prober, monitor, flight
+
+
+def _mismatch_incidents(monitor):
+    return [
+        i for i in monitor.incidents() if i["metric"] == "canary.mismatch"
+    ]
+
+
+# ======================================================================
+# Config validation
+# ======================================================================
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        CanaryConfig(k_mismatch=0)
+    with pytest.raises(ValueError):
+        CanaryConfig(stale_sweeps=1)
+    with pytest.raises(ValueError):
+        CanaryConfig(probe_tokens=0)
+    with pytest.raises(ValueError):
+        CanaryConfig(prompts=())
+    assert len(DEFAULT_PROMPTS) >= 2
+    assert len(VERDICTS) == 6
+
+
+# ======================================================================
+# Oracle capture and match (probe_once seam; no thread)
+# ======================================================================
+
+
+def test_capture_then_match_against_fleet_oracle():
+    """First clean probe becomes the oracle; every later probe (same
+    fingerprint) must reproduce it bit-exactly.  The oracle equals the
+    fake's own greedy generation — captured, not configured."""
+    replica = FakeReplica().start()
+    try:
+        prober, _, _ = _prober([replica])
+        assert prober.probe_once() == {replica.name: "capture"}
+        assert prober.probe_once() == {replica.name: "match"}
+        snap = prober.snapshot()
+        assert snap["sweeps"] == 2
+        [oracle] = snap["oracles"]
+        assert oracle["tokens"] == fake_generate((11, 13, 17, 19), 4)
+        assert oracle["params_fingerprint"] == replica.params_fp
+        row = snap["replicas"][replica.name]
+        assert row["verdict"] == "match"
+        assert row["probes"] == 2 and row["mismatches"] == 0
+        assert row["ttft_s"] is not None and row["itl_s"] is not None
+        assert row["fenced_by_canary"] is False
+    finally:
+        replica.stop()
+
+
+def test_oracle_shared_across_replicas_same_fingerprint():
+    """Replica B is verdicted against the oracle replica A captured
+    (same weights + greedy => same tokens) — the cross-replica SDC
+    detection the fleet-wide oracle map exists for."""
+    a, b = FakeReplica().start(), FakeReplica().start()
+    try:
+        prober, _, _ = _prober([a, b])
+        verdicts = prober.probe_once()
+        assert sorted(verdicts.values()) == ["capture", "match"]
+        assert len(prober.snapshot()["oracles"]) == 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_oracle_refreshes_on_params_fingerprint_change():
+    """A redeploy = new fingerprint on the summary poll = fresh oracle
+    capture; no operator-maintained goldens, no false mismatch."""
+    replica = FakeReplica().start()
+    try:
+        prober, monitor, _ = _prober([replica])
+        prober.probe_once()
+        prober.probe_once()
+        replica.params_fp = "fake-params-fp-v2"  # "redeploy"
+        assert prober.probe_once() == {replica.name: "capture"}
+        assert prober.probe_once() == {replica.name: "match"}
+        snap = prober.snapshot()
+        assert len(snap["oracles"]) == 2  # old retained, new captured
+        assert (
+            snap["replicas"][replica.name]["params_fingerprint"]
+            == "fake-params-fp-v2"
+        )
+        assert _mismatch_incidents(monitor) == []
+    finally:
+        replica.stop()
+
+
+# ======================================================================
+# K-consecutive mismatch gate + auto-fence
+# ======================================================================
+
+
+def test_single_blip_never_fires_and_streak_resets():
+    """ONE corrupted response (a probe racing a restart, a torn read)
+    must neither incident nor fence — and a clean probe resets the
+    streak to zero."""
+    replica = FakeReplica().start()
+    replica.corrupt_after = 1  # first serve clean (oracle), then...
+    replica.corrupt_count = 1  # ...exactly one corrupted serve
+    try:
+        prober, monitor, _ = _prober([replica], k_mismatch=2)
+        assert prober.probe_once() == {replica.name: "capture"}
+        assert prober.probe_once() == {replica.name: "mismatch"}
+        assert prober.probe_once() == {replica.name: "match"}
+        snap = prober.snapshot()
+        row = snap["replicas"][replica.name]
+        assert row["mismatch_streak"] == 0 and row["mismatches"] == 1
+        assert _mismatch_incidents(monitor) == []
+        assert snap["fences_fired"] == 0
+        assert not replica._fenced.is_set()
+    finally:
+        replica.stop()
+
+
+def test_k_consecutive_mismatches_incident_then_auto_fence():
+    """K consecutive wrong answers: the canary.mismatch incident fires
+    EXACTLY once (at streak == K), the auto-fence lands through the
+    replica's own POST /debug/fence, and the next sweep skips the
+    fenced replica."""
+    replica = FakeReplica().start()
+    replica.corrupt_after = 1  # clean oracle capture, then corrupt
+    try:
+        prober, monitor, _ = _prober([replica], k_mismatch=3)
+        assert prober.probe_once() == {replica.name: "capture"}
+        for expect_streak in (1, 2):
+            assert prober.probe_once() == {replica.name: "mismatch"}
+            assert _mismatch_incidents(monitor) == []
+            assert not replica._fenced.is_set()
+            row = prober.snapshot()["replicas"][replica.name]
+            assert row["mismatch_streak"] == expect_streak
+        # Third consecutive mismatch: incident + fence, same sweep.
+        assert prober.probe_once() == {replica.name: "mismatch"}
+        [incident] = _mismatch_incidents(monitor)
+        assert incident["replica"] == replica.name
+        assert replica._fenced.is_set()
+        assert replica.fence_reason == "canary-mismatch"
+        snap = prober.snapshot()
+        assert snap["fences_fired"] == 1
+        assert snap["replicas"][replica.name]["fenced_by_canary"] is True
+        # Fenced now: probing it proves nothing — and no second
+        # incident for the same episode.
+        assert prober.probe_once() == {replica.name: "skip_fenced"}
+        assert len(_mismatch_incidents(monitor)) == 1
+    finally:
+        replica.stop()
+
+
+def test_fence_policy_off_is_observe_only():
+    """--canary-fence 0: the incident still fires (operators still get
+    paged) but the prober never dials /debug/fence."""
+    replica = FakeReplica().start()
+    replica.corrupt_after = 1
+    try:
+        prober, monitor, _ = _prober([replica], k_mismatch=2, fence=False)
+        prober.probe_once()
+        prober.probe_once()
+        assert prober.probe_once() == {replica.name: "mismatch"}
+        assert len(_mismatch_incidents(monitor)) == 1
+        assert not replica._fenced.is_set()
+        assert prober.snapshot()["fences_fired"] == 0
+    finally:
+        replica.stop()
+
+
+# ======================================================================
+# Staleness detector (zombie telemetry)
+# ======================================================================
+
+
+def test_frozen_requests_total_verdicts_stale_once():
+    """Our own probes bump requests_total; a summary that stops
+    advancing while probes land is zombie telemetry — canary.stale
+    incident after stale_sweeps consecutive frozen sweeps, no fence."""
+    replica = FakeReplica().start()
+    try:
+        prober, monitor, _ = _prober([replica], stale_sweeps=2)
+        prober.probe_once()  # capture (requests_total baseline)
+        replica.freeze_summary_counters = True
+        # The freeze latches AFTER the capture probe bumped the
+        # counter, so this sweep still sees one last advance...
+        assert prober.probe_once() == {replica.name: "match"}
+        assert prober.probe_once() == {replica.name: "match"}  # streak 1
+        assert prober.probe_once() == {replica.name: "stale"}  # streak 2
+        assert prober.probe_once() == {replica.name: "stale"}
+        stale = [
+            i for i in monitor.incidents()
+            if i["metric"] == "canary.stale"
+        ]
+        assert len(stale) == 1 and stale[0]["replica"] == replica.name
+        assert not replica._fenced.is_set()
+        # Telemetry thaws: verdict recovers, episode flag resets.
+        replica.freeze_summary_counters = False
+        assert prober.probe_once() == {replica.name: "match"}
+        assert (
+            prober.snapshot()["replicas"][replica.name]["stale_streak"]
+            == 0
+        )
+    finally:
+        replica.stop()
+
+
+def test_dead_replica_is_error_not_crash():
+    replica = FakeReplica().start()
+    name = replica.name
+    replica.stop()
+    prober, monitor, _ = _prober([replica])
+    assert prober.probe_once() == {name: "error"}
+    assert monitor.incidents() == []
+
+
+# ======================================================================
+# Through-router probe: verdict only, never attribution
+# ======================================================================
+
+
+def test_router_path_mismatch_fires_no_incident_and_no_fence():
+    """The end-to-end probe can SAY the serving path is wrong but can
+    never pin it on a replica: verdict lands in router_verdict, zero
+    incidents, zero fences — attribution belongs to direct probes."""
+    replica = FakeReplica().start()
+    # The "router" double serves the same /generate contract but
+    # corrupts every response — an end-to-end path that is wrong even
+    # though the direct-probed replica is clean.
+    router_double = FakeReplica().start()
+    router_double.corrupt_after = 0
+    try:
+        cfg = CanaryConfig(
+            interval_s=0.05, prompts=((11, 13, 17, 19),), via_router=True
+        )
+        flight = FlightRecorder(capacity=256, name="canary-test")
+        monitor = AnomalyMonitor(flight=flight)
+        prober = CanaryProber(
+            lambda: [replica.name],
+            config=cfg,
+            router_url=router_double.name,
+            flight=flight,
+            anomaly=monitor,
+        )
+        prober.probe_once()  # direct capture; router probe pre-oracle
+        prober.probe_once()
+        snap = prober.snapshot()
+        assert snap["replicas"][replica.name]["verdict"] == "match"
+        assert snap["router_verdict"] == "mismatch"
+        assert monitor.incidents() == []
+        assert snap["fences_fired"] == 0
+        assert not router_double._fenced.is_set()
+    finally:
+        replica.stop()
+        router_double.stop()
+
+
+# ======================================================================
+# RouterServer integration: daemon thread, /debug/canary, metrics
+# ======================================================================
+
+
+@pytest.fixture
+def canary_fleet():
+    from k8s_device_plugin_tpu.router.server import RouterServer
+
+    replica = FakeReplica().start()
+    router = RouterServer(
+        [replica.name],
+        host="127.0.0.1",
+        port=0,
+        poll_interval_s=0.05,
+        hedge=False,
+        canary=True,
+        canary_config=CanaryConfig(
+            interval_s=0.05, prompts=((11, 13, 17, 19),), k_mismatch=2
+        ),
+    ).start()
+    yield replica, router
+    router.stop()
+    if not replica.killed.is_set():
+        replica.stop()
+
+
+def test_router_serves_debug_canary_and_metrics(canary_fleet):
+    replica, router = canary_fleet
+    _wait(
+        lambda: (_get(router.port, "/debug/canary")["replicas"] or {})
+        .get(replica.name, {})
+        .get("verdict")
+        == "match",
+        msg="canary match verdict over the wire",
+    )
+    snap = _get(router.port, "/debug/canary")
+    assert snap["config"]["via_router"] is True
+    assert snap["config"]["fence"] is True
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{router.port}/metrics", timeout=5
+    ) as resp:
+        text = resp.read().decode()
+    assert 'tpu_router_canary_probes_total{' in text
+    assert 'verdict="match"' in text
+    assert "tpu_router_canary_probe_ttft_seconds_bucket" in text
+    assert "tpu_router_canary_probe_itl_seconds_count" in text
+
+
+def test_canary_end_to_end_fence_demotes_through_router(canary_fleet):
+    """The acceptance wiring: corrupt replica -> prober mismatch x K ->
+    auto-fence via /debug/fence -> the router's own poll sees
+    fenced=true (the PR-10 fenced-demotion path owns the drain)."""
+    replica, router = canary_fleet
+    _wait(
+        lambda: (_get(router.port, "/debug/canary")["replicas"] or {})
+        .get(replica.name, {})
+        .get("verdict")
+        == "match",
+        msg="clean canary baseline",
+    )
+    replica.corrupt_after = 0  # every serve corrupt from here
+    _wait(
+        lambda: _get(router.port, "/debug/canary")["fences_fired"] >= 1,
+        msg="canary auto-fence",
+    )
+    assert replica._fenced.is_set()
+    assert replica.fence_reason == "canary-mismatch"
+    _wait(
+        lambda: _get(router.port, "/debug/fleet")["replicas"][
+            replica.name
+        ].get("fenced"),
+        msg="router poll observes the fence",
+    )
+
+
+def test_router_canary_off_by_default():
+    from k8s_device_plugin_tpu.router.server import RouterServer
+
+    replica = FakeReplica().start()
+    router = RouterServer(
+        [replica.name],
+        host="127.0.0.1",
+        port=0,
+        poll_interval_s=0.05,
+        hedge=False,
+    ).start()
+    try:
+        assert router.prober is None
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(router.port, "/debug/canary")
+        assert err.value.code == 404
+    finally:
+        router.stop()
+        replica.stop()
+
+
+def test_metrics_lint_clean_on_live_canary_router(canary_fleet):
+    """Satellite: the router /metrics with canary probe counters and
+    latency histograms populated stays metrics-lint clean, and the
+    families carry explicit cardinality budgets."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(repo, "tools", "metrics_lint.py")
+    )
+    lint_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint_mod)
+
+    replica, router = canary_fleet
+    _wait(
+        lambda: (_get(router.port, "/debug/canary")["replicas"] or {})
+        .get(replica.name, {})
+        .get("probes", 0)
+        >= 2,
+        msg="probes recorded",
+    )
+    assert (
+        lint_mod.lint_url(f"http://127.0.0.1:{router.port}/metrics") == []
+    )
+    assert "tpu_router_canary_probes_total" in lint_mod.FAMILY_BUDGETS
+    assert "tpu_router_canary_fences_total" in lint_mod.FAMILY_BUDGETS
+
+
+# ======================================================================
+# tools/canary_report.py (stdlib CLI; loaded by path like the others)
+# ======================================================================
+
+
+def _load_canary_report():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "canary_report", os.path.join(repo, "tools", "canary_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_canary_report_exit_codes_and_rendering(tmp_path, capsys):
+    tool = _load_canary_report()
+    replica = FakeReplica().start()
+    replica.corrupt_after = 1
+    try:
+        prober, _, _ = _prober([replica], k_mismatch=2)
+        prober.probe_once()  # clean: capture
+        ok = tmp_path / "ok.json"
+        ok.write_text(json.dumps(prober.snapshot()))
+        assert tool.main([str(ok)]) == 0
+        assert "fleet verdict: OK" in capsys.readouterr().out
+
+        prober.probe_once()  # mismatch streak 1: degraded
+        degraded = tmp_path / "degraded.json"
+        degraded.write_text(json.dumps(prober.snapshot()))
+        assert tool.main([str(degraded)]) == 3
+        assert "fleet verdict: DEGRADED" in capsys.readouterr().out
+
+        prober.probe_once()  # streak 2 == K: incident + fence
+        corrupt = tmp_path / "corrupt.json"
+        corrupt.write_text(json.dumps(prober.snapshot()))
+        assert tool.main([str(corrupt)]) == 4
+        out = capsys.readouterr().out
+        assert "fleet verdict: CORRUPT" in out
+        assert "YES" in out  # the fenced column names the quarantine
+        # --json round-trips the snapshot.
+        assert tool.main([str(corrupt), "--json"]) == 4
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["fences_fired"] == 1
+    finally:
+        replica.stop()
+
+
+def test_canary_report_live_url_and_prober_off(canary_fleet, capsys):
+    tool = _load_canary_report()
+    replica, router = canary_fleet
+    _wait(
+        lambda: (_get(router.port, "/debug/canary")["replicas"] or {})
+        .get(replica.name, {})
+        .get("verdict")
+        == "match",
+        msg="live match verdict",
+    )
+    assert tool.main(["--url", f"127.0.0.1:{router.port}"]) == 0
+    out = capsys.readouterr().out
+    assert replica.name in out and "match" in out
+    # A prober-off router's error body renders on stderr, exit 1.
+    import tempfile
+    import os
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        json.dump({"error": "canary prober off (--canary)"}, f)
+        path = f.name
+    try:
+        assert tool.main([path]) == 1
+    finally:
+        os.unlink(path)

@@ -1,0 +1,322 @@
+"""Idle-chip self-test sweep (plugin/selftest.py): the plugin half of
+the active correctness plane.
+
+All unit tests drive :meth:`SelftestSweeper.poll_once` directly —
+no daemon thread, no sleeps, jax-free (the probe is a seeded numpy
+matmul checksum).  ``probe_fn`` is the corruption seam for unit tests;
+the ``selftest.probe`` failpoint covers the chaos-injection path; the
+quarantine tests close the loop through the REAL ChipHealthChecker
+override-file contract (plugin/health.py reads what the sweeper
+writes).  The MetricsServer test is the plugin half of satellite 5's
+both-expositions live-scrape lint.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from k8s_device_plugin_tpu.plugin.discovery import TpuChip
+from k8s_device_plugin_tpu.plugin.health import (
+    HEALTH_OVERRIDE_DIR,
+    ChipHealthChecker,
+)
+from k8s_device_plugin_tpu.plugin.selftest import (
+    FAILPOINT_PROBE,
+    SelftestConfig,
+    SelftestSweeper,
+    matmul_checksum,
+)
+from k8s_device_plugin_tpu.utils import failpoints
+from k8s_device_plugin_tpu.utils.anomaly import AnomalyMonitor
+from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+
+def _chip(i):
+    return TpuChip(index=i, device_path=f"/dev/accel{i}")
+
+
+def _sweeper(chips, tmp_path, busy=None, probe_fn=None, **cfg_kw):
+    cfg_kw.setdefault("interval_s", 0.05)
+    flight = FlightRecorder(capacity=512, name="selftest-test")
+    monitor = AnomalyMonitor(flight=flight)
+    sweeper = SelftestSweeper(
+        lambda: chips,
+        lambda: set(busy or ()),
+        config=SelftestConfig(**cfg_kw),
+        root=str(tmp_path),
+        flight=flight,
+        anomaly=monitor,
+        probe_fn=probe_fn,
+    )
+    return sweeper, monitor, flight
+
+
+def _fail_incidents(monitor):
+    return [
+        i for i in monitor.incidents() if i["metric"] == "selftest.fail"
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SelftestConfig(fail_threshold=0)
+    with pytest.raises(ValueError):
+        SelftestConfig(seeds=())
+
+
+def test_matmul_checksum_deterministic_per_seed():
+    """The self-golden property: same seed => same checksum on every
+    call and every host; different seeds => different workloads."""
+    assert matmul_checksum(0) == matmul_checksum(0)
+    assert matmul_checksum(1) == matmul_checksum(1)
+    assert matmul_checksum(0) != matmul_checksum(1)
+
+
+def test_idle_chips_pass_and_seeds_rotate(tmp_path):
+    chips = [_chip(0), _chip(1)]
+    seen_seeds = []
+    sweeper, monitor, _ = _sweeper(
+        chips,
+        tmp_path,
+        probe_fn=lambda chip, seed: seen_seeds.append(seed)
+        or matmul_checksum(seed),
+        seeds=(0, 1),
+    )
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "pass"}
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "pass"}
+    # Seed rotated between sweeps (both chips share a sweep's seed).
+    assert seen_seeds == [0, 0, 1, 1]
+    assert monitor.incidents() == []
+    snap = sweeper.snapshot()
+    assert snap["sweeps"] == 2 and snap["quarantines"] == 0
+    assert snap["chips"]["tpu-0"]["probes"] == 2
+    assert snap["chips"]["tpu-0"]["verdict"] == "pass"
+
+
+def test_busy_chips_never_probed(tmp_path):
+    """The ledger is the arbiter: an allocated chip is never charged a
+    probe — the sweep can't race a workload for the device."""
+    probed = []
+    sweeper, _, _ = _sweeper(
+        [_chip(0), _chip(1)],
+        tmp_path,
+        busy={"tpu-1"},
+        probe_fn=lambda chip, seed: probed.append(chip.k8s_id)
+        or matmul_checksum(seed),
+    )
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "skip_busy"}
+    assert probed == ["tpu-0"]
+    assert sweeper.snapshot()["chips"]["tpu-1"]["probes"] == 0
+
+
+def test_threshold_gate_then_quarantine_via_health_override(tmp_path):
+    """fail_threshold consecutive bad checksums: the selftest.fail
+    incident fires exactly once (at streak == threshold), the override
+    file lands, and the REAL health checker now reports the chip
+    Unhealthy — the kubelet pulls it from the allocatable list."""
+    sick = {"tpu-1"}
+    chips = [_chip(0), _chip(1)]
+
+    def probe(chip, seed):
+        good = matmul_checksum(seed)
+        return good ^ 0xFF if chip.k8s_id in sick else good
+
+    sweeper, monitor, _ = _sweeper(
+        chips, tmp_path, probe_fn=probe, fail_threshold=2
+    )
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "fail"}
+    # One blip never acts.
+    assert _fail_incidents(monitor) == []
+    override = tmp_path / HEALTH_OVERRIDE_DIR / "accel1"
+    assert not override.exists()
+    # Second consecutive failure: incident + quarantine.
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "fail"}
+    [incident] = _fail_incidents(monitor)
+    assert incident["device"] == "tpu-1"
+    assert override.read_text() == "Unhealthy"
+    snap = sweeper.snapshot()
+    assert snap["quarantines"] == 1
+    assert snap["chips"]["tpu-1"]["quarantined"] is True
+    assert snap["chips"]["tpu-0"]["quarantined"] is False
+    # Third failure: no second incident, no double quarantine.
+    sweeper.poll_once()
+    assert len(_fail_incidents(monitor)) == 1
+    assert sweeper.snapshot()["quarantines"] == 1
+    # The loop closes through the real health checker: device nodes
+    # exist, but the override file the sweeper wrote wins.
+    for chip in chips:
+        dev = tmp_path / chip.device_path.lstrip("/")
+        dev.parent.mkdir(parents=True, exist_ok=True)
+        dev.write_text("")
+    checker = ChipHealthChecker(root=str(tmp_path))
+    health = checker.check_many(chips)
+    assert health["tpu-0"] is True
+    assert health["tpu-1"] is False
+
+
+def test_single_blip_resets_streak(tmp_path):
+    flaky = [True]  # fail exactly the first probe
+
+    def probe(chip, seed):
+        bad = flaky[0]
+        flaky[0] = False
+        return matmul_checksum(seed) ^ 0x1 if bad else matmul_checksum(seed)
+
+    sweeper, monitor, _ = _sweeper(
+        [_chip(0)], tmp_path, probe_fn=probe, fail_threshold=2
+    )
+    assert sweeper.poll_once() == {"tpu-0": "fail"}
+    assert sweeper.poll_once() == {"tpu-0": "pass"}
+    assert sweeper.snapshot()["chips"]["tpu-0"]["fail_streak"] == 0
+    assert _fail_incidents(monitor) == []
+    assert not (tmp_path / HEALTH_OVERRIDE_DIR / "accel0").exists()
+
+
+def test_quarantine_policy_off_is_observe_only(tmp_path):
+    sweeper, monitor, _ = _sweeper(
+        [_chip(0)],
+        tmp_path,
+        probe_fn=lambda c, s: matmul_checksum(s) ^ 0x1,
+        fail_threshold=1,
+        quarantine=False,
+    )
+    assert sweeper.poll_once() == {"tpu-0": "fail"}
+    assert len(_fail_incidents(monitor)) == 1
+    assert not (tmp_path / HEALTH_OVERRIDE_DIR / "accel0").exists()
+    assert sweeper.snapshot()["quarantines"] == 0
+
+
+def test_failpoint_corrupt_seam_scopes_to_one_chip(tmp_path):
+    """The chaos-injection path: selftest.probe.<k8s_id>=corrupt flips
+    ONE chip's checksum through the first-class failpoint registry;
+    the other chip stays clean — per-chip attribution ground truth."""
+    sweeper, monitor, _ = _sweeper(
+        [_chip(0), _chip(1)], tmp_path, fail_threshold=2
+    )
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "pass"}
+    failpoints.arm_spec(f"{FAILPOINT_PROBE}.tpu-1=corrupt")
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "fail"}
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "fail"}
+    [incident] = _fail_incidents(monitor)
+    assert incident["device"] == "tpu-1"
+    assert (tmp_path / HEALTH_OVERRIDE_DIR / "accel1").exists()
+    failpoints.disarm_all()
+    # Quarantined chips still probe (telemetry keeps flowing); the
+    # override file is the kubelet-facing act, and recovery is manual.
+    assert sweeper.poll_once() == {"tpu-0": "pass", "tpu-1": "pass"}
+
+
+def test_failpoint_error_mode_is_probe_error_not_sick_chip(tmp_path):
+    sweeper, monitor, _ = _sweeper(
+        [_chip(0)], tmp_path, fail_threshold=1
+    )
+    failpoints.arm_spec(f"{FAILPOINT_PROBE}.tpu-0=error")
+    assert sweeper.poll_once() == {"tpu-0": "error"}
+    assert _fail_incidents(monitor) == []
+    assert not (tmp_path / HEALTH_OVERRIDE_DIR / "accel0").exists()
+
+
+def test_inventory_error_is_sweep_error_not_crash(tmp_path):
+    def boom():
+        raise RuntimeError("discovery broken")
+
+    flight = FlightRecorder(capacity=64, name="selftest-test")
+    sweeper = SelftestSweeper(
+        boom,
+        set,
+        config=SelftestConfig(interval_s=0.05),
+        root=str(tmp_path),
+        flight=flight,
+    )
+    assert sweeper.poll_once() == {}
+    assert sweeper.sweeps == 1
+
+
+def test_metrics_families_and_live_scrape_lint(tmp_path):
+    """Satellite 5, plugin half: the plugin exposition with selftest
+    verdict counters, the probe-latency histogram, and the quarantine
+    gauge populated stays metrics-lint clean."""
+    import importlib.util
+
+    from k8s_device_plugin_tpu.plugin.server import PluginMetrics
+    from k8s_device_plugin_tpu.utils.metrics import (
+        MetricsRegistry,
+        MetricsServer,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(repo, "tools", "metrics_lint.py")
+    )
+    lint_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint_mod)
+
+    registry = MetricsRegistry()
+    metrics = PluginMetrics(registry)
+    sick = {"tpu-1"}
+    sweeper = SelftestSweeper(
+        lambda: [_chip(0), _chip(1), _chip(2)],
+        lambda: {"tpu-2"},
+        config=SelftestConfig(interval_s=0.05, fail_threshold=1),
+        root=str(tmp_path),
+        metrics=metrics,
+        probe_fn=lambda c, s: matmul_checksum(s) ^ 0xFF
+        if c.k8s_id in sick
+        else matmul_checksum(s),
+    )
+    sweeper.poll_once()
+    sweeper.poll_once()
+    server = MetricsServer(
+        registry,
+        host="127.0.0.1",
+        port=0,
+        debug={"/debug/selftest": sweeper.snapshot},
+    )
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        assert lint_mod.lint_url(f"{url}/metrics") == []
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert 'tpu_chip_selftest_total{device="tpu-0",verdict="pass"} 2' in text
+        assert 'tpu_chip_selftest_total{device="tpu-1",verdict="fail"} 2' in text
+        assert 'tpu_chip_selftest_total{device="tpu-2",verdict="skip_busy"} 2' in text
+        assert "tpu_chip_selftest_seconds_bucket" in text
+        assert 'tpu_chip_selftest_quarantined{device="tpu-1"} 1' in text
+        assert "tpu_chip_selftest_total" in lint_mod.FAMILY_BUDGETS
+        # /debug/selftest rides the same MetricsServer debug map the
+        # daemon wires (cli.py).
+        with urllib.request.urlopen(
+            f"{url}/debug/selftest", timeout=5
+        ) as resp:
+            snap = json.loads(resp.read())
+        assert snap["chips"]["tpu-1"]["quarantined"] is True
+    finally:
+        server.stop()
+
+
+def test_cli_flags_wire_sweeper():
+    """--selftest-interval/-fail-threshold/-quarantine parse and land
+    in the daemon's SelftestConfig (0 = disabled, the default)."""
+    from k8s_device_plugin_tpu.plugin.cli import build_parser
+
+    args = build_parser().parse_args([])
+    assert args.selftest_interval == 0
+    assert args.selftest_fail_threshold == 2
+    assert args.selftest_quarantine == 1
+    args = build_parser().parse_args(
+        ["--selftest-interval", "30", "--selftest-fail-threshold", "3",
+         "--selftest-quarantine", "0"]
+    )
+    assert args.selftest_interval == 30
+    assert args.selftest_fail_threshold == 3
+    assert args.selftest_quarantine == 0

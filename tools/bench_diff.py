@@ -244,6 +244,23 @@ def load_record(path: str) -> dict:
             rec["slo_overhead"] = slo.get("overhead")
             rec["slo_verdicts"] = slo.get("sli_verdicts")
             rec["slo_burn_alert_fired"] = slo.get("burn_alert_fired")
+        # Canary block (CANARY serving rows, benchmark.py
+        # _run_canary_phase): measured prober-on vs prober-off serving
+        # throughput overhead, plus the injected-corruption self-check
+        # (a probe stream with one flipped token MUST verdict
+        # mismatch).  The regression tells: overhead creeping past 1%
+        # (active probing stopped being free — PROBE-OVERHEAD), or
+        # mismatch_detected flipping false (MISMATCH-MISSED, the worst
+        # possible correctness-plane regression: the detector is blind
+        # and nothing else would say so).
+        canary = parsed.get("canary")
+        if isinstance(canary, dict):
+            rec["canary_overhead"] = canary.get("overhead")
+            rec["canary_probes"] = canary.get("probes")
+            rec["canary_mismatch_detected"] = canary.get(
+                "mismatch_detected"
+            )
+            rec["canary_fences"] = canary.get("fences")
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -311,6 +328,8 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "disagg_handoff_entries", "disagg_tokens_match",
         "trace_overhead", "trace_spans",
         "slo_overhead", "slo_verdicts", "slo_burn_alert_fired",
+        "canary_overhead", "canary_probes", "canary_mismatch_detected",
+        "canary_fences",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -504,6 +523,24 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("slo_overhead") is not None
+                else ""
+            )
+            + (
+                f"; canary overhead {b['canary_overhead']} "
+                f"({b.get('canary_probes')} probes, "
+                f"{b.get('canary_fences')} fences"
+                + (
+                    ", PROBE-OVERHEAD"
+                    if (b.get("canary_overhead") or 0.0) > 0.01
+                    else ""
+                )
+                + (
+                    ""
+                    if b.get("canary_mismatch_detected", True)
+                    else ", MISMATCH-MISSED"
+                )
+                + ")"
+                if b.get("canary_overhead") is not None
                 else ""
             )
             + (

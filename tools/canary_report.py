@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Render the router's active correctness plane (/debug/canary,
+router/prober.py) into an operator-readable probe report.
+
+Works against a live router or offline against a saved snapshot:
+
+    python tools/canary_report.py --url http://router:8100
+    python tools/canary_report.py canary_snapshot.json
+    python tools/canary_report.py --url http://router:8100 --json
+
+Exit code 0 when every replica's last verdict is clean (match /
+capture / skip_fenced with no open mismatch streak), 3 when a replica
+is degraded (stale telemetry, probe errors, or an open mismatch
+streak below the fence bar), 4 when a confirmed-corruption state is
+live (a replica the canary fenced, or a mismatch streak at/over
+k_mismatch) — so a cron/CI wrapper can page on silent corruption
+without parsing anything, exactly like slo_report.py's verdict codes.
+Stdlib-only and jax-free, like every fleet-side tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+EXIT_CODES = {"ok": 0, "degraded": 3, "corrupt": 4}
+
+
+def load_live(url: str) -> dict:
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.startswith("http"):
+        base = f"http://{base}"
+    with urllib.request.urlopen(base + "/debug/canary", timeout=10) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def fleet_verdict(snap: dict) -> str:
+    """One word for the whole fleet: ok / degraded / corrupt."""
+    k = int((snap.get("config") or {}).get("k_mismatch", 3))
+    verdict = "ok"
+    for row in (snap.get("replicas") or {}).values():
+        streak = int(row.get("mismatch_streak", 0))
+        if row.get("fenced_by_canary") or streak >= k:
+            return "corrupt"
+        if streak > 0 or row.get("verdict") in ("stale", "error"):
+            verdict = "degraded"
+    if snap.get("router_verdict") == "mismatch":
+        verdict = "degraded"
+    return verdict
+
+
+def render(snap: dict) -> str:
+    cfg = snap.get("config") or {}
+    lines = [
+        f"canary sweeps: {snap.get('sweeps', 0)}  "
+        f"fences fired: {snap.get('fences_fired', 0)}  "
+        f"oracles: {len(snap.get('oracles') or [])}  "
+        f"interval: {cfg.get('interval_s', '?')}s  "
+        f"K: {cfg.get('k_mismatch', '?')}  "
+        f"auto-fence: {'on' if cfg.get('fence', True) else 'OFF'}",
+        f"{'replica':<22} {'verdict':<12} {'streak':>6} {'stale':>5} "
+        f"{'probes':>7} {'mism':>5} {'ttft_ms':>8} {'itl_ms':>7} fenced",
+    ]
+    for name, row in sorted((snap.get("replicas") or {}).items()):
+        ttft = row.get("ttft_s")
+        itl = row.get("itl_s")
+        lines.append(
+            f"{name:<22} {str(row.get('verdict')):<12} "
+            f"{row.get('mismatch_streak', 0):>6} "
+            f"{row.get('stale_streak', 0):>5} "
+            f"{row.get('probes', 0):>7} "
+            f"{row.get('mismatches', 0):>5} "
+            f"{ttft * 1e3 if ttft is not None else float('nan'):>8.2f} "
+            f"{itl * 1e3 if itl is not None else float('nan'):>7.2f} "
+            f"{'YES' if row.get('fenced_by_canary') else '-'}"
+        )
+    rv = snap.get("router_verdict")
+    lines.append(
+        f"through-router probe: {rv if rv is not None else 'off'}"
+    )
+    lines.append(f"fleet verdict: {fleet_verdict(snap).upper()}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="canary-report",
+        description="render /debug/canary probe verdicts, mismatch "
+        "streaks, and auto-fence state",
+    )
+    p.add_argument(
+        "snapshot",
+        nargs="?",
+        help="saved /debug/canary JSON (alternative to --url)",
+    )
+    p.add_argument("--url", default="", help="live router base URL")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the raw snapshot JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+    if not args.url and not args.snapshot:
+        p.error("need --url or a snapshot file")
+    try:
+        if args.url:
+            snap = load_live(args.url)
+        else:
+            with open(args.snapshot) as f:
+                snap = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"canary-report: {e}", file=sys.stderr)
+        return 1
+    if "replicas" not in snap and "error" in snap:
+        print(f"canary-report: {snap['error']}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(snap, indent=2))
+    else:
+        print(render(snap))
+    return EXIT_CODES[fleet_verdict(snap)]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

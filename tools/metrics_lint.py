@@ -85,6 +85,15 @@ FAMILY_BUDGETS = {
     "tpu_engine_tenant_decode_tokens_total": TENANT_FAMILY_BUDGET,
     "tpu_engine_tenant_kv_page_seconds_total": TENANT_FAMILY_BUDGET,
     "tpu_engine_tenant_queue_wait_seconds_total": TENANT_FAMILY_BUDGET,
+    # Active correctness plane (router/prober.py, plugin/selftest.py).
+    # Probe counters are replica x verdict / device x verdict with a
+    # CLOSED verdict set (6 canary, 4 selftest) over small fleets —
+    # a budget breach means a label leaked an unbounded value (a rid,
+    # a timestamp) into what must stay a fixed enum.
+    "tpu_router_canary_probes_total": 48,  # 8 replicas x 6 verdicts
+    "tpu_router_canary_fences_total": 8,
+    "tpu_chip_selftest_total": 32,  # 8 chips x 4 verdicts
+    "tpu_chip_selftest_quarantined": 8,
 }
 
 
