@@ -598,6 +598,246 @@ def _run_router_phase(args) -> dict | None:
     return block
 
 
+def _run_fabric_phase(args) -> dict | None:
+    """FABRIC perf phase: the fleet-wide content-addressed KV fabric
+    (router/fabric.py, ISSUE 18) vs an affinity-only control over the
+    SAME seeded traffic in which every session opens with one SHARED
+    system prompt.
+
+    What the row claims and how it is measured:
+
+    - **fleet hits/request** — with the fabric on, the shared prefix is
+      prefilled ONCE fleet-wide: the first replica to hold it advertises
+      a bloom digest, the router's locator stamps it as the handoff
+      source on every dial whose target lacks the prefix, and the target
+      pulls the pages instead of recomputing them.  Engine KV-tier hits
+      (retained + host arena) per request must be strictly ABOVE the
+      affinity-only control, where each replica pays its own cold
+      prefill of the very same system prompt.  bench_diff screams
+      NO-FABRIC-HITS when the cross-peer pull count is zero.
+    - **TTFT p99** — the router's client-observed histogram over the
+      identical sequence; the pulls must not cost latency (bench_diff
+      screams FABRIC-TTFT-REGRESSED past 1.2x the control).
+
+    The fabric pass runs FIRST so residual warmth favors the CONTROL;
+    the control pass sleeps the same locator-settle time the fabric
+    pass measured, so neither side gets a free warm-up.  Returns the
+    JSON ``fabric`` block (None when multi-replica phases are disabled
+    via --router-replicas < 2)."""
+    import dataclasses
+    import os as _os
+    import sys as _sys
+    import threading
+    import time as _time
+
+    from ..router.fabric import FabricConfig
+    from ..router.server import RouterServer
+    from ..utils.metrics import MetricsRegistry
+    from .engine import EngineMetrics, ServingEngine
+    from .http_server import EngineServer
+    from .transformer import GPTConfig, PagedConfig, TransformerLM
+
+    if getattr(args, "router_replicas", 2) < 2:
+        return None
+    # Fleet-wide dedup is only interesting past two replicas: with
+    # three, affinity alone CANNOT keep the shared prompt hot
+    # everywhere, so the control pays the recompute the fabric avoids.
+    n_replicas = max(3, getattr(args, "router_replicas", 2))
+    try:
+        from tests.sim.traffic import RouterTraffic
+    except ImportError:
+        _sys.path.insert(
+            0,
+            _os.path.dirname(
+                _os.path.dirname(
+                    _os.path.dirname(_os.path.abspath(__file__))
+                )
+            ),
+        )
+        from tests.sim.traffic import RouterTraffic
+
+    page_size = 4
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    paged = PagedConfig(
+        page_size=page_size, num_pages=64, max_pages_per_seq=16
+    )
+    servers = []
+    engines = []
+    # IDENTICAL weights on every replica — a real fleet serves one
+    # model, and the handoff fingerprint check rightly refuses KV
+    # pulled across different params.
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    for i in range(n_replicas):
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg,
+            params,
+            paged,
+            max_slots=4,
+            metrics=EngineMetrics(registry),
+            kv_retain=True,
+            kv_host_cache_mb=16,
+        )
+        engines.append(engine)
+        servers.append(
+            EngineServer(
+                engine, host="127.0.0.1", port=0, registry=registry
+            ).start()
+        )
+
+    def _post_replica(port, prompt, max_new):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": max_new}
+            ).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=120).read()
+
+    # Warmup every replica over the (batch, bucket) grid the replay can
+    # hit (shared 16 + unique 16 + suffix <= 4 tokens; admissions batch
+    # up to the client concurrency) so no XLA compile lands inside a
+    # measured pass.
+    for server in servers:
+        for group in (1, 2, 3, 4):
+            threads = [
+                threading.Thread(
+                    target=_post_replica,
+                    args=(server.port, [7 + g] * 36, 6),
+                )
+                for g in range(group)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    replica_names = [f"127.0.0.1:{s.port}" for s in servers]
+    # Every session shares the same 16-token system prompt but keeps a
+    # 16-token unique tail, so affinity homes SESSIONS apart while the
+    # fabric dedups the shared HEAD across those homes.
+    sessions, prefix_len, shared_len, n_requests = 8, 32, 16, 32
+
+    def _kv_hits():
+        return sum(e.kv_retained_hits + e.kv_host_hits for e in engines)
+
+    def _pulls():
+        return sum(e.handoff_fetches for e in engines)
+
+    def _measure(use_fabric, settle_s):
+        router = RouterServer(
+            replica_names,
+            host="127.0.0.1",
+            port=0,
+            prefix_block_tokens=page_size,
+            prefix_max_blocks=prefix_len // page_size,
+            poll_interval_s=0.2,
+            hedge=False,
+            policy_mode="affinity",
+            seed=3,
+            fabric=use_fabric,
+            fabric_config=FabricConfig(default_page_size=page_size),
+        ).start()
+        traffic = RouterTraffic(
+            "127.0.0.1",
+            router.port,
+            seed=17,
+            sessions=sessions,
+            prefix_len=prefix_len,
+            shared_prefix_len=shared_len,
+            vocab=cfg.vocab_size,
+        )
+        # Warm pass (identical shapes), then clear every KV tier so the
+        # measurement starts cold on every replica.
+        traffic.run(
+            n_requests, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        for engine in engines:
+            engine.kvcache_clear()
+        # Seed ONE owner with the shared system prompt (through the
+        # router, so affinity picks the home it would in production),
+        # then give the locator time to see the cleared digests and the
+        # new owner's advertisement.  The control pass sleeps the SAME
+        # measured settle so TTFT is compared apples to apples.
+        t0 = _time.monotonic()
+        _post_replica(router.port, traffic.prefixes[0][:shared_len], 4)
+        if use_fabric:
+            # Right after the clear the locator still holds PRE-clear
+            # views (every replica nonzero) for up to a poll tick —
+            # settled means the refreshed truth: exactly the seed
+            # owner advertises, everyone else reads empty.
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                roots = router.fabric.advertised_roots()
+                if sum(1 for v in roots.values() if v) == 1:
+                    break
+                _time.sleep(0.05)
+            settle_s = _time.monotonic() - t0
+        else:
+            _time.sleep(max(0.0, settle_s - (_time.monotonic() - t0)))
+        hits0 = _kv_hits()
+        pulls0 = _pulls()
+        ttft_snap = router.metrics.ttft_seconds.snapshot()
+        report = traffic.run(
+            n_requests, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        out = {
+            "fleet_hits": _kv_hits() - hits0,
+            "hit_rate": round((_kv_hits() - hits0) / n_requests, 3),
+            "ttft_p99_ms": (
+                None
+                if (
+                    q := router.metrics.ttft_seconds.quantile(
+                        0.99, since=ttft_snap
+                    )
+                )
+                is None
+                else round(q * 1e3, 3)
+            ),
+            "cross_peer_pulls": _pulls() - pulls0,
+            "dropped": report.dropped,
+        }
+        router.stop()
+        return out, settle_s
+
+    # Fabric FIRST: any residual warmth then biases toward the
+    # affinity-only CONTROL, never for the claim.
+    fabric_run, settle_s = _measure(True, 0.0)
+    control, _ = _measure(False, settle_s)
+    for server in servers:
+        server.stop()
+    block = {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "sessions": sessions,
+        "shared_prefix_len": shared_len,
+        "fabric": fabric_run,
+        "control": control,
+    }
+    log(
+        "perf-ledger row: | FABRIC fleet KV (K=%d, %d sessions, shared "
+        "%d) | fabric %.2f KV hits/req, TTFT p99 %s ms, %d cross-peer "
+        "pulls vs control %.2f hits/req, %s ms | - | `benchmark.py "
+        "--model serving` | update on bench round |"
+        % (
+            n_replicas,
+            sessions,
+            shared_len,
+            fabric_run["hit_rate"],
+            fabric_run["ttft_p99_ms"],
+            fabric_run["cross_peer_pulls"],
+            control["hit_rate"],
+            control["ttft_p99_ms"],
+        )
+    )
+    return block
+
+
 def _run_canary_phase(args) -> dict | None:
     """CANARY perf phase: the active correctness plane's overhead and
     detection self-check (router/prober.py, ISSUE 17).
@@ -2078,6 +2318,8 @@ def run_serving(args) -> None:
     disagg_block = _run_disagg_phase(eng, args)
     # --- Router phase (ROUTER row): affinity vs random placement -------
     router_block = _run_router_phase(args)
+    # --- Fabric phase (FABRIC row): fleet KV vs affinity-only control --
+    fabric_block = _run_fabric_phase(args)
     # --- SLO phase (SLO row): accounting overhead + alert self-check ---
     slo_block = _run_slo_phase(eng, args)
     # --- Canary phase (CANARY row): prober overhead + detection check --
@@ -2129,6 +2371,7 @@ def run_serving(args) -> None:
                 "elastic": elastic_block,
                 "disagg": disagg_block,
                 "router": router_block,
+                "fabric": fabric_block,
                 "slo": slo_block,
                 "canary": canary_block,
                 "trace": trace_block,

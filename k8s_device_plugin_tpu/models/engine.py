@@ -558,6 +558,10 @@ class ServingEngine(
         # and re-registered with different content — surviving child links
         # would then form a stale chain, so they die with the parent.
         self._child_keys: dict[int, list[tuple[int, tuple]]] = {}
+        # Trie mutation counter (register/teardown bump it): the fabric
+        # digest cache (engine_handoff.py) keys on this + the arena
+        # version so an unchanged trie never rebuilds the bloom.
+        self._trie_version = 0  # guarded by: _lock
         # KV cache tiering (engine_kvcache.py): with kv_retain, a
         # prefix-registered page whose refcount hits zero is RETAINED
         # (trie links live, reclaimed lazily under pool pressure)

@@ -861,8 +861,16 @@ class AdmissionMixin:
                 slot, job["cache"], pages, plen, n_shared, row_idx=row_idx
             )
             # Grafted: the private pages are now real K/V and may be
-            # prefix-shared by any later request.
-            self._pending_pages.difference_update(pages[n_shared:])
+            # prefix-shared by any later request.  The pending->grafted
+            # transition changes what the fabric digest may advertise
+            # (it must skip pending pages), so it has to invalidate the
+            # version-keyed digest cache like any trie edit — otherwise
+            # a digest built mid-prefill stays cached as empty forever.
+            grafted = self._pending_pages.intersection(pages[n_shared:])
+            if grafted:
+                self._pending_pages.difference_update(grafted)
+                with self._lock:
+                    self._trie_version += 1
             first = self._sample_first_token(req, job["logits"][row_idx])
             req.tokens.append(first)
             self._slot_last[slot] = first

@@ -61,6 +61,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..utils import failpoints
+from ..utils.prefixbloom import PrefixBloom
 from . import engine_snapshot as snap
 
 ROLES = ("unified", "prefill", "decode")
@@ -86,6 +87,14 @@ HANDOFF_LOCAL = "local"
 # are missing (the router's signal that the request needs a prefill
 # dispatch, not another decode replica).
 PREFILL_NEEDED_HEADER = "X-Prefill-Needed"
+# Fabric pull discipline: when this header rides a /v1/prefill request,
+# the serving side streams RESIDENT pages only and answers 409 when
+# coverage is incomplete — it never runs a prefill probe for the
+# caller.  The router's fabric locator stamps it on every any-peer
+# pull, so a bloom false positive or a stale advertisement costs one
+# refused dial and the puller degrades to LOCAL prefill; the classic
+# prefill-pool pull omits it and keeps the probe-on-miss contract.
+FABRIC_RESIDENT_ONLY_HEADER = "X-Fabric-Resident-Only"
 
 
 class HandoffTap:
@@ -175,6 +184,22 @@ class HandoffMixin:
         self.handoff_refusals = 0
         self.handoff_skipped_tokens = 0  # prefill positions never computed
         self.handoff_noprefill_admits = 0  # zero-compute admissions
+        # Fleet KV fabric: cached bloom advertisement of the prefixes
+        # this replica can serve over /v1/prefill, rebuilt only when
+        # the arena or trie actually mutated (version pair below), so
+        # the router's ?summary=1 poll stays cheap.
+        self._fabric_digest_wire: Optional[dict] = None  # guarded by: _lock
+        self._fabric_digest_versions = (-1, -1)  # guarded by: _lock
+        # Single-flight fabric pulls, keyed by source replica: a burst
+        # of requests all missing the same shared prefix collapses to
+        # ONE wire pull — the winner dials, the rest wait on its Event
+        # and then ride whatever it admitted (http_server admission
+        # gate).  Guarded by: _lock.
+        self._handoff_pull_waits: dict = {}
+        self.fabric_digest_builds = 0
+        self.fabric_pulls = 0
+        self.fabric_pull_failures = 0
+        self.fabric_drops = 0
         if self.metrics:
             self.metrics.role.set(ROLE_VALUES[role])
 
@@ -295,6 +320,42 @@ class HandoffMixin:
                 entry = self._kv_arena.get(key)
                 if entry is None:
                     return None
+                out.append((key, entry["rows"]))
+        return out
+
+    def handoff_resident_prefix_entries(
+        self, prompt: list, adapter: Optional[int]
+    ) -> list[tuple[tuple, dict]]:
+        """The LEADING resident full pages of ``prompt`` as ``(key,
+        rows)`` entries — the fabric any-peer serve: a peer sharing
+        only a prefix of this prompt (the fleet-wide shared system
+        prompt) pulls exactly the pages this replica holds, and a
+        bloom false positive overclaiming depth just serves shallower.
+        Empty when not even the first page is resident (the caller
+        answers the resident-only 409; never a probe)."""
+        ps = self.paged.page_size
+        n_full = len(prompt) // ps
+        root = self._trie_root(adapter)
+        out: list[tuple[tuple, dict]] = []
+        with self._lock:
+            parent = root
+            for i in range(n_full):
+                key = ("prefix", root, tuple(prompt[: (i + 1) * ps]))
+                page = (
+                    self._prefix_pages.get(
+                        (parent, tuple(prompt[i * ps : (i + 1) * ps]))
+                    )
+                    if parent is not None
+                    else None
+                )
+                if page is not None and page not in self._pending_pages:
+                    out.append((key, self._kv_read_page_rows(page)))
+                    parent = page
+                    continue
+                parent = None  # device chain broken: arena-only from here
+                entry = self._kv_arena.get(key)
+                if entry is None:
+                    break
                 out.append((key, entry["rows"]))
         return out
 
@@ -512,6 +573,159 @@ class HandoffMixin:
                 "noprefill_admits": self.handoff_noprefill_admits,
             }
 
+    # ------------------------------------------------------- fleet fabric
+
+    def fabric_digest(self) -> Optional[dict]:
+        """Wire-form bloom advertisement (utils/prefixbloom.py) of every
+        cumulative full-page prefix this replica can serve over ``POST
+        /v1/prefill`` — grafted/retained trie chains walked from the
+        roots plus the host arena's offloaded entries, i.e. exactly the
+        coverage :meth:`handoff_resident_entries` would find.  ``None``
+        when the replica cannot serve pulls at all (prefix sharing or
+        the arena off) — the router then never places prefixes here.
+
+        Rides the ``?summary=1`` poll, so the fast path is lock-free by
+        the summary handler's documented racy-read contract: the cached
+        dict and its (arena, trie) version pair are read off-lock, and
+        a torn read costs at worst one redundant rebuild or one poll
+        tick of staleness — staleness is already survivable fabric-wide
+        (a stale advertisement degrades to a refused pull and local
+        prefill).  The rebuild itself runs under the lock."""
+        if not self.prefix_sharing or not self._kv_arena.enabled:
+            return None
+        cached = self._fabric_digest_wire
+        if cached is not None and self._fabric_digest_versions == (
+            self._kv_arena.version,
+            self._trie_version,
+        ):
+            return cached
+        with self._lock:
+            versions = (self._kv_arena.version, self._trie_version)
+            if (
+                self._fabric_digest_wire is not None
+                and self._fabric_digest_versions == versions
+            ):
+                return self._fabric_digest_wire
+            bloom = PrefixBloom()
+            seen: set = set()
+            for key in self._kv_arena.prefix_keys():
+                ident = (key[1], key[2])
+                if ident not in seen:
+                    seen.add(ident)
+                    bloom.add(key[1], key[2])
+            # Trie-resident chains: group links by parent, BFS from the
+            # pseudo-roots (negative parents) accumulating cumulative
+            # token tuples — O(resident pages).  Pending pages are the
+            # un-grafted prefill frontier; resident_entries refuses
+            # them, so the digest must not advertise them either.
+            children: dict[int, list[tuple[tuple, int]]] = {}
+            for (parent, chunk), page in self._prefix_pages.items():
+                children.setdefault(parent, []).append((chunk, page))
+            stack = [(root, (), root) for root in children if root < 0]
+            while stack:
+                parent, cum, root = stack.pop()
+                for chunk, page in children.get(parent, ()):
+                    if page in self._pending_pages:
+                        continue
+                    tokens = cum + chunk
+                    ident = (root, tokens)
+                    if ident not in seen:
+                        seen.add(ident)
+                        bloom.add(root, tokens)
+                    stack.append((page, tokens, root))
+            wire = bloom.to_wire()
+            wire["page_size"] = self.paged.page_size
+            self._fabric_digest_wire = wire
+            self._fabric_digest_versions = versions
+            self.fabric_digest_builds += 1
+            if self.metrics:
+                self.metrics.fabric_digest_roots.set(len(seen))
+            return wire
+
+    def fabric_pull(
+        self,
+        source: str,
+        prompt: list,
+        adapter: Optional[int] = None,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """Router-driven replication pull (``POST /debug/fabric/pull``):
+        copy ``prompt``'s covered pages from ``source`` into this
+        replica's arena through the SAME parse-before-admit verifier as
+        a request-path fetch — a dead peer or torn stream admits
+        nothing and this replica simply stays a non-owner."""
+        result = fetch_prefill(
+            self,
+            source,
+            prompt,
+            adapter=adapter,
+            timeout_s=timeout_s,
+            resident_only=True,
+        )
+        ok = bool(result.get("ok"))
+        with self._lock:
+            if ok:
+                self.fabric_pulls += 1
+            else:
+                self.fabric_pull_failures += 1
+        if self.metrics:
+            self.metrics.fabric_pulls.inc(outcome="ok" if ok else "error")
+        if self.flight is not None:
+            self.flight.record(
+                "fabric.pulled" if ok else "fabric.pull_failed",
+                source=source,
+                prompt_tokens=len(prompt),
+                restored=int(result.get("restored", 0)),
+                reason=result.get("reason", ""),
+            )
+        return result
+
+    def fabric_drop(self, prompt: list, adapter: Optional[int] = None) -> dict:
+        """Router-driven eviction (``POST /debug/fabric/drop``): release
+        this replica's HOST-ARENA copies of every cumulative full-page
+        key of ``prompt`` (plus the shipped admission logits).  Live and
+        retained device pages are deliberately untouched — they are
+        refcounted serving state owned by local traffic, and a replica
+        still warm in the trie legitimately remains an owner; the drop
+        only reclaims the bytes replication put here."""
+        ps = self.paged.page_size
+        root = self._trie_root(adapter)
+        dropped = 0
+        with self._lock:
+            for i in range(len(prompt) // ps):
+                key = ("prefix", root, tuple(prompt[: (i + 1) * ps]))
+                if self._kv_arena.pop(key) is not None:
+                    dropped += 1
+            self._kv_arena.pop(("logits", root, tuple(prompt)))
+            if dropped:
+                self.fabric_drops += 1
+        if dropped and self.metrics:
+            self.metrics.fabric_drops.inc()
+        if dropped and self.flight is not None:
+            self.flight.record(
+                "fabric.dropped",
+                prompt_tokens=len(prompt),
+                entries=dropped,
+            )
+        return {"ok": True, "dropped": dropped}
+
+    def fabric_state(self) -> dict:
+        """JSON-safe fabric snapshot: the body of ``GET /debug/fabric``
+        on the engine (the router has its own locator-side view)."""
+        digest = self.fabric_digest()
+        with self._lock:
+            return {
+                "enabled": digest is not None,
+                "digest": digest,
+                "advertised_roots": int(digest["count"]) if digest else 0,
+                "digest_builds": self.fabric_digest_builds,
+                "arena_version": self._kv_arena.version,
+                "trie_version": self._trie_version,
+                "pulls": self.fabric_pulls,
+                "pull_failures": self.fabric_pull_failures,
+                "drops": self.fabric_drops,
+            }
+
 
 # ------------------------------------------------- logits wire section
 
@@ -574,6 +788,7 @@ def fetch_prefill(
     adapter: Optional[int] = None,
     timeout_s: float = 30.0,
     trace_context: Optional[str] = None,
+    resident_only: bool = False,
 ) -> dict:
     """Decode-side pull: ``POST /v1/prefill`` on ``source``
     (``"host:port"`` — the router's ``X-Handoff-Source`` locator),
@@ -616,6 +831,11 @@ def fetch_prefill(
                 snap.LAYOUT_HEADER: snap.layout_fingerprint(expected_layout),
                 snap.PARAMS_HEADER: expected_fp,
             }
+            if resident_only:
+                # Fabric any-peer pull: the owner must already hold the
+                # pages — a probe on the peer would move the prefill to
+                # the WRONG replica instead of degrading it to local.
+                headers[FABRIC_RESIDENT_ONLY_HEADER] = "1"
             if trace_context:
                 from ..utils.spans import TRACE_CONTEXT_HEADER
 

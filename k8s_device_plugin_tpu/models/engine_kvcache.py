@@ -78,6 +78,11 @@ class HostKVArena:
         self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
         self.bytes = 0
         self.evictions = 0
+        # Monotonic mutation counter: bumped on every put/pop/clear and
+        # per eviction.  The fabric digest (engine_handoff.py) caches
+        # its bloom against this + the trie version, so the cheap
+        # summary poll never rebuilds an unchanged filter.
+        self.version = 0
 
     @property
     def enabled(self) -> bool:
@@ -107,23 +112,34 @@ class HostKVArena:
         entry = {**entry, "nbytes": int(nbytes)}
         self._entries[key] = entry
         self.bytes += entry["nbytes"]
+        self.version += 1
         evicted = 0
         while self.bytes > self.budget_bytes:
             _, victim = self._entries.popitem(last=False)
             self.bytes -= victim["nbytes"]
             self.evictions += 1
+            self.version += 1
             evicted += 1
         return evicted
+
+    def prefix_keys(self) -> list[tuple]:
+        """Content keys of the offloaded full-page ``("prefix", ...)``
+        entries — the fabric digest's arena contribution (snapshot
+        donors iterate ``_entries`` directly).  Caller holds the engine
+        lock like every other arena access."""
+        return [key for key in self._entries if key[0] == "prefix"]
 
     def pop(self, key: tuple) -> Optional[dict]:
         entry = self._entries.pop(key, None)
         if entry is not None:
             self.bytes -= entry["nbytes"]
+            self.version += 1
         return entry
 
     def clear(self) -> None:
         self._entries.clear()
         self.bytes = 0
+        self.version += 1
 
 
 class KVCacheMixin:

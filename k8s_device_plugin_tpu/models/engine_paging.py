@@ -170,7 +170,7 @@ class PagingMixin:
             self._teardown_page_links(page)
             self.free_pages.append(page)
 
-    def _teardown_page_links(self, page: int) -> None:
+    def _teardown_page_links(self, page: int) -> None:  # caller holds: _lock
         """Remove every trie link touching a dying page: keys registered
         FOR it and keys in which it is the PARENT — a freed id can be
         reallocated and re-registered with different content, so a
@@ -180,9 +180,11 @@ class PagingMixin:
         invariant.  Caller holds the engine lock."""
         for key in self._page_keys.pop(page, []):
             self._prefix_pages.pop(key, None)
+            self._trie_version += 1
         for key in self._child_keys.pop(page, []):
             child = self._prefix_pages.pop(key, None)
             if child is not None:
+                self._trie_version += 1
                 keys = self._page_keys.get(child)
                 if keys and key in keys:
                     keys.remove(key)
@@ -232,7 +234,7 @@ class PagingMixin:
             parent = page
         return pages
 
-    def _register_prefix(
+    def _register_prefix(  # caller holds: _lock
         self, eff: list[int], pages: list[int], n: int, adapter: Optional[int]
     ) -> None:
         """Register ``eff``'s first ``n`` full pages as trie links so
@@ -249,6 +251,7 @@ class PagingMixin:
             if key not in self._prefix_pages:
                 self._prefix_pages[key] = pages[i]
                 self._page_keys.setdefault(pages[i], []).append(key)
+                self._trie_version += 1
                 if parent >= 0:
                     self._child_keys.setdefault(parent, []).append(key)
             parent = self._prefix_pages[key]

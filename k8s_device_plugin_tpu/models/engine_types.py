@@ -439,6 +439,27 @@ class EngineMetrics:
             "fetchable (no X-Handoff-Source locator) — the router "
             "should have routed the prefill first",
         )
+        # Fleet KV fabric (models/engine_handoff.py fabric_digest +
+        # the router's locator/replication plane, router/fabric.py).
+        self.fabric_digest_roots = registry.gauge(
+            "tpu_engine_fabric_digest_roots",
+            "Distinct cumulative prefix roots advertised in the last "
+            "built fabric bloom digest (trie-resident + host-arena); "
+            "what the router's locator believes this replica can serve",
+        )
+        self.fabric_pulls = registry.counter(
+            "tpu_engine_fabric_pulls_total",
+            "Router-driven replication pulls (POST /debug/fabric/pull "
+            "-> fetch_prefill from the named peer) by outcome (ok / "
+            "error); error admits NOTHING and leaves the arena as-is",
+            ["outcome"],
+        )
+        self.fabric_drops = registry.counter(
+            "tpu_engine_fabric_drops_total",
+            "Router-driven replica-eviction drops (POST "
+            "/debug/fabric/drop): host-arena copies of a cold prefix "
+            "released; live/retained device pages are never touched",
+        )
 
 
 @dataclasses.dataclass

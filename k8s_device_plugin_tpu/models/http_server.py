@@ -300,6 +300,49 @@ class EngineServer:
                     # format as chunks finish.
                     self._serve_prefill()
                     return
+                if path in ("/debug/fabric/pull", "/debug/fabric/drop"):
+                    # Fleet-fabric replication plane (router/fabric.py
+                    # drives these on the poll cadence): pull = copy a
+                    # hot prefix from the named owner through the
+                    # parse-before-admit verifier; drop = release this
+                    # replica's host-arena copies of a cold one.  Same
+                    # trust domain and gate as the other mutating admin
+                    # endpoints.
+                    if not server._enable_admin:
+                        self.send_error(404)
+                        return
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length", "0")
+                        )
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        fab_prompt = [int(t) for t in body["prompt"]]
+                        fab_adapter = (
+                            int(body["adapter"])
+                            if body.get("adapter") is not None
+                            else None
+                        )
+                        if path.endswith("/pull"):
+                            fab_source = str(body["source"])
+                    except (KeyError, TypeError, ValueError) as e:
+                        self._reply(400, {"error": f"bad request: {e}"})
+                        return
+                    if path.endswith("/pull"):
+                        result = server.engine.fabric_pull(
+                            fab_source,
+                            fab_prompt,
+                            adapter=fab_adapter,
+                            timeout_s=server._handoff_timeout,
+                        )
+                        self._reply(200 if result.get("ok") else 502, result)
+                    else:
+                        self._reply(
+                            200,
+                            server.engine.fabric_drop(
+                                fab_prompt, adapter=fab_adapter
+                            ),
+                        )
+                    return
                 if path != "/generate":
                     self.send_error(404)
                     return
@@ -451,8 +494,8 @@ class EngineServer:
                         trace_id,
                     )
                     return
-                # Decode-role admission gate (models/engine_handoff.py):
-                # a prompt whose full-page prefix is not resident is
+                # Handoff admission gate (models/engine_handoff.py): a
+                # prompt whose full-page prefix is not resident is
                 # PULLED from the router's X-Handoff-Source locator
                 # before submit (the fetch rides this handler thread —
                 # the step loop keeps decoding others), refused with a
@@ -460,8 +503,26 @@ class EngineServer:
                 # locator, and degraded to ordinary LOCAL prefill when
                 # the fetch fails (prefill replica died mid-transfer,
                 # torn stream, refusal) — never a dropped request.
+                # Decode-role replicas always run the gate; unified
+                # replicas run it only when the router's FABRIC locator
+                # stamped a concrete owner (any-peer pull — resident-
+                # only on the serving side, so a stale locator costs
+                # one refused dial, then local prefill).
                 handoff_fetch = None
-                if server.engine.role == "decode":
+                fabric_source = self.headers.get(
+                    handoff_mod.HANDOFF_SOURCE_HEADER
+                )
+                fabric_pull = bool(
+                    self.headers.get(
+                        handoff_mod.FABRIC_RESIDENT_ONLY_HEADER
+                    )
+                )
+                if server.engine.role == "decode" or (
+                    server.engine.role == "unified"
+                    and fabric_pull
+                    and fabric_source
+                    and fabric_source != handoff_mod.HANDOFF_LOCAL
+                ):
                     try:
                         clean_prompt = [int(t) for t in prompt]
                     except (TypeError, ValueError) as e:
@@ -508,6 +569,32 @@ class EngineServer:
                                 prefill_needed=str(n_full - covered),
                             )
                             return
+                    pull_gate = None  # single-flight claim (fabric)
+                    if covered < n_full and source and fabric_pull:
+                        # Stampede collapse: concurrent requests all
+                        # missing the same source-resident prefix (the
+                        # fleet-wide shared system prompt arriving on
+                        # every session at once) must not each dial the
+                        # owner.  The first handler claims the per-
+                        # source gate and pulls; the rest wait on it,
+                        # re-read their coverage, and ride whatever the
+                        # winner admitted — falling through to ordinary
+                        # local prefill for anything still missing (a
+                        # failed pull degrades every waiter the same
+                        # way it degrades the winner).
+                        eng = server.engine
+                        waiter = None
+                        with eng._lock:
+                            waiter = eng._handoff_pull_waits.get(source)
+                            if waiter is None:
+                                pull_gate = threading.Event()
+                                eng._handoff_pull_waits[source] = pull_gate
+                        if waiter is not None:
+                            waiter.wait(server._handoff_timeout)
+                            covered, n_full = eng.handoff_coverage(
+                                clean_prompt, adapter
+                            )
+                            source = None
                     if covered < n_full and source:
                         t_fetch = time.monotonic()
                         fetch_ctx = None
@@ -531,19 +618,28 @@ class EngineServer:
                                 if server.engine.spans
                                 else 0
                             )
-                        handoff_fetch = handoff_mod.fetch_prefill(
-                            server.engine,
-                            source,
-                            clean_prompt,
-                            adapter=adapter,
-                            timeout_s=min(
-                                server._handoff_timeout,
-                                deadline_s
-                                if deadline_s is not None
-                                else server._handoff_timeout,
-                            ),
-                            trace_context=fetch_ctx,
-                        )
+                        try:
+                            handoff_fetch = handoff_mod.fetch_prefill(
+                                server.engine,
+                                source,
+                                clean_prompt,
+                                adapter=adapter,
+                                timeout_s=min(
+                                    server._handoff_timeout,
+                                    deadline_s
+                                    if deadline_s is not None
+                                    else server._handoff_timeout,
+                                ),
+                                trace_context=fetch_ctx,
+                                resident_only=fabric_pull,
+                            )
+                        finally:
+                            if pull_gate is not None:
+                                with server.engine._lock:
+                                    server.engine._handoff_pull_waits.pop(
+                                        source, None
+                                    )
+                                pull_gate.set()
                         handoff_fetch["span_id"] = fetch_span
                         handoff_fetch["t0"] = t_fetch
                 try:
@@ -1057,10 +1153,19 @@ class EngineServer:
                 the known entry count, then per-CRC entries), so the
                 decode side's transfer overlaps this side's compute.
                 Fingerprint headers refuse with 409 before any compute
-                or bytes; decode-role replicas refuse outright; the
-                ``engine.handoff.serve`` failpoint injects refusal
-                (``error``) or a stream torn after a fraction of the
-                entries (``truncate`` — the prefill-died shape)."""
+                or bytes; decode-role replicas (and any request
+                carrying X-Fabric-Resident-Only — the fabric any-peer
+                pull) serve RESIDENT pages only: full coverage streams
+                everything, partial coverage streams just the leading
+                resident pages (the shared-system-prompt pull), and
+                ZERO coverage answers 409, so a stale locator or a
+                bloom false positive degrades the puller to local
+                prefill instead of moving the prefill to the wrong
+                replica; only prefill/unified roles run probes, and
+                only for non-fabric pulls.  The ``engine.handoff.serve``
+                failpoint injects refusal (``error``) or a stream torn
+                after a fraction of the entries (``truncate`` — the
+                prefill-died shape)."""
                 from ..utils import failpoints
                 from . import engine_snapshot as snap_mod
 
@@ -1071,14 +1176,6 @@ class EngineServer:
                     if metrics:
                         metrics.handoff_serves.inc(outcome=outcome)
 
-                if eng.role == "decode":
-                    _count(outcome="refused")
-                    self._reply(
-                        409,
-                        {"error": "replica role is decode; it does not "
-                                  "serve /v1/prefill"},
-                    )
-                    return
                 if server._fence.is_set() or server._draining.is_set():
                     _count(outcome="refused")
                     self._reply(
@@ -1139,6 +1236,45 @@ class EngineServer:
                     return
                 n_full = len(prompt) // eng.paged.page_size
                 resident = eng.handoff_resident_entries(prompt, adapter)
+                resident_only = eng.role == "decode" or bool(
+                    self.headers.get(
+                        handoff_mod.FABRIC_RESIDENT_ONLY_HEADER
+                    )
+                )
+                if resident is None and resident_only:
+                    # Resident-only serve (decode role / fabric pull):
+                    # no probe ever.  A peer sharing only this prompt's
+                    # PREFIX (the fleet-wide shared system prompt, or a
+                    # bloom FP overclaiming depth) is served exactly
+                    # the leading pages this replica holds; zero
+                    # coverage answers 409 — the puller's locator was
+                    # stale and it must prefill locally.  Arena and
+                    # trie are untouched either way.
+                    partial = eng.handoff_resident_prefix_entries(
+                        prompt, adapter
+                    )
+                    if partial:
+                        resident = partial
+                        n_full = len(partial)
+                    else:
+                        _count(outcome="refused")
+                        eng.flight.record(
+                            "fabric.serve_refused",
+                            peer=self.client_address[0],
+                            prompt_tokens=len(prompt),
+                            covered=0,
+                            of=n_full,
+                            role=eng.role,
+                        )
+                        self._reply(
+                            409,
+                            {
+                                "error": "prefix not resident on this "
+                                "replica (resident-only serve)",
+                                "missing_pages": n_full,
+                            },
+                        )
+                        return
                 tap = None
                 if resident is None:
                     try:
@@ -1375,6 +1511,16 @@ class EngineServer:
                             if server.engine.metrics is not None
                             else None
                         ),
+                        # Fleet KV fabric advertisement: the bloom
+                        # digest of every cumulative prefix this
+                        # replica can serve over /v1/prefill, cached
+                        # against the arena/trie version pair so an
+                        # unchanged replica answers from the cache (the
+                        # fast path reads it racily like every other
+                        # summary field — one poll tick of staleness
+                        # degrades to a refused pull, by contract).
+                        # None when prefix sharing / the arena is off.
+                        "fabric_digest": server.engine.fabric_digest(),
                     }
                     if "summary=1" in (self.path.split("?", 1) + [""])[1]:
                         # ?summary=1: the summary ALONE — skips the
@@ -1433,6 +1579,13 @@ class EngineServer:
                     # never token content, so it stays as open as
                     # /metrics.
                     self._reply(200, server.engine.handoff_state())
+                elif path == "/debug/fabric":
+                    # Fleet KV fabric snapshot (engine_handoff.py
+                    # fabric_state): the advertised digest + build/
+                    # pull/drop counters — the replica-side half of the
+                    # router's /debug/fabric locator view.  Digest bits
+                    # are hashes of token tuples, never token content.
+                    self._reply(200, server.engine.fabric_state())
                 elif path == "/debug/kvcache":
                     # KV tiering snapshot (models/engine_kvcache.py):
                     # tier sizes, hit/evict/restore counters, resume

@@ -51,6 +51,8 @@ Surfaces: ``POST /generate`` (unary + SSE passthrough), ``GET /healthz``
 state + the scale-up/down recommendation ``tools/fleet_plan.py``
 renders), ``GET /debug/slo`` (fleet error budgets + burn-rate alerts
 merged from the per-replica SLI counters every summary poll carries),
+``GET /debug/fabric`` (the fleet KV fabric's locator views and
+replication ledger — router/fabric.py),
 ``GET /debug/spans`` (the router's request-span ring;
 ``?rid=`` filters one trace).  Every fault-handling decision is a
 flight event (``router.*``, per-request ones carrying ``rid``) so a
@@ -97,12 +99,22 @@ from ..utils.spans import (
     sanitize_trace_id,
 )
 from ..models.engine_handoff import (
+    FABRIC_RESIDENT_ONLY_HEADER,
     HANDOFF_LOCAL,
     HANDOFF_SOURCE_HEADER,
     PREFILL_NEEDED_HEADER,
 )
 from .breaker import STATE_VALUE, CircuitBreaker, RetryBudget
 from .disagg import NO_POOL, ROLE_PREFILL, SPLIT, DisaggConfig, DisaggPolicy, pick_prefill
+from .fabric import (
+    VERDICT_HIT,
+    VERDICT_MISS,
+    VERDICT_RESIDENT,
+    VERDICT_SKIP,
+    FabricConfig,
+    FabricLocator,
+    FabricReplicator,
+)
 from .migration import (
     MigrationConfig,
     MigrationPlanner,
@@ -278,6 +290,41 @@ class RouterMetrics:
             "Canary probe mean inter-token latency (direct replica "
             "dials)",
         )
+        # Fleet KV fabric (router/fabric.py, --fabric): the locator's
+        # per-dial resolution verdicts (closed set: hit/resident/miss/
+        # skip), the replication plane's pull/drop outcomes (ok/error),
+        # and each replica's advertised digest size off the poll.
+        self.fabric_resolutions = registry.counter(
+            "tpu_router_fabric_resolutions_total",
+            "Fabric locator resolutions per upstream dial (hit: a "
+            "better owner than the target was stamped as "
+            "X-Handoff-Source; resident: the target already advertises "
+            "the prompt's prefix; miss: nobody in the fleet advertises "
+            "it; skip: adapter prompt — engine-local trie roots the "
+            "router cannot address)",
+            ("verdict",),
+        )
+        self.fabric_replications = registry.counter(
+            "tpu_router_fabric_replications_total",
+            "K-replica hot-prefix replication pulls fired at engines "
+            "(POST /debug/fabric/pull) by outcome — an error admits "
+            "nothing on the target and self-heals out of the ledger",
+            ("outcome",),
+        )
+        self.fabric_drops = registry.counter(
+            "tpu_router_fabric_drops_total",
+            "Cold-prefix eviction drops fired at engines "
+            "(POST /debug/fabric/drop) by outcome; only router-created "
+            "copies are ever dropped, never a traffic-warmed origin",
+            ("outcome",),
+        )
+        self.fabric_advertised_roots = registry.gauge(
+            "tpu_router_fabric_advertised_roots",
+            "Prefix roots each replica's fabric digest advertised on "
+            "its last summary poll (0 = no digest: handoff off, arena "
+            "off, or an unparseable advertisement)",
+            ("replica",),
+        )
 
     def drop_replica(self, name: str) -> None:
         for gauge in (
@@ -286,6 +333,7 @@ class RouterMetrics:
             self.replica_draining,
             self.replica_fenced,
             self.breaker_state,
+            self.fabric_advertised_roots,
         ):
             gauge.remove(replica=name)
 
@@ -364,13 +412,18 @@ class _StreamCtl:
     thread at token-event boundaries — plain attribute store/load
     (GIL-atomic); a one-event-stale read is by design.  ``replica`` /
     ``emitted`` are relay-thread-only bookkeeping the planner reads
-    racily to rank candidates."""
+    racily to rank candidates.  ``prefix_tokens`` is the prompt's
+    leading affinity-horizon slice, immutable after registration — the
+    fabric replicator's hot-prefix census groups live streams by it
+    (the same content addressing the engines' arenas key on)."""
 
-    __slots__ = ("rid", "prefix_key", "replica", "emitted", "migrate_to")
+    __slots__ = ("rid", "prefix_key", "prefix_tokens", "replica",
+                 "emitted", "migrate_to")
 
-    def __init__(self, rid: str, prefix_key: int):
+    def __init__(self, rid: str, prefix_key: int, prefix_tokens=()):
         self.rid = rid
         self.prefix_key = prefix_key
+        self.prefix_tokens = tuple(prefix_tokens)
         self.replica = ""
         self.emitted = 0
         self.migrate_to: Optional[str] = None
@@ -439,6 +492,8 @@ class RouterServer:
         slo: bool = False,
         canary: bool = False,
         canary_config: Optional[CanaryConfig] = None,
+        fabric: bool = False,
+        fabric_config: Optional[FabricConfig] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -541,6 +596,22 @@ class RouterServer:
         self.disagg = (
             DisaggPolicy(disagg_config) if disagg else None
         )
+        # Fleet KV fabric (router/fabric.py; library default OFF like
+        # migration/disagg — the CLI arms it).  The locator holds each
+        # replica's bloom digest off the summary poll; the replicator
+        # is poll-thread-owned planning state (MigrationPlanner
+        # discipline).  Resolution counters are racy plain ints, the
+        # dispatches/failures idiom.
+        self.fabric_cfg = fabric_config or FabricConfig()
+        self.fabric = (
+            FabricLocator(self.fabric_cfg.default_page_size)
+            if fabric
+            else None
+        )
+        self.replicator = FabricReplicator(self.fabric_cfg) if fabric else None
+        self._fabric_inflight: set = set()  # guarded by: _lock
+        self._fabric_resolutions = 0
+        self._fabric_hits = 0
         # Statically configured prefill replicas survive DNS
         # reconciliation (they are not in the headless Service's
         # records).
@@ -703,6 +774,11 @@ class RouterServer:
                     # single-replica fleet's totals here match that
                     # replica's /debug/slo exactly.
                     self._reply(200, server.slo_state())
+                elif path == "/debug/fabric":
+                    # Fleet KV fabric (router/fabric.py): per-replica
+                    # digest views, locator resolution counters, and
+                    # the replication ledger.
+                    self._reply(200, server.fabric_state())
                 elif path == "/debug/canary":
                     # Active correctness plane (router/prober.py):
                     # per-replica probe verdicts, mismatch streaks,
@@ -811,6 +887,9 @@ class RouterServer:
             del self.replicas[name]
         if self.planner is not None:
             self.planner.forget(name)
+        if self.fabric is not None:
+            self.fabric.forget(name)
+            self.replicator.forget(name)
         self.metrics.drop_replica(name)
         self._record("router.replica_removed", replica=name)
 
@@ -888,6 +967,15 @@ class RouterServer:
             if fenced != st.fenced:
                 self._mark_fenced(name, fenced)
             self._merge_slo(st, payload.get("slo"))
+            if self.fabric is not None:
+                # Fabric digest off the same poll (fleet KV fabric,
+                # router/fabric.py): an absent or unparseable digest
+                # clears the replica's view — the locator never places
+                # on stale advertisements after a restart.
+                self.metrics.fabric_advertised_roots.set(
+                    self.fabric.update(name, payload.get("fabric_digest")),
+                    replica=name,
+                )
             st.last_poll = time.monotonic()
             self.metrics.replica_queue_depth.set(
                 st.queue_depth, replica=name
@@ -898,6 +986,10 @@ class RouterServer:
         # The fleet burn-rate rules ride the same cadence: one
         # evaluation per sweep over the freshly merged SLI deltas.
         self._evaluate_slo()
+        # K-replica hot-prefix replication rides the same cadence too:
+        # host-side pressure signals + the live-stream census, bounded
+        # actions per sweep — never device counters.
+        self._fabric_tick()
 
     def _merge_slo(self, st, slo_block) -> None:
         """Delta one replica's cumulative SLI counters into the fleet
@@ -1216,6 +1308,205 @@ class RouterServer:
             missing_pages=missing,
         )
 
+    # ------------------------------------------------------- fleet fabric
+
+    def _fabric_source_for(
+        self, target: str, payload: dict
+    ) -> Optional[str]:
+        """Per-dial locator resolution (fleet KV fabric): the best
+        owner of this prompt's deepest advertised cumulative prefix,
+        or None when the TARGET already advertises it (or nobody
+        does).  Called immediately before every upstream dial —
+        primary, retry, hedge, failover and migration legs alike — so
+        a re-dialed leg re-resolves against CURRENT membership and
+        can never be pointed at a dead, fenced, or draining peer."""
+        if self.fabric is None:
+            return None
+        prompt = payload.get("prompt")
+        if not prompt:
+            return None
+        self._fabric_resolutions += 1
+        if payload.get("adapter"):
+            # Adapter trie roots are engine-local indices the router
+            # cannot address; adapter traffic rides affinity + the
+            # classic prefill-pool path unchanged.
+            self.metrics.fabric_resolutions.inc(verdict=VERDICT_SKIP)
+            return None
+        resident = self.fabric.coverage(target, prompt)
+        candidates = [
+            name
+            for name, st in list(self.replicas.items())
+            if name != target
+            and st.reachable
+            and not st.draining
+            and not st.fenced
+        ]
+        best = self.fabric.best_owner(prompt, candidates)
+        if best is None or best[1] <= resident:
+            self.metrics.fabric_resolutions.inc(
+                verdict=VERDICT_RESIDENT if resident else VERDICT_MISS
+            )
+            return None
+        owner, covered = best
+        self._fabric_hits += 1
+        self.metrics.fabric_resolutions.inc(verdict=VERDICT_HIT)
+        self._record(
+            "router.fabric_locate",
+            target=target,
+            source=owner,
+            covered_tokens=covered,
+            prompt_tokens=len(prompt),
+        )
+        return owner
+
+    def _fabric_tick(self) -> None:
+        """Poll-thread sweep: census the live streams' prefixes, feed
+        the replicator the fleet's pressure signals, and fire its
+        bounded pull/drop verdicts at the engines off-thread."""
+        if self.replicator is None:
+            return
+        with self._streams_lock:
+            hot: dict[tuple, int] = {}
+            for c in self._streams.values():
+                if c.prefix_tokens:
+                    hot[c.prefix_tokens] = hot.get(c.prefix_tokens, 0) + 1
+        pressures = {
+            name: replica_pressure(
+                st.queue_wait_ewma_s, st.drain_rate_rps, st.queue_depth
+            )
+            for name, st in list(self.replicas.items())
+            if st.reachable
+            and not st.draining
+            and not st.fenced
+            and st.role != ROLE_PREFILL
+        }
+        for action in self.replicator.plan(self.fabric, hot, pressures):
+            self._fabric_execute(action)
+
+    def _fabric_execute(self, action: dict) -> None:
+        """Fire one replication verdict at its target engine on a
+        worker thread (a pull streams the whole prefix over the
+        handoff wire — the poll loop must not wait on it).  The
+        in-flight set keeps one sweep's action from being re-fired
+        while a slow transfer is still running."""
+        target = action["target"]
+        st = self.replicas.get(target)
+        if st is None:
+            return
+        op = action["op"]
+        key = (op, target, tuple(action["prompt"]))
+        with self._lock:
+            if key in self._fabric_inflight:
+                return
+            self._fabric_inflight.add(key)
+        path = "/debug/fabric/pull" if op == "pull" else "/debug/fabric/drop"
+        body: dict = {"prompt": action["prompt"]}
+        if op == "pull":
+            body["source"] = action["source"]
+
+        def run():
+            ok = False
+            detail: dict = {}
+            try:
+                conn = http.client.HTTPConnection(
+                    st.host, st.port, timeout=self.fabric_cfg.pull_timeout_s
+                )
+                try:
+                    conn.request(
+                        "POST",
+                        path,
+                        json.dumps(body).encode(),
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    detail = json.loads(resp.read() or b"{}")
+                    ok = resp.status == 200 and bool(detail.get("ok"))
+                finally:
+                    conn.close()
+            except (*_CONN_ERRORS, ValueError) as e:
+                detail = {"error": str(e)}
+            finally:
+                with self._lock:
+                    self._fabric_inflight.discard(key)
+            outcome = "ok" if ok else "error"
+            if op == "pull":
+                self.metrics.fabric_replications.inc(outcome=outcome)
+            else:
+                self.metrics.fabric_drops.inc(outcome=outcome)
+            self._record(
+                "router.fabric_replicated" if op == "pull"
+                else "router.fabric_dropped",
+                target=target,
+                source=action.get("source"),
+                prompt_tokens=len(action["prompt"]),
+                ok=ok,
+                detail=detail.get("error") or detail.get("outcome"),
+            )
+
+        threading.Thread(
+            target=run, name="router-fabric", daemon=True
+        ).start()
+
+    def fabric_state(self) -> dict:
+        """GET /debug/fabric: digest views, locator counters, and the
+        replication ledger."""
+        if self.fabric is None:
+            return {"enabled": False}
+        resolutions = self._fabric_resolutions
+        hits = self._fabric_hits
+        return {
+            "enabled": True,
+            "replicas": self.fabric.snapshot(),
+            "resolutions": resolutions,
+            "cross_peer_hits": hits,
+            "cross_peer_hit_rate": (
+                round(hits / resolutions, 4) if resolutions else 0.0
+            ),
+            "replication": self.replicator.snapshot(),
+        }
+
+    def _fabric_summary(self) -> dict:
+        """The /debug/fleet fabric block ``tools/fleet_plan.py``
+        renders: per-replica advertised-root counts, the hottest live
+        prefixes' current replication factors, and the cross-peer hit
+        rate."""
+        if self.fabric is None:
+            return {"enabled": False}
+        with self._streams_lock:
+            hot: dict[tuple, int] = {}
+            for c in self._streams.values():
+                if c.prefix_tokens:
+                    hot[c.prefix_tokens] = hot.get(c.prefix_tokens, 0) + 1
+        names = list(self.replicas)
+        ps = self.fabric.page_size()
+        hottest = [
+            {
+                "prefix_tokens": len(prefix),
+                "streams": count,
+                "replication_factor": self.replicator.replication_factor(
+                    self.fabric, prefix, names
+                ),
+            }
+            for prefix, count in sorted(
+                hot.items(),
+                key=lambda item: (
+                    -(item[1] * (len(item[0]) // ps)),
+                    item[0],
+                ),
+            )[:5]
+        ]
+        resolutions = self._fabric_resolutions
+        hits = self._fabric_hits
+        return {
+            "enabled": True,
+            "advertised_roots": self.fabric.advertised_roots(),
+            "hottest_prefixes": hottest,
+            "cross_peer_hits": hits,
+            "cross_peer_hit_rate": (
+                round(hits / resolutions, 4) if resolutions else 0.0
+            ),
+        }
+
     def fleet_state(self) -> dict:
         """GET /debug/fleet: per-replica host-side signals, planner
         state, and the fleet scale recommendation — what
@@ -1269,6 +1560,10 @@ class RouterServer:
             # ROADMAP #5's autoscaler — can act on budget burn, not
             # just queue pressure.
             "slo": self._fleet_slo_summary(),
+            # Compact fleet KV fabric view (the full version is
+            # /debug/fabric): advertised-root counts, hottest-prefix
+            # replication factors, cross-peer hit rate.
+            "fabric": self._fabric_summary(),
         }
 
     def _fleet_slo_summary(self) -> dict:
@@ -1361,11 +1656,26 @@ class RouterServer:
         }
         if hop_header is not None:
             headers[TRACE_CONTEXT_HEADER] = hop_header
-        if handoff is not None:
+        if handoff is not None and handoff != HANDOFF_LOCAL:
             # Disaggregation locator: the decode replica pulls this
             # prompt's prefix from the named prefill replica before
             # admitting (models/engine_handoff.py).
             headers[HANDOFF_SOURCE_HEADER] = handoff
+        else:
+            # Fleet KV fabric: no prefill-pool locator rides this leg
+            # (unified fleet, short prompt, or the LOCAL sentinel), so
+            # resolve the best advertised owner of the prompt's prefix
+            # against current membership and stamp it — resident-only,
+            # so a bloom FP or stale digest degrades the TARGET to
+            # local prefill instead of moving the prefill to the
+            # wrong replica.  Re-resolved on EVERY dial: failover and
+            # migration legs never inherit a dead peer.
+            fabric_source = self._fabric_source_for(name, payload)
+            if fabric_source is not None:
+                headers[HANDOFF_SOURCE_HEADER] = fabric_source
+                headers[FABRIC_RESIDENT_ONLY_HEADER] = "1"
+            elif handoff is not None:
+                headers[HANDOFF_SOURCE_HEADER] = handoff
         if deadline is not None:
             headers["X-Request-Deadline"] = (
                 f"{max(deadline - time.monotonic(), 0.0):.3f}"
@@ -1876,7 +2186,15 @@ class RouterServer:
         handle (the planner flags it through this registry), relay, and
         always unregister — a dead handler thread must never leave a
         ghost stream for the planner to keep planning against."""
-        ctl = _StreamCtl(trace_id, self.policy.key_of(prompt))
+        # The affinity-horizon slice (block x max-blocks leading tokens)
+        # is the stream's hot-prefix identity for the fabric replicator:
+        # shared system prompts collapse to one census entry.
+        horizon = (
+            self.policy.prefix_block_tokens * self.policy.prefix_max_blocks
+        )
+        ctl = _StreamCtl(
+            trace_id, self.policy.key_of(prompt), tuple(prompt[:horizon])
+        )
         with self._streams_lock:
             self._streams[trace_id] = ctl
         try:
@@ -2684,6 +3002,57 @@ def main(argv: Optional[list[str]] = None) -> None:
         "replica's /debug/fence so the fenced-demotion machinery "
         "drains it; 0 = observe-only (incidents still fire)",
     )
+    p.add_argument(
+        "--fabric",
+        type=int,
+        choices=[0, 1],
+        default=0,
+        help="fleet-wide content-addressed KV fabric (router/fabric.py, "
+        "docs/routing.md \"Fleet KV fabric\"): parse each replica's "
+        "bloom prefix digest off the summary poll, stamp the best "
+        "advertised owner as a resident-only X-Handoff-Source on every "
+        "dial whose prompt prefix is non-resident at the target (the "
+        "target pulls the KV pages peer-to-peer instead of re-running "
+        "the prefill), and run the K-replica hot-prefix "
+        "replication/eviction sweep each poll tick; requires the "
+        "replicas to run with --enable-admin for the replication "
+        "pull/drop endpoints",
+    )
+    p.add_argument(
+        "--fabric-k",
+        type=int,
+        default=2,
+        help="target replication factor for hot prefixes (copies are "
+        "planned until this many replicas advertise the prefix)",
+    )
+    p.add_argument(
+        "--fabric-hot-wait",
+        type=float,
+        default=2.0,
+        help="owner queue-wait pressure (seconds) at/above which its "
+        "hot prefixes are proactively replicated",
+    )
+    p.add_argument(
+        "--fabric-cold-wait",
+        type=float,
+        default=0.5,
+        help="replication-target pressure ceiling (seconds) — copies "
+        "only land on replicas with cold headroom",
+    )
+    p.add_argument(
+        "--fabric-hot-score",
+        type=float,
+        default=2.0,
+        help="minimum hotness (live streams x full prefix pages) "
+        "before a prefix is worth replicating",
+    )
+    p.add_argument(
+        "--fabric-actions",
+        type=int,
+        default=2,
+        help="replication/eviction actions fired per poll sweep, "
+        "fleet-wide (the pacing bound)",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -2777,6 +3146,15 @@ def main(argv: Optional[list[str]] = None) -> None:
             budget=args.migrate_budget,
             refill_per_s=args.migrate_refill,
         ),
+        fabric=bool(args.fabric),
+        fabric_config=FabricConfig(
+            replicate_k=args.fabric_k,
+            hot_wait_s=args.fabric_hot_wait,
+            cold_wait_s=args.fabric_cold_wait,
+            hot_score=args.fabric_hot_score,
+            max_actions_per_sweep=args.fabric_actions,
+            default_page_size=args.prefix_block_tokens,
+        ),
     ).start()
 
     import signal
@@ -2800,7 +3178,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     print(
         f"routing on :{server.port} over {len(server.replicas)} replicas "
         "(POST /generate, GET /healthz /metrics /debug/router "
-        "/debug/fleet /debug/slo /debug/canary /debug/spans)",
+        "/debug/fleet /debug/slo /debug/fabric /debug/canary "
+        "/debug/spans)",
         file=sys.stderr,
         flush=True,
     )

@@ -21,7 +21,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import grpc
 
 from k8s_device_plugin_tpu.kubelet import constants
+from k8s_device_plugin_tpu.models.engine_handoff import (
+    FABRIC_RESIDENT_ONLY_HEADER,
+)
 from k8s_device_plugin_tpu.utils import failpoints
+from k8s_device_plugin_tpu.utils.prefixbloom import PrefixBloom
 from k8s_device_plugin_tpu.utils.spans import (
     SpanRecorder,
     parse_trace_context,
@@ -412,7 +416,13 @@ class FakeReplica:
         # X-Handoff-Source locator (409 + X-Prefill-Needed), pulls the
         # prefix through the real parser when one rides the dial, and
         # degrades to "local prefill" (pays cold_prefill_delay_s) when
-        # the fetch fails — the engine contract in miniature.
+        # the fetch fails — the engine contract in miniature.  The
+        # fleet-KV-fabric surfaces ride along: the summary advertises a
+        # bloom digest of warm prefixes, /v1/prefill serves RESIDENT
+        # prefixes to ANY peer (decode role or the
+        # X-Fabric-Resident-Only header → 409 on a cold prefix), and
+        # POST /debug/fabric/pull|drop mirror the engine's admin
+        # replication endpoints.
         self.role = role
         self.prefill_chunk_s = prefill_chunk_s
         # Silent-data-corruption knob (canary prober tests): after
@@ -440,6 +450,12 @@ class FakeReplica:
         self.handoff_fetches = 0
         self.handoff_fetch_failures = 0
         self.seen_handoff: list = []  # X-Handoff-Source header per /generate
+        # X-Handoff-Source values that arrived WITH the fabric
+        # resident-only header — the router's locator-stamped dials, as
+        # distinct from disagg prefill-pool handoffs.
+        self.seen_fabric_sources: list = []
+        self.fabric_pulls = 0  # POST /debug/fabric/pull admissions
+        self.fabric_drops = 0  # POST /debug/fabric/drop removals
         # Flight recorder for chaos scoring: handoff.fetched /
         # handoff.fetch_failed land here like the real engine's.
         from k8s_device_plugin_tpu.utils.flight import FlightRecorder
@@ -542,6 +558,41 @@ class FakeReplica:
                         replica.unfence()
                         self._json(200, {"fenced": False, "changed": changed})
                     return
+                if path == "/debug/fabric/pull":
+                    # The EngineServer admin pull endpoint in
+                    # miniature (the router's replication plane dials
+                    # this): pull ``prompt`` from ``source`` through
+                    # the real wire parser; failure admits nothing.
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = [int(t) for t in body.get("prompt") or []]
+                    source = str(body.get("source") or "")
+                    ok = bool(source) and bool(
+                        replica.fetch_prefill(
+                            source, prompt, resident_only=True
+                        )["ok"]
+                    )
+                    with replica._lock:
+                        if ok:
+                            replica.fabric_pulls += 1
+                    self._json(200, {"ok": ok})
+                    return
+                if path == "/debug/fabric/drop":
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = [int(t) for t in body.get("prompt") or []]
+                    key = (
+                        tuple(prompt[: replica.prefix_tokens])
+                        if replica.prefix_tokens
+                        else None
+                    )
+                    with replica._lock:
+                        dropped = key in replica.warm_prefixes
+                        replica.warm_prefixes.discard(key)
+                        if dropped:
+                            replica.fabric_drops += 1
+                    self._json(200, {"ok": True, "dropped": dropped})
+                    return
                 if path != "/generate":
                     self.send_error(404)
                     return
@@ -609,17 +660,23 @@ class FakeReplica:
                 max_new = int(body.get("max_new_tokens", 16))
                 stream = bool(body.get("stream", False))
                 handoff_src = self.headers.get("X-Handoff-Source")
+                fabric_pull = bool(
+                    self.headers.get(FABRIC_RESIDENT_ONLY_HEADER)
+                )
                 with replica._lock:
                     replica.seen_handoff.append(handoff_src)
+                    if fabric_pull:
+                        replica.seen_fabric_sources.append(handoff_src)
                 if (
-                    replica.role == "decode"
-                    and replica.prefix_tokens
+                    replica.prefix_tokens
                     and len(prompt) >= replica.prefix_tokens
+                    and (replica.role == "decode" or fabric_pull)
                 ):
-                    # The decode-role admission gate in miniature:
-                    # resident admits; a locator pulls; no locator +
-                    # cold prefix refuses 409 + X-Prefill-Needed; a
-                    # failed pull degrades to "local prefill" (the
+                    # The admission gate in miniature (decode role, or
+                    # any role dialed with a fabric locator): resident
+                    # admits; a locator pulls; a cold decode prefix
+                    # with no locator refuses 409 + X-Prefill-Needed;
+                    # a failed pull degrades to "local prefill" (the
                     # cold_prefill_delay_s below) — never a drop.
                     key = tuple(prompt[: replica.prefix_tokens])
                     with replica._lock:
@@ -648,7 +705,9 @@ class FakeReplica:
                             self.end_headers()
                             self.wfile.write(out)
                             return
-                        replica.fetch_prefill(handoff_src, prompt)
+                        replica.fetch_prefill(
+                            handoff_src, prompt, resident_only=fabric_pull
+                        )
                 with replica._lock:
                     replica.generate_requests += 1
                     replica.seen_trace_ids.append(trace_id)
@@ -795,6 +854,11 @@ class FakeReplica:
                             if replica.slo_totals is not None
                             else None
                         ),
+                        # Fleet-KV-fabric contract (EngineServer
+                        # summary): a bloom digest of the resident
+                        # prefix roots, or null when the fake has no
+                        # prefix model (a replica with handoff off).
+                        "fabric_digest": replica.fabric_digest(),
                     })
                 elif path == "/debug/snapshot":
                     self._serve_snapshot()
@@ -829,24 +893,46 @@ class FakeReplica:
 
             def _serve_prefill(self) -> None:
                 """The EngineServer POST /v1/prefill contract in
-                miniature: decode role refuses 409; fingerprint headers
-                refuse 409 before any bytes; otherwise one REAL
-                wire-format entry per cumulative 16-token prefix of the
-                prompt, streamed preamble-first and trickled
-                ``prefill_chunk_s`` per entry so kill() lands
-                mid-body.  Served prefixes warm this replica (the
-                publish step)."""
+                miniature: decode role (and any role dialed with
+                X-Fabric-Resident-Only) serves RESIDENT prefixes only,
+                409 otherwise — the any-peer fabric pull path;
+                fingerprint headers refuse 409 before any bytes;
+                otherwise one REAL wire-format entry per cumulative
+                16-token prefix of the prompt, streamed preamble-first
+                and trickled ``prefill_chunk_s`` per entry so kill()
+                lands mid-body.  Served prefixes warm this replica
+                (the publish step)."""
                 from k8s_device_plugin_tpu.models import (
                     engine_snapshot as snap_mod,
                 )
                 import numpy as np
 
-                if replica.role == "decode":
-                    self._json(409, {"error": "replica role is decode"})
-                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 prompt = [int(t) for t in body.get("prompt") or []]
+                resident_only = replica.role == "decode" or bool(
+                    self.headers.get(FABRIC_RESIDENT_ONLY_HEADER)
+                )
+                if resident_only:
+                    key = (
+                        tuple(prompt[: replica.prefix_tokens])
+                        if replica.prefix_tokens
+                        else None
+                    )
+                    with replica._lock:
+                        resident = (
+                            key is not None
+                            and key in replica.warm_prefixes
+                        )
+                    if not resident:
+                        with replica._lock:
+                            replica.prefill_refusals += 1
+                        self._json(
+                            409,
+                            {"error": "prefix not resident "
+                                      "(resident-only serve)"},
+                        )
+                        return
                 want_layout = self.headers.get(snap_mod.LAYOUT_HEADER)
                 want_params = self.headers.get(snap_mod.PARAMS_HEADER)
                 layout_fp = snap_mod.layout_fingerprint(
@@ -1070,6 +1156,29 @@ class FakeReplica:
     def end_shed(self) -> None:
         self._shedding.clear()
 
+    def fabric_digest(self) -> dict | None:
+        """The EngineServer ``fabric_digest`` summary field in
+        miniature: a bloom over the cumulative full-page prefixes of
+        every warm prefix key (base root, same content addressing as
+        the engine's arena), or None when the fake has no prefix model
+        — the shape a replica with handoff off reports."""
+        if not self.prefix_tokens:
+            return None
+        ps = self.SNAPSHOT_LAYOUT["page_size"]
+        with self._lock:
+            prefixes = sorted(self.warm_prefixes)
+        bloom = PrefixBloom()
+        seen: set = set()
+        for key in prefixes:
+            for pages in range(1, len(key) // ps + 1):
+                cum = tuple(int(t) for t in key[: pages * ps])
+                if cum not in seen:
+                    seen.add(cum)
+                    bloom.add(-1, cum)
+        wire = bloom.to_wire()
+        wire["page_size"] = ps
+        return wire
+
     # --- chaos ---
     def snapshot_bytes(self) -> bytes:
         """This fake's warm prefixes encoded in the REAL
@@ -1137,11 +1246,15 @@ class FakeReplica:
                 self.warm_prefixes.add(key[2])
         return {"ok": True, "restored": len(entries), "peer": peer}
 
-    def fetch_prefill(self, source: str, prompt) -> dict:
+    def fetch_prefill(
+        self, source: str, prompt, resident_only: bool = False
+    ) -> dict:
         """The decode-side pull in miniature: POST /v1/prefill on
         ``source``, parse through the REAL wire verifier, adopt the
-        served prefixes as warm.  ANY failure (source killed
-        mid-transfer, torn stream, refusal, unreachable) adopts
+        served prefixes as warm.  ``resident_only`` stamps the fabric
+        header so the source serves only what it already holds (the
+        any-peer pull path — no probe on miss).  ANY failure (source
+        killed mid-transfer, torn stream, refusal, unreachable) adopts
         NOTHING — the caller's cold-prefill path IS the local-prefill
         degradation.  Records handoff.fetched / handoff.fetch_failed
         flight events exactly like the engine, so chaos scenarios score
@@ -1151,6 +1264,15 @@ class FakeReplica:
         from k8s_device_plugin_tpu.models import engine_snapshot as snap_mod
 
         host, _, port = source.rpartition(":")
+        headers = {
+            "Content-Type": "application/json",
+            snap_mod.LAYOUT_HEADER: snap_mod.layout_fingerprint(
+                self.SNAPSHOT_LAYOUT
+            ),
+            snap_mod.PARAMS_HEADER: self.SNAPSHOT_PARAMS_FP,
+        }
+        if resident_only:
+            headers[FABRIC_RESIDENT_ONLY_HEADER] = "1"
         try:
             conn = http.client.HTTPConnection(host, int(port), timeout=10)
             try:
@@ -1160,13 +1282,7 @@ class FakeReplica:
                     json.dumps(
                         {"prompt": [int(t) for t in prompt]}
                     ).encode(),
-                    headers={
-                        "Content-Type": "application/json",
-                        snap_mod.LAYOUT_HEADER: snap_mod.layout_fingerprint(
-                            self.SNAPSHOT_LAYOUT
-                        ),
-                        snap_mod.PARAMS_HEADER: self.SNAPSHOT_PARAMS_FP,
-                    },
+                    headers=headers,
                 )
                 resp = conn.getresponse()
                 if resp.status != 200:

@@ -306,14 +306,24 @@ class RouterTraffic:
         prefix_len: int = 32,
         vocab: int = 32000,
         expected_fn=None,
+        shared_prefix_len: int = 0,
     ):
         self.host = host
         self.port = port
         self.vocab = vocab
         self.expected_fn = expected_fn
         rng = random.Random(seed * 7919 + 13)
+        # ``shared_prefix_len`` leading tokens common to EVERY session
+        # (the fleet-wide system prompt the KV fabric deduplicates);
+        # the rest of each session's prefix stays session-unique so
+        # affinity still scatters sessions across replicas.
+        shared = [rng.randrange(2, vocab) for _ in range(shared_prefix_len)]
         self.prefixes = [
-            [rng.randrange(2, vocab) for _ in range(prefix_len)]
+            shared
+            + [
+                rng.randrange(2, vocab)
+                for _ in range(max(0, prefix_len - shared_prefix_len))
+            ]
             for _ in range(sessions)
         ]
         self.seed = seed

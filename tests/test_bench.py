@@ -377,6 +377,72 @@ def test_bench_diff_parses_router_block(tmp_path):
     assert "DROPPED 2" in bench_diff.ledger_row(a, c)
 
 
+def test_bench_diff_parses_fabric_block(tmp_path):
+    """Records grew a FABRIC block (ISSUE 18, benchmark.py
+    _run_fabric_phase): fleet hit rate, TTFT p99, and cross-peer pull
+    count vs the affinity-only control must surface in the normalized
+    record, the field diff, and the ledger row — and the row must
+    scream when the any-peer pull path stops moving pages
+    (cross_peer_pulls 0 — NO-FABRIC-HITS) or locating costs more than
+    it saves (fabric p99 > 1.2x control — FABRIC-TTFT-REGRESSED)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 17,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    fabbed = json.loads(json.dumps(base))
+    fabbed["n"] = 18
+    fabbed["parsed"]["fabric"] = {
+        "replicas": 3, "requests": 32, "sessions": 8,
+        "shared_prefix_len": 16,
+        "fabric": {"fleet_hits": 120, "hit_rate": 3.75,
+                   "ttft_p99_ms": 234.0, "cross_peer_pulls": 2,
+                   "dropped": 0},
+        "control": {"fleet_hits": 116, "hit_rate": 3.62,
+                    "ttft_p99_ms": 238.0, "cross_peer_pulls": 0,
+                    "dropped": 0},
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(fabbed))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["fabric_hit_rate"] == 3.75
+    assert b["fabric_ttft_p99_ms"] == 234.0
+    assert b["fabric_cross_peer_pulls"] == 2
+    assert b["fabric_control_hit_rate"] == 3.62
+    assert b["fabric_control_ttft_p99_ms"] == 238.0
+    assert b["fabric_dropped"] == 0
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "fabric_hit_rate" in diff
+    assert "fabric_cross_peer_pulls" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "fabric 3.75 hits/req" in row and "(2 pulls)" in row
+    assert "vs control 3.62" in row
+    assert "NO-FABRIC-HITS" not in row
+    assert "FABRIC-TTFT-REGRESSED" not in row
+    # Zero cross-peer pulls: the fabric is silently affinity-only.
+    fabbed["parsed"]["fabric"]["fabric"]["cross_peer_pulls"] = 0
+    (tmp_path / "c.json").write_text(json.dumps(fabbed))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "NO-FABRIC-HITS" in bench_diff.ledger_row(a, c)
+    # Fabric TTFT past 1.2x the control: locating costs more than it
+    # saves.
+    fabbed["parsed"]["fabric"]["fabric"]["cross_peer_pulls"] = 2
+    fabbed["parsed"]["fabric"]["fabric"]["ttft_p99_ms"] = 300.0
+    (tmp_path / "d.json").write_text(json.dumps(fabbed))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "FABRIC-TTFT-REGRESSED" in bench_diff.ledger_row(a, d)
+
+
 def test_bench_diff_parses_overload_block(tmp_path):
     """Records grew an OVERLOAD block (ISSUE 9, benchmark.py
     _run_overload_phase): goodput ratio, shed count, and the

@@ -2647,3 +2647,256 @@ def test_chaos_canary_silent_corruption_detect_and_fence(tmp_path):
     finally:
         failpoints.disarm_all()
         _teardown_router(replicas, router)
+
+
+# ======================================================================
+# Scenario 15: fleet KV fabric — stale locator + owner death mid-pull
+# ======================================================================
+
+
+def _fabric_fleet(n, **replica_kwargs):
+    """n fabric-speaking FakeReplicas + a fabric-enabled RouterServer
+    (jax-free): the chaos twin of test_router's fabric fleet."""
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+
+    from tests.fakes import FakeReplica
+
+    kwargs = dict(
+        prefix_tokens=16, cold_prefill_delay_s=0.05, token_delay_s=0.02
+    )
+    kwargs.update(replica_kwargs)
+    replicas = [FakeReplica(**kwargs).start() for _ in range(n)]
+    flight = FlightRecorder(capacity=4096, name="chaos-router")
+    router = RouterServer(
+        [r.name for r in replicas],
+        host="127.0.0.1",
+        port=0,
+        flight=flight,
+        poll_interval_s=0.15,
+        hedge=False,
+        backoff_base_s=0.02,
+        backoff_max_s=0.3,
+        upstream_timeout_s=30.0,
+        request_timeout_s=60.0,
+        fabric=True,
+    ).start()
+    return replicas, router, flight
+
+
+def _fabric_prompt_homed(router, replica_name, prefix, base=500,
+                         suffix_len=16):
+    """A prompt sharing ``prefix`` whose ring home is ``replica_name``
+    (the suffix block varies the affinity key, the prefix does not)."""
+    for salt in range(base, base + 500):
+        prompt = list(prefix) + [salt] * suffix_len
+        if router.ring.order(router.policy.key_of(prompt))[0] == replica_name:
+            return prompt
+    raise AssertionError(f"no prompt with that prefix homes on {replica_name}")
+
+
+def _fabric_post(port, payload, timeout=30):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_chaos_fabric_stale_locator_degrades_to_local_prefill(tmp_path):
+    """Fleet KV fabric under locator staleness (ISSUE 18): 3 replicas
+    behind a fabric-enabled router.  Control: replica A warms a shared
+    prefix through ordinary traffic, the locator lights up, and a
+    request homed on B pulls the prefix over the real /v1/prefill wire
+    — zero failures, bit-identical tokens.  Fault: A's advertisement
+    is FROZEN and its working set evicted (the digest-lag shape: owner
+    advertised, then evicted), so the locator stamps an owner that
+    refuses the resident-only pull — the victim homed on C degrades to
+    LOCAL prefill with bit-identical tokens, and its
+    handoff.fetch_failed flight events score precision/recall 1.0
+    against the injected staleness window (B's successful pull and the
+    whole control phase are the precision control)."""
+    from tests.fakes import fake_generate
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _fabric_fleet(3)
+    a, b, c = replicas
+    try:
+        # --- Control: warm prefix1 on A; B pulls it cleanly.
+        prefix1 = list(range(300, 316))
+        pa = _fabric_prompt_homed(router, a.name, prefix1)
+        out = _fabric_post(router.port, {"prompt": pa, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(pa, 3)
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=10,
+        ), "locator never saw A's advertisement"
+        pb = _fabric_prompt_homed(router, b.name, prefix1, base=1200)
+        out = _fabric_post(router.port, {"prompt": pb, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(pb, 3)
+        assert b.handoff_fetches == 1 and b.handoff_fetch_failures == 0
+        assert a.prefill_serves == 1
+
+        # --- Fault: warm prefix2 on A only, freeze the digest, evict.
+        prefix2 = list(range(400, 416))
+        pa2 = _fabric_prompt_homed(router, a.name, prefix2, base=2000)
+        out = _fabric_post(router.port, {"prompt": pa2, "max_new_tokens": 2})
+        assert out["tokens"] == fake_generate(pa2, 2)
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 2,
+            timeout=10,
+        )
+        stale = a.fabric_digest()
+        a.fabric_digest = lambda: stale  # the poll keeps reading this
+        with a._lock:
+            a.warm_prefixes.clear()
+        t0 = time.time()
+        pc = _fabric_prompt_homed(router, c.name, prefix2, base=2800)
+        out = _fabric_post(router.port, {"prompt": pc, "max_new_tokens": 3})
+        t1 = time.time()
+        # Bit-identical through the local-prefill degradation.
+        assert out["tokens"] == fake_generate(pc, 3)
+        assert c.handoff_fetch_failures == 1
+        assert c.cold_prefills >= 1, "local prefill never ran"
+        assert a.prefill_refusals >= 1  # resident-only 409, no probe
+        assert b.handoff_fetch_failures == 0
+        assert any(
+            e["target"] == c.name
+            for e in flight.window(kinds=["router.fabric_locate"])
+        ), "the stale stamp never happened"
+
+        # --- Score: fetch_failed events vs the staleness window.
+        injected = [
+            {"cls": "fabric_stale", "replica": c.name, "t0": t0, "t1": t1}
+        ]
+        detected = [
+            {"cls": "fabric_stale", "replica": r.name, "ts": e["ts"]}
+            for r in replicas
+            for e in r.flight.window(kinds=["handoff.fetch_failed"])
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        cls = score["per_class"]["fabric_stale"]
+        assert cls["precision"] == 1.0 and cls["recall"] == 1.0, score
+        _publish({
+            "scenario": "fabric_stale_locator",
+            "faults": injected,
+            "detections": detected,
+            "score": score,
+            "slo": {
+                "targets": {"dropped_streams": 0, "bit_identical": True},
+                "measured": {
+                    "dropped_streams": 0,
+                    "fetch_failures": c.handoff_fetch_failures,
+                    "control_pulls": b.handoff_fetches,
+                },
+                "pass": True,
+            },
+        })
+    finally:
+        _teardown_router(replicas, router)
+
+
+def test_chaos_fabric_owner_death_mid_pull(tmp_path):
+    """Fleet KV fabric under owner death (ISSUE 18): the advertised
+    owner trickles its /v1/prefill body (prefill_chunk_s) and is
+    KILLED mid-transfer while a locator-stamped pull is in flight.
+    Control: a clean pull through the same trickled wire.  Fault: the
+    pulling replica's parse-before-admit verifier rejects the torn
+    stream, admits NOTHING, and degrades to LOCAL prefill with
+    bit-identical tokens and zero dropped streams; its
+    handoff.fetch_failed events score precision/recall 1.0 against the
+    injected kill window."""
+    import threading
+
+    from tests.fakes import fake_generate
+
+    chaos_report = _chaos_report()
+    replicas, router, flight = _fabric_fleet(3, prefill_chunk_s=0.05)
+    a, b, c = replicas
+    try:
+        # --- Control: B pulls prefix1 from A through the trickled wire.
+        prefix1 = list(range(500, 516))
+        pa = _fabric_prompt_homed(router, a.name, prefix1)
+        out = _fabric_post(router.port, {"prompt": pa, "max_new_tokens": 2})
+        assert out["tokens"] == fake_generate(pa, 2)
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=10,
+        )
+        pb = _fabric_prompt_homed(router, b.name, prefix1, base=1200)
+        out = _fabric_post(router.port, {"prompt": pb, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(pb, 3)
+        assert b.handoff_fetch_failures == 0
+        assert a.prefill_serves == 1
+
+        # --- Fault: a 64-token pull (4 entries x 0.05s trickle) from
+        # A; kill A while the body is mid-stream.
+        prefix2 = list(range(600, 616))
+        pa2 = _fabric_prompt_homed(router, a.name, prefix2, base=2000)
+        out = _fabric_post(router.port, {"prompt": pa2, "max_new_tokens": 2})
+        assert out["tokens"] == fake_generate(pa2, 2)
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 2,
+            timeout=10,
+        )
+        pc = _fabric_prompt_homed(
+            router, c.name, prefix2, base=2800, suffix_len=48
+        )
+        holder: dict = {}
+
+        def run_request():
+            holder["out"] = _fabric_post(
+                router.port, {"prompt": pc, "max_new_tokens": 3}, timeout=60
+            )
+
+        t0 = time.time()
+        requester = threading.Thread(target=run_request, daemon=True)
+        requester.start()
+        assert wait_until(
+            lambda: a.prefill_serves >= 2, timeout=10
+        ), "the pull never started"
+        time.sleep(0.07)  # land inside the trickled body (~entry 2 of 4)
+        a.kill()
+        requester.join(timeout=60)
+        t1 = time.time()
+        assert "out" in holder, "request never finished"
+        # ZERO drops, bit-identical via the local-prefill fallback.
+        assert holder["out"]["tokens"] == fake_generate(pc, 3)
+        assert c.handoff_fetch_failures == 1
+        assert c.cold_prefills >= 1, "local prefill never ran"
+        assert b.handoff_fetch_failures == 0
+
+        # --- Score: fetch_failed events vs the kill window.
+        injected = [
+            {"cls": "fabric_owner_death", "replica": c.name,
+             "t0": t0, "t1": t1}
+        ]
+        detected = [
+            {"cls": "fabric_owner_death", "replica": r.name, "ts": e["ts"]}
+            for r in replicas
+            for e in r.flight.window(kinds=["handoff.fetch_failed"])
+        ]
+        score = chaos_report.score_detections(injected, detected, grace_s=2.0)
+        cls = score["per_class"]["fabric_owner_death"]
+        assert cls["precision"] == 1.0 and cls["recall"] == 1.0, score
+        _publish({
+            "scenario": "fabric_owner_death_mid_pull",
+            "faults": injected,
+            "detections": detected,
+            "score": score,
+            "slo": {
+                "targets": {"dropped_streams": 0, "bit_identical": True},
+                "measured": {
+                    "dropped_streams": 0,
+                    "fetch_failures": c.handoff_fetch_failures,
+                    "control_pulls": b.handoff_fetches,
+                },
+                "pass": True,
+            },
+        })
+    finally:
+        _teardown_router(replicas, router)

@@ -379,9 +379,18 @@ def test_v1_prefill_serves_wire_and_decode_gate_degrades(tiered_engine):
         )
         assert status == 200 and json.loads(body)["tokens"] == ref
         assert eng.handoff_fetch_failures == 1  # unchanged: no dial
-        # Decode role serves no prefill.
+        # Decode role serves RESIDENT prefixes to any peer (the fabric
+        # any-peer pull path: the local prefills above made this prompt
+        # resident) — and refuses a cold prompt WITHOUT probing (409 +
+        # fabric.serve_refused; the arena stays untouched).
         status, _, _ = _post(server.port, "/v1/prefill", {"prompt": prompt})
-        assert status == 409
+        assert status == 200
+        status, _, body = _post(
+            server.port, "/v1/prefill", {"prompt": [5] * len(prompt)}
+        )
+        assert status == 409 and b"resident-only" in body
+        refused = eng.flight.window(kinds=["fabric.serve_refused"])
+        assert refused and refused[-1]["role"] == "decode"
         # /debug/disagg carries the ledger.
         with urllib.request.urlopen(
             f"http://127.0.0.1:{server.port}/debug/disagg", timeout=10
@@ -451,5 +460,155 @@ def test_summary_and_debug_state_carry_role(tiered_engine):
             state = json.loads(resp.read())
         assert state["engine"]["config"]["role"] == "decode"
         assert state["engine"]["disagg"]["role"] == "decode"
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ fleet fabric
+
+
+def test_fabric_digest_advertises_resident_prefixes(tiered_engine):
+    """The bloom advertisement covers exactly the cumulative full-page
+    prefixes the replica can serve, roundtrips through the wire form,
+    and is version-cached (the summary poll must not rebuild an
+    unchanged filter).  ``None`` when the replica cannot serve pulls."""
+    from k8s_device_plugin_tpu.utils.prefixbloom import PrefixBloom
+
+    cfg, params, eng = tiered_engine
+    eng.kvcache_clear()
+    prompt = [3, 141, 59, 7, 11, 5, 9, 2]  # 2 full pages @ page_size 4
+    eng.run([(prompt, 3)])
+    root = eng._trie_root(None)
+    wire = eng.fabric_digest()
+    assert wire is not None and wire["page_size"] == eng.paged.page_size
+    assert wire["count"] >= 2
+    bloom = PrefixBloom.from_wire(wire)
+    assert bloom is not None
+    assert bloom.contains(root, tuple(prompt[:4]))
+    assert bloom.contains(root, tuple(prompt))
+    # Version-keyed cache: an unchanged arena+trie returns the SAME
+    # rendered dict with zero rebuild work.
+    builds = eng.fabric_digest_builds
+    assert eng.fabric_digest() is wire
+    assert eng.fabric_digest_builds == builds
+    # A replica that cannot serve pulls advertises nothing at all —
+    # the locator must never place prefixes on it.
+    eng.prefix_sharing = False
+    try:
+        assert eng.fabric_digest() is None
+    finally:
+        eng.prefix_sharing = True
+
+
+def test_fabric_digest_invalidated_when_graft_unpends_pages(tiered_engine):
+    """Regression: a digest built MID-prefill (the router poll racing a
+    cold admission) sees only pending pages and caches an empty filter;
+    the pending->grafted transition in ``_activate`` must invalidate
+    that cache like any trie edit, or the replica advertises nothing
+    until unrelated churn bumps a version.  Chunked prefill holds the
+    pages pending across several steps so the race is deterministic."""
+    cfg, params, eng = tiered_engine
+    eng.kvcache_clear()
+    eng._prefill_chunk = 4
+    prompt = [3, 141, 59, 265, 35, 7, 7, 3, 1, 2, 9, 4]  # 3 full pages
+    root = eng._trie_root(None)
+    req = eng.submit(prompt, 2)
+    eng.step()  # admit + first chunk: pages registered, still pending
+    mid = eng.fabric_digest()
+    assert mid is not None and mid["count"] == 0  # pending never advertised
+    assert eng.fabric_digest() is mid  # ...and the empty filter is cached
+    for _ in range(200):
+        if req.done:
+            break
+        eng.step()
+    assert req.done
+    done = eng.fabric_digest()
+    assert done is not mid, "graft did not invalidate the digest cache"
+    assert done["count"] >= 3
+    from k8s_device_plugin_tpu.utils.prefixbloom import PrefixBloom
+
+    bloom = PrefixBloom.from_wire(done)
+    for pages in (1, 2, 3):
+        assert bloom.contains(root, tuple(prompt[: pages * 4]))
+
+
+def test_fabric_partial_serve_stops_at_resident_coverage(tiered_engine):
+    """Any-peer pull of a LONGER prompt sharing only the leading pages
+    (the fleet-wide shared system prompt): a resident-only serve
+    streams exactly the covered prefix — entry count in the preamble is
+    the COVERED page count, every entry parses, and no probe ran."""
+    cfg, params, eng = tiered_engine
+    eng.kvcache_clear()
+    shared = [3, 141, 59, 7, 11, 5, 9, 2]  # resident: 2 full pages
+    eng.run([(shared, 3)])
+    server = _served(eng)
+    try:
+        with eng._lock:
+            layout = snap.snapshot_layout(eng)
+            fp = snap.params_fingerprint(eng.params)
+        probes_before = eng.handoff_serves
+        published_before = eng.handoff_published_entries
+        status, headers, wire = _post(
+            server.port,
+            "/v1/prefill",
+            {"prompt": shared + [13, 2, 5, 8]},  # 3rd page NOT resident
+            {handoff.FABRIC_RESIDENT_ONLY_HEADER: "1"},
+        )
+        assert status == 200
+        assert headers[snap.ENTRIES_HEADER] == "2"
+        _, entries = snap._parse_snapshot(io.BytesIO(wire), layout, fp)
+        assert [e[0] for e in entries] == [
+            ("prefix", eng._trie_root(None), tuple(shared[:4])),
+            ("prefix", eng._trie_root(None), tuple(shared)),
+        ]
+        assert _wait(lambda: eng.handoff_serves == probes_before + 1)
+        # No probe: the engine never admitted the longer prompt.
+        assert eng.handoff_published_entries == published_before
+    finally:
+        server.stop()
+
+
+def test_fabric_pull_and_drop_roundtrip_over_wire(tiered_engine):
+    """``fabric_pull`` (the router's replication verb) admits the
+    owner's pages into the host arena through the real /v1/prefill
+    wire + parse-before-admit verifier; ``fabric_drop`` releases
+    exactly those host copies while the trie-resident serving state
+    stays untouched.  Self-pull keeps it to one engine — the wire
+    path is identical either way."""
+    cfg, params, eng = tiered_engine
+    eng.kvcache_clear()
+    prompt = [3, 141, 59, 7, 11, 5, 9, 2]
+    eng.run([(prompt, 3)])
+    root = eng._trie_root(None)
+    server = _served(eng)
+    try:
+        result = eng.fabric_pull(f"127.0.0.1:{server.port}", prompt)
+        assert result["ok"] and result["restored"] == 2
+        assert eng.fabric_pulls == 1
+        assert ("prefix", root, tuple(prompt)) in eng._kv_arena
+        pulled = eng.flight.window(kinds=["fabric.pulled"])
+        assert pulled and pulled[-1]["restored"] == 2
+        # Drop releases the HOST copies only...
+        drop = eng.fabric_drop(prompt)
+        assert drop == {"ok": True, "dropped": 2}
+        assert eng.fabric_drops == 1
+        assert ("prefix", root, tuple(prompt)) not in eng._kv_arena
+        assert eng.flight.window(kinds=["fabric.dropped"])
+        # ...so the replica is still an owner: resident-only serve of
+        # the trie pages keeps answering.
+        status, _, _ = _post(
+            server.port,
+            "/v1/prefill",
+            {"prompt": prompt},
+            {handoff.FABRIC_RESIDENT_ONLY_HEADER: "1"},
+        )
+        assert status == 200
+        # The replica-side ledger carries all of it.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/fabric", timeout=10
+        ) as resp:
+            state = json.loads(resp.read())
+        assert state["enabled"] and state["advertised_roots"] >= 2
+        assert state["pulls"] == 1 and state["drops"] == 1
     finally:
         server.stop()

@@ -782,6 +782,11 @@ def test_debug_state_summary_mode(served):
     fp = summary.pop("params_fingerprint")
     assert isinstance(fp, str) and fp
     assert isinstance(summary.pop("requests_total"), int)
+    # Fleet-KV-fabric advertisement (router/fabric.py): a wire bloom
+    # dict when this engine can serve any-peer pulls, else null; the
+    # populated shape is pinned in test_engine_handoff.py.
+    digest = summary.pop("fabric_digest")
+    assert digest is None or set(digest) >= {"m", "k", "bits", "count"}
     assert summary == {
         "role": "unified",
         "queue_depth": 0,

@@ -1819,3 +1819,346 @@ def test_disagg_role_discovered_by_poll_reconciles_ring():
         assert wait_until(lambda: a.name in router.ring.nodes, timeout=5)
     finally:
         _teardown([a, b], router)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide content-addressed KV fabric (router/fabric.py, --fabric):
+# bloom-advertised locator, any-peer pulls, K-replica hot-prefix
+# replication.  All jax-free: FakeReplica advertises real PrefixBloom
+# digests and serves /v1/prefill in the real wire format.
+
+
+def _bloom_wire(prefixes, page_size=16, root=-1):
+    """A fabric_digest wire dict advertising the given token prefixes."""
+    from k8s_device_plugin_tpu.utils.prefixbloom import PrefixBloom
+
+    bloom = PrefixBloom()
+    for p in prefixes:
+        bloom.add(root, p)
+    wire = bloom.to_wire()
+    wire["page_size"] = page_size
+    return wire
+
+
+def test_fabric_locator_coverage_best_owner_and_forget():
+    """FabricLocator resolves the deepest page-aligned advertised
+    prefix per replica, deterministic name tie-break, and drops views
+    on absent/unparseable digests and membership removal."""
+    from k8s_device_plugin_tpu.router.fabric import FabricLocator
+
+    loc = FabricLocator(16)
+    prompt = list(range(100, 148))  # 3 full 16-token pages
+    assert loc.update("a", _bloom_wire([prompt[:16], prompt[:32]])) == 2
+    assert loc.update(
+        "b", _bloom_wire([prompt[:16], prompt[:32], prompt[:48]])
+    ) == 3
+    assert loc.update("c", {"bogus": 1}) == 0  # unparseable: no view
+    assert loc.coverage("a", prompt) == 32
+    assert loc.coverage("b", prompt) == 48
+    assert loc.coverage("c", prompt) == 0
+    assert loc.best_owner(prompt, ["a", "b", "c"]) == ("b", 48)
+    # Equal depth ties break toward the smaller name: stable stamping.
+    loc.update("b", _bloom_wire([prompt[:16], prompt[:32]]))
+    assert loc.best_owner(prompt, ["b", "a"]) == ("a", 32)
+    # owners() is the FULL-prefix census the replicator counts.
+    assert loc.owners(prompt[:32], ["a", "b"]) == ["a", "b"]
+    assert loc.owners(prompt, ["a", "b"]) == []
+    # A poll with no digest clears the view; forget drops it outright.
+    assert loc.update("a", None) == 0
+    assert loc.coverage("a", prompt) == 0
+    loc.forget("b")
+    assert loc.advertised_roots() == {}
+
+
+def test_fabric_replicator_k_copies_ledger_and_cold_eviction():
+    """FabricReplicator plans one bounded pull for a hot prefix whose
+    owner runs hot, counts the unconfirmed copy toward K (no duplicate
+    while digests lag), and drops ONLY the router-created copy after
+    the prefix goes cold."""
+    from k8s_device_plugin_tpu.router.fabric import (
+        FabricConfig,
+        FabricLocator,
+        FabricReplicator,
+    )
+
+    loc = FabricLocator(16)
+    prefix = tuple(range(200, 232))  # 2 full pages
+    loc.update("a", _bloom_wire([list(prefix)[:16], list(prefix)]))
+    cfg = FabricConfig(
+        replicate_k=2, hot_wait_s=1.0, cold_wait_s=0.2,
+        hot_score=2.0, cold_sweeps=2, confirm_sweeps=3,
+    )
+    rep = FabricReplicator(cfg)
+    hot = {prefix: 1}  # 1 live stream x 2 pages = 2.0 >= hot_score
+    pressures = {"a": 5.0, "b": 0.0, "c": 0.1}
+    assert rep.plan(loc, hot, pressures) == [{
+        "op": "pull", "target": "b", "source": "a",
+        "prompt": list(prefix), "streams": 1, "pages": 2,
+    }]
+    # The planned copy counts toward K until confirmed: no duplicate.
+    assert rep.plan(loc, hot, pressures) == []
+    # The pull lands and the target's digest confirms the copy.
+    loc.update("b", _bloom_wire([list(prefix)[:16], list(prefix)]))
+    assert rep.plan(loc, hot, pressures) == []
+    # Cold: after cold_sweeps zero-stream sweeps the ROUTER-CREATED
+    # copy is dropped; the traffic-warmed owner "a" keeps its own.
+    assert rep.plan(loc, {}, pressures) == []  # streak 1 of 2
+    assert rep.plan(loc, {}, pressures) == [
+        {"op": "drop", "target": "b", "prompt": list(prefix)}
+    ]
+    assert rep.snapshot()["ledger"] == []
+    # Comfortable owners never trigger copies (affinity already works).
+    assert FabricReplicator(cfg).plan(
+        loc, hot, {"a": 0.3, "b": 0.0}
+    ) == []
+    # No cold target = no copy (a scale signal, not an action).
+    assert FabricReplicator(cfg).plan(
+        loc, hot, {"a": 5.0, "b": 2.0}
+    ) == []
+
+
+def _fabric_prompt_on(router, replica_name, prefix, base=500):
+    """A prompt sharing ``prefix`` whose ring home is ``replica_name``
+    (the suffix block varies the affinity key, the shared prefix does
+    not)."""
+    for salt in range(base, base + 500):
+        prompt = list(prefix) + [salt] * 16
+        if router.ring.order(router.policy.key_of(prompt))[0] == replica_name:
+            return prompt
+    raise AssertionError(f"no prompt with that prefix homes on {replica_name}")
+
+
+def test_fabric_stamps_any_peer_source_and_pulls_once():
+    """The tentpole path: replica A warms a prefix through ordinary
+    traffic and advertises it on the poll; a request for the same
+    prefix homed on B gets A stamped as X-Handoff-Source (+ the
+    resident-only fabric header); B pulls the prefix over the REAL
+    /v1/prefill wire exactly once and later requests are resident —
+    the shared prefix is prefilled once fleet-wide."""
+    replicas, router, flight = _fleet(
+        3,
+        router_kwargs={"fabric": True, "racecheck": True},
+        prefix_tokens=16,
+    )
+    a, b = replicas[0], replicas[1]
+    try:
+        prompt = _home_prompt(router, a.name, length=32)
+        out = _post(router.port, {"prompt": prompt, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(prompt, 3)
+        assert a.cold_prefills == 1  # first touch prefills locally
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=5,
+        )
+        # Same 16-token prefix, different suffix, homed on B.
+        p2 = _fabric_prompt_on(router, b.name, prompt[:16])
+        out = _post(router.port, {"prompt": p2, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(p2, 3)
+        assert b.seen_fabric_sources[-1] == a.name
+        assert b.handoff_fetches == 1 and b.handoff_fetch_failures == 0
+        assert a.prefill_serves == 1
+        assert b.cold_prefills == 0  # the pull REPLACED the local prefill
+        assert any(
+            e["source"] == a.name and e["target"] == b.name
+            for e in flight.window(kinds=["router.fabric_locate"])
+        )
+        # Third request, same prefix, same home: now resident on B —
+        # no new pull, no new serve.
+        out = _post(router.port, {"prompt": p2, "max_new_tokens": 2})
+        assert out["tokens"] == fake_generate(p2, 2)
+        assert b.handoff_fetches == 1
+        # Surfaces: GET /debug/fabric + the /debug/fleet fabric block.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/fabric", timeout=10
+        ) as resp:
+            state = json.loads(resp.read())
+        assert state["enabled"] and state["cross_peer_hits"] >= 1
+        assert a.name in state["replicas"]
+        fleet = router.fleet_state()["fabric"]
+        assert fleet["enabled"]
+        assert fleet["advertised_roots"].get(a.name, 0) >= 1
+        assert 0.0 < fleet["cross_peer_hit_rate"] <= 1.0
+    finally:
+        _teardown(replicas, router)
+
+
+def test_fabric_stale_locator_degrades_to_local_prefill():
+    """A stale digest (the owner advertised, then evicted) stamps a
+    source that refuses the resident-only pull: the target degrades to
+    LOCAL prefill and the client stream is oracle-identical — the
+    fabric can waste a fetch, never corrupt an answer."""
+    replicas, router, _ = _fleet(
+        3, router_kwargs={"fabric": True}, prefix_tokens=16
+    )
+    a, b = replicas[0], replicas[1]
+    try:
+        prompt = _home_prompt(router, a.name, length=32)
+        _post(router.port, {"prompt": prompt, "max_new_tokens": 2})
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=5,
+        )
+        # Freeze A's advertisement, then evict its working set: the
+        # locator keeps naming A while A can no longer serve.
+        stale = a.fabric_digest()
+        a.fabric_digest = lambda: stale
+        with a._lock:
+            a.warm_prefixes.clear()
+        p2 = _fabric_prompt_on(router, b.name, prompt[:16])
+        out = _post(router.port, {"prompt": p2, "max_new_tokens": 3})
+        assert out["tokens"] == fake_generate(p2, 3)  # bit-identical
+        assert b.handoff_fetch_failures == 1
+        assert a.prefill_refusals >= 1  # resident-only 409, no probe
+        assert b.cold_prefills >= 1  # the local-prefill degradation
+    finally:
+        _teardown(replicas, router)
+
+
+def test_fabric_replication_copies_hot_prefix_then_evicts_cold():
+    """The replication plane end-to-end: a live stream on a hot owner
+    triggers ONE proactive copy to the coldest peer (the engine-side
+    /debug/fabric/pull wire), the ledger caps fan-out at K, and the
+    router-created copy is dropped once the prefix goes cold."""
+    from k8s_device_plugin_tpu.router.fabric import FabricConfig
+
+    replicas, router, flight = _fleet(
+        3,
+        router_kwargs={
+            "fabric": True,
+            "fabric_config": FabricConfig(
+                replicate_k=2, hot_wait_s=0.5, cold_wait_s=0.2,
+                hot_score=2.0, cold_sweeps=2, confirm_sweeps=50,
+                pull_timeout_s=10.0,
+            ),
+        },
+        prefix_tokens=32,
+        token_delay_s=0.06,
+    )
+    a = replicas[0]
+    others = replicas[1:]
+    try:
+        prompt = _home_prompt(router, a.name, length=32)
+        with a._lock:
+            a.warm_prefixes.add(tuple(prompt))  # traffic-warmed owner
+        a.wait_ewma_s = 5.0  # the owner runs hot (host-side signal)
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 2,
+            timeout=5,
+        )
+        import threading as _threading
+
+        t = _threading.Thread(
+            target=lambda: _stream(
+                router.port, {"prompt": prompt, "max_new_tokens": 50}
+            ),
+        )
+        t.start()
+        try:
+            # 1 stream x 2 pages = hot_score: one pull lands on the
+            # colder peer through the engine admin endpoint.
+            assert wait_until(
+                lambda: sum(r.fabric_pulls for r in others) == 1,
+                timeout=5,
+            )
+            target = next(r for r in others if r.fabric_pulls)
+            assert tuple(prompt) in target.warm_prefixes
+            assert a.prefill_serves == 1  # pulled FROM the hot owner
+            # K=2 satisfied (ledger + digest): no further fan-out.
+            time.sleep(0.5)
+            assert sum(r.fabric_pulls for r in others) == 1
+            assert any(
+                e["ok"] and e["target"] == target.name
+                for e in flight.window(kinds=["router.fabric_replicated"])
+            )
+        finally:
+            t.join()
+        # Stream over: the prefix goes cold and the router drops the
+        # copy IT created — the owner's own copy stays.
+        assert wait_until(lambda: target.fabric_drops == 1, timeout=5)
+        assert tuple(prompt) not in target.warm_prefixes
+        assert tuple(prompt) in a.warm_prefixes
+        assert flight.window(kinds=["router.fabric_dropped"])
+        assert router.fabric_state()["replication"]["pulls_planned"] == 1
+    finally:
+        _teardown(replicas, router)
+
+
+def test_metrics_lint_clean_on_live_router_with_fabric_lit():
+    """The strict exposition lint against a router whose fabric plane
+    has actually resolved (locator families populated): the closed
+    verdict enums stay inside their FAMILY_BUDGETS rows."""
+    metrics_lint = _load_metrics_lint()
+    replicas, router, _ = _fleet(
+        2, router_kwargs={"fabric": True}, prefix_tokens=16
+    )
+    a, b = replicas
+    try:
+        prompt = _home_prompt(router, a.name, length=32)
+        _post(router.port, {"prompt": prompt, "max_new_tokens": 2})
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=5,
+        )
+        p2 = _fabric_prompt_on(router, b.name, prompt[:16])
+        _post(router.port, {"prompt": p2, "max_new_tokens": 2})  # hit
+        _post(router.port, {"prompt": p2, "max_new_tokens": 2})
+        errors = metrics_lint.lint_url(
+            f"http://127.0.0.1:{router.port}/metrics"
+        )
+        assert errors == [], errors
+    finally:
+        _teardown(replicas, router)
+
+
+def test_fleet_plan_renders_fabric_columns():
+    """tools/fleet_plan.py grew the locator view (ISSUE 18): the
+    per-replica kv_roots column, the cross-peer hit-rate line, and the
+    hottest-prefix replication factors render from /debug/fleet —
+    live for the locator numbers, synthetic for the hottest-prefix
+    rows (they require an in-flight stream); a fabric-less fleet
+    renders the disabled line, not a crash."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_plan", os.path.join(repo, "tools", "fleet_plan.py")
+    )
+    fleet_plan = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_plan)
+
+    replicas, router, _ = _fleet(
+        2, router_kwargs={"fabric": True}, prefix_tokens=16
+    )
+    a, b = replicas
+    try:
+        prompt = _home_prompt(router, a.name, length=32)
+        _post(router.port, {"prompt": prompt, "max_new_tokens": 2})
+        assert wait_until(
+            lambda: router.fabric.advertised_roots().get(a.name, 0) >= 1,
+            timeout=5,
+        )
+        p2 = _fabric_prompt_on(router, b.name, prompt[:16])
+        _post(router.port, {"prompt": p2, "max_new_tokens": 2})  # pull
+        fleet = router.fleet_state()
+        out = fleet_plan.render(fleet)
+        assert "kv_roots" in out
+        assert "fabric: cross-peer hit rate" in out
+        # The owner's row carries its advertised-root count.
+        owner_row = next(
+            line for line in out.splitlines() if line.startswith(a.name)
+        )
+        assert f" {fleet['fabric']['advertised_roots'][a.name]} " in (
+            owner_row + " "
+        )
+        # Hottest-prefix rows (live streams) rendered from a snapshot.
+        fleet["fabric"]["hottest_prefixes"] = [
+            {"prefix_tokens": 16, "streams": 3, "replication_factor": 2}
+        ]
+        out = fleet_plan.render(fleet)
+        assert "hot prefix 16 tokens: 3 streams, K=2" in out
+    finally:
+        _teardown(replicas, router)
+    # A fabric-less fleet renders the disabled line.
+    bare = fleet_plan.render({"replicas": {}, "slo": {"enabled": False}})
+    assert "fabric: disabled" in bare

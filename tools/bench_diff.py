@@ -116,6 +116,29 @@ def load_record(path: str) -> dict:
                 else (affinity.get("dropped") or 0)
                 + (control.get("dropped") or 0)
             )
+        # Fabric block (FABRIC serving rows, benchmark.py
+        # _run_fabric_phase): fleet-wide KV prefix hits/request and
+        # client TTFT p99 with the content-addressed fabric on vs the
+        # affinity-only control over the same shared-prefix traffic.
+        # The regression tells: cross_peer_pulls dropping to 0 (the
+        # any-peer pull path stopped moving pages and "fabric" is
+        # silently affinity-only — NO-FABRIC-HITS), or the fabric TTFT
+        # p99 exceeding 1.2x the control's (FABRIC-TTFT-REGRESSED:
+        # locating and pulling costs more than the prefill it saves).
+        fabric = parsed.get("fabric")
+        if isinstance(fabric, dict) and not fabric.get("skipped"):
+            on = fabric.get("fabric") or {}
+            off = fabric.get("control") or {}
+            rec["fabric_hit_rate"] = on.get("hit_rate")
+            rec["fabric_ttft_p99_ms"] = on.get("ttft_p99_ms")
+            rec["fabric_cross_peer_pulls"] = on.get("cross_peer_pulls")
+            rec["fabric_control_hit_rate"] = off.get("hit_rate")
+            rec["fabric_control_ttft_p99_ms"] = off.get("ttft_p99_ms")
+            rec["fabric_dropped"] = (
+                None
+                if on.get("dropped") is None and off.get("dropped") is None
+                else (on.get("dropped") or 0) + (off.get("dropped") or 0)
+            )
         # Overload block (OVERLOAD serving rows, benchmark.py
         # _run_overload_phase): high-priority TTFT p99 under a 2x
         # mixed-priority storm vs unloaded, the goodput ratio
@@ -334,6 +357,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
         "router_dropped",
+        "fabric_hit_rate", "fabric_ttft_p99_ms",
+        "fabric_cross_peer_pulls", "fabric_control_hit_rate",
+        "fabric_control_ttft_p99_ms", "fabric_dropped",
     ):
         va, vb = a.get(field), b.get(field)
         if va is None and vb is None:
@@ -406,6 +432,31 @@ def ledger_row(a: dict, b: dict) -> str:
                     else ""
                 )
                 if b.get("router_replicas") is not None
+                else ""
+            )
+            + (
+                f"; fabric {b['fabric_hit_rate']} hits/req "
+                f"p99 {b.get('fabric_ttft_p99_ms')}ms "
+                f"({b.get('fabric_cross_peer_pulls')} pulls) vs control "
+                f"{b.get('fabric_control_hit_rate')} / "
+                f"{b.get('fabric_control_ttft_p99_ms')}ms"
+                + (
+                    ", NO-FABRIC-HITS"
+                    if b.get("fabric_cross_peer_pulls") == 0
+                    else ""
+                )
+                + (
+                    ", FABRIC-TTFT-REGRESSED"
+                    if (b.get("fabric_ttft_p99_ms") or 0.0)
+                    > 1.2 * (b.get("fabric_control_ttft_p99_ms") or float("inf"))
+                    else ""
+                )
+                + (
+                    f", DROPPED {b['fabric_dropped']}"
+                    if b.get("fabric_dropped")
+                    else ""
+                )
+                if b.get("fabric_hit_rate") is not None
                 else ""
             )
             + (

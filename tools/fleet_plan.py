@@ -41,9 +41,11 @@ def load_fleet(url: str | None, path: str | None) -> dict:
 
 def render(fleet: dict) -> str:
     """The operator table: one row per replica, then the verdict."""
+    fabric = fleet.get("fabric") or {}
     lines = [
         f"{'replica':<24} {'pressure_s':>10} {'queue':>6} {'slots':>6} "
-        f"{'wait_ewma':>10} {'drain_rps':>10} {'avail_sli':>10}  state"
+        f"{'wait_ewma':>10} {'drain_rps':>10} {'avail_sli':>10} "
+        f"{'kv_roots':>8}  state"
     ]
     for name, row in sorted((fleet.get("replicas") or {}).items()):
         state = []
@@ -59,13 +61,23 @@ def render(fleet: dict) -> str:
         # good/total per replica, "-" until the replica exports it.
         avail = (row.get("slo_totals") or {}).get("availability")
         sli = f"{avail[0]}/{avail[1]}" if avail else "-"
+        # Fleet-KV-fabric locator column (ISSUE 18): how many prefix
+        # roots this replica currently advertises — 0 on a replica
+        # whose digest went dark is the first thing to look at when
+        # cross-peer hits sag.  "-" until the fabric is on.
+        roots = (
+            (fabric.get("advertised_roots") or {}).get(name, 0)
+            if fabric.get("enabled")
+            else "-"
+        )
         lines.append(
             f"{name:<24} {row.get('pressure_s', 0):>10.3f} "
             f"{row.get('queue_depth', 0):>6} "
             f"{row.get('active_slots', 0):>6} "
             f"{wait if wait is not None else '-':>10} "
             f"{drain if drain is not None else '-':>10} "
-            f"{sli:>10}  "
+            f"{sli:>10} "
+            f"{roots:>8}  "
             f"{','.join(state) or 'ok'}"
         )
     migration = fleet.get("migration") or {}
@@ -101,6 +113,25 @@ def render(fleet: dict) -> str:
             )
     else:
         lines.append("slo: disabled")
+    # Fleet KV fabric view (ISSUE 18; the full view is /debug/fabric):
+    # the hottest live prefixes' current replication factors and the
+    # cross-peer hit rate, next to the per-replica kv_roots column
+    # above — replication factor stuck at 1 on a hot prefix while its
+    # owner's pressure climbs means the replication plane stalled.
+    if fabric.get("enabled"):
+        lines.append(
+            f"fabric: cross-peer hit rate "
+            f"{fabric.get('cross_peer_hit_rate', 0.0)} "
+            f"({fabric.get('cross_peer_hits', 0)} hits)"
+        )
+        for hot in fabric.get("hottest_prefixes") or []:
+            lines.append(
+                f"  hot prefix {hot.get('prefix_tokens', '?')} tokens: "
+                f"{hot.get('streams', 0)} streams, "
+                f"K={hot.get('replication_factor', 0)}"
+            )
+    else:
+        lines.append("fabric: disabled")
     rec = fleet.get("recommendation") or {}
     lines.append(
         f"recommendation: {rec.get('action', 'hold').upper()} "

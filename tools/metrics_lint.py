@@ -94,6 +94,17 @@ FAMILY_BUDGETS = {
     "tpu_router_canary_fences_total": 8,
     "tpu_chip_selftest_total": 32,  # 8 chips x 4 verdicts
     "tpu_chip_selftest_quarantined": 8,
+    # Fleet KV fabric (router/fabric.py, models/engine_handoff.py).
+    # Locator verdicts and replication outcomes are CLOSED enums
+    # (fabric.VERDICTS; ok/error) over a bounded fleet — a breach
+    # means a prompt hash or replica-local value leaked into a label.
+    "tpu_router_fabric_resolutions_total": 4,  # hit/resident/miss/skip
+    "tpu_router_fabric_replications_total": 2,  # ok / error
+    "tpu_router_fabric_drops_total": 2,  # ok / error
+    "tpu_router_fabric_advertised_roots": 8,  # one gauge per replica
+    "tpu_engine_fabric_pulls_total": 2,  # ok / error
+    "tpu_engine_fabric_drops_total": 1,  # unlabeled counter
+    "tpu_engine_fabric_digest_roots": 1,  # unlabeled gauge
 }
 
 
