@@ -1048,6 +1048,247 @@ def _run_canary_phase(args) -> dict | None:
     return block
 
 
+def _run_autoscale_phase(args) -> dict:
+    """AUTOSCALE perf phase: the closed-loop fleet controller
+    (controller/reconciler.py — the REAL Reconciler + FleetSimActuator,
+    fake clock) vs a static peak-provisioned fleet over the SAME
+    deterministic 600-sim-second diurnal + flash-crowd demand trace.
+
+    What the row claims and how it is measured:
+
+    - **replica-minutes** — both fleets' bills over the identical
+      trace, from the controller's own accrual ledger (serving AND
+      still-warming replicas are billed; the elastic fleet must come
+      in STRICTLY under the static fleet sized for the observed peak,
+      or the autoscaler is not paying for itself).
+    - **TTFT p99 / SLO violations** — a fluid-queue fleet model: one
+      global backlog drained at ``cap_rps`` per serving replica, plus
+      an M/M/1-flavored in-service wait term so a keeping-up-but-busy
+      fleet reports nonzero pressure (utilization separates busy from
+      idle without a backlog — without that term the model flaps:
+      every drain-to-empty reads as cold, every reap re-hots the
+      fleet).  TTFT = base + queue wait; a sim-second above ``slo_ms``
+      is a violation, and the controller fleet must log ZERO.
+
+    The demand trace, thresholds, and clock are all deterministic (no
+    RNG, no wall time), so the block's numbers are exactly reproducible
+    and tools/bench_diff.py can gate on them (REPLICA-MINUTES-REGRESSED
+    / AUTOSCALE-SLO-VIOLATED).  Pure host-side Python: no compiles, no
+    devices, ~milliseconds of wall clock."""
+    import math
+
+    from ..controller import (
+        ControllerConfig,
+        FleetSimActuator,
+        Reconciler,
+    )
+    from ..router.migration import scale_recommendation
+
+    sim_seconds = 600
+    cap_rps = 40.0  # one replica's drain rate
+    base_ttft_ms = 60.0
+    slo_ms = 2500.0  # TTFT budget: base + queue wait
+    hot_wait_s, cold_wait_s = 0.2, 0.02
+    warm_lag_s = 3.0  # spawn -> serving (peer-warmed join)
+
+    def demand(t: float) -> float:
+        """Diurnal sinusoid (5-minute "day", 15..75 rps) with a flash
+        crowd riding the second peak: +80 rps ramping in over 30s,
+        holding 60s, ramping out."""
+        diurnal = 45.0 + 30.0 * math.sin(
+            2 * math.pi * (t - 225.0) / 300.0
+        )
+        if 300 <= t < 330:
+            flash = 80.0 * (t - 300) / 30.0
+        elif 330 <= t < 390:
+            flash = 80.0
+        elif 390 <= t < 420:
+            flash = 80.0 * (420 - t) / 30.0
+        else:
+            flash = 0.0
+        return max(0.0, diurnal + flash)
+
+    class _Sim:
+        """Deterministic fluid-queue fleet: the actuator seam mutates
+        it, the fleet() view is what the controller polls."""
+
+        def __init__(self, n0: int):
+            self.n = n0
+            self.names = [f"sim-{i}" for i in range(n0)]
+            self.counter = n0
+            self.warming: list = []  # [ready_at, name]
+            self.queue = 0.0
+            self.t = 0.0
+            self.ttfts_ms: list = []
+            self.violations = 0
+            self.replica_seconds = 0.0
+            self.peak = n0
+
+        # ----- actuator verbs (FleetSimActuator closures) -----------
+        def spawn(self, role: str) -> str:
+            name = f"sim-{self.counter}"
+            self.counter += 1
+            self.warming.append([self.t + warm_lag_s, name])
+            return name
+
+        def reap(self, name: str) -> None:
+            if name in self.names:
+                self.names.remove(name)
+                self.n -= 1
+
+        # ----- signal model -----------------------------------------
+        def wait_s(self, d: float) -> float:
+            # rho capped below 1: past saturation the backlog term
+            # carries the overload signal (uncapped, the M/M/1 term
+            # diverges and reports a 25s wait over an empty queue).
+            rho = min(0.98, d / (self.n * cap_rps))
+            return (
+                self.queue / (self.n * cap_rps)
+                + rho / (1.0 - rho) / cap_rps
+            )
+
+        # ----- one sim second ---------------------------------------
+        def step(self) -> None:
+            for entry in [w for w in self.warming if w[0] <= self.t]:
+                self.warming.remove(entry)
+                self.names.append(entry[1])
+                self.n += 1
+            d = demand(self.t)
+            self.queue = max(0.0, self.queue + d - self.n * cap_rps)
+            ttft = base_ttft_ms + self.wait_s(d) * 1000.0
+            self.ttfts_ms.append(ttft)
+            self.violations += ttft > slo_ms
+            self.replica_seconds += self.n + len(self.warming)
+            self.peak = max(self.peak, self.n + len(self.warming))
+            self.t += 1.0
+
+        # ----- the /debug/fleet shape the controller polls ----------
+        def fleet(self) -> dict:
+            wait = round(self.wait_s(demand(self.t)), 4)
+            per_q = int(self.queue / self.n)
+            rows = {
+                name: {
+                    "role": "unified",
+                    "pressure_s": wait,
+                    "queue_depth": per_q,
+                    "eligible": True,
+                    "reachable": True,
+                    "draining": False,
+                    "fenced": False,
+                }
+                for name in self.names
+            }
+            # Warming joiners: visible (and billed) but ineligible, so
+            # they neither read as cold headroom nor get reaped.
+            for _, name in self.warming:
+                rows[name] = {
+                    "role": "unified",
+                    "pressure_s": 0.0,
+                    "queue_depth": 0,
+                    "eligible": False,
+                    "reachable": True,
+                    "draining": False,
+                    "fenced": False,
+                }
+            return {
+                "replicas": rows,
+                "recommendation": scale_recommendation(
+                    rows,
+                    hot_wait_s=hot_wait_s,
+                    cold_wait_s=cold_wait_s,
+                ),
+            }
+
+    static_n = max(
+        math.ceil(demand(t) / cap_rps) for t in range(sim_seconds)
+    )
+
+    sim = _Sim(2)
+    actuator = FleetSimActuator(
+        spawn_fn=sim.spawn,
+        join_fn=lambda name, role: None,  # joins when warm_lag elapses
+        drain_fn=lambda name: None,  # cold pool: nothing in flight
+        reap_fn=sim.reap,
+        warm_fn=lambda name, donor: None,  # lag above IS the transfer
+    )
+    rc = Reconciler(
+        sim.fleet,
+        actuator,
+        config=ControllerConfig(
+            interval_s=2.0,
+            sustain_ticks=2,
+            cooldown_s=10.0,
+            min_replicas=1,
+            max_replicas=12,
+            hot_wait_s=hot_wait_s,
+            cold_wait_s=cold_wait_s,
+        ),
+        now=lambda: sim.t,
+    )
+    for s in range(sim_seconds):
+        if s % 2 == 0:
+            rc.tick()
+        sim.step()
+
+    static = _Sim(static_n)
+    for _ in range(sim_seconds):
+        static.step()
+
+    def _p99(xs: list) -> float:
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+    ctrl_minutes = round(sim.replica_seconds / 60.0, 2)
+    static_minutes = round(static.replica_seconds / 60.0, 2)
+    block = {
+        "sim_seconds": sim_seconds,
+        "slo_ms": slo_ms,
+        "controller": {
+            "replica_minutes": ctrl_minutes,
+            "ttft_p99_ms": round(_p99(sim.ttfts_ms), 1),
+            "slo_violations": sim.violations,
+            "peak_replicas": sim.peak,
+            "scale_ups": rc.scale_ups,
+            "scale_downs": rc.scale_downs,
+            "role_flips": rc.role_flips,
+            "actions": rc.actions_executed,
+        },
+        "static_peak": {
+            "replicas": static_n,
+            "replica_minutes": static_minutes,
+            "ttft_p99_ms": round(_p99(static.ttfts_ms), 1),
+            "slo_violations": static.violations,
+        },
+        "replica_minutes_saved": (
+            round(1.0 - ctrl_minutes / static_minutes, 3)
+            if static_minutes
+            else None
+        ),
+    }
+    log(
+        "perf-ledger row: | AUTOSCALE closed-loop controller (%ds "
+        "diurnal+flash sim) | replica-minutes %.1f vs static-peak %.1f "
+        "(%.0f%% saved); ttft p99 %.0fms vs %.0fms (slo %.0fms, "
+        "violations %d vs %d); %d actions (%d up, %d down) | - | "
+        "`benchmark.py --model serving` | update on bench round |"
+        % (
+            sim_seconds,
+            ctrl_minutes,
+            static_minutes,
+            100.0 * (block["replica_minutes_saved"] or 0.0),
+            block["controller"]["ttft_p99_ms"],
+            block["static_peak"]["ttft_p99_ms"],
+            slo_ms,
+            sim.violations,
+            static.violations,
+            rc.actions_executed,
+            rc.scale_ups,
+            rc.scale_downs,
+        )
+    )
+    return block
+
+
 def _run_kernels_phase(args) -> dict | None:
     """KERNELS perf phase: the split-K paged-attention kernel vs the
     engine's gather fallback vs the old single-pass Pallas path, per
@@ -2324,6 +2565,8 @@ def run_serving(args) -> None:
     slo_block = _run_slo_phase(eng, args)
     # --- Canary phase (CANARY row): prober overhead + detection check --
     canary_block = _run_canary_phase(args)
+    # --- Autoscale phase (AUTOSCALE row): controller vs static peak ----
+    autoscale_block = _run_autoscale_phase(args)
     print(
         json.dumps(
             {
@@ -2374,6 +2617,7 @@ def run_serving(args) -> None:
                 "fabric": fabric_block,
                 "slo": slo_block,
                 "canary": canary_block,
+                "autoscale": autoscale_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

@@ -147,7 +147,7 @@ class HandoffMixin:
     """Role bookkeeping + the prefill-side tap feed, mixed into
     ServingEngine like the other engine_* files."""
 
-    def _init_handoff(self, role: str) -> None:
+    def _validate_role(self, role: str) -> None:
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         if role != "unified":
@@ -165,6 +165,9 @@ class HandoffMixin:
                     f"role={role!r} requires kv_host_cache_mb > 0 (the "
                     "content-addressed arena is the handoff medium)"
                 )
+
+    def _init_handoff(self, role: str) -> None:
+        self._validate_role(role)
         self.role = role
         # Decode-role engines SKIP prefill chunks whose positions are
         # fully covered by restored/shared pages (the dense cache is
@@ -202,6 +205,29 @@ class HandoffMixin:
         self.fabric_drops = 0
         if self.metrics:
             self.metrics.role.set(ROLE_VALUES[role])
+
+    def set_role(self, role: str) -> bool:
+        """Runtime role flip (the fleet controller's rebalancing verb,
+        ``POST /debug/role``): same preconditions as construction —
+        both split roles need the content-addressed KV tiers.  In-flight
+        work is untouched: queued/slotted requests finish under the old
+        contract, and the new role governs admission from the next
+        request on (a flipped-to-prefill replica starts answering 409
+        on /generate; the router lifts it off the ring at its next
+        summary poll).  Idempotent — returns False when already there."""
+        self._validate_role(role)
+        with self._lock:
+            if role == self.role:
+                return False
+            previous = self.role
+            self.role = role
+            self._handoff_skip_covered = role == "decode"
+        if self.metrics:
+            self.metrics.role.set(ROLE_VALUES[role])
+        self.flight.record(
+            "engine.role_changed", previous=previous, role=role
+        )
+        return True
 
     # ------------------------------------------------------ prefill side
 

@@ -183,6 +183,10 @@ class EngineServer:
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._loop_alive = False
+        # Process birth (monotonic): ?summary=1 exports the age as
+        # ``uptime_s`` — the fleet controller's replica-minutes ledger
+        # and scale-down tie-breaker read it off the router's poll.
+        self._started = time.monotonic()
         self._timeout = request_timeout_s
         self._trace_lock = threading.Lock()
         self._enable_trace = enable_trace
@@ -280,6 +284,31 @@ class EngineServer:
                     else:
                         changed = server.unfence()
                         self._reply(200, {"fenced": False, "changed": changed})
+                    return
+                if path == "/debug/role":
+                    # Runtime role flip (fleet controller rebalancing,
+                    # ISSUE 19): same trust domain and gate as fence —
+                    # a flip moves this replica on/off the router's
+                    # /generate ring at its next summary poll.
+                    if not server._enable_admin:
+                        self.send_error(404)
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", "0"))
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        role = str(body["role"])
+                    except (KeyError, TypeError, ValueError) as e:
+                        self._reply(400, {"error": f"bad request: {e}"})
+                        return
+                    try:
+                        changed = server.set_role(role)
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
+                        return
+                    self._reply(
+                        200,
+                        {"role": server.engine.role, "changed": changed},
+                    )
                     return
                 if path in ("/debug/trace", "/debug/profile/capture"):
                     if not server._enable_trace:
@@ -1470,6 +1499,13 @@ class EngineServer:
                         # assignments; streams fail over).
                         "fenced": server._fence.is_set(),
                         "loop_alive": server._loop_alive,
+                        # Process age: the fleet controller's
+                        # replica-minutes accounting (ISSUE 19) and its
+                        # scale-down victim tie-breaker — reap the
+                        # youngest-warmed, not the long-lived donor.
+                        "uptime_s": round(
+                            time.monotonic() - server._started, 3
+                        ),
                         # Host-side overload signals (the Host-Side
                         # Telemetry pattern): the router's migration
                         # planner and /debug/fleet scale signal read
@@ -1743,6 +1779,12 @@ class EngineServer:
             fp = snap_mod.params_fingerprint(self.engine.params)
             self._params_fp_cache = fp
         return fp
+
+    def set_role(self, role: str) -> bool:
+        """Flip the engine's disaggregation role at runtime (the fleet
+        controller's ``POST /debug/role`` rebalancing verb).  Raises
+        ``ValueError`` on an invalid or unsupported role; idempotent."""
+        return self.engine.set_role(role)
 
     def begin_fence(
         self, reason: str, source: str = "operator", detail=None

@@ -235,6 +235,12 @@ def scale_recommendation(
       headroom nobody uses (suggest dropping one at a time: consistent
       hashing remaps ~1/K per removal, so gentle beats bold).
     - **hold** otherwise (including no data: never scale on a guess).
+
+    Every verdict carries the ``hot_wait_s``/``cold_wait_s`` thresholds
+    it was judged with, so a downstream consumer (the fleet controller)
+    classifies replicas the recommendation doesn't cover — the prefill
+    pool is ineligible here by design — with the SAME knobs and a
+    decision stays explainable from one snapshot.
     """
     eligible = {
         name: row for name, row in signals.items() if row.get("eligible")
@@ -248,6 +254,8 @@ def scale_recommendation(
             "suggested_replicas": len(signals),
             "hot": [],
             "cold": [],
+            "hot_wait_s": hot_wait_s,
+            "cold_wait_s": cold_wait_s,
         }
     hot = sorted(
         name
@@ -270,6 +278,8 @@ def scale_recommendation(
             "suggested_replicas": n + max(1, len(hot)),
             "hot": hot,
             "cold": cold,
+            "hot_wait_s": hot_wait_s,
+            "cold_wait_s": cold_wait_s,
         }
     total_queue = sum(row["queue_depth"] for row in eligible.values())
     if len(cold) == n and n > 1 and total_queue == 0:
@@ -283,6 +293,8 @@ def scale_recommendation(
             "suggested_replicas": n - 1,
             "hot": hot,
             "cold": cold,
+            "hot_wait_s": hot_wait_s,
+            "cold_wait_s": cold_wait_s,
         }
     return {
         "action": "hold",
@@ -295,4 +307,6 @@ def scale_recommendation(
         "suggested_replicas": n,
         "hot": hot,
         "cold": cold,
+        "hot_wait_s": hot_wait_s,
+        "cold_wait_s": cold_wait_s,
     }

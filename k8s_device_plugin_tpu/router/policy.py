@@ -92,6 +92,14 @@ class ReplicaState:
         # (a shrunk counter = replica restart -> re-baselined from the
         # fresh totals).  None until the replica exports an SLO block.
         self.slo_totals = None  # guarded by: owner-thread
+        # Replica process uptime off the summary poll (``uptime_s``):
+        # the fleet controller's replica-minutes accounting and the
+        # scale_down victim tie-breaker.  None until the replica
+        # exports it; first_seen is the router-side fallback (when the
+        # replica predates the field, age-since-registration still
+        # bounds the bill).
+        self.uptime_s = None  # guarded by: owner-thread
+        self.first_seen = time.monotonic()
         self.last_poll = 0.0  # last successful poll (monotonic); guarded by: owner-thread
         self.dispatches = 0
         self.failures = 0
@@ -107,6 +115,8 @@ class ReplicaState:
             "queue_wait_ewma_s": self.queue_wait_ewma_s,
             "drain_rate_rps": self.drain_rate_rps,
             "slo_totals": self.slo_totals,
+            "uptime_s": self.uptime_s,
+            "age_s": round(time.monotonic() - self.first_seen, 3),
             "breaker": self.breaker.snapshot(),
             "dispatches": self.dispatches,
             "failures": self.failures,

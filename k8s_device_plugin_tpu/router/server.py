@@ -960,6 +960,12 @@ class RouterServer:
             st.drain_rate_rps = (
                 float(raw_drain) if raw_drain is not None else None
             )
+            # Replica process uptime (replica-minutes accounting for the
+            # fleet controller).  Absent on replicas predating the field.
+            raw_uptime = payload.get("uptime_s")
+            st.uptime_s = (
+                float(raw_uptime) if raw_uptime is not None else None
+            )
             draining = bool(payload.get("draining", False))
             if draining != st.draining:
                 self._mark_draining(name, draining)
@@ -1511,6 +1517,8 @@ class RouterServer:
         """GET /debug/fleet: per-replica host-side signals, planner
         state, and the fleet scale recommendation — what
         ``tools/fleet_plan.py`` renders and an autoscaler would poll."""
+        cfg = self.planner.cfg if self.planner is not None else MigrationConfig()
+        now = time.monotonic()
         signals = {}
         for name, st in list(self.replicas.items()):
             eligible = (
@@ -1519,16 +1527,17 @@ class RouterServer:
                 and not st.fenced
                 and st.role != ROLE_PREFILL
             )
+            pressure = round(
+                replica_pressure(
+                    st.queue_wait_ewma_s,
+                    st.drain_rate_rps,
+                    st.queue_depth,
+                ),
+                4,
+            )
             signals[name] = {
                 "role": st.role,
-                "pressure_s": round(
-                    replica_pressure(
-                        st.queue_wait_ewma_s,
-                        st.drain_rate_rps,
-                        st.queue_depth,
-                    ),
-                    4,
-                ),
+                "pressure_s": pressure,
                 "queue_depth": st.queue_depth,
                 "active_slots": st.active_slots,
                 "queue_wait_ewma_s": st.queue_wait_ewma_s,
@@ -1538,8 +1547,23 @@ class RouterServer:
                 "reachable": st.reachable,
                 "draining": st.draining,
                 "fenced": st.fenced,
+                # Replica-minutes accounting (ISSUE 19): the replica's
+                # self-reported process uptime, falling back to
+                # age-since-registration for replicas predating the
+                # summary field.
+                "uptime_s": (
+                    st.uptime_s
+                    if st.uptime_s is not None
+                    else round(now - st.first_seen, 3)
+                ),
+                # Per-replica scale_recommendation inputs, pre-judged
+                # with the SAME thresholds the verdict below uses — a
+                # controller decision (including over the prefill pool
+                # the recommendation excludes) is explainable from this
+                # one snapshot.
+                "hot": pressure >= cfg.hot_wait_s,
+                "cold": pressure <= cfg.cold_wait_s,
             }
-        cfg = self.planner.cfg if self.planner is not None else MigrationConfig()
         with self._streams_lock:
             active_streams = len(self._streams)
         return {

@@ -46,6 +46,7 @@ PLUGIN_TIER_FILES = {
     "test_attribution.py",
     "test_cli.py",
     "test_codelint.py",
+    "test_controller.py",
     "test_discovery.py",
     "test_envs.py",
     "test_health.py",

@@ -424,6 +424,10 @@ class FakeReplica:
         # POST /debug/fabric/pull|drop mirror the engine's admin
         # replication endpoints.
         self.role = role
+        self.role_flips = 0  # POST /debug/role transitions accepted
+        # Birth time: the summary exports ``uptime_s`` like the real
+        # EngineServer (replica-minutes accounting, ISSUE 19).
+        self.started = time.monotonic()
         self.prefill_chunk_s = prefill_chunk_s
         # Silent-data-corruption knob (canary prober tests): after
         # ``corrupt_after`` clean /generate responses, every later
@@ -557,6 +561,26 @@ class FakeReplica:
                         changed = replica._fenced.is_set()
                         replica.unfence()
                         self._json(200, {"fenced": False, "changed": changed})
+                    return
+                if path == "/debug/role":
+                    # The EngineServer runtime role flip (always
+                    # enabled on the fake, like fence — tests ARE the
+                    # operator): the fleet controller's rebalancing
+                    # verb.  The router reconciles the new role off its
+                    # next summary poll.
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    role = str(body.get("role") or "")
+                    if role not in ("unified", "prefill", "decode"):
+                        self._json(400, {"error": f"bad role {role!r}"})
+                        return
+                    changed = role != replica.role
+                    replica.role = role
+                    replica.role_flips += 1 if changed else 0
+                    replica.flight.record(
+                        "engine.role_changed", role=role
+                    )
+                    self._json(200, {"role": role, "changed": changed})
                     return
                 if path == "/debug/fabric/pull":
                     # The EngineServer admin pull endpoint in
@@ -833,6 +857,12 @@ class FakeReplica:
                         "draining": replica._draining.is_set(),
                         "fenced": replica._fenced.is_set(),
                         "loop_alive": True,
+                        # Process age (the EngineServer summary
+                        # contract): replica-minutes accounting for the
+                        # fleet controller.
+                        "uptime_s": round(
+                            time.monotonic() - replica.started, 3
+                        ),
                         # Host-side overload signals (the EngineServer
                         # summary contract): test-settable so scenarios
                         # shape hot/cold fleets for the planner.

@@ -938,3 +938,77 @@ def test_bench_diff_parses_disagg_block(tmp_path):
     f = bench_diff.load_record(str(tmp_path / "f.json"))
     assert "disagg_ratio" not in f
     assert "disagg decode p99" not in bench_diff.ledger_row(a, f)
+
+
+def test_bench_diff_parses_autoscale_block(tmp_path):
+    """Records grew an AUTOSCALE block (ISSUE 19, benchmark.py
+    _run_autoscale_phase): the closed-loop controller's replica-minute
+    bill vs the static peak fleet's, TTFT p99, and SLO-violation
+    seconds over the deterministic diurnal+flash sim must surface in
+    the normalized record, the field diff, and the ledger row — and
+    the row must scream REPLICA-MINUTES-REGRESSED when the elastic
+    bill reaches the static one (the autoscaler stopped paying for
+    itself) and AUTOSCALE-SLO-VIOLATED when the controller fleet
+    logged violation seconds (saving replica-minutes by burning user
+    latency)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 8,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 9
+    loaded["parsed"]["autoscale"] = {
+        "sim_seconds": 600, "slo_ms": 2500.0,
+        "controller": {
+            "replica_minutes": 23.3, "ttft_p99_ms": 498.8,
+            "slo_violations": 0, "peak_replicas": 5,
+            "scale_ups": 7, "scale_downs": 6, "role_flips": 0,
+            "actions": 13,
+        },
+        "static_peak": {
+            "replicas": 4, "replica_minutes": 40.0,
+            "ttft_p99_ms": 349.8, "slo_violations": 0,
+        },
+        "replica_minutes_saved": 0.417,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["autoscale_replica_minutes"] == 23.3
+    assert b["autoscale_static_minutes"] == 40.0
+    assert b["autoscale_violations"] == 0
+    assert b["autoscale_minutes_saved"] == 0.417
+    assert b["autoscale_actions"] == 13
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "autoscale_replica_minutes" in diff
+    assert "autoscale_violations" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "autoscale 23.3 vs static 40.0 replica-min" in row
+    assert "13 actions" in row
+    assert "REPLICA-MINUTES-REGRESSED" not in row
+    assert "AUTOSCALE-SLO-VIOLATED" not in row
+    # The elastic bill caught up with static peak: not paying for
+    # itself anymore.
+    loaded["parsed"]["autoscale"]["controller"]["replica_minutes"] = 41.0
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "REPLICA-MINUTES-REGRESSED" in bench_diff.ledger_row(a, c)
+    # Violation seconds appeared: the savings are fake.
+    loaded["parsed"]["autoscale"]["controller"]["replica_minutes"] = 23.3
+    loaded["parsed"]["autoscale"]["controller"]["slo_violations"] = 4
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    assert "AUTOSCALE-SLO-VIOLATED" in bench_diff.ledger_row(a, d)
+    # A record without the block stays quiet in the row.
+    assert "autoscale" not in bench_diff.ledger_row(a, a)

@@ -2900,3 +2900,382 @@ def test_chaos_fabric_owner_death_mid_pull(tmp_path):
         })
     finally:
         _teardown_router(replicas, router)
+
+
+# ======================================================================
+# Scenario 14: closed-loop autoscaler rides a flash crowd (ISSUE 19)
+# ======================================================================
+
+
+def test_chaos_autoscale_flash_crowd(tmp_path):
+    """The ISSUE 19 acceptance scenario: the REAL controller (Reconciler
+    polling the router's /debug/fleet over HTTP, FleetSimActuator doing
+    peer-warmed joins and drain-then-reap) rides four load windows:
+
+      W0 steady   -> ZERO actions (and a separate steady control fleet
+                     with its own controller also takes ZERO actions);
+      W1 prefill saturates while a decode replica idles -> exactly one
+                     role_flip (role rebalance BEFORE buying hardware);
+      W2 flash crowd -> two warm scale_ups (donor via donor_for, joiner
+                     adopts the donor's warm prefixes);
+      W3 crowd gone -> two drain-then-reap scale_downs, then the
+                     last-replica refusal holds the floor.
+
+    Executed actions are joined against the injected windows with
+    tools/chaos_report.score_detections and must score precision and
+    recall 1.0 per class — an action outside its window is a false
+    positive, a missed window a false negative.  Traffic streams run
+    through every transition: zero drops, every stream bit-identical to
+    the fake_generate oracle, TTFT p99 within SLO, and the controller's
+    replica-minute bill strictly below the static-peak fleet's."""
+    import threading
+
+    from k8s_device_plugin_tpu.controller import (
+        ControllerConfig,
+        ControllerMetrics,
+        FleetSimActuator,
+        NullActuator,
+        Reconciler,
+        fetch_fleet,
+    )
+    from k8s_device_plugin_tpu.router.server import RouterServer
+    from k8s_device_plugin_tpu.utils.flight import FlightRecorder
+    from k8s_device_plugin_tpu.utils.metrics import MetricsRegistry
+    from tests.fakes import FakeReplica, fake_generate
+    from tests.sim.fleet import wait_until as _wait
+
+    mk = dict(
+        token_delay_s=0.02, prefix_tokens=32, cold_prefill_delay_s=0.35
+    )
+    # Pool replicas are UNIFIED (a decode-role fake 409s cold prompts;
+    # unified ones pay the cold re-prefill like a real merged engine).
+    u1, u2 = FakeReplica(**mk).start(), FakeReplica(**mk).start()
+    p1 = FakeReplica(role="prefill", **mk).start()
+    replicas = {u1.name: u1, u2.name: u2, p1.name: p1}
+    flight = FlightRecorder(capacity=4096, name="autoscale-router")
+    router = RouterServer(
+        [u1.name, u2.name, p1.name],
+        host="127.0.0.1", port=0, flight=flight,
+        poll_interval_s=0.1, hedge=False,
+        upstream_timeout_s=60.0, request_timeout_s=60.0,
+    ).start()
+
+    # ---- The real actuator, wired to the fake fleet: spawn pays a
+    # peer-warmed join (donor_for inside FleetSimActuator), scale-down
+    # drains to zero in-flight before the reap.
+    spawned: list = []
+
+    def spawn_fn(role):
+        r = FakeReplica(**mk).start()
+        replicas[r.name] = r
+        spawned.append(r)
+        return r.name
+
+    def warm_fn(name, donor):
+        replicas[name].warm_from_peer(donor)
+
+    def join_fn(name, role):
+        router.add_replica(name, role=role)
+
+    def drain_fn(name):
+        replicas[name].begin_drain()
+        assert _wait(
+            lambda: replicas[name].active_streams == 0, timeout=20
+        ), f"{name} never drained to zero in-flight"
+
+    def reap_fn(name):
+        router.remove_replica(name)
+        replicas[name].stop()
+
+    actuator = FleetSimActuator(
+        spawn_fn=spawn_fn, join_fn=join_fn,
+        drain_fn=drain_fn, reap_fn=reap_fn, warm_fn=warm_fn,
+    )
+    cflight = FlightRecorder(capacity=2048, name="autoscale-controller")
+    rc = Reconciler(
+        lambda: fetch_fleet(f"http://127.0.0.1:{router.port}"),
+        actuator,
+        config=ControllerConfig(
+            interval_s=0.1, sustain_ticks=2, cooldown_s=0.5,
+            min_replicas=1, max_replicas=6,
+        ),
+        metrics=ControllerMetrics(MetricsRegistry()),
+        flight=cflight,
+    )
+    peak_fleet = 0
+
+    def _ticks_until(pred, timeout=20.0):
+        """Drive the reconciler at its cadence until ``pred()``."""
+        nonlocal peak_fleet
+        deadline = time.monotonic() + timeout
+        while True:
+            rc.tick()
+            peak_fleet = max(peak_fleet, sum(rc._observed.values()))
+            if pred():
+                return
+            assert time.monotonic() < deadline, (
+                f"controller never converged: {rc.snapshot(last=6)}"
+            )
+            time.sleep(0.06)
+
+    def _pressures():
+        return {
+            n: r["pressure_s"]
+            for n, r in router.fleet_state()["replicas"].items()
+        }
+
+    def _settled(want):
+        """Router poll has caught up with the signal knobs."""
+        got = _pressures()
+        return all(
+            abs(got.get(n, -1.0) - p) < 0.01 for n, p in want.items()
+        )
+
+    sessions = [
+        [(i * 7 + j) % 500 + 2 for j in range(32)] for i in range(10)
+    ]
+    all_results: list = []
+
+    def _round(tag, concurrent_with=None):
+        results: list = []
+        threads = [
+            threading.Thread(
+                target=_timed_stream,
+                args=(router.port, p, 8, f"{tag}-{i}", results),
+                daemon=True,
+            )
+            for i, p in enumerate(sessions)
+        ]
+        for t in threads:
+            t.start()
+        if concurrent_with is not None:
+            concurrent_with()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == len(sessions), f"round {tag} lost streams"
+        all_results.extend(results)
+        return results
+
+    try:
+        t_start = time.monotonic()
+        # ---- W0: steady state.  Mid-band pressure everywhere (between
+        # cold_wait 0.5 and hot_wait 2.0): the fleet is earning its
+        # keep, the controller must not touch it.
+        u1.wait_ewma_s = u2.wait_ewma_s = p1.wait_ewma_s = 1.0
+        assert _wait(
+            lambda: _settled({u1.name: 1.0, u2.name: 1.0, p1.name: 1.0}),
+            timeout=5,
+        )
+        _round("steady")
+        for _ in range(8):
+            d = rc.tick()
+            assert (d["action"], d["outcome"]) == ("hold", "idle"), d
+            time.sleep(0.05)
+        assert rc.actions_executed == 0
+
+        # ---- W1: prefill pool saturates while u2 idles.  The verdict
+        # must be a role FLIP (rebalance before buying hardware), and it
+        # must land before any scale_up.
+        t0_flip = time.monotonic()
+        p1.wait_ewma_s = 6.0
+        u2.wait_ewma_s = 0.1
+        assert _wait(
+            lambda: _settled({p1.name: 6.0, u2.name: 0.1}), timeout=5
+        )
+        _ticks_until(lambda: rc.role_flips == 1)
+        t1_flip = time.monotonic()
+        assert u2.role == "prefill", "flip never reached the replica"
+        assert rc.scale_ups == 0, "bought hardware before rebalancing"
+        # The flip solved the saturation; u2 now works the prefill pool.
+        p1.wait_ewma_s = u2.wait_ewma_s = 1.0
+        assert _wait(
+            lambda: router.fleet_state()["replicas"][u2.name]["role"]
+            == "prefill",
+            timeout=5,
+        )
+
+        # ---- W2: flash crowd on the (now single-replica) decode pool.
+        # Two peer-warmed scale_ups: the joiner goes hot too before the
+        # second buy, and the prefill pool (at 1.0, not idle) blocks the
+        # flip-before-buy shortcut so real hardware is added.
+        t0_up = time.monotonic()
+        u1.wait_ewma_s = 6.0
+        assert _wait(lambda: _settled({u1.name: 6.0}), timeout=5)
+        _round("crowd", concurrent_with=lambda: _ticks_until(
+            lambda: rc.scale_ups == 1
+        ))
+        j1 = spawned[0]
+        assert j1.warm_prefixes, "joiner adopted no warm prefixes"
+        j1.wait_ewma_s = 6.0
+        assert _wait(
+            lambda: _settled({j1.name: 6.0, u1.name: 6.0}), timeout=5
+        )
+        _ticks_until(lambda: rc.scale_ups == 2)
+        t1_up = time.monotonic()
+        j2 = spawned[1]
+
+        # ---- W3: crowd gone, pool cold and empty -> drain-then-reap
+        # down to one decode-capable replica, then the last-replica
+        # refusal holds the floor.  Streams run THROUGH the first reap:
+        # the drain must wait out in-flight work (zero drops).
+        t0_down = time.monotonic()
+        u1.wait_ewma_s = j1.wait_ewma_s = j2.wait_ewma_s = 0.05
+        assert _wait(
+            lambda: _settled({
+                u1.name: 0.05, j1.name: 0.05, j2.name: 0.05
+            }),
+            timeout=5,
+        )
+        _round("falling", concurrent_with=lambda: _ticks_until(
+            lambda: rc.scale_downs == 1, timeout=40
+        ))
+        _ticks_until(lambda: rc.scale_downs == 2, timeout=40)
+        t1_down = time.monotonic()
+        # The floor: one decode-capable replica left, and the verdict
+        # itself goes quiet (scale_recommendation never proposes
+        # reaping a single-replica pool; the explicit
+        # refused_last_replica outcome is pinned by the unit suite).
+        for _ in range(6):
+            d = rc.tick()
+            assert d["outcome"] not in ("executed", "dry_run"), d
+            time.sleep(0.05)
+        assert rc.scale_downs == 2, "reaped below the role floor"
+        pool_left = [
+            n
+            for n, r in router.fleet_state()["replicas"].items()
+            if r["role"] != "prefill"
+        ]
+        assert len(pool_left) == 1, pool_left
+        _round("after")
+        t_end = time.monotonic()
+
+        # ---- Score executed actions against the injected windows.
+        injected = [
+            {"cls": "role_flip", "t0": t0_flip, "t1": t1_flip},
+            {"cls": "scale_up", "t0": t0_up, "t1": t1_up},
+            {"cls": "scale_up", "t0": t0_up, "t1": t1_up},
+            {"cls": "scale_down", "t0": t0_down, "t1": t1_down},
+            {"cls": "scale_down", "t0": t0_down, "t1": t1_down},
+        ]
+        executed = [
+            d for d in rc.decisions if d["outcome"] == "executed"
+        ]
+        detected = [
+            {"cls": d["action"], "ts": d["t"]} for d in executed
+        ]
+        chaos_report = _chaos_report()
+        score = chaos_report.score_detections(
+            injected, detected, grace_s=1.0
+        )
+        for cls in ("role_flip", "scale_up", "scale_down"):
+            per = score["per_class"][cls]
+            assert per["precision"] == 1.0 and per["recall"] == 1.0, score
+        # Role rebalance strictly precedes the first hardware buy.
+        kinds = [d["action"] for d in executed]
+        assert kinds == [
+            "role_flip", "scale_up", "scale_up",
+            "scale_down", "scale_down",
+        ], kinds
+        events = {e["kind"] for e in cflight.snapshot()["events"]}
+        assert {
+            "controller.role_flip", "controller.scale_up",
+            "controller.scale_down",
+        } <= events, events
+
+        # ---- The bill: elastic replica-minutes strictly under the
+        # static fleet provisioned for the observed peak.
+        assert peak_fleet == 5, peak_fleet
+        static_minutes = peak_fleet * (t_end - t_start) / 60.0
+        assert 0 < rc.replica_minutes < static_minutes, (
+            rc.replica_minutes, static_minutes
+        )
+
+        # ---- Serving SLOs across every transition: zero drops, bit-
+        # identical tokens, TTFT p99 within budget (cold re-prefill
+        # 0.35s + scheduling noise on a loaded CI box stays far under).
+        slo_ttft_s = 1.5
+        oracle = {
+            tuple(p): fake_generate(p, 8) for p in sessions
+        }
+        drops = [r for r in all_results if not r["completed"]]
+        assert not drops, f"{len(drops)} dropped streams: {drops[:3]}"
+        for r in all_results:
+            i = int(r["rid"].rsplit("-", 1)[1])
+            assert r["tokens"] == oracle[tuple(sessions[i])], r["rid"]
+        ttfts = sorted(r["ttft_s"] for r in all_results)
+        p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+        assert p99 <= slo_ttft_s, (p99, ttfts[-3:])
+
+        # ---- Control fleet: an identical steady fleet with its own
+        # controller must take ZERO actions over the same horizon.
+        c1, c2 = FakeReplica(**mk).start(), FakeReplica(**mk).start()
+        cp = FakeReplica(role="prefill", **mk).start()
+        c1.wait_ewma_s = c2.wait_ewma_s = cp.wait_ewma_s = 1.0
+        control_router = RouterServer(
+            [c1.name, c2.name, cp.name],
+            host="127.0.0.1", port=0, poll_interval_s=0.1, hedge=False,
+        ).start()
+        try:
+            control = Reconciler(
+                lambda: fetch_fleet(
+                    f"http://127.0.0.1:{control_router.port}"
+                ),
+                NullActuator(),
+                config=ControllerConfig(
+                    interval_s=0.1, sustain_ticks=2, cooldown_s=0.5
+                ),
+            )
+            assert _wait(
+                lambda: all(
+                    abs(r["pressure_s"] - 1.0) < 0.01
+                    for r in control_router.fleet_state()[
+                        "replicas"
+                    ].values()
+                ),
+                timeout=5,
+            )
+            control_outcomes = set()
+            for _ in range(12):
+                d = control.tick()
+                control_outcomes.add((d["action"], d["outcome"]))
+                time.sleep(0.05)
+            assert control.actions_executed == 0
+            assert control_outcomes == {("hold", "idle")}, control_outcomes
+        finally:
+            control_router.stop()
+            for r in (c1, c2, cp):
+                r.stop()
+
+        _publish({
+            "scenario": "autoscale_flash_crowd",
+            "faults": injected,
+            "detections": detected,
+            "score": score,
+            "slo": {
+                "targets": {
+                    "dropped_streams": 0,
+                    "bit_identical": True,
+                    "ttft_p99_s": slo_ttft_s,
+                    "replica_minutes_vs_static_peak": "strictly_less",
+                    "control_fleet_actions": 0,
+                },
+                "measured": {
+                    "dropped_streams": 0,
+                    "ttft_p99_s": round(p99, 3),
+                    "replica_minutes": round(rc.replica_minutes, 3),
+                    "static_peak_minutes": round(static_minutes, 3),
+                    "peak_fleet": peak_fleet,
+                    "executed": kinds,
+                    "control_fleet_actions": 0,
+                },
+                "pass": True,
+            },
+        })
+    finally:
+        router.stop()
+        for r in replicas.values():
+            if not r.killed.is_set():
+                try:
+                    r.stop()
+                except OSError:
+                    pass

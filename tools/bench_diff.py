@@ -284,6 +284,33 @@ def load_record(path: str) -> dict:
                 "mismatch_detected"
             )
             rec["canary_fences"] = canary.get("fences")
+        # Autoscale block (AUTOSCALE serving rows, benchmark.py
+        # _run_autoscale_phase): the closed-loop fleet controller vs a
+        # static peak-provisioned fleet over the same deterministic
+        # diurnal+flash demand trace.  The regression tells: the
+        # controller's replica-minute bill reaching the static fleet's
+        # (REPLICA-MINUTES-REGRESSED: the autoscaler stopped paying for
+        # itself — a fleet that costs as much as static peak with none
+        # of its simplicity should not exist), or controller SLO
+        # violation seconds appearing (AUTOSCALE-SLO-VIOLATED: it
+        # "saves" replica-minutes by burning user latency).
+        autoscale = parsed.get("autoscale")
+        if isinstance(autoscale, dict):
+            ctrl = autoscale.get("controller") or {}
+            static = autoscale.get("static_peak") or {}
+            rec["autoscale_replica_minutes"] = ctrl.get("replica_minutes")
+            rec["autoscale_ttft_p99_ms"] = ctrl.get("ttft_p99_ms")
+            rec["autoscale_violations"] = ctrl.get("slo_violations")
+            rec["autoscale_actions"] = ctrl.get("actions")
+            rec["autoscale_static_minutes"] = static.get(
+                "replica_minutes"
+            )
+            rec["autoscale_static_ttft_p99_ms"] = static.get(
+                "ttft_p99_ms"
+            )
+            rec["autoscale_minutes_saved"] = autoscale.get(
+                "replica_minutes_saved"
+            )
         kvcache = parsed.get("kvcache")
         if isinstance(kvcache, dict):
             rec["kvcache_hits"] = kvcache.get("hits")
@@ -353,6 +380,10 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "slo_overhead", "slo_verdicts", "slo_burn_alert_fired",
         "canary_overhead", "canary_probes", "canary_mismatch_detected",
         "canary_fences",
+        "autoscale_replica_minutes", "autoscale_static_minutes",
+        "autoscale_minutes_saved", "autoscale_ttft_p99_ms",
+        "autoscale_static_ttft_p99_ms", "autoscale_violations",
+        "autoscale_actions",
         "router_replicas", "router_affinity_hit_rate",
         "router_affinity_ttft_p99_ms", "router_home_rate",
         "router_random_hit_rate", "router_random_ttft_p99_ms",
@@ -592,6 +623,29 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("canary_overhead") is not None
+                else ""
+            )
+            + (
+                f"; autoscale {b['autoscale_replica_minutes']} vs "
+                f"static {b.get('autoscale_static_minutes')} "
+                f"replica-min ({b.get('autoscale_actions')} actions, "
+                f"p99 {b.get('autoscale_ttft_p99_ms')}ms"
+                + (
+                    ", REPLICA-MINUTES-REGRESSED"
+                    if (b.get("autoscale_replica_minutes") or 0.0)
+                    >= (
+                        b.get("autoscale_static_minutes")
+                        or float("inf")
+                    )
+                    else ""
+                )
+                + (
+                    ", AUTOSCALE-SLO-VIOLATED"
+                    if (b.get("autoscale_violations") or 0) > 0
+                    else ""
+                )
+                + ")"
+                if b.get("autoscale_replica_minutes") is not None
                 else ""
             )
             + (

@@ -14,6 +14,15 @@ plus the recommendation, from a live router or a saved JSON snapshot:
 Exit code 0 on hold, 3 on scale_up, 4 on scale_down — so a cron/CI
 wrapper can act on the verdict without parsing anything.  Stdlib-only
 and jax-free, like every fleet-side tool.
+
+With ``--controller-url`` (the closed-loop fleet controller's own HTTP
+surface, ISSUE 19 — it supersedes the exit-code cron recipe) the plan
+also renders the controller's desired-vs-observed spec, replica-minutes
+ledger, and recent decision log next to the recommendation, so the
+operator sees what the loop DID with the verdict, not just the verdict:
+
+    python tools/fleet_plan.py --url http://router:8100 \\
+        --controller-url http://controller:8200
 """
 
 from __future__ import annotations
@@ -146,6 +155,72 @@ def render(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def load_controller(url: str) -> dict:
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.startswith("http"):
+        base = f"http://{base}"
+    with urllib.request.urlopen(
+        base + "/debug/controller", timeout=10
+    ) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def render_controller(snap: dict) -> str:
+    """The controller appendix: what the closed loop DID with the
+    verdict — desired vs observed spec, the replica-minutes bill, and
+    the recent decision log."""
+
+    def spec(d: dict) -> str:
+        return (
+            ", ".join(f"{role} {n}" for role, n in sorted(d.items()))
+            or "empty"
+        )
+
+    mode = "DRY-RUN" if snap.get("dry_run") else "active"
+    lines = [
+        f"controller: {snap.get('ticks', 0)} ticks, "
+        f"actuator {snap.get('actuator', 'none')}, {mode}"
+    ]
+    lines.append(
+        f"  desired:  {spec(snap.get('desired') or {})}   "
+        f"observed: {spec(snap.get('observed') or {})}"
+    )
+    by_role = snap.get("replica_minutes_by_role") or {}
+    lines.append(
+        f"  replica-minutes: {snap.get('replica_minutes', 0.0)}"
+        + (f" ({spec(by_role)})" if by_role else "")
+    )
+    actions = snap.get("actions") or {}
+    lines.append(
+        f"  actions: {actions.get('executed', 0)} executed "
+        f"({actions.get('role_flips', 0)} flips, "
+        f"{actions.get('scale_ups', 0)} up, "
+        f"{actions.get('scale_downs', 0)} down)"
+    )
+    if snap.get("last_error"):
+        lines.append(f"  last_error: {snap['last_error']}")
+    decisions = snap.get("decisions") or []
+    if decisions:
+        lines.append("  decisions:")
+    for d in decisions:
+        detail = []
+        if d.get("replica"):
+            detail.append(str(d["replica"]))
+        if d.get("from"):
+            detail.append(f"{d['from']}->{d.get('to', '?')}")
+        if d.get("donor"):
+            detail.append(f"donor {d['donor']}")
+        lines.append(
+            f"    [{d.get('tick', '?')}] {d.get('action', '?')} "
+            f"{str(d.get('outcome', '?')).upper()}"
+            + (f" ({', '.join(detail)})" if detail else "")
+            + f" — {d.get('reason', '')}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="fleet-plan",
@@ -157,6 +232,15 @@ def main(argv=None) -> int:
         help="saved /debug/fleet JSON (alternative to --url)",
     )
     p.add_argument("--url", default="", help="live router base URL")
+    p.add_argument(
+        "--controller-url",
+        default="",
+        help=(
+            "fleet controller base URL (python -m "
+            "k8s_device_plugin_tpu.controller); appends its "
+            "desired-vs-observed spec and decision log to the plan"
+        ),
+    )
     p.add_argument(
         "--json",
         action="store_true",
@@ -170,10 +254,21 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"fleet-plan: {e}", file=sys.stderr)
         return 1
+    controller = None
+    if args.controller_url:
+        try:
+            controller = load_controller(args.controller_url)
+        except (OSError, ValueError) as e:
+            print(f"fleet-plan: controller: {e}", file=sys.stderr)
+            return 1
     if args.json:
+        if controller is not None:
+            fleet = dict(fleet, controller=controller)
         print(json.dumps(fleet, indent=2))
     else:
         print(render(fleet))
+        if controller is not None:
+            print(render_controller(controller))
     action = (fleet.get("recommendation") or {}).get("action", "hold")
     return EXIT_CODES.get(action, 0)
 

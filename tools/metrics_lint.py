@@ -105,6 +105,15 @@ FAMILY_BUDGETS = {
     "tpu_engine_fabric_pulls_total": 2,  # ok / error
     "tpu_engine_fabric_drops_total": 1,  # unlabeled counter
     "tpu_engine_fabric_digest_roots": 1,  # unlabeled gauge
+    # Fleet controller (controller/reconciler.py).  Actions and
+    # outcomes are CLOSED enums (reconciler.ACTIONS x OUTCOMES) and
+    # roles a 3-value enum (unified/prefill/decode) — a breach means a
+    # replica name or reason string leaked into a label.
+    "tpu_controller_ticks_total": 2,  # ok / error
+    "tpu_controller_decisions_total": 36,  # 4 actions x 9 outcomes
+    "tpu_controller_desired_replicas": 3,  # one gauge per role
+    "tpu_controller_observed_replicas": 3,  # one gauge per role
+    "tpu_controller_replica_minutes_total": 3,  # one counter per role
 }
 
 
