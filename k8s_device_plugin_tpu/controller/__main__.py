@@ -16,8 +16,11 @@ import argparse
 import sys
 import time
 
+from ..utils import flight as flight_mod
+from ..utils.anomaly import AnomalyMonitor
 from ..utils.flight import FlightRecorder, install_dump_handlers
 from ..utils.metrics import MetricsRegistry
+from ..utils.spans import SpanRecorder
 from .actuators import KubernetesActuator, NullActuator
 from .reconciler import (
     ControllerConfig,
@@ -139,6 +142,22 @@ def main(argv=None) -> int:
         default=256,
         help="decision-log ring capacity served at /debug/controller",
     )
+    p.add_argument(
+        "--dump-dir",
+        default=flight_mod.default_dump_dir() or "",
+        help="directory for flight dumps and postmortem bundles "
+        "(default: $TPU_PLUGIN_DUMP_DIR): SIGUSR2/exit dumps the "
+        "flight ring, and every actuator-failure incident snapshots "
+        "the controller's forensic state (utils/postmortem.py)",
+    )
+    p.add_argument(
+        "--dump-budget-mb",
+        type=int,
+        default=0,
+        help="retention budget (MiB) for --dump-dir, shared by flight "
+        "dumps and postmortem bundles: after every write the oldest "
+        "entries are pruned until the directory fits (0 = unbounded)",
+    )
     args = p.parse_args(argv)
 
     try:
@@ -158,8 +177,18 @@ def main(argv=None) -> int:
         p.error(str(e))
 
     registry = MetricsRegistry()
-    flight = FlightRecorder(capacity=2048, name="controller")
-    install_dump_handlers()
+    # Registered so SIGUSR2/atexit dumps include the controller's ring;
+    # the span ring rides the same dumps (trace-assembler input).
+    flight = flight_mod.register(
+        FlightRecorder(capacity=2048, name="controller")
+    )
+    spans = flight_mod.register_spans(
+        SpanRecorder(capacity=512, name="controller")
+    )
+    install_dump_handlers(args.dump_dir or None)
+    if args.dump_budget_mb:
+        flight_mod.set_dump_budget(args.dump_budget_mb * 1024 * 1024)
+    anomaly = AnomalyMonitor(flight=flight)
     actuator = (
         KubernetesActuator() if args.actuator == "k8s" else NullActuator()
     )
@@ -169,10 +198,31 @@ def main(argv=None) -> int:
         config=cfg,
         metrics=ControllerMetrics(registry),
         flight=flight,
+        anomaly=anomaly,
     )
     server = ControllerServer(
-        reconciler, registry, host=args.host, port=args.port
+        reconciler, registry, host=args.host, port=args.port, spans=spans
     )
+    if args.dump_dir:
+        # Incident-triggered local postmortem capture: an actuator
+        # failure snapshots the decision log + flight ring before the
+        # rings roll (utils/postmortem.py).
+        from ..utils.postmortem import PostmortemCapture
+
+        capture = PostmortemCapture(
+            "controller",
+            args.dump_dir,
+            flight=flight,
+            spans=spans,
+            registry=registry,
+            state_fn=server._debug_state,
+            budget_bytes=(
+                args.dump_budget_mb * 1024 * 1024
+                if args.dump_budget_mb
+                else None
+            ),
+        )
+        anomaly.add_listener(capture.on_incident)
     server.start()
     print(
         f"controller: reconciling {args.url} every {cfg.interval_s}s "

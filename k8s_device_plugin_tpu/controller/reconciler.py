@@ -199,6 +199,7 @@ class Reconciler:
         config: Optional[ControllerConfig] = None,
         metrics: Optional[ControllerMetrics] = None,
         flight=None,
+        anomaly=None,
         now=time.monotonic,
     ):
         self.cfg = config or ControllerConfig()
@@ -206,6 +207,11 @@ class Reconciler:
         self.actuator = actuator
         self.metrics = metrics
         self.flight = flight
+        # Optional AnomalyMonitor (utils/anomaly.py): actuator failures
+        # are DISCRETE incidents (wrong on first observation) — the
+        # report fans out to the incident ring, the JSON log, and any
+        # postmortem-capture listener.
+        self.anomaly = anomaly
         self._now = now
         self.ticks = 0
         self.actions_executed = 0
@@ -566,6 +572,12 @@ class Reconciler:
             self._record(
                 "controller.actuator_error", action=action, error=str(e)
             )
+            if self.anomaly is not None:
+                self.anomaly.report(
+                    "controller.actuator_error",
+                    action=action,
+                    error=str(e),
+                )
             return self._decide(
                 t0, candidate, outcome="actuator_error", error=str(e)
             )

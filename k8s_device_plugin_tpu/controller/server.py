@@ -37,16 +37,34 @@ class ControllerServer:
         *,
         host: str = "0.0.0.0",
         port: int = 8200,
+        spans=None,
     ):
         self.reconciler = reconciler
+        self.spans = spans
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Forensics parity with the router and plugin daemon: the
+        # controller's flight ring and span ring are pullable surfaces,
+        # so the fleet postmortem collector (router/postmortem.py) can
+        # join controller decisions into an incident timeline.
+        debug = {
+            "/debug/controller": self._debug_controller,
+            "/debug/state": self._debug_state,
+        }
+        if reconciler.flight is not None:
+            debug["/debug/flight"] = reconciler.flight.snapshot
+        if spans is not None:
+            debug["/debug/spans"] = lambda query: spans.dump(
+                trace_id=(query.get("rid") or [None])[0]
+            )
+        if reconciler.anomaly is not None:
+            debug["/debug/incidents"] = reconciler.anomaly.snapshot
         self._http = MetricsServer(
             registry,
             host=host,
             port=port,
             health=self._loop_alive,
-            debug={"/debug/controller": self._debug_controller},
+            debug=debug,
         )
 
     def _loop_alive(self) -> bool:
@@ -59,6 +77,15 @@ class ControllerServer:
         except (TypeError, ValueError):
             pass
         return self.reconciler.snapshot(last=last)
+
+    def _debug_state(self) -> dict:
+        """The controller's ``/debug/state``-equivalent — what the fleet
+        postmortem collector pulls alongside flight/spans/metrics."""
+        return {
+            "component": "controller",
+            "loop_alive": self._loop_alive(),
+            "controller": self.reconciler.snapshot(last=32),
+        }
 
     @property
     def port(self) -> int:

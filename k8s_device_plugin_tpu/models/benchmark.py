@@ -1048,6 +1048,214 @@ def _run_canary_phase(args) -> dict | None:
     return block
 
 
+def _run_postmortem_phase(args) -> dict | None:
+    """POSTMORTEM perf phase: black-box archaeology overhead and the
+    capture/classification self-check (router/postmortem.py +
+    tools/postmortem.py, ISSUE 20).
+
+    What the row claims and how it is measured:
+
+    - **overhead** — serving throughput (client-observed tokens/sec
+      through the router over the SAME seeded traffic) with the fleet
+      postmortem collector armed vs off, against real (tiny) serving
+      replicas.  The armed pass runs FIRST so residual warmth favors
+      the control — the overhead number is conservative.  bench_diff
+      screams CAPTURE-OVERHEAD past 1%.
+    - **bundle_found / root_cause** — the archaeology self-check: after
+      the measured passes, a watchdog-source fence incident is injected
+      on one replica; the summary-poll incident cursor must fire
+      exactly one fleet bundle, and ``tools/postmortem.py`` must
+      classify the ON-DISK bundle ``watchdog_hang``.  bench_diff
+      screams CAPTURE-MISSED when no bundle lands and ROOTCAUSE-WRONG
+      on a misclassification — a capture plane that misses or
+      misattributes incidents is worse than none (operators trust it).
+
+    Returns the JSON ``postmortem`` block (None when the router phase
+    is disabled via --router-replicas < 2 — same replicas budget)."""
+    import dataclasses
+    import importlib.util
+    import os as _os
+    import shutil as _shutil
+    import sys as _sys
+    import tempfile as _tempfile
+    import threading
+    import time as _time
+
+    from ..router.server import RouterServer
+    from ..utils.metrics import MetricsRegistry
+    from .engine import EngineMetrics, ServingEngine
+    from .http_server import EngineServer
+    from .transformer import GPTConfig, PagedConfig, TransformerLM
+
+    if getattr(args, "router_replicas", 2) < 2:
+        return None
+    repo_root = _os.path.dirname(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+    try:
+        from tests.sim.traffic import RouterTraffic
+    except ImportError:
+        _sys.path.insert(0, repo_root)
+        from tests.sim.traffic import RouterTraffic
+
+    spec = importlib.util.spec_from_file_location(
+        "postmortem_tool", _os.path.join(repo_root, "tools", "postmortem.py")
+    )
+    pm_tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm_tool)
+
+    page_size = 4
+    cfg = dataclasses.replace(GPTConfig.tiny(), max_seq=64)
+    paged = PagedConfig(
+        page_size=page_size, num_pages=64, max_pages_per_seq=16
+    )
+    servers = []
+    for i in range(2):
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(200 + i), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg,
+            params,
+            paged,
+            max_slots=4,
+            metrics=EngineMetrics(registry),
+        )
+        servers.append(
+            EngineServer(
+                engine, host="127.0.0.1", port=0, registry=registry
+            ).start()
+        )
+
+    def _post_replica(port, prompt, max_new):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": max_new}
+            ).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=120).read()
+
+    # Warm every (batch, bucket) shape the traffic replay can hit, so
+    # no XLA compile lands inside either measured pass.
+    for server in servers:
+        for group in (1, 2, 3, 4):
+            threads = [
+                threading.Thread(
+                    target=_post_replica,
+                    args=(server.port, [7 + g] * 18, 6),
+                )
+                for g in range(group)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    replica_names = [f"127.0.0.1:{s.port}" for s in servers]
+    dump_dir = _tempfile.mkdtemp(prefix="bench-postmortem-")
+
+    def _measure(postmortem_on):
+        router = RouterServer(
+            replica_names,
+            host="127.0.0.1",
+            port=0,
+            prefix_block_tokens=page_size,
+            prefix_max_blocks=4,
+            poll_interval_s=0.2,
+            hedge=False,
+            seed=3,
+            postmortem=postmortem_on,
+            postmortem_dir=dump_dir,
+        ).start()
+        traffic = RouterTraffic(
+            "127.0.0.1",
+            router.port,
+            seed=29,
+            sessions=4,
+            prefix_len=16,
+            vocab=cfg.vocab_size,
+        )
+        # Warm pass, then the measured pass over identical shapes.
+        traffic.run(8, concurrency=4, suffix_len=(1, 4), max_new=(4, 8))
+        report = traffic.run(
+            24, concurrency=4, suffix_len=(1, 4), max_new=(4, 8)
+        )
+        tps = report.tokens / max(report.duration_s, 1e-9)
+        return router, tps, report
+
+    # Collector ON first: residual warmth then favors the OFF control,
+    # never the claim.
+    router_on, tps_on, report_on = _measure(True)
+
+    # Archaeology self-check on the still-running armed router: a
+    # watchdog-source fence incident on replica 0 (the flight event +
+    # discrete incident the real hung-step watchdog emits) must ride
+    # the summary-poll cursor into ONE fleet bundle that classifies as
+    # watchdog_hang FROM DISK.
+    victim = servers[0]
+    victim.engine.flight.record(
+        "engine.fenced", reason="hung_step", source="watchdog"
+    )
+    victim.engine.anomaly.report(
+        "engine.fenced", reason="hung_step", source="watchdog"
+    )
+    bundle_found = False
+    root_cause = None
+    deadline = _time.monotonic() + 20.0
+    while _time.monotonic() < deadline:
+        if router_on.postmortem.captures >= 1:
+            bundle_found = True
+            break
+        _time.sleep(0.1)
+    captures = router_on.postmortem.captures
+    if bundle_found:
+        bundle_path = router_on.postmortem.last_bundle
+        loaded = pm_tool.load_bundle(bundle_path)
+        timeline = pm_tool.build_timeline(loaded["components"])
+        root_cause = pm_tool.classify(timeline)["root_cause"]
+    router_on.stop()
+
+    router_off, tps_off, report_off = _measure(False)
+    router_off.stop()
+    for server in servers:
+        server.stop()
+    _shutil.rmtree(dump_dir, ignore_errors=True)
+
+    overhead = max(0.0, 1.0 - tps_on / tps_off) if tps_off else None
+    rootcause_ok = root_cause == "watchdog_hang"
+    block = {
+        "replicas": 2,
+        "tokens_per_sec_postmortem": round(tps_on, 2),
+        "tokens_per_sec_control": round(tps_off, 2),
+        "overhead": round(overhead, 4) if overhead is not None else None,
+        "dropped": report_on.dropped + report_off.dropped,
+        "captures": captures,
+        "bundle_found": bundle_found,
+        "root_cause": root_cause,
+        "rootcause_ok": rootcause_ok,
+    }
+    log(
+        "perf-ledger row: | POSTMORTEM fleet capture | overhead %s "
+        "(%.2f vs %.2f tokens/sec); injected watchdog fence %s "
+        "(%d bundles, classified %s) | - | `benchmark.py --model "
+        "serving` | update on bench round |"
+        % (
+            block["overhead"],
+            tps_on,
+            tps_off,
+            "captured" if bundle_found else "MISSED",
+            captures,
+            root_cause if rootcause_ok else f"WRONG ({root_cause})",
+        )
+    )
+    return block
+
+
 def _run_autoscale_phase(args) -> dict:
     """AUTOSCALE perf phase: the closed-loop fleet controller
     (controller/reconciler.py — the REAL Reconciler + FleetSimActuator,
@@ -2567,6 +2775,8 @@ def run_serving(args) -> None:
     canary_block = _run_canary_phase(args)
     # --- Autoscale phase (AUTOSCALE row): controller vs static peak ----
     autoscale_block = _run_autoscale_phase(args)
+    # --- Postmortem phase (POSTMORTEM row): capture overhead + verdict -
+    postmortem_block = _run_postmortem_phase(args)
     print(
         json.dumps(
             {
@@ -2618,6 +2828,7 @@ def run_serving(args) -> None:
                 "slo": slo_block,
                 "canary": canary_block,
                 "autoscale": autoscale_block,
+                "postmortem": postmortem_block,
                 "trace": trace_block,
                 "spans_recorded": len(spans.snapshot()) + spans.dropped,
                 "profile": {

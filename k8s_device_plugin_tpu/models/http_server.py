@@ -1541,6 +1541,17 @@ class EngineServer:
                         # cumulative request counter whose freezing while
                         # probes keep landing is the metric-staleness
                         # verdict.
+                        # Cumulative anomaly-incident counter: the
+                        # router's fleet postmortem collector
+                        # (router/postmortem.py) deltas this between
+                        # polls — an advance triggers a fleet evidence
+                        # capture while this replica's rings still hold
+                        # the lead-up.
+                        "incidents_total": (
+                            server.engine.anomaly.incidents_total
+                            if server.engine.anomaly is not None
+                            else None
+                        ),
                         "params_fingerprint": server.params_fp(),
                         "requests_total": (
                             int(server.engine.metrics.requests.value())
@@ -2340,6 +2351,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         "deploy yamls mount an emptyDir here)",
     )
     p.add_argument(
+        "--dump-budget-mb",
+        type=int,
+        default=0,
+        help="retention budget (MiB) for --dump-dir, shared by flight "
+        "dumps and postmortem bundles (utils/postmortem.py): after "
+        "every write the oldest entries are pruned until the "
+        "directory fits (0 = unbounded)",
+    )
+    p.add_argument(
         "--drain-grace",
         type=float,
         default=10.0,
@@ -2702,6 +2722,34 @@ def main(argv: Optional[list[str]] = None) -> None:
             )
             warmed = server.warm_from_fleet(args.warm_from_fleet, self_name)
         print(f"peer warm-up: {warmed}", file=sys.stderr, flush=True)
+    if args.dump_budget_mb:
+        flight_mod.set_dump_budget(args.dump_budget_mb * 1024 * 1024)
+    if args.dump_dir:
+        # Local postmortem capture (utils/postmortem.py): every emitted
+        # incident — EWMA detector trips, watchdog/chip-health fences,
+        # admission invariants — snapshots this replica's flight ring,
+        # span ring, metrics exposition, and debug state into a
+        # content-addressed bundle under --dump-dir, debounced per
+        # incident metric so one episode writes one bundle.
+        from ..utils.postmortem import PostmortemCapture
+
+        capture = PostmortemCapture(
+            "engine",
+            args.dump_dir,
+            flight=box,
+            spans=engine.spans,
+            registry=registry,
+            state_fn=lambda: {
+                "engine": engine.debug_state(),
+                "fence": server.fence_state(),
+            },
+            budget_bytes=(
+                args.dump_budget_mb * 1024 * 1024
+                if args.dump_budget_mb
+                else None
+            ),
+        )
+        engine.anomaly.add_listener(capture.on_incident)
     server.start()
 
     # A pod delete sends SIGTERM: drain gracefully — stop admitting,

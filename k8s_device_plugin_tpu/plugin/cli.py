@@ -113,6 +113,15 @@ def build_parser() -> argparse.ArgumentParser:
         "yamls mount /run/tpu/dump here)",
     )
     p.add_argument(
+        "--dump-budget-mb",
+        type=int,
+        default=0,
+        help="retention budget (MiB) for --dump-dir, shared by flight "
+        "dumps and postmortem bundles (utils/postmortem.py): after "
+        "every write the oldest entries are pruned until the directory "
+        "fits (0 = unbounded)",
+    )
+    p.add_argument(
         "--health-flap-threshold",
         type=int,
         default=2,
@@ -205,6 +214,8 @@ def main(argv: list[str] | None = None) -> int:
         flight_mod.FlightRecorder(capacity=args.flight_ring, name="daemon")
     )
     flight_mod.install_dump_handlers(args.dump_dir or None)
+    if args.dump_budget_mb:
+        flight_mod.set_dump_budget(args.dump_budget_mb * 1024 * 1024)
     # Chaos failpoints (utils/failpoints.py): env arming first, then the
     # flag adds/overrides; triggers become flight events in the same box
     # the detectors attach to incidents — injected cause and detected
@@ -328,6 +339,46 @@ def main(argv: list[str] | None = None) -> int:
             anomaly=monitor,
         )
         debug_endpoints["/debug/selftest"] = selftest.snapshot
+
+    def daemon_state() -> dict:
+        # The daemon's /debug/state-equivalent: the non-query debug
+        # surfaces joined into one snapshot — what the fleet postmortem
+        # collector pulls alongside flight/spans/metrics, and what the
+        # local capture hook writes as state.json.
+        state = {"component": "daemon", "served": served}
+        for path, fn in debug_endpoints.items():
+            if path in ("/debug/flight", "/debug/spans", "/debug/state"):
+                continue  # own evidence files / this aggregate itself
+            try:
+                state[path.rsplit("/", 1)[-1]] = fn()
+            except Exception as e:
+                state[path.rsplit("/", 1)[-1]] = {"error": str(e)}
+        return state
+
+    debug_endpoints["/debug/state"] = daemon_state
+    if args.dump_dir:
+        # Incident-triggered local postmortem capture
+        # (utils/postmortem.py): every incident the monitor emits —
+        # slow health sweeps, attribution drift, self-test failures —
+        # snapshots the daemon's forensic state into a content-addressed
+        # bundle under --dump-dir, debounced per cause metric.
+        from ..utils.postmortem import PostmortemCapture
+
+        capture = PostmortemCapture(
+            "daemon",
+            args.dump_dir,
+            flight=box,
+            spans=spans,
+            registry=DEFAULT_REGISTRY,
+            state_fn=daemon_state,
+            budget_bytes=(
+                args.dump_budget_mb * 1024 * 1024
+                if args.dump_budget_mb
+                else None
+            ),
+        )
+        monitor.add_listener(capture.on_incident)
+        debug_endpoints["/debug/postmortem"] = capture.snapshot
     metrics_server = None
 
     def _on_signal(signum, _frame):

@@ -99,6 +99,14 @@ class ReplicaState:
         # replica predates the field, age-since-registration still
         # bounds the bill).
         self.uptime_s = None  # guarded by: owner-thread
+        # Cumulative anomaly-incident counter off the summary poll
+        # (``incidents_total``): the fleet postmortem collector's
+        # trigger cursor — an advance between polls means the replica
+        # emitted an incident and its forensic state is worth
+        # capturing NOW, before the rings roll.  None until the
+        # replica exports the field (and on the first observation, so
+        # joining a fleet with historical incidents never back-fires).
+        self.incidents_total = None  # guarded by: owner-thread
         self.first_seen = time.monotonic()
         self.last_poll = 0.0  # last successful poll (monotonic); guarded by: owner-thread
         self.dispatches = 0

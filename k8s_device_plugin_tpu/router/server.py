@@ -80,6 +80,7 @@ import json
 import queue as queue_mod
 import random
 import socket
+import tempfile
 import threading
 import time
 import urllib.parse
@@ -494,6 +495,13 @@ class RouterServer:
         canary_config: Optional[CanaryConfig] = None,
         fabric: bool = False,
         fabric_config: Optional[FabricConfig] = None,
+        postmortem: bool = False,
+        postmortem_dir: Optional[str] = None,
+        postmortem_plugin_url: Optional[str] = None,
+        postmortem_controller_url: Optional[str] = None,
+        postmortem_debounce_s: float = 120.0,
+        postmortem_budget_bytes: Optional[int] = None,
+        postmortem_admin: bool = True,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics = RouterMetrics(self.registry)
@@ -584,6 +592,7 @@ class RouterServer:
         # state, mutated only on the poll thread.
         self.slo = SLOTracker() if slo else None
         self.slo_anomaly = None
+        self.canary_anomaly = None
         if slo:
             from ..utils.anomaly import AnomalyMonitor
 
@@ -625,7 +634,54 @@ class RouterServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] != "/generate":
+                post_path = self.path.split("?")[0]
+                if post_path == "/debug/postmortem/capture":
+                    # Admin-gated manual capture: an operator forcing a
+                    # fleet bundle NOW (synchronous, no debounce) — the
+                    # "grab everything before I restart it" button.
+                    if server.postmortem is None:
+                        self._reply(
+                            404,
+                            {"error": "postmortem collector off "
+                             "(--postmortem)"},
+                        )
+                        return
+                    if not server.postmortem.admin:
+                        self._reply(
+                            403,
+                            {"error": "postmortem admin capture "
+                             "disabled (--postmortem-admin)"},
+                        )
+                        return
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length", "0")
+                        )
+                        body = json.loads(
+                            self.rfile.read(length) or b"{}"
+                        )
+                    except ValueError:
+                        body = {}
+                    incident_id = str(
+                        body.get("incident_id") or "manual"
+                    )
+                    bundle = server.postmortem.capture_now(
+                        incident_id, trigger="manual"
+                    )
+                    self._reply(
+                        200,
+                        {
+                            "captured": bundle is not None,
+                            "bundle": bundle,
+                            "error": (
+                                None
+                                if bundle is not None
+                                else server.postmortem.last_error
+                            ),
+                        },
+                    )
+                    return
+                if post_path != "/generate":
                     self.send_error(404)
                     return
                 trace_id = sanitize_trace_id(self.headers.get("X-Request-Id"))
@@ -789,6 +845,19 @@ class RouterServer:
                         )
                     else:
                         self._reply(200, server.prober.snapshot())
+                elif path == "/debug/postmortem":
+                    # Fleet postmortem collector (router/postmortem.py):
+                    # capture/skip counters and the bundle ledger —
+                    # where an operator finds what evidence exists for
+                    # tools/postmortem.py to classify.
+                    if server.postmortem is None:
+                        self._reply(
+                            404,
+                            {"error": "postmortem collector off "
+                             "(--postmortem)"},
+                        )
+                    else:
+                        self._reply(200, server.postmortem.snapshot())
                 elif path == "/debug/spans":
                     # ?rid=<trace id>: one request's tree only — the
                     # trace assembler's live mode pulls per-request,
@@ -849,6 +918,52 @@ class RouterServer:
                 flight=flight,
                 anomaly=self.canary_anomaly,
             )
+        # Fleet postmortem collector (router/postmortem.py; library
+        # default OFF like migration/canary — the CLI arms it).  Two
+        # trigger paths: the summary poll's incidents_total cursor
+        # (any replica's incident), and the router's OWN monitors (SLO
+        # burn alerts, canary mismatches) via the full-record listener
+        # seam.  Capture runs on its own worker thread — never the poll
+        # thread.
+        self.postmortem = None
+        if postmortem:
+            from ..utils.flight import default_dump_dir
+            from .postmortem import FleetPostmortem
+
+            directory = (
+                postmortem_dir
+                or default_dump_dir()
+                or tempfile.gettempdir()
+            )
+
+            def _local_state():
+                return {
+                    "component": "router",
+                    "flight": (
+                        self.flight.snapshot()
+                        if self.flight is not None
+                        else None
+                    ),
+                    "spans": self.spans.dump(),
+                    "state": self.snapshot(),
+                    "metrics": self.registry.render(),
+                }
+
+            self.postmortem = FleetPostmortem(
+                directory,
+                lambda: list(self.replicas.keys()),
+                local_fn=_local_state,
+                plugin_url=postmortem_plugin_url,
+                controller_url=postmortem_controller_url,
+                flight=flight,
+                registry=self.registry,
+                debounce_s=postmortem_debounce_s,
+                budget_bytes=postmortem_budget_bytes,
+                admin=postmortem_admin,
+            )
+            for monitor in (self.slo_anomaly, self.canary_anomaly):
+                if monitor is not None:
+                    monitor.add_listener(self.postmortem.on_incident)
 
     # ------------------------------------------------------- membership
 
@@ -966,6 +1081,27 @@ class RouterServer:
             st.uptime_s = (
                 float(raw_uptime) if raw_uptime is not None else None
             )
+            # Anomaly-incident cursor (fleet postmortem trigger): an
+            # advance since the LAST poll means the replica just
+            # emitted an incident — capture its forensic state before
+            # the rings roll.  The first observation only seeds the
+            # cursor (a router joining a fleet with historical
+            # incidents must not back-fire on the backlog).
+            raw_incidents = payload.get("incidents_total")
+            if raw_incidents is not None:
+                try:
+                    incidents = int(raw_incidents)
+                except (TypeError, ValueError):
+                    incidents = None
+                if incidents is not None:
+                    previous = st.incidents_total
+                    st.incidents_total = incidents
+                    if (
+                        self.postmortem is not None
+                        and previous is not None
+                        and incidents > previous
+                    ):
+                        self.postmortem.observe_poll(name, incidents)
             draining = bool(payload.get("draining", False))
             if draining != st.draining:
                 self._mark_draining(name, draining)
@@ -3077,6 +3213,59 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="replication/eviction actions fired per poll sweep, "
         "fleet-wide (the pacing bound)",
     )
+    p.add_argument(
+        "--postmortem",
+        type=int,
+        choices=[0, 1],
+        default=0,
+        help="fleet postmortem collector (router/postmortem.py, "
+        "docs/operations.md \"Postmortem archaeology\"): on any "
+        "incident — a replica's incidents_total cursor advancing on "
+        "the summary poll, or the router's own SLO/canary monitors "
+        "firing — fan out to every replica's (plus the plugin "
+        "daemon's and controller's, when given) /debug/flight, "
+        "/debug/spans, /debug/state, and /metrics, and write ONE "
+        "fleet evidence bundle under --dump-dir for "
+        "tools/postmortem.py to classify; served at GET "
+        "/debug/postmortem, manual capture via the admin-gated POST "
+        "/debug/postmortem/capture",
+    )
+    p.add_argument(
+        "--postmortem-plugin-url",
+        default="",
+        help="host:port of the plugin daemon's metrics server — its "
+        "forensic endpoints join every fleet bundle",
+    )
+    p.add_argument(
+        "--postmortem-controller-url",
+        default="",
+        help="host:port of the fleet controller's debug server — its "
+        "forensic endpoints join every fleet bundle",
+    )
+    p.add_argument(
+        "--postmortem-debounce",
+        type=float,
+        default=120.0,
+        help="per-episode capture debounce (seconds): however many "
+        "incidents an episode re-fires, one bundle per window",
+    )
+    p.add_argument(
+        "--postmortem-admin",
+        type=int,
+        choices=[0, 1],
+        default=0,
+        help="1 arms the manual POST /debug/postmortem/capture "
+        "endpoint (same opt-in posture as the replicas' "
+        "--enable-admin)",
+    )
+    p.add_argument(
+        "--dump-budget-mb",
+        type=int,
+        default=0,
+        help="retention budget (MiB) for --dump-dir, shared by flight "
+        "dumps and postmortem bundles: after every write the oldest "
+        "entries are pruned until the directory fits (0 = unbounded)",
+    )
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument(
         "--policy",
@@ -3115,6 +3304,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         SpanRecorder(capacity=args.span_ring, name="router")
     )
     flight_mod.install_dump_handlers(args.dump_dir or None)
+    if args.dump_budget_mb:
+        flight_mod.set_dump_budget(args.dump_budget_mb * 1024 * 1024)
     failpoints.set_flight(box)
     failpoints.arm_from_env()
     if args.failpoints:
@@ -3170,6 +3361,17 @@ def main(argv: Optional[list[str]] = None) -> None:
             budget=args.migrate_budget,
             refill_per_s=args.migrate_refill,
         ),
+        postmortem=bool(args.postmortem),
+        postmortem_dir=args.dump_dir or None,
+        postmortem_plugin_url=args.postmortem_plugin_url or None,
+        postmortem_controller_url=args.postmortem_controller_url or None,
+        postmortem_debounce_s=args.postmortem_debounce,
+        postmortem_budget_bytes=(
+            args.dump_budget_mb * 1024 * 1024
+            if args.dump_budget_mb
+            else None
+        ),
+        postmortem_admin=bool(args.postmortem_admin),
         fabric=bool(args.fabric),
         fabric_config=FabricConfig(
             replicate_k=args.fabric_k,
@@ -3203,7 +3405,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         f"routing on :{server.port} over {len(server.replicas)} replicas "
         "(POST /generate, GET /healthz /metrics /debug/router "
         "/debug/fleet /debug/slo /debug/fabric /debug/canary "
-        "/debug/spans)",
+        "/debug/postmortem /debug/spans)",
         file=sys.stderr,
         flush=True,
     )

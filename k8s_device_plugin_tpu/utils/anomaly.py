@@ -193,8 +193,20 @@ class AnomalyMonitor:
         self._lock = threading.Lock()
         self._detectors: dict[str, AnomalyDetector] = {}
         self._incidents: deque[dict] = deque(maxlen=capacity)
+        self._listeners: list = []
         self.incidents_dropped = 0
         self.incidents_total = 0
+
+    def add_listener(self, fn) -> None:
+        """Register a full-record incident listener: ``fn(incident)`` is
+        called once per emitted incident with the complete record (flight
+        window included), AFTER the ring/log/flight fan-out and the
+        ``on_incident`` metric hook.  This is the postmortem-capture
+        seam (utils/postmortem.py): a listener that does real work (file
+        I/O) runs outside the monitor lock and its exceptions are
+        swallowed — a broken listener must never poison detection."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def configure(self, metric: str, **kwargs) -> AnomalyDetector:
         with self._lock:
@@ -297,6 +309,13 @@ class AnomalyMonitor:
                 self._on_incident(incident["metric"])
             except Exception:
                 log.exception("incident hook failed")
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener(incident)
+            except Exception:
+                log.exception("incident listener failed")
         return incident
 
     def incidents(self) -> list[dict]:

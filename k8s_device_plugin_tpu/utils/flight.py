@@ -216,6 +216,22 @@ def default_dump_dir(environ=None) -> Optional[str]:
     return environ.get(DUMP_DIR_ENV) or None
 
 
+# Process-wide dump-dir retention budget (utils/postmortem.py's shared
+# LRU sweeper): when armed (the daemons' --dump-budget-mb flag),
+# dump_all prunes oldest-first after each write so SIGUSR2/atexit dumps
+# and postmortem bundles never accumulate unbounded.
+_dump_budget: dict = {"bytes": None, "entries": None}
+
+
+def set_dump_budget(
+    budget_bytes: Optional[int], max_entries: Optional[int] = None
+) -> None:
+    """Arm (or clear, with None) the dump-dir retention budget applied
+    after every dump_all write."""
+    _dump_budget["bytes"] = budget_bytes
+    _dump_budget["entries"] = max_entries
+
+
 def dump_all(
     dump_dir: Optional[str] = None,
     reason: str = "manual",
@@ -264,6 +280,18 @@ def dump_all(
         log.error("flight dump to %s failed: %s", path, e)
         return None
     log.info("flight dump (%s) -> %s", reason, path)
+    if _dump_budget["bytes"] is not None or _dump_budget["entries"] is not None:
+        # Retention sweep (never raises): the just-written dump is
+        # protected so a tiny budget cannot eat its own forensics.
+        from . import postmortem as _postmortem
+
+        _postmortem.sweep_dump_dir(
+            directory,
+            _dump_budget["bytes"],
+            _dump_budget["entries"],
+            protect=(path,),
+            flight=recs[0] if recs else None,
+        )
     return path
 
 

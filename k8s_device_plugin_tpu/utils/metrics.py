@@ -333,6 +333,13 @@ class MetricsRegistry:
             self._metrics[metric.name] = metric
         return metric
 
+    def get(self, name: str):
+        """Look up an already-registered metric family by name (None
+        when absent) — the get-or-create seam for hooks that may be
+        constructed more than once against a process-wide registry."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def counter(self, name: str, help_text: str, labelnames: Iterable[str] = ()) -> Counter:
         return self._register(Counter(name, help_text, labelnames))
 

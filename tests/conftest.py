@@ -52,6 +52,7 @@ PLUGIN_TIER_FILES = {
     "test_health.py",
     "test_manager.py",
     "test_native.py",
+    "test_postmortem.py",
     "test_prober.py",
     "test_protocol.py",
     "test_resources.py",

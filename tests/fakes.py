@@ -465,6 +465,12 @@ class FakeReplica:
         from k8s_device_plugin_tpu.utils.flight import FlightRecorder
 
         self.flight = FlightRecorder(capacity=512, name="fake-replica")
+        # Cumulative incident counter (the EngineServer summary
+        # contract's ``incidents_total``): the router's fleet
+        # postmortem collector deltas it between polls — a fake bumps
+        # it through report_incident() (and every begin_fence, like the
+        # real fence path's anomaly.report).
+        self.incidents_total = 0
         # Warm-prefix model (elastic scale-up scenarios): with
         # ``prefix_tokens`` set, a prompt whose leading prefix-key is
         # NOT in ``warm_prefixes`` pays ``cold_prefill_delay_s`` (the
@@ -873,6 +879,10 @@ class FakeReplica:
                         # staleness detector watches.
                         "params_fingerprint": replica.params_fp,
                         "requests_total": requests_total,
+                        # Cumulative incident counter (EngineServer
+                        # summary contract): the fleet postmortem
+                        # collector's trigger cursor.
+                        "incidents_total": replica.incidents_total,
                         # Cumulative SLI counters (EngineServer summary
                         # contract): the router deltas these into its
                         # fleet SLO tracker.
@@ -892,6 +902,10 @@ class FakeReplica:
                     })
                 elif path == "/debug/snapshot":
                     self._serve_snapshot()
+                elif path == "/debug/flight":
+                    # The EngineServer forensic surface the fleet
+                    # postmortem collector pulls into bundles.
+                    self._json(200, replica.flight.snapshot())
                 elif path == "/debug/spans":
                     # The EngineServer contract incl. the ?rid= filter
                     # (the trace assembler's live mode).
@@ -1156,7 +1170,10 @@ class FakeReplica:
 
     # --- the EngineServer fence contract ---
     def begin_fence(
-        self, reason: str = "operator", retry_after: str = "1"
+        self,
+        reason: str = "operator",
+        retry_after: str = "1",
+        source: str = "operator",
     ) -> None:
         """Replica self-fenced (watchdog trip / sick chip / operator):
         new /generate answers a plain 503 + Retry-After (no X-Shed),
@@ -1164,10 +1181,32 @@ class FakeReplica:
         ``fenced: true`` — the router must stop assigning and let
         in-flight streams fail over.  In-flight FAKE streams keep
         running (the real server cuts them; tests that need the cut use
-        kill())."""
+        kill()).  Like the real fence path, the transition lands in the
+        flight ring (``engine.fenced`` with reason+source) AND as a
+        discrete incident — the postmortem trigger/evidence pair."""
         self.fence_reason = reason
         self.retry_after = retry_after
+        already = self._fenced.is_set()
         self._fenced.set()
+        if not already:
+            self.flight.record(
+                "engine.fenced", reason=reason, source=source
+            )
+            self.report_incident(
+                "engine.fenced", reason=reason, source=source
+            )
+
+    def report_incident(
+        self, metric: str, observed: float = 1.0, **fields
+    ) -> None:
+        """The AnomalyMonitor fan-out in miniature: one ``incident``
+        flight event + the cumulative ``incidents_total`` the summary
+        exports (the fleet postmortem collector's trigger cursor)."""
+        self.flight.record(
+            "incident", metric=metric, observed=observed, **fields
+        )
+        with self._lock:
+            self.incidents_total += 1
 
     def unfence(self) -> None:
         self._fenced.clear()

@@ -606,6 +606,79 @@ def test_bench_diff_parses_canary_block(tmp_path):
     assert "MISMATCH-MISSED" in bench_diff.ledger_row(a, d)
 
 
+def test_bench_diff_parses_postmortem_block(tmp_path):
+    """Records grew a POSTMORTEM block (ISSUE 20, benchmark.py
+    _run_postmortem_phase): the collector-armed vs collector-off
+    serving overhead and the capture/classification self-check must
+    surface in the normalized record, the field diff, and the ledger
+    row — and the row must scream CAPTURE-OVERHEAD past 1%,
+    CAPTURE-MISSED when the injected incident produced no bundle, and
+    ROOTCAUSE-WRONG when the on-disk bundle misclassified."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    )
+    bench_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_diff)
+
+    base = {
+        "n": 8,
+        "rc": 0,
+        "parsed": {"metric": "serving_tokens_per_sec", "value": 100.0,
+                   "unit": "tokens/sec", "platform": "tpu"},
+    }
+    loaded = json.loads(json.dumps(base))
+    loaded["n"] = 9
+    loaded["parsed"]["postmortem"] = {
+        "overhead": 0.004, "tokens_per_sec_postmortem": 99.6,
+        "tokens_per_sec_control": 100.0, "captures": 1,
+        "bundle_found": True, "root_cause": "watchdog_hang",
+        "rootcause_ok": True,
+    }
+    (tmp_path / "a.json").write_text(json.dumps(base))
+    (tmp_path / "b.json").write_text(json.dumps(loaded))
+    a = bench_diff.load_record(str(tmp_path / "a.json"))
+    b = bench_diff.load_record(str(tmp_path / "b.json"))
+    assert b["postmortem_overhead"] == 0.004
+    assert b["postmortem_captures"] == 1
+    assert b["postmortem_bundle_found"] is True
+    assert b["postmortem_root_cause"] == "watchdog_hang"
+    assert b["postmortem_rootcause_ok"] is True
+    diff = "\n".join(bench_diff.diff_lines(a, b))
+    assert "postmortem_overhead" in diff
+    assert "postmortem_root_cause" in diff
+    row = bench_diff.ledger_row(a, b)
+    assert "postmortem overhead 0.004" in row
+    assert "1 bundles" in row and "root watchdog_hang" in row
+    for scream in ("CAPTURE-OVERHEAD", "CAPTURE-MISSED",
+                   "ROOTCAUSE-WRONG"):
+        assert scream not in row
+    # Capture past 1% of serving throughput screams...
+    loaded["parsed"]["postmortem"]["overhead"] = 0.02
+    (tmp_path / "c.json").write_text(json.dumps(loaded))
+    c = bench_diff.load_record(str(tmp_path / "c.json"))
+    assert "CAPTURE-OVERHEAD" in bench_diff.ledger_row(a, c)
+    # ...a black box that recorded nothing screams...
+    loaded["parsed"]["postmortem"]["overhead"] = 0.004
+    loaded["parsed"]["postmortem"]["bundle_found"] = False
+    loaded["parsed"]["postmortem"]["captures"] = 0
+    loaded["parsed"]["postmortem"]["root_cause"] = None
+    loaded["parsed"]["postmortem"]["rootcause_ok"] = False
+    (tmp_path / "d.json").write_text(json.dumps(loaded))
+    d = bench_diff.load_record(str(tmp_path / "d.json"))
+    row_d = bench_diff.ledger_row(a, d)
+    assert "CAPTURE-MISSED" in row_d and "ROOTCAUSE-WRONG" in row_d
+    # ...and a wrong verdict screams even when a bundle landed.
+    loaded["parsed"]["postmortem"]["bundle_found"] = True
+    loaded["parsed"]["postmortem"]["captures"] = 1
+    loaded["parsed"]["postmortem"]["root_cause"] = "overload_shed_storm"
+    (tmp_path / "e.json").write_text(json.dumps(loaded))
+    e = bench_diff.load_record(str(tmp_path / "e.json"))
+    row_e = bench_diff.ledger_row(a, e)
+    assert "ROOTCAUSE-WRONG" in row_e and "CAPTURE-MISSED" not in row_e
+
+
 def test_bench_diff_parses_restart_block(tmp_path):
     """Records grew a RESTART block (ISSUE 10, benchmark.py
     _run_restart_phase): cold vs warm post-restart TTFT p99 and the

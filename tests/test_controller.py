@@ -640,6 +640,85 @@ def test_controller_server_surface_and_exposition_lint():
     assert "controller.stopped" in kinds
 
 
+def test_controller_forensics_parity_endpoints(tmp_path):
+    """Forensics parity (postmortem satellite): the controller serves
+    the same pullable surfaces as engines and the router —
+    /debug/flight, /debug/spans, /debug/state, /debug/incidents — so
+    the fleet postmortem collector can join controller decisions into
+    an incident timeline.  Driven through a REAL actuator failure: the
+    discrete controller.actuator_error incident lands in the monitor
+    AND triggers the wired PostmortemCapture listener."""
+    from k8s_device_plugin_tpu.utils.anomaly import AnomalyMonitor
+    from k8s_device_plugin_tpu.utils.postmortem import PostmortemCapture
+    from k8s_device_plugin_tpu.utils.spans import SpanRecorder
+
+    registry = MetricsRegistry()
+    flight = FlightRecorder(capacity=128, name="controller")
+    spans = SpanRecorder(capacity=32, name="controller")
+    anomaly = AnomalyMonitor(flight=flight)
+    hot = {
+        "d1:1": _row("decode", 5.0, queue=4),
+        "d2:1": _row("decode", 5.0, queue=4),
+    }
+    clock = Clock()
+    rc = Reconciler(
+        lambda: _fleet(hot),
+        RecordingActuator(fail=True),
+        config=ControllerConfig(
+            interval_s=30.0, sustain_ticks=2, cooldown_s=30.0
+        ),
+        metrics=ControllerMetrics(registry),
+        flight=flight,
+        anomaly=anomaly,
+        now=clock,
+    )
+    capture = PostmortemCapture(
+        "controller", str(tmp_path), flight=flight, spans=spans,
+        registry=registry, state_fn=lambda: {"component": "controller"},
+    )
+    anomaly.add_listener(capture.on_incident)
+    with spans.span("controller.tick", trace_id="c" * 32):
+        pass
+    clock.t += 5.0
+    rc.tick()
+    clock.t += 5.0
+    assert rc.tick()["outcome"] == "actuator_error"
+    incidents = anomaly.incidents()
+    assert [i["metric"] for i in incidents] == ["controller.actuator_error"]
+    assert incidents[0]["action"] == "scale_up"
+    # The incident listener captured a local controller bundle.
+    assert capture.captures == 1
+    assert os.path.isdir(capture.last_bundle)
+
+    server = ControllerServer(
+        rc, registry, host="127.0.0.1", port=0, spans=spans
+    )
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        snap = get("/debug/flight")
+        assert snap["name"] == "controller"
+        kinds = [e["kind"] for e in snap["events"]]
+        assert "controller.actuator_error" in kinds
+        assert "postmortem.captured" in kinds
+        dump = get("/debug/spans")
+        assert [s["name"] for s in dump["spans"]] == ["controller.tick"]
+        assert get("/debug/spans?rid=" + "f" * 32)["spans"] == []
+        state = get("/debug/state")
+        assert state["component"] == "controller"
+        assert state["loop_alive"] is True
+        assert state["controller"]["observed"] == {"decode": 2}
+        inc = get("/debug/incidents")
+        assert inc["incidents_total"] == 1
+    finally:
+        server.stop()
+
+
 def test_fleet_plan_renders_controller_section(tmp_path, capsys):
     """tools/fleet_plan.py --controller-url: the decision log and
     desired-vs-observed spec render next to the recommendation

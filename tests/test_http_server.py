@@ -782,6 +782,14 @@ def test_debug_state_summary_mode(served):
     fp = summary.pop("params_fingerprint")
     assert isinstance(fp, str) and fp
     assert isinstance(summary.pop("requests_total"), int)
+    # Process age (ISSUE 19): the controller's replica-minutes ledger
+    # input; value is wall-clock dependent, shape pinned here.
+    assert summary.pop("uptime_s") >= 0.0
+    # Incident cursor (postmortem archaeology): the cumulative
+    # AnomalyMonitor count the router's fleet collector watches for
+    # advances; the trigger behaviour is pinned in
+    # test_summary_incidents_total_advances_on_incident.
+    assert isinstance(summary.pop("incidents_total"), int)
     # Fleet-KV-fabric advertisement (router/fabric.py): a wire bloom
     # dict when this engine can serve any-peer pulls, else null; the
     # populated shape is pinned in test_engine_handoff.py.
@@ -795,6 +803,24 @@ def test_debug_state_summary_mode(served):
         "fenced": False,
         "loop_alive": True,
     }
+
+
+def test_summary_incidents_total_advances_on_incident(served):
+    """The postmortem trigger cursor: every AnomalyMonitor incident
+    (detector-emitted or discrete report) advances the summary's
+    cumulative incidents_total, which the router's fleet collector
+    turns into a capture."""
+    _, _, server = served
+    before = _get_json(server.port, "/debug/state?summary=1")[
+        "incidents_total"
+    ]
+    server.engine.anomaly.report(
+        "engine.fenced", reason="summary-pin", source="operator"
+    )
+    after = _get_json(server.port, "/debug/state?summary=1")[
+        "incidents_total"
+    ]
+    assert after == before + 1
 
 
 def test_summary_params_fingerprint_and_requests_total(served):

@@ -632,6 +632,83 @@ def test_router_validation_healthz_and_debug_snapshot():
         _teardown(replicas, router)
 
 
+def test_debug_postmortem_off_by_default_and_admin_gated(tmp_path):
+    """The fleet collector surface: 404 while --postmortem is off; when
+    armed, GET /debug/postmortem serves the ledger and POST
+    /debug/postmortem/capture is admin-gated (403 until
+    --postmortem-admin) — same gating shape as the fence/drain admin
+    endpoints."""
+
+    def _capture_post(port):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/postmortem/capture",
+            data=json.dumps({"incident_id": "operator-drill"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    replicas, router, _ = _fleet(2)
+    try:
+        assert router.postmortem is None
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/debug/postmortem",
+                timeout=5,
+            )
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _capture_post(router.port)
+        assert e.value.code == 404
+    finally:
+        _teardown(replicas, router)
+
+    replicas, router, _ = _fleet(
+        2,
+        router_kwargs=dict(
+            postmortem=True,
+            postmortem_dir=str(tmp_path),
+            postmortem_admin=False,
+        ),
+    )
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/postmortem", timeout=5
+        ) as resp:
+            snap = json.loads(resp.read())
+        assert snap["enabled"] is True
+        assert snap["directory"] == str(tmp_path)
+        assert snap["captures"] == 0 and snap["bundles"] == []
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _capture_post(router.port)
+        assert e.value.code == 403
+    finally:
+        _teardown(replicas, router)
+
+    replicas, router, _ = _fleet(
+        2,
+        router_kwargs=dict(
+            postmortem=True,
+            postmortem_dir=str(tmp_path),
+            postmortem_admin=True,
+        ),
+    )
+    try:
+        body = _capture_post(router.port)
+        assert body["captured"] is True
+        assert os.path.isdir(body["bundle"])
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/postmortem", timeout=5
+        ) as resp:
+            snap = json.loads(resp.read())
+        assert snap["captures"] == 1
+        assert snap["bundles"][0]["incident_id"] == "operator-drill"
+        assert snap["bundles"][0]["trigger"] == "manual"
+    finally:
+        _teardown(replicas, router)
+
+
 def test_poll_marks_replica_down_and_up():
     replicas, router, flight = _fleet(2)
     try:

@@ -284,6 +284,29 @@ def load_record(path: str) -> dict:
                 "mismatch_detected"
             )
             rec["canary_fences"] = canary.get("fences")
+        # Postmortem block (POSTMORTEM serving rows, benchmark.py
+        # _run_postmortem_phase): measured collector-armed vs
+        # collector-off serving throughput overhead, plus the
+        # archaeology self-check (an injected watchdog-source fence
+        # incident MUST land one fleet bundle that classifies as
+        # watchdog_hang from disk).  The regression tells: overhead
+        # creeping past 1% (incident capture stopped being free —
+        # CAPTURE-OVERHEAD), bundle_found flipping false
+        # (CAPTURE-MISSED: the black box records nothing exactly when
+        # it matters), or rootcause_ok flipping false (ROOTCAUSE-WRONG:
+        # the classifier points operators at the wrong subsystem, worse
+        # than no verdict).
+        postmortem = parsed.get("postmortem")
+        if isinstance(postmortem, dict):
+            rec["postmortem_overhead"] = postmortem.get("overhead")
+            rec["postmortem_captures"] = postmortem.get("captures")
+            rec["postmortem_bundle_found"] = postmortem.get(
+                "bundle_found"
+            )
+            rec["postmortem_root_cause"] = postmortem.get("root_cause")
+            rec["postmortem_rootcause_ok"] = postmortem.get(
+                "rootcause_ok"
+            )
         # Autoscale block (AUTOSCALE serving rows, benchmark.py
         # _run_autoscale_phase): the closed-loop fleet controller vs a
         # static peak-provisioned fleet over the same deterministic
@@ -380,6 +403,9 @@ def diff_lines(a: dict, b: dict) -> list[str]:
         "slo_overhead", "slo_verdicts", "slo_burn_alert_fired",
         "canary_overhead", "canary_probes", "canary_mismatch_detected",
         "canary_fences",
+        "postmortem_overhead", "postmortem_captures",
+        "postmortem_bundle_found", "postmortem_root_cause",
+        "postmortem_rootcause_ok",
         "autoscale_replica_minutes", "autoscale_static_minutes",
         "autoscale_minutes_saved", "autoscale_ttft_p99_ms",
         "autoscale_static_ttft_p99_ms", "autoscale_violations",
@@ -623,6 +649,29 @@ def ledger_row(a: dict, b: dict) -> str:
                 )
                 + ")"
                 if b.get("canary_overhead") is not None
+                else ""
+            )
+            + (
+                f"; postmortem overhead {b['postmortem_overhead']} "
+                f"({b.get('postmortem_captures')} bundles, "
+                f"root {b.get('postmortem_root_cause')}"
+                + (
+                    ", CAPTURE-OVERHEAD"
+                    if (b.get("postmortem_overhead") or 0.0) > 0.01
+                    else ""
+                )
+                + (
+                    ""
+                    if b.get("postmortem_bundle_found", True)
+                    else ", CAPTURE-MISSED"
+                )
+                + (
+                    ""
+                    if b.get("postmortem_rootcause_ok", True)
+                    else ", ROOTCAUSE-WRONG"
+                )
+                + ")"
+                if b.get("postmortem_overhead") is not None
                 else ""
             )
             + (

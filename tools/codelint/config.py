@@ -168,6 +168,7 @@ CLI_MODULES = [
     "k8s_device_plugin_tpu/router/server.py",
     "k8s_device_plugin_tpu/models/engine.py",
     "k8s_device_plugin_tpu/controller/__main__.py",
+    "tools/postmortem.py",
 ]
 # Extra argparse modules whose flags exist but are NOT doc-checked
 # (tools/ scripts document themselves in their --help); they still

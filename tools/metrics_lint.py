@@ -114,6 +114,13 @@ FAMILY_BUDGETS = {
     "tpu_controller_desired_replicas": 3,  # one gauge per role
     "tpu_controller_observed_replicas": 3,  # one gauge per role
     "tpu_controller_replica_minutes_total": 3,  # one counter per role
+    # Postmortem archaeology (utils/postmortem.py, router/postmortem.py).
+    # Triggers and outcomes are CLOSED enums: trigger in {incident,
+    # summary_poll, local_incident, manual}, outcome in {captured,
+    # debounced, duplicate, error, no_dir} — a breach means an incident
+    # key or bundle name leaked into what must stay a fixed enum.
+    "tpu_postmortem_captures_total": 20,  # 4 triggers x 5 outcomes
+    "tpu_postmortem_bundle_bytes": 1,  # unlabeled gauge
 }
 
 

@@ -1,0 +1,526 @@
+#!/usr/bin/env python3
+"""Postmortem archaeology: join a fleet evidence bundle into one
+causally-ordered incident timeline and classify the root cause.
+
+The capture side (utils/postmortem.py per process, router/postmortem.py
+fleet-wide) freezes each component's flight ring, span ring, metrics
+exposition, and debug state at incident time.  This tool owns the
+read side:
+
+- **Load** a bundle directory — either a fleet bundle
+  (``postmortem-fleet-*/`` with ``router.json`` / ``replica-*.json`` /
+  ``plugin.json`` / ``controller.json``) or a single-process bundle
+  (``postmortem-<component>-*/`` with ``flight.json`` / ``spans.json``
+  / ``state.json`` / ``incident.json``) — or dial live components'
+  forensic endpoints with ``--url``.
+- **Join** evidence across components into ONE timeline: every flight
+  event and span start becomes a row ``(ts, component, kind, detail)``,
+  ordered by wall-clock ts with a deterministic tie-break, carrying the
+  PR 12 trace/rid keys where the source event has them — so a
+  mid-decode failover reads as the replica's death, the router's
+  ``router.failover``, and the resumed stream in causal order.
+- **Classify** against a CLOSED rule table (``ROOT_CAUSES``): each
+  class has signature evidence kinds; cascade suppression explains
+  away downstream matches (an unplugged chip also hangs the watchdog —
+  the unplug is the root), and genuinely ambiguous or empty evidence
+  verdicts ``unknown`` rather than guessing.  The verdict cites its
+  supporting evidence rows by timeline index.
+
+Output: a markdown report (``--out``; stdout by default) and/or a JSON
+verdict (``--json``) shaped for ``chaos_report.score_detections``
+(``{"cls": <root cause>, "ts": <first evidence ts>}``).
+
+Usage:
+
+    python tools/postmortem.py /run/tpu/dump/postmortem-fleet-...-abc/
+    python tools/postmortem.py --dump-dir /run/tpu/dump   # latest bundle
+    python tools/postmortem.py --url 127.0.0.1:8000 --url 127.0.0.1:8100
+
+Stdlib only; jax-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+from typing import Optional
+
+# The closed root-cause set.  Every verdict is one of these — an
+# operator never reads a free-text guess.
+ROOT_CAUSES = (
+    "chip_unplug",
+    "watchdog_hang",
+    "canary_corruption",
+    "donor_death_mid_transfer",
+    "overload_shed_storm",
+    "kubelet_outage",
+    "actuator_failure",
+    "unknown",
+)
+
+# Signature evidence per class: flight-event kinds (exact match) plus
+# field predicates.  A row matches a class when its kind is in the
+# class's kind set AND every listed field predicate holds.
+_FENCE_SOURCES = {"chip_health": "chip_unplug", "watchdog": "watchdog_hang"}
+
+# Event kinds whose mere presence is class evidence.
+_KIND_RULES: dict[str, str] = {
+    "device.unplug": "chip_unplug",
+    "canary.mismatch": "canary_corruption",
+    "canary.fence": "canary_corruption",
+    "selftest.checksum_mismatch": "canary_corruption",
+    "selftest.fail": "canary_corruption",
+    "selftest.quarantine": "canary_corruption",
+    "engine.snapshot.fetch_failed": "donor_death_mid_transfer",
+    "handoff.fetch_failed": "donor_death_mid_transfer",
+    "fabric.pull_failed": "donor_death_mid_transfer",
+    "kubelet.restart": "kubelet_outage",
+    "kubelet.absent": "kubelet_outage",
+    "podresources.down": "kubelet_outage",
+    "controller.actuator_error": "actuator_failure",
+}
+
+# Shed-pressure kinds counted toward the storm threshold: any one shed
+# is normal back-pressure; a BURST of them is the incident.
+_STORM_KINDS = ("admission.shed", "router.replica_shed", "overload.limit")
+DEFAULT_STORM_THRESHOLD = 5
+
+# Cascade suppression: key class CAUSES the value classes — when both
+# match, the downstream match is explained evidence, not a second root.
+_CASCADES: dict[str, set] = {
+    "chip_unplug": {"watchdog_hang", "overload_shed_storm",
+                    "donor_death_mid_transfer"},
+    "watchdog_hang": {"overload_shed_storm", "donor_death_mid_transfer"},
+    "canary_corruption": {"overload_shed_storm"},
+    "donor_death_mid_transfer": {"overload_shed_storm"},
+    "kubelet_outage": {"overload_shed_storm", "chip_unplug"},
+    "actuator_failure": set(),
+    "overload_shed_storm": set(),
+}
+
+
+# ------------------------------------------------------------------ load
+
+ENDPOINTS = ("/debug/flight", "/debug/spans", "/debug/state", "/metrics")
+
+
+def _read_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_bundle(path: str) -> dict:
+    """Load one bundle directory into ``{manifest, components}`` where
+    components is ``[{name, flight, spans, state, incident}]``.
+    Handles both the fleet layout and the single-process layout."""
+    manifest = {}
+    manifest_path = os.path.join(path, "manifest.json")
+    if os.path.isfile(manifest_path):
+        manifest = _read_json(manifest_path)
+    components: list[dict] = []
+    names = sorted(os.listdir(path))
+    single = {"flight.json", "spans.json", "state.json"} & set(names)
+    if single and not any(n.startswith("replica-") for n in names):
+        # Single-process bundle: one component, files at top level.
+        comp = {"name": manifest.get("component", "local")}
+        for fname, key in (
+            ("flight.json", "flight"),
+            ("spans.json", "spans"),
+            ("state.json", "state"),
+            ("incident.json", "incident"),
+        ):
+            fpath = os.path.join(path, fname)
+            comp[key] = _read_json(fpath) if os.path.isfile(fpath) else None
+        components.append(comp)
+        return {"manifest": manifest, "components": components, "path": path}
+    for fname in names:
+        if not fname.endswith(".json") or fname == "manifest.json":
+            continue
+        body = _read_json(os.path.join(path, fname))
+        if not isinstance(body, dict):
+            continue
+        components.append(
+            {
+                "name": body.get("component") or fname[: -len(".json")],
+                "flight": body.get("flight"),
+                "spans": body.get("spans"),
+                "state": body.get("state"),
+                "incident": body.get("incident"),
+            }
+        )
+    return {"manifest": manifest, "components": components, "path": path}
+
+
+def latest_bundle(dump_dir: str) -> Optional[str]:
+    """Newest ``postmortem-*`` bundle directory under ``dump_dir``."""
+    best = None
+    best_mtime = -1.0
+    try:
+        names = os.listdir(dump_dir)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith("postmortem-") or name.endswith(".inprogress"):
+            continue
+        full = os.path.join(dump_dir, name)
+        if not os.path.isdir(full):
+            continue
+        mtime = os.stat(full).st_mtime
+        if mtime > best_mtime:
+            best, best_mtime = full, mtime
+    return best
+
+
+def dial_component(target: str, timeout_s: float = 5.0) -> dict:
+    """Pull one live component's forensic endpoints (ignoring the ones
+    it lacks) — the ``--url`` path."""
+    host, _, port = target.rpartition(":")
+    comp: dict = {"name": target, "flight": None, "spans": None,
+                  "state": None, "incident": None}
+    for path in ENDPOINTS:
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                continue
+            if path == "/metrics":
+                continue  # exposition text: not timeline evidence
+            comp[path.rsplit("/", 1)[-1]] = json.loads(raw or b"{}")
+        except (OSError, ValueError):
+            continue
+        finally:
+            conn.close()
+    return comp
+
+
+# -------------------------------------------------------------- timeline
+
+
+def _flight_events(flight) -> list[dict]:
+    """Events out of either a FlightRecorder.snapshot() or a bare event
+    list (live /debug/flight and bundled snapshots share the shape)."""
+    if flight is None:
+        return []
+    if isinstance(flight, dict):
+        events = flight.get("events") or []
+    else:
+        events = flight
+    return [e for e in events if isinstance(e, dict) and "ts" in e]
+
+
+def _span_rows(spans, component: str) -> list[dict]:
+    """Span starts as timeline rows: the trace/rid join keys (PR 12)
+    ride along so cross-component rows correlate per request."""
+    if not isinstance(spans, dict):
+        return []
+    rows = []
+    for span in spans.get("spans") or []:
+        if not isinstance(span, dict) or "start" not in span:
+            continue
+        rows.append(
+            {
+                "ts": float(span["start"]),
+                "component": component,
+                "kind": f"span:{span.get('name', '?')}",
+                "rid": span.get("trace_id"),
+                "detail": {
+                    "duration_ms": span.get("duration_ms"),
+                    "span_id": span.get("span_id"),
+                },
+            }
+        )
+    return rows
+
+
+def build_timeline(components: list[dict], spans: bool = True) -> list[dict]:
+    """One causally-ordered row list across every component: flight
+    events (evidence) plus span starts (request correlation).  Sorted by
+    wall-clock ts with a deterministic (component, kind) tie-break, so
+    the verdict never depends on input file order."""
+    rows: list[dict] = []
+    for comp in components:
+        name = str(comp.get("name", "?"))
+        for event in _flight_events(comp.get("flight")):
+            detail = {
+                k: v for k, v in event.items() if k not in ("ts", "kind")
+            }
+            rows.append(
+                {
+                    "ts": float(event["ts"]),
+                    "component": name,
+                    "kind": str(event.get("kind", "?")),
+                    "rid": detail.get("rid") or detail.get("trace_id"),
+                    "detail": detail,
+                }
+            )
+        incident = comp.get("incident")
+        if isinstance(incident, dict) and "ts" in incident:
+            detail = {
+                k: v
+                for k, v in incident.items()
+                if k not in ("ts", "kind", "flight_window")
+            }
+            rows.append(
+                {
+                    "ts": float(incident["ts"]),
+                    "component": name,
+                    "kind": "incident",
+                    "rid": None,
+                    "detail": detail,
+                }
+            )
+        if spans:
+            rows.extend(_span_rows(comp.get("spans"), name))
+    rows.sort(key=lambda r: (r["ts"], r["component"], r["kind"]))
+    return rows
+
+
+# -------------------------------------------------------------- classify
+
+
+def _row_classes(row: dict) -> list[str]:
+    """Classes one timeline row is signature evidence for."""
+    kind = row["kind"]
+    detail = row.get("detail") or {}
+    classes = []
+    mapped = _KIND_RULES.get(kind)
+    if mapped is not None:
+        classes.append(mapped)
+    if kind == "engine.fenced":
+        cls = _FENCE_SOURCES.get(str(detail.get("source", "")))
+        if cls is not None:
+            classes.append(cls)
+    if kind == "incident":
+        metric = str(detail.get("metric", ""))
+        mapped = _KIND_RULES.get(metric)
+        if mapped is not None:
+            classes.append(mapped)
+        if metric == "engine.fenced":
+            cls = _FENCE_SOURCES.get(str(detail.get("source", "")))
+            if cls is not None:
+                classes.append(cls)
+    if kind == "controller.decision" and (
+        str(detail.get("outcome", "")) == "actuator_error"
+    ):
+        classes.append("actuator_failure")
+    return classes
+
+
+def classify(
+    timeline: list[dict],
+    storm_threshold: int = DEFAULT_STORM_THRESHOLD,
+) -> dict:
+    """The deterministic closed-set verdict over a joined timeline.
+
+    Set-based (order-independent): gather each class's evidence rows,
+    suppress matches a higher cascade explains (an unplugged chip also
+    hangs the watchdog and storms the shed path — one root), and
+    verdict ``unknown`` on empty OR still-ambiguous evidence.  Returns
+    ``{root_cause, ts, evidence: {cls: [row indices]}, suppressed,
+    candidates}`` — evidence rows are cited by timeline index."""
+    evidence: dict[str, list[int]] = {}
+    storm_rows: list[int] = []
+    for i, row in enumerate(timeline):
+        for cls in _row_classes(row):
+            evidence.setdefault(cls, []).append(i)
+        if row["kind"] in _STORM_KINDS:
+            storm_rows.append(i)
+    if len(storm_rows) >= max(1, storm_threshold):
+        evidence["overload_shed_storm"] = storm_rows
+    candidates = set(evidence)
+    suppressed: dict[str, str] = {}
+    # Snapshot taken BEFORE discards: a cause that is itself explained
+    # away still suppresses its own downstream matches (transitive —
+    # kubelet outage -> chip gone -> watchdog hang is ONE root).
+    # Sorted so the suppressed-by attribution is deterministic.
+    for cause in sorted(candidates):
+        for downstream in _CASCADES.get(cause, ()):
+            if downstream in candidates:
+                candidates.discard(downstream)
+                suppressed[downstream] = cause
+    if len(candidates) == 1:
+        root = candidates.pop()
+    else:
+        # Empty evidence, or two roots neither of which explains the
+        # other: an honest "unknown" beats a coin flip.
+        root = "unknown"
+    first_ts = None
+    if root != "unknown" and evidence.get(root):
+        first_ts = timeline[evidence[root][0]]["ts"]
+    return {
+        "root_cause": root,
+        "ts": first_ts,
+        "evidence": {cls: rows for cls, rows in sorted(evidence.items())},
+        "suppressed": suppressed,
+        "candidates": sorted(candidates) if root == "unknown" else [root],
+        "storm_threshold": storm_threshold,
+        "rows": len(timeline),
+    }
+
+
+# ---------------------------------------------------------------- report
+
+
+def render_markdown(
+    bundle: dict,
+    timeline: list[dict],
+    verdict: dict,
+    last: int = 40,
+) -> str:
+    manifest = bundle.get("manifest") or {}
+    lines = ["# Postmortem report", ""]
+    if bundle.get("path"):
+        lines.append(f"- bundle: `{bundle['path']}`")
+    if manifest.get("incident_id"):
+        lines.append(f"- incident: `{manifest['incident_id']}`")
+    if manifest.get("trigger"):
+        lines.append(f"- trigger: `{manifest['trigger']}`")
+    lines.append(
+        f"- components: {len(bundle.get('components') or [])}, "
+        f"timeline rows: {len(timeline)}"
+    )
+    lines += ["", f"## Root cause: `{verdict['root_cause']}`", ""]
+    if verdict["root_cause"] == "unknown":
+        cands = verdict.get("candidates") or []
+        lines.append(
+            "Ambiguous evidence: candidates "
+            + ", ".join(f"`{c}`" for c in cands)
+            if cands
+            else "No signature evidence in the bundle."
+        )
+    for cls, rows in verdict["evidence"].items():
+        cited = ", ".join(str(i) for i in rows[:8])
+        more = f" (+{len(rows) - 8} more)" if len(rows) > 8 else ""
+        marker = (
+            "**root**"
+            if cls == verdict["root_cause"]
+            else f"explained by `{verdict['suppressed'][cls]}`"
+            if cls in verdict["suppressed"]
+            else "candidate"
+        )
+        lines.append(f"- `{cls}` — rows [{cited}]{more} — {marker}")
+    lines += ["", f"## Timeline (last {min(last, len(timeline))} rows)", ""]
+    lines.append("| # | ts | component | event | rid |")
+    lines.append("|---|----|-----------|-------|-----|")
+    start = max(0, len(timeline) - last)
+    for i in range(start, len(timeline)):
+        row = timeline[i]
+        rid = row.get("rid") or ""
+        lines.append(
+            f"| {i} | {row['ts']:.3f} | {row['component']} "
+            f"| `{row['kind']}` | {rid} |"
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/postmortem.py",
+        description=(
+            "join a postmortem evidence bundle (or live components) "
+            "into one incident timeline and classify the root cause "
+            "against the closed rule table"
+        ),
+    )
+    p.add_argument(
+        "bundle",
+        nargs="?",
+        default="",
+        help="bundle directory (fleet or single-process layout)",
+    )
+    p.add_argument(
+        "--dump-dir",
+        default="",
+        help="classify the NEWEST postmortem bundle under this dump dir",
+    )
+    p.add_argument(
+        "--url",
+        action="append",
+        default=[],
+        help="live host:port to pull forensic endpoints from instead of "
+        "a bundle (repeatable: router + replicas + daemon + controller)",
+    )
+    p.add_argument(
+        "--storm-threshold",
+        type=int,
+        default=DEFAULT_STORM_THRESHOLD,
+        help="shed/overload events at/above which the burst counts as "
+        "an overload_shed_storm (below it, shed is normal back-pressure)",
+    )
+    p.add_argument(
+        "--last",
+        type=int,
+        default=40,
+        help="timeline rows shown in the markdown report (the full "
+        "timeline always feeds the classifier)",
+    )
+    p.add_argument(
+        "--no-spans",
+        action="store_true",
+        help="exclude span rows from the timeline (evidence-only view)",
+    )
+    p.add_argument("--json", default="", help="write the JSON verdict here")
+    p.add_argument(
+        "--out", default="", help="write the markdown report here (default "
+        "stdout)",
+    )
+    args = p.parse_args(argv)
+
+    if args.url:
+        bundle = {
+            "manifest": {"trigger": "live"},
+            "components": [dial_component(u) for u in args.url],
+            "path": None,
+        }
+    else:
+        path = args.bundle
+        if not path and args.dump_dir:
+            path = latest_bundle(args.dump_dir)
+            if path is None:
+                print(
+                    f"no postmortem bundle under {args.dump_dir}",
+                    file=sys.stderr,
+                )
+                return 1
+        if not path:
+            p.error("need a bundle path, --dump-dir, or --url")
+        if not os.path.isdir(path):
+            print(f"not a bundle directory: {path}", file=sys.stderr)
+            return 1
+        bundle = load_bundle(path)
+
+    timeline = build_timeline(
+        bundle["components"], spans=not args.no_spans
+    )
+    verdict = classify(timeline, storm_threshold=args.storm_threshold)
+    report = render_markdown(bundle, timeline, verdict, last=args.last)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+    else:
+        print(report)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(
+                {
+                    "cls": verdict["root_cause"],
+                    "ts": verdict["ts"],
+                    "verdict": verdict,
+                },
+                f,
+                indent=2,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
